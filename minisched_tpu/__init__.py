@@ -1,0 +1,12 @@
+"""tpu-minisched: a TPU-native pluggable scheduling framework.
+
+A ground-up rebuild of the capabilities of Shunpoco/mini-kube-scheduler
+(an educational Kubernetes scheduler) designed for JAX/XLA: host-side
+event-driven control plane + scheduling queue, and a device-side batch
+evaluator where registered filter/score plugins compile into one fused
+(pods × nodes) kernel with seeded masked-argmax host selection.
+
+See SURVEY.md for the reference analysis and BASELINE.md for targets.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,420 @@
+"""Minimal cluster object model (the corev1 subset the scheduler needs).
+
+The reference manipulates real Kubernetes API objects via client-go
+(sched.go:70-143 creates ``v1.Node``/``v1.Pod``; binding POSTs a
+``v1.Binding``, minisched/minisched.go:267-273).  This module provides a
+dependency-free equivalent: plain dataclasses with deep-copy semantics, a
+resource-quantity model, and the label/taint/affinity fields the default
+plugin roster reads.
+
+Quantities are held in integer base units (milli-CPU, bytes) so device-side
+tables (models/tables.py) can mirror them exactly in int32/int64 arrays —
+bit-exact parity between the scalar oracle and the TPU kernels depends on
+never touching floats for resources.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def new_uid(prefix: str = "obj") -> str:
+    with _uid_lock:
+        return f"{prefix}-{next(_uid_counter):08d}"
+
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+CPU = "cpu"  # milli-cores
+MEMORY = "memory"  # bytes
+PODS = "pods"  # count
+EPHEMERAL_STORAGE = "ephemeral-storage"  # bytes
+
+DEFAULT_POD_CPU_REQUEST = 100  # milli-CPU, mirrors upstream non-zero default
+DEFAULT_POD_MEMORY_REQUEST = 200 * 1024 * 1024  # bytes
+
+
+def parse_quantity(value: Any, resource: str) -> int:
+    """Parse '4', '4000m', '8Gi', '512Mi' → integer base units."""
+    if isinstance(value, int):
+        return value
+    s = str(value).strip()
+    if resource == CPU:
+        if s.endswith("m"):
+            return int(s[:-1])
+        return int(float(s) * 1000)
+    suffixes = {
+        "Ki": 1024,
+        "Mi": 1024**2,
+        "Gi": 1024**3,
+        "Ti": 1024**4,
+        "k": 1000,
+        "M": 1000**2,
+        "G": 1000**3,
+        "T": 1000**4,
+    }
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return int(float(s[: -len(suf)]) * mult)
+    return int(float(s))
+
+
+@dataclass
+class ResourceList:
+    """Typed resource vector in integer base units."""
+
+    milli_cpu: int = 0
+    memory: int = 0
+    pods: int = 0
+    ephemeral_storage: int = 0
+    scalar: Dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def parse(raw: Optional[Dict[str, Any]]) -> "ResourceList":
+        rl = ResourceList()
+        if not raw:
+            return rl
+        for k, v in raw.items():
+            if k == CPU:
+                rl.milli_cpu = parse_quantity(v, CPU)
+            elif k == MEMORY:
+                rl.memory = parse_quantity(v, MEMORY)
+            elif k == PODS:
+                rl.pods = int(v)
+            elif k == EPHEMERAL_STORAGE:
+                rl.ephemeral_storage = parse_quantity(v, EPHEMERAL_STORAGE)
+            else:
+                rl.scalar[k] = parse_quantity(v, k)
+        return rl
+
+    def add(self, other: "ResourceList") -> None:
+        self.milli_cpu += other.milli_cpu
+        self.memory += other.memory
+        self.pods += other.pods
+        self.ephemeral_storage += other.ephemeral_storage
+        for k, v in other.scalar.items():
+            self.scalar[k] = self.scalar.get(k, 0) + v
+
+    def sub(self, other: "ResourceList") -> None:
+        self.milli_cpu -= other.milli_cpu
+        self.memory -= other.memory
+        self.pods -= other.pods
+        self.ephemeral_storage -= other.ephemeral_storage
+        for k, v in other.scalar.items():
+            self.scalar[k] = self.scalar.get(k, 0) - v
+
+    def clone(self) -> "ResourceList":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+TAINT_EFFECT_NO_SCHEDULE = "NoSchedule"
+TAINT_EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_EFFECT_NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TAINT_EFFECT_NO_SCHEDULE
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            # empty key with Exists tolerates everything
+            return self.operator == TOLERATION_OP_EXISTS
+        if self.key != taint.key:
+            return False
+        if self.operator == TOLERATION_OP_EXISTS:
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=ResourceList)
+    allocatable: ResourceList = field(default_factory=ResourceList)
+    images: Dict[str, int] = field(default_factory=dict)  # image name → size bytes
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def clone(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    requests: ResourceList = field(default_factory=ResourceList)
+    limits: ResourceList = field(default_factory=ResourceList)
+    ports: List[int] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In, NotIn, Exists, DoesNotExist, Gt, Lt
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            if not _match_expression(req, labels):
+                return False
+        return True
+
+
+def _match_expression(req: LabelSelectorRequirement, labels: Dict[str, str]) -> bool:
+    val = labels.get(req.key)
+    if req.operator == "In":
+        return val is not None and val in req.values
+    if req.operator == "NotIn":
+        return val is None or val not in req.values
+    if req.operator == "Exists":
+        return val is not None
+    if req.operator == "DoesNotExist":
+        return val is None
+    if req.operator in ("Gt", "Lt"):
+        # Kubernetes treats an unparsable operand or label value as no-match,
+        # never as an error surfacing from the filter path.
+        try:
+            lhs = int(val)  # type: ignore[arg-type]
+            rhs = int(req.values[0])
+        except (TypeError, ValueError, IndexError):
+            return False
+        return lhs > rhs if req.operator == "Gt" else lhs < rhs
+    return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, node_labels: Dict[str, str]) -> bool:
+        return all(_match_expression(r, node_labels) for r in self.match_expressions)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    # required: OR over terms; None means no requirement
+    required_terms: Optional[List[NodeSelectorTerm]] = None
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    # DoNotSchedule | ScheduleAnyway
+    when_unsatisfiable: str = "DoNotSchedule"
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""  # set by binding
+    containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    affinity: Optional[Affinity] = None
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
+    priority: int = 0
+    scheduler_name: str = "default-scheduler"
+
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    conditions: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def clone(self) -> "Pod":
+        return copy.deepcopy(self)
+
+    def resource_requests(self) -> ResourceList:
+        """Sum container requests, with upstream's non-zero defaults applied
+        only by the LeastAllocated scorer (which asks for them explicitly)."""
+        total = ResourceList()
+        for c in self.spec.containers:
+            total.add(c.requests)
+        total.pods = max(total.pods, 1)
+        return total
+
+
+@dataclass
+class Binding:
+    """v1.Binding equivalent (POSTed by minisched/minisched.go:267-273)."""
+
+    pod_name: str
+    pod_namespace: str
+    node_name: str
+
+
+# ---------------------------------------------------------------------------
+# Convenience constructors (the shapes sched.go:74-133 builds)
+# ---------------------------------------------------------------------------
+
+
+def make_node(
+    name: str,
+    unschedulable: bool = False,
+    labels: Optional[Dict[str, str]] = None,
+    capacity: Optional[Dict[str, Any]] = None,
+    taints: Optional[List[Taint]] = None,
+) -> Node:
+    cap = ResourceList.parse(capacity or {CPU: "4", MEMORY: "16Gi", PODS: 110})
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
+        spec=NodeSpec(unschedulable=unschedulable, taints=list(taints or [])),
+        status=NodeStatus(capacity=cap, allocatable=cap.clone()),
+    )
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    requests: Optional[Dict[str, Any]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    **spec_kwargs: Any,
+) -> Pod:
+    containers = [Container(requests=ResourceList.parse(requests))] if requests else [
+        Container()
+    ]
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
+        spec=PodSpec(containers=containers, **spec_kwargs),
+    )

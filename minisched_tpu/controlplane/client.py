@@ -1,0 +1,118 @@
+"""Client facade over the control plane — the client-go surface.
+
+Mirrors exactly the clientset calls the reference makes: ``Nodes().Create /
+List`` (sched.go:84,121; minisched/minisched.go:40), ``Pods().Create / Get /
+Update`` (sched.go:91,111; resultstore store.go:120-128) and the binding
+subresource ``Pods().Bind`` (minisched/minisched.go:267-273).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from minisched_tpu.api.objects import Binding, Node, Pod, PodStatus
+from minisched_tpu.controlplane.store import ObjectStore
+
+KIND_POD = "Pod"
+KIND_NODE = "Node"
+KIND_EVENT = "Event"
+KIND_PV = "PersistentVolume"
+KIND_PVC = "PersistentVolumeClaim"
+
+
+class AlreadyBound(Exception):
+    pass
+
+
+class _NodeAPI:
+    def __init__(self, store: ObjectStore):
+        self._store = store
+
+    def create(self, node: Node) -> Node:
+        return self._store.create(KIND_NODE, node)
+
+    def get(self, name: str) -> Node:
+        return self._store.get(KIND_NODE, "", name)
+
+    def list(self) -> List[Node]:
+        return self._store.list(KIND_NODE)
+
+    def update(self, node: Node) -> Node:
+        return self._store.update(KIND_NODE, node)
+
+    def delete(self, name: str) -> None:
+        self._store.delete(KIND_NODE, "", name)
+
+
+class _PodAPI:
+    def __init__(self, store: ObjectStore, namespace: str = "default"):
+        self._store = store
+        self._ns = namespace
+
+    def create(self, pod: Pod) -> Pod:
+        if not pod.metadata.namespace:
+            pod.metadata.namespace = self._ns
+        return self._store.create(KIND_POD, pod)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> Pod:
+        return self._store.get(KIND_POD, namespace or self._ns, name)
+
+    def list(self) -> List[Pod]:
+        return self._store.list(KIND_POD)
+
+    def update(self, pod: Pod) -> Pod:
+        return self._store.update(KIND_POD, pod)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self._store.delete(KIND_POD, namespace or self._ns, name)
+
+    def bind(self, binding: Binding) -> Pod:
+        """The binding subresource: sets spec.nodeName exactly once.
+
+        The real apiserver rejects a second bind; preserving that guard is
+        what makes wave-scheduling conflict detection observable.
+        """
+
+        def apply(pod: Pod) -> Pod:
+            if pod.spec.node_name:
+                raise AlreadyBound(
+                    f"pod {pod.metadata.key} already bound to {pod.spec.node_name}"
+                )
+            pod.spec.node_name = binding.node_name
+            pod.status = PodStatus(phase="Running")
+            return pod
+
+        return self._store.mutate(
+            KIND_POD, binding.pod_namespace, binding.pod_name, apply
+        )
+
+
+class Client:
+    """clientset.Interface equivalent."""
+
+    def __init__(self, store: Optional[ObjectStore] = None):
+        self.store = store or ObjectStore()
+
+    def nodes(self) -> _NodeAPI:
+        return _NodeAPI(self.store)
+
+    def pods(self, namespace: str = "default") -> _PodAPI:
+        return _PodAPI(self.store, namespace)
+
+
+class EventRecorder:
+    """Events-broadcaster stand-in (scheduler/scheduler.go:55-59): records
+    scheduler lifecycle events as plain dicts on an in-memory list."""
+
+    def __init__(self) -> None:
+        self.events: List[Any] = []
+
+    def eventf(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        self.events.append(
+            {
+                "object": getattr(getattr(obj, "metadata", None), "key", str(obj)),
+                "type": event_type,
+                "reason": reason,
+                "message": message,
+            }
+        )

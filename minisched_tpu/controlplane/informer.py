@@ -1,0 +1,155 @@
+"""Informer machinery: cached watches with event-handler fanout.
+
+Re-creates the client-go SharedInformerFactory surface the reference uses —
+``scheduler.NewInformerFactory`` (scheduler/scheduler.go:54), handler
+registration with filtering (minisched/eventhandler.go:14-77), ``Start`` +
+``WaitForCacheSync`` (scheduler/scheduler.go:72-73).
+
+Each informer runs ONE dispatch thread that drains its store watch and
+invokes registered handlers in order — the analog of client-go's
+processor goroutine.  Handlers therefore never run on the mutator's thread
+(no re-entrancy deadlocks) and see events in store-mutation order.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from minisched_tpu.controlplane.store import EventType, ObjectStore, WatchEvent
+
+Handler = Callable[[Any], None]
+UpdateHandler = Callable[[Any, Any], None]
+
+
+@dataclass
+class ResourceEventHandlers:
+    """AddFunc/UpdateFunc/DeleteFunc bundle (cache.ResourceEventHandlerFuncs)."""
+
+    on_add: Optional[Handler] = None
+    on_update: Optional[UpdateHandler] = None
+    on_delete: Optional[Handler] = None
+    # FilteringResourceEventHandler (eventhandler.go:20-35)
+    filter: Optional[Callable[[Any], bool]] = None
+
+
+class Informer:
+    def __init__(self, store: ObjectStore, kind: str):
+        self._store = store
+        self._kind = kind
+        self._handlers: List[ResourceEventHandlers] = []
+        self._lock = threading.Lock()
+        self._cache: Dict[str, Any] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._watch = None
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+
+    def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
+        with self._lock:
+            self._handlers.append(handlers)
+            replay = list(self._cache.values()) if self._synced.is_set() else []
+        # Late registration replays the cache as adds (client-go does).
+        # Invoked OUTSIDE the lock so a handler may call back into the
+        # informer (e.g. lister()); a live event racing the replay can
+        # at worst duplicate an add — handlers get at-least-once delivery,
+        # same as client-go.
+        for obj in replay:
+            self._invoke_one(handlers, WatchEvent(EventType.ADDED, obj))
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._watch, snapshot = self._store.watch(self._kind, send_initial=True)
+        self._initial = len(snapshot)
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self._kind}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        seen = 0
+        if self._initial == 0:
+            self._synced.set()
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.1)
+            if ev is None:
+                if self._watch.stopped:
+                    return
+                continue
+            key = ev.obj.metadata.key
+            with self._lock:
+                if ev.type == EventType.DELETED:
+                    old = self._cache.pop(key, None)
+                    if old is not None:
+                        ev = WatchEvent(EventType.DELETED, old)
+                elif ev.type == EventType.MODIFIED:
+                    ev = WatchEvent(EventType.MODIFIED, ev.obj, self._cache.get(key))
+                    self._cache[key] = ev.obj
+                else:
+                    self._cache[key] = ev.obj
+                handlers = list(self._handlers)
+            for h in handlers:
+                self._invoke_one(h, ev)
+            seen += 1
+            if seen >= self._initial:
+                self._synced.set()
+
+    def _invoke_one(self, h: ResourceEventHandlers, ev: WatchEvent) -> None:
+        try:
+            if h.filter is not None and not h.filter(ev.obj):
+                # on MODIFIED, client-go also fires delete when an object
+                # falls out of the filter; the reference relies only on the
+                # add path (eventhandler.go:20-35), keep it simple.
+                return
+            if ev.type == EventType.ADDED and h.on_add:
+                h.on_add(ev.obj)
+            elif ev.type == EventType.MODIFIED and h.on_update:
+                h.on_update(ev.old_obj, ev.obj)
+            elif ev.type == EventType.DELETED and h.on_delete:
+                h.on_delete(ev.obj)
+        except Exception:  # handler errors must not kill the stream
+            import traceback
+
+            traceback.print_exc()
+
+    def wait_for_cache_sync(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def lister(self) -> List[Any]:
+        with self._lock:
+            return list(self._cache.values())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class SharedInformerFactory:
+    """Factory + lifecycle for per-kind informers
+    (scheduler/scheduler.go:54,72-73)."""
+
+    def __init__(self, store: ObjectStore):
+        self._store = store
+        self._informers: Dict[str, Informer] = {}
+
+    def informer_for(self, kind: str) -> Informer:
+        if kind not in self._informers:
+            self._informers[kind] = Informer(self._store, kind)
+        return self._informers[kind]
+
+    def start(self) -> None:
+        for inf in self._informers.values():
+            inf.start()
+
+    def wait_for_cache_sync(self, timeout: float = 5.0) -> bool:
+        return all(i.wait_for_cache_sync(timeout) for i in self._informers.values())
+
+    def shutdown(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()

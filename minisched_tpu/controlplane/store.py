@@ -1,0 +1,195 @@
+"""In-memory, versioned object store with watch semantics.
+
+This is the fast-path replacement for the reference's L1/L0 stack — the
+in-process kube-apiserver backed by etcd (k8sapiserver/k8sapiserver.go:43-71,
+storage wiring :93-105) — per SURVEY.md §7 stage 2.  The public surface is
+deliberately shaped like a storage backend boundary so an etcd/gRPC-backed
+implementation can drop in behind the same interface later.
+
+Semantics preserved from the reference stack:
+
+* every mutation bumps a global, monotonically-increasing resource version
+  (etcd revision analog);
+* watchers receive ADDED / MODIFIED / DELETED events in mutation order
+  (the apiserver→informer watch stream, SURVEY.md §3.3);
+* reads return deep copies — mutating a returned object never changes the
+  store (client-go returns decoded copies off the wire).
+
+Thread-safety: one RLock guards the maps, and events are *enqueued* to
+watchers while that lock is held so the per-watch queue order always equals
+mutation order; delivery to consumers is decoupled through those unbounded
+per-watcher queues, so a slow consumer still cannot stall a mutator
+(client-go's watch buffering).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class EventType(enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: EventType
+    obj: Any
+    old_obj: Any = None
+
+
+class Watch:
+    """A subscription to one kind's event stream."""
+
+    def __init__(self, store: "ObjectStore", kind: str):
+        self._store = store
+        self._kind = kind
+        self._cond = threading.Condition()
+        self._events: List[WatchEvent] = []
+        self._stopped = False
+
+    # called by the store while it holds its lock; only touches this
+    # watch's own condition/queue, so it cannot block on user code
+    def _deliver(self, event: WatchEvent) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._events.append(event)
+            self._cond.notify_all()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        with self._cond:
+            if not self._events and not self._stopped:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._store._remove_watch(self._kind, self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+class ObjectStore:
+    """Versioned multi-kind object store + watch hub."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, Any]] = {}  # kind -> key -> obj
+        self._watches: Dict[str, List[Watch]] = {}
+        self._rv = 0
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _key(obj: Any) -> str:
+        return obj.metadata.key
+
+    def _bump(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _fanout(self, kind: str, event: WatchEvent) -> None:
+        for w in list(self._watches.get(kind, ())):
+            w._deliver(event)
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in objs:
+                raise KeyError(f"{kind} {key!r} already exists")
+            stored = obj.clone()
+            if not stored.metadata.uid:
+                from minisched_tpu.api.objects import new_uid
+
+                stored.metadata.uid = new_uid(kind.lower())
+            stored.metadata.resource_version = self._bump()
+            objs[key] = stored
+            out = stored.clone()
+            self._fanout(kind, WatchEvent(EventType.ADDED, stored.clone()))
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._objects.get(kind, {}).get(f"{namespace}/{name}")
+            if obj is None:
+                raise KeyError(f"{kind} {namespace}/{name} not found")
+            return obj.clone()
+
+    def list(self, kind: str) -> List[Any]:
+        with self._lock:
+            return [o.clone() for o in self._objects.get(kind, {}).values()]
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            old = objs.get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            stored = obj.clone()
+            stored.metadata.uid = old.metadata.uid
+            stored.metadata.resource_version = self._bump()
+            objs[key] = stored
+            out = stored.clone()
+            self._fanout(kind, WatchEvent(EventType.MODIFIED, stored.clone(), old.clone()))
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            objs = self._objects.get(kind, {})
+            key = f"{namespace}/{name}"
+            old = objs.pop(key, None)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            self._bump()
+            self._fanout(kind, WatchEvent(EventType.DELETED, old.clone()))
+
+    def mutate(
+        self, kind: str, namespace: str, name: str, fn: Callable[[Any], Any]
+    ) -> Any:
+        """Read-modify-write under the store lock (optimistic-concurrency-free
+        convenience for in-process callers; the binding subresource uses it)."""
+        with self._lock:
+            obj = self.get(kind, namespace, name)
+            updated = fn(obj) or obj
+            return self.update(kind, updated)
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, kind: str, send_initial: bool = True) -> Tuple[Watch, List[Any]]:
+        """Open a watch; returns (watch, current snapshot).
+
+        ``send_initial`` replays the snapshot as ADDED events into the watch
+        (list+watch, what client-go's reflector does on start).
+        """
+        with self._lock:
+            w = Watch(self, kind)
+            snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
+            if send_initial:
+                for obj in snapshot:
+                    w._deliver(WatchEvent(EventType.ADDED, obj.clone()))
+            self._watches.setdefault(kind, []).append(w)
+        return w, snapshot
+
+    def _remove_watch(self, kind: str, w: Watch) -> None:
+        with self._lock:
+            lst = self._watches.get(kind, [])
+            if w in lst:
+                lst.remove(w)

@@ -1,0 +1,57 @@
+"""NodeInfo: a node plus scheduler-relevant aggregates.
+
+Re-creates framework.NodeInfo (wrapped per listed node at
+minisched/minisched.go:126-127).  Tracks the pods assigned to the node and
+their aggregate resource requests so filter/score plugins can read
+``requested`` vs ``allocatable`` without rescanning pods.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from minisched_tpu.api.objects import Node, Pod, ResourceList
+
+
+class NodeInfo:
+    __slots__ = ("node", "pods", "requested")
+
+    def __init__(self, node: Optional[Node] = None):
+        self.node: Optional[Node] = node
+        self.pods: List[Pod] = []
+        self.requested: ResourceList = ResourceList()
+
+    @property
+    def name(self) -> str:
+        return self.node.metadata.name if self.node else ""
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+        self.requested.add(pod.resource_requests())
+
+    def remove_pod(self, pod: Pod) -> None:
+        for i, p in enumerate(self.pods):
+            if p.metadata.uid == pod.metadata.uid:
+                del self.pods[i]
+                self.requested.sub(pod.resource_requests())
+                return
+
+    def clone(self) -> "NodeInfo":
+        ni = NodeInfo(self.node)
+        ni.pods = list(self.pods)
+        ni.requested = self.requested.clone()
+        return ni
+
+
+def build_node_infos(nodes: List[Node], pods: List[Pod]) -> List[NodeInfo]:
+    """Snapshot helper: wrap nodes and attach assigned pods."""
+    by_name: Dict[str, NodeInfo] = {}
+    infos: List[NodeInfo] = []
+    for n in nodes:
+        ni = NodeInfo(n)
+        by_name[n.metadata.name] = ni
+        infos.append(ni)
+    for p in pods:
+        if p.spec.node_name and p.spec.node_name in by_name:
+            by_name[p.spec.node_name].add_pod(p)
+    return infos

@@ -1,0 +1,172 @@
+"""The plugin contract: extension points, both scalar and batched.
+
+The reference wires four plugin chains — filter / pre-score / score / permit
+(minisched/initialize.go:25-28,44-66) — against the upstream interfaces
+``framework.{Filter,PreScore,Score,Permit}Plugin`` + ``ScoreExtensions`` +
+``EnqueueExtensions``.  This module re-creates that contract twice:
+
+* **Scalar protocol** — per-(pod, node) methods exactly mirroring the
+  upstream signatures.  This is what the parity oracle
+  (``minisched_tpu.engine``) runs, one pod at a time, matching the Go loop
+  in minisched/minisched.go:115-237 step for step.
+
+* **Batch protocol** (``BatchEvaluable``) — the TPU-native design (SURVEY.md
+  §7): a plugin is additionally a *vectorized predicate/score function over
+  struct-of-arrays tables*, returning a ``(pods × nodes)`` mask or score
+  matrix.  All batch methods must be pure and jax-traceable so the fused
+  evaluator (``minisched_tpu.ops.fused``) can compose every registered
+  plugin into ONE jitted kernel: filter → pre-score → score → normalize →
+  weighted-sum → masked-argmax.
+
+A plugin that implements both protocols is parity-checked by
+tests/test_parity.py: identical placements, bit-exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Protocol, Tuple, runtime_checkable
+
+from minisched_tpu.framework.events import ClusterEvent
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.types import CycleState, NodeScoreList, Status
+
+
+class Plugin:
+    """Base: every plugin has a stable name (framework.Plugin)."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+# ---------------------------------------------------------------------------
+# Scalar extension points (upstream-shaped)
+# ---------------------------------------------------------------------------
+
+
+@runtime_checkable
+class FilterPlugin(Protocol):
+    def name(self) -> str: ...
+
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        """Reject or accept one (pod, node) pair
+        (framework.FilterPlugin.Filter; called minisched/minisched.go:130)."""
+        ...
+
+
+@runtime_checkable
+class PreScorePlugin(Protocol):
+    def name(self) -> str: ...
+
+    def pre_score(
+        self, state: CycleState, pod: Any, nodes: List[Any]
+    ) -> Status:
+        """Once-per-pod prep before scoring (minisched/minisched.go:153-162)."""
+        ...
+
+
+class ScoreExtensions(Protocol):
+    def normalize_score(
+        self, state: CycleState, pod: Any, scores: NodeScoreList
+    ) -> Status:
+        """Rescale a plugin's raw node scores to [0, 100]
+        (minisched/minisched.go:178-183)."""
+        ...
+
+
+@runtime_checkable
+class ScorePlugin(Protocol):
+    def name(self) -> str: ...
+
+    def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
+        """Score one (pod, node) pair (minisched/minisched.go:171-176)."""
+        ...
+
+    def score_extensions(self) -> Optional[ScoreExtensions]: ...
+
+
+@runtime_checkable
+class PermitPlugin(Protocol):
+    def name(self) -> str: ...
+
+    def permit(
+        self, state: CycleState, pod: Any, node_name: str
+    ) -> Tuple[Status, float]:
+        """Approve / reject / delay binding; returns (status, timeout_s)
+        (minisched/minisched.go:208-236)."""
+        ...
+
+
+@runtime_checkable
+class EnqueueExtensions(Protocol):
+    def events_to_register(self) -> List[ClusterEvent]:
+        """Which cluster events might make a pod this plugin rejected
+        schedulable again (minisched/initialize.go:140-157)."""
+        ...
+
+
+# ---------------------------------------------------------------------------
+# Batch (TPU) protocol
+# ---------------------------------------------------------------------------
+
+
+class BatchEvaluable:
+    """Mixin declaring the vectorized form of a plugin.
+
+    Methods take a ``BatchContext`` (static per-compilation config), a
+    ``PodTable`` and ``NodeTable`` (minisched_tpu.models.tables) whose leaves
+    are jnp arrays, and return arrays.  They are traced inside ONE jit — no
+    python control flow on array values, no host callbacks.
+
+    Conventions:
+      * mask arrays are bool ``(P, N)``; True = feasible.
+      * score arrays are int32 ``(P, N)`` in [MIN_NODE_SCORE, MAX_NODE_SCORE]
+        after normalize; raw scores may exceed that before normalize.
+      * ``batch_pre_score`` returns an aux dict of arrays, passed to
+        ``batch_score`` — the array analog of writing CycleState
+        (nodenumber.go:58-61).
+    """
+
+    #: set False for plugins that have no scalar counterpart (none today)
+    has_batch = True
+
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        raise NotImplementedError
+
+    def batch_pre_score(self, ctx: Any, pods: Any, nodes: Any) -> Dict[str, Any]:
+        return {}
+
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        raise NotImplementedError
+
+    def batch_normalize(self, ctx: Any, scores, mask):
+        """Default: identity (plugins without ScoreExtensions)."""
+        return scores
+
+
+# ---------------------------------------------------------------------------
+# Capability probing helpers
+# ---------------------------------------------------------------------------
+
+
+def implements_filter(p: Any) -> bool:
+    return callable(getattr(p, "filter", None))
+
+
+def implements_pre_score(p: Any) -> bool:
+    return callable(getattr(p, "pre_score", None))
+
+
+def implements_score(p: Any) -> bool:
+    return callable(getattr(p, "score", None))
+
+
+def implements_permit(p: Any) -> bool:
+    return callable(getattr(p, "permit", None))
+
+
+def implements_enqueue(p: Any) -> bool:
+    return callable(getattr(p, "events_to_register", None))
+
+
+def implements_batch(p: Any) -> bool:
+    return isinstance(p, BatchEvaluable) and p.has_batch

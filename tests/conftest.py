@@ -1,0 +1,18 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before jax is imported anywhere: tests never require TPU hardware;
+multi-chip sharding is validated on virtual CPU devices (the driver's
+``dryrun_multichip`` does the same).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

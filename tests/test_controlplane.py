@@ -1,0 +1,165 @@
+"""Tests for the in-memory control plane: store, watch, informer, client."""
+
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.client import AlreadyBound, Client
+from minisched_tpu.controlplane.informer import (
+    ResourceEventHandlers,
+    SharedInformerFactory,
+)
+from minisched_tpu.controlplane.store import EventType, ObjectStore
+
+
+def wait_until(pred, timeout=3.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestStore:
+    def test_crud_roundtrip(self):
+        s = ObjectStore()
+        n = make_node("n1")
+        created = s.create("Node", n)
+        assert created.metadata.uid
+        assert created.metadata.resource_version == 1
+        got = s.get("Node", "", "n1")
+        assert got.metadata.name == "n1"
+        got.spec.unschedulable = True
+        s.update("Node", got)
+        assert s.get("Node", "", "n1").spec.unschedulable
+        s.delete("Node", "", "n1")
+        with pytest.raises(KeyError):
+            s.get("Node", "", "n1")
+
+    def test_reads_are_copies(self):
+        s = ObjectStore()
+        s.create("Node", make_node("n1"))
+        a = s.get("Node", "", "n1")
+        a.spec.unschedulable = True
+        assert not s.get("Node", "", "n1").spec.unschedulable
+
+    def test_duplicate_create_rejected(self):
+        s = ObjectStore()
+        s.create("Node", make_node("n1"))
+        with pytest.raises(KeyError):
+            s.create("Node", make_node("n1"))
+
+    def test_resource_versions_monotonic(self):
+        s = ObjectStore()
+        s.create("Node", make_node("a"))
+        s.create("Node", make_node("b"))
+        vs = sorted(o.metadata.resource_version for o in s.list("Node"))
+        assert vs == [1, 2]
+
+    def test_watch_sees_mutation_order(self):
+        s = ObjectStore()
+        s.create("Node", make_node("pre"))
+        w, snapshot = s.watch("Node")
+        assert len(snapshot) == 1
+        s.create("Node", make_node("n1"))
+        s.delete("Node", "", "n1")
+        types = [w.next(timeout=1.0).type for _ in range(3)]
+        assert types == [EventType.ADDED, EventType.ADDED, EventType.DELETED]
+
+    def test_watch_stop(self):
+        s = ObjectStore()
+        w, _ = s.watch("Node")
+        w.stop()
+        assert w.next(timeout=0.05) is None
+        s.create("Node", make_node("n1"))  # no crash fanning out to stopped watch
+
+
+class TestInformer:
+    def test_handlers_fire_and_cache_syncs(self):
+        store = ObjectStore()
+        client = Client(store)
+        client.nodes().create(make_node("n1"))
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Node")
+        added = []
+        inf.add_event_handlers(ResourceEventHandlers(on_add=lambda o: added.append(o.metadata.name)))
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        client.nodes().create(make_node("n2"))
+        assert wait_until(lambda: sorted(added) == ["n1", "n2"])
+        assert sorted(o.metadata.name for o in inf.lister()) == ["n1", "n2"]
+        factory.shutdown()
+
+    def test_filtering_handler(self):
+        # the unassigned-pod filter pattern (eventhandler.go:20-35)
+        store = ObjectStore()
+        client = Client(store)
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Pod")
+        seen = []
+        inf.add_event_handlers(
+            ResourceEventHandlers(
+                on_add=lambda o: seen.append(o.metadata.name),
+                filter=lambda o: not o.spec.node_name,
+            )
+        )
+        factory.start()
+        factory.wait_for_cache_sync()
+        bound = make_pod("bound")
+        bound.spec.node_name = "n1"
+        client.pods().create(bound)
+        client.pods().create(make_pod("pending"))
+        assert wait_until(lambda: seen == ["pending"])
+        factory.shutdown()
+
+    def test_update_events_carry_old_object(self):
+        store = ObjectStore()
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Node")
+        updates = []
+        inf.add_event_handlers(
+            ResourceEventHandlers(on_update=lambda old, new: updates.append((old, new)))
+        )
+        factory.start()
+        factory.wait_for_cache_sync()
+        store.create("Node", make_node("n1"))
+        n = store.get("Node", "", "n1")
+        n.spec.unschedulable = True
+        store.update("Node", n)
+        assert wait_until(lambda: len(updates) == 1)
+        old, new = updates[0]
+        assert old is not None and not old.spec.unschedulable
+        assert new.spec.unschedulable
+        factory.shutdown()
+
+
+class TestClient:
+    def test_bind_subresource(self):
+        client = Client()
+        client.pods().create(make_pod("p1"))
+        client.pods().bind(Binding("p1", "default", "node7"))
+        p = client.pods().get("p1")
+        assert p.spec.node_name == "node7"
+        assert p.status.phase == "Running"
+        with pytest.raises(AlreadyBound):
+            client.pods().bind(Binding("p1", "default", "node8"))
+
+    def test_concurrent_binds_single_winner(self):
+        client = Client()
+        client.pods().create(make_pod("p1"))
+        outcomes = []
+
+        def binder(node):
+            try:
+                client.pods().bind(Binding("p1", "default", node))
+                outcomes.append(("ok", node))
+            except AlreadyBound:
+                outcomes.append(("conflict", node))
+
+        ts = [threading.Thread(target=binder, args=(f"n{i}",)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sum(1 for o, _ in outcomes if o == "ok") == 1

@@ -1,0 +1,200 @@
+"""Tests for framework types, events, and the object model."""
+
+import threading
+
+import pytest
+
+from minisched_tpu.api.objects import (
+    ResourceList,
+    Taint,
+    Toleration,
+    make_node,
+    make_pod,
+    parse_quantity,
+)
+from minisched_tpu.framework.events import (
+    NODE_ADD,
+    WILDCARD_EVENT,
+    ActionType,
+    ClusterEvent,
+    GVK,
+    event_helps_pod,
+    merge_event_registrations,
+    unioned_gvks,
+)
+from minisched_tpu.framework.types import (
+    Code,
+    CycleState,
+    Diagnosis,
+    FitError,
+    Status,
+    is_success,
+)
+
+
+class TestStatus:
+    def test_none_is_success(self):
+        assert is_success(None)
+        assert is_success(Status.success())
+        assert not is_success(Status.unschedulable("no"))
+
+    def test_codes(self):
+        assert Status.wait().is_wait()
+        assert Status.skip().is_skip()
+        assert Status.unschedulable("x").is_unschedulable()
+        assert Status.unresolvable("x").is_unschedulable()
+        assert Status.error("boom").code == Code.ERROR
+
+    def test_as_error_never_none_for_failure(self):
+        # reference bug (minisched.go:64,73,92): stale/nil err reached
+        # ErrorFunc; our Status always materializes one.
+        s = Status.unschedulable("because")
+        assert s.as_error() is not None
+        assert "because" in str(s.as_error())
+        assert Status.success().as_error() is None
+
+    def test_with_plugin(self):
+        s = Status.unschedulable("r").with_plugin("NodeUnschedulable")
+        assert s.plugin == "NodeUnschedulable"
+
+
+class TestCycleState:
+    def test_read_write_delete(self):
+        cs = CycleState()
+        with pytest.raises(KeyError):
+            cs.read("missing")
+        cs.write("k", 42)
+        assert cs.read("k") == 42
+        cs.delete("k")
+        with pytest.raises(KeyError):
+            cs.read("k")
+
+    def test_clone_is_independent(self):
+        cs = CycleState()
+        cs.write("k", 1)
+        c2 = cs.clone()
+        c2.write("k", 2)
+        assert cs.read("k") == 1
+
+    def test_thread_safety(self):
+        cs = CycleState()
+        errs = []
+
+        def writer(i):
+            try:
+                for j in range(200):
+                    cs.write(f"key{i}-{j % 5}", j)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+
+
+class TestEvents:
+    def test_wildcard_matches_all(self):
+        assert WILDCARD_EVENT.match(NODE_ADD)
+        assert WILDCARD_EVENT.match(ClusterEvent(GVK.POD, ActionType.DELETE))
+
+    def test_resource_and_action_intersection(self):
+        reg = ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL)
+        assert reg.match(NODE_ADD)
+        assert reg.match(ClusterEvent(GVK.NODE, ActionType.UPDATE_NODE_LABEL))
+        assert not reg.match(ClusterEvent(GVK.NODE, ActionType.DELETE))
+        assert not reg.match(ClusterEvent(GVK.POD, ActionType.ADD))
+
+    def test_merge_registers_under_own_plugin_name(self):
+        # the reference registers nodenumber's events under the wrong plugin
+        # name (initialize.go:154) — assert our fix.
+        event_map = {}
+        merge_event_registrations(
+            [("NodeNumber", [NODE_ADD]), ("Other", [NODE_ADD])], event_map
+        )
+        assert event_map[NODE_ADD] == {"NodeNumber", "Other"}
+
+    def test_unioned_gvks(self):
+        event_map = {}
+        merge_event_registrations(
+            [
+                ("A", [ClusterEvent(GVK.NODE, ActionType.ADD)]),
+                ("B", [ClusterEvent(GVK.NODE, ActionType.DELETE)]),
+                ("C", [ClusterEvent(GVK.POD, ActionType.ADD)]),
+            ],
+            event_map,
+        )
+        u = unioned_gvks(event_map)
+        assert u[GVK.NODE] == ActionType.ADD | ActionType.DELETE
+        assert u[GVK.POD] == ActionType.ADD
+
+    def test_event_helps_pod_gating(self):
+        # semantics of podMatchesEvent (queue.go:167-190)
+        event_map = {}
+        merge_event_registrations([("NodeNumber", [NODE_ADD])], event_map)
+        assert event_helps_pod(NODE_ADD, {"NodeNumber"}, event_map)
+        assert not event_helps_pod(NODE_ADD, {"SomeoneElse"}, event_map)
+        # no failed plugins recorded → retry on anything
+        assert event_helps_pod(NODE_ADD, set(), event_map)
+        # wildcard registration helps any failed plugin
+        event_map2 = {}
+        merge_event_registrations([("P", [WILDCARD_EVENT])], event_map2)
+        assert event_helps_pod(
+            ClusterEvent(GVK.POD, ActionType.DELETE), {"P"}, event_map2
+        )
+
+
+class TestFitError:
+    def test_message_aggregates_reasons(self):
+        d = Diagnosis(
+            node_to_status={
+                "n1": Status.unschedulable("node(s) were unschedulable"),
+                "n2": Status.unschedulable("node(s) were unschedulable"),
+            },
+            unschedulable_plugins={"NodeUnschedulable"},
+        )
+        fe = FitError(pod=None, num_all_nodes=2, diagnosis=d)
+        assert "0/2 nodes are available" in str(fe)
+        assert "2 node(s) were unschedulable" in str(fe)
+
+
+class TestObjects:
+    def test_parse_quantity(self):
+        assert parse_quantity("4", "cpu") == 4000
+        assert parse_quantity("250m", "cpu") == 250
+        assert parse_quantity("8Gi", "memory") == 8 * 1024**3
+        assert parse_quantity("512Mi", "memory") == 512 * 1024**2
+        assert parse_quantity(123, "memory") == 123
+
+    def test_resource_list_math(self):
+        a = ResourceList.parse({"cpu": "1", "memory": "1Gi"})
+        b = ResourceList.parse({"cpu": "500m", "memory": "512Mi"})
+        a.add(b)
+        assert a.milli_cpu == 1500
+        a.sub(b)
+        assert a.milli_cpu == 1000
+        assert a.memory == 1024**3
+
+    def test_toleration_matching(self):
+        t = Taint(key="dedicated", value="gpu", effect="NoSchedule")
+        assert Toleration(key="dedicated", operator="Equal", value="gpu").tolerates(t)
+        assert Toleration(key="dedicated", operator="Exists").tolerates(t)
+        assert not Toleration(key="dedicated", operator="Equal", value="cpu").tolerates(t)
+        assert not Toleration(
+            key="dedicated", operator="Equal", value="gpu", effect="NoExecute"
+        ).tolerates(t)
+        assert Toleration(operator="Exists").tolerates(t)  # empty key + Exists
+
+    def test_make_helpers(self):
+        n = make_node("node1", unschedulable=True)
+        assert n.spec.unschedulable
+        assert n.status.allocatable.milli_cpu == 4000
+        p = make_pod("pod1", requests={"cpu": "100m"})
+        assert p.resource_requests().milli_cpu == 100
+        assert p.resource_requests().pods == 1
+
+    def test_clone_independence(self):
+        n = make_node("n")
+        c = n.clone()
+        c.spec.unschedulable = True
+        assert not n.spec.unschedulable
