@@ -376,6 +376,44 @@ class Pod:
 
 
 @dataclass
+class PVSpec:
+    capacity: int = 0  # bytes
+    claim_ref: str = ""  # namespace/name of bound PVC
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta
+    spec: PVSpec = field(default_factory=PVSpec)
+    kind = "PersistentVolume"
+
+    def clone(self) -> "PersistentVolume":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PVCSpec:
+    request: int = 0  # bytes
+    volume_name: str = ""
+
+
+@dataclass
+class PVCStatus:
+    phase: str = "Pending"
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta
+    spec: PVCSpec = field(default_factory=PVCSpec)
+    status: PVCStatus = field(default_factory=PVCStatus)
+    kind = "PersistentVolumeClaim"
+
+    def clone(self) -> "PersistentVolumeClaim":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class Binding:
     """v1.Binding equivalent (POSTed by minisched/minisched.go:267-273)."""
 

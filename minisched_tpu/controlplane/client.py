@@ -29,6 +29,9 @@ class _NodeAPI:
         self._store = store
 
     def create(self, node: Node) -> Node:
+        # nodes are cluster-scoped: normalize away ObjectMeta's "default"
+        # namespace so get/delete (which use "") always find them
+        node.metadata.namespace = ""
         return self._store.create(KIND_NODE, node)
 
     def get(self, name: str) -> Node:

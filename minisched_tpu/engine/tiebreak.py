@@ -1,0 +1,50 @@
+"""Deterministic host-selection tie-break.
+
+The reference breaks score ties with reservoir-sampled uniform randomness
+(minisched/minisched.go:316-325), which makes placements irreproducible.
+SURVEY.md §7 ("hard parts" #1) requires a deterministic total order so the
+scalar oracle and the TPU kernel agree bit-exactly.
+
+Rule: among max-score nodes, pick the node minimizing ``mix32(pod_seed,
+node_index)`` — a stateless integer hash evaluated identically (same 32-bit
+ops) in pure Python here and in jnp inside the fused kernel
+(minisched_tpu.ops.fused).  Still "uniform-ish" across pods (different pods
+break ties differently), but reproducible given the pod's uid-derived seed.
+"""
+
+from __future__ import annotations
+
+_M32 = 0xFFFFFFFF
+
+
+def mix32(seed: int, idx: int) -> int:
+    """murmur3-finalizer-style mix of (seed, idx) → uint32."""
+    x = (seed ^ ((idx * 0x9E3779B9) & _M32)) & _M32
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & _M32
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & _M32
+    x ^= x >> 16
+    return x
+
+
+def select_host(scores, feasible, seed: int) -> int:
+    """Pick argmax score over feasible node indices; ties broken by
+    minimal mix32(seed, idx).  Returns -1 if nothing is feasible.
+
+    ``scores``: sequence of ints; ``feasible``: sequence of bools.
+    """
+    best_idx = -1
+    best_score = None
+    best_hash = None
+    for idx, (score, ok) in enumerate(zip(scores, feasible)):
+        if not ok:
+            continue
+        h = mix32(seed, idx)
+        if (
+            best_idx < 0
+            or score > best_score
+            or (score == best_score and h < best_hash)
+        ):
+            best_idx, best_score, best_hash = idx, score, h
+    return best_idx

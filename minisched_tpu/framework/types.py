@@ -176,9 +176,13 @@ class CycleState:
             self._storage.pop(key, None)
 
     def clone(self) -> "CycleState":
+        import copy as _copy
+
         c = CycleState()
         with self._lock:
-            c._storage = dict(self._storage)
+            # deep-copy values: upstream clones each StateData so mutable
+            # plugin state never aliases across cycle copies
+            c._storage = {k: _copy.deepcopy(v) for k, v in self._storage.items()}
             c.skip_filter_plugins = set(self.skip_filter_plugins)
             c.skip_score_plugins = set(self.skip_score_plugins)
         return c

@@ -1,0 +1,269 @@
+"""Struct-of-arrays cluster state: NodeTable / PodTable.
+
+The TPU-native replacement for per-object ``NodeInfo`` graphs (SURVEY.md §7
+design stance): cluster state lives as flat, statically-shaped arrays in HBM
+so every registered plugin can evaluate as a vectorized ``(pods × nodes)``
+computation inside one jit.  The reference instead re-lists all nodes and
+re-wraps them per pod every cycle (minisched/minisched.go:40,126-127) — the
+#1 pattern not to copy.
+
+Conventions:
+
+* CPU in milli-cores (int32), memory in MiB (int32) — integer units keep
+  parity with the scalar oracle bit-exact (no float resource math).
+* Tables are padded to TPU-friendly sizes (multiples of 128 lanes) with a
+  ``valid`` mask; kernels must mask, never rely on dynamic shapes
+  (recompilation is the enemy — SURVEY.md §7 hard part 4).
+* String data (label keys/values, taints) is carried as stable 32-bit
+  FNV-1a hashes computed host-side; kernels compare ints.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MIB = 1024 * 1024
+
+# Fixed per-object capacities for variable-length k8s fields; overflow raises
+# host-side at table-build time (static shapes are non-negotiable under jit).
+MAX_TAINTS = 8
+MAX_TOLERATIONS = 8
+MAX_LABELS = 16
+
+EFFECT_NONE = 0
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+_EFFECT_CODES = {
+    "": EFFECT_NONE,
+    "NoSchedule": EFFECT_NO_SCHEDULE,
+    "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+    "NoExecute": EFFECT_NO_EXECUTE,
+}
+
+TOLERATION_OP_EQUAL_CODE = 0
+TOLERATION_OP_EXISTS_CODE = 1
+
+
+def fnv1a32(s: str) -> int:
+    """Stable 32-bit FNV-1a; returned as signed int32 range for jnp."""
+    h = 0x811C9DC5
+    for b in s.encode("utf-8"):
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    # map to signed int32
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+#: hash of the empty string — used as the "absent" sentinel nowhere; absent
+#: slots use 0 with a count field instead.
+def pad_to(n: int, multiple: int = 128) -> int:
+    if n == 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _register_table(cls):
+    """Register a dataclass of jnp arrays as a pytree."""
+    names = [f.name for f in fields(cls)]
+    jax.tree_util.register_pytree_node(
+        cls,
+        lambda t: ([getattr(t, n) for n in names], None),
+        lambda _, leaves: cls(**dict(zip(names, leaves))),
+    )
+    return cls
+
+
+@_register_table
+@dataclass
+class NodeTable:
+    """All scheduler-relevant node state, shape (N,) or (N, K)."""
+
+    # resources
+    alloc_cpu: Any  # i32[N] allocatable milli-cpu
+    alloc_mem: Any  # i32[N] allocatable MiB
+    alloc_pods: Any  # i32[N] allocatable pod count
+    req_cpu: Any  # i32[N] requested (sum of assigned pods)
+    req_mem: Any  # i32[N]
+    req_pods: Any  # i32[N]
+    # flags
+    unschedulable: Any  # bool[N] (spec.unschedulable)
+    # nodenumber plugin
+    suffix: Any  # i32[N] trailing-digit of name, -1 if none
+    # taints
+    taint_key: Any  # i32[N, MAX_TAINTS] fnv hash
+    taint_value: Any  # i32[N, MAX_TAINTS]
+    taint_effect: Any  # i32[N, MAX_TAINTS] effect code
+    num_taints: Any  # i32[N]
+    # labels
+    label_key: Any  # i32[N, MAX_LABELS]
+    label_value: Any  # i32[N, MAX_LABELS]
+    num_labels: Any  # i32[N]
+    # padding mask
+    valid: Any  # bool[N]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+
+@_register_table
+@dataclass
+class PodTable:
+    """All scheduler-relevant pending-pod state, shape (P,) or (P, K)."""
+
+    req_cpu: Any  # i32[P] requested milli-cpu (sum of containers)
+    req_mem: Any  # i32[P] MiB
+    req_pods: Any  # i32[P] (1)
+    suffix: Any  # i32[P] trailing digit of name, -1 if none
+    # tolerations
+    tol_key: Any  # i32[P, MAX_TOLERATIONS]
+    tol_value: Any  # i32[P, MAX_TOLERATIONS]
+    tol_effect: Any  # i32[P, MAX_TOLERATIONS]
+    tol_op: Any  # i32[P, MAX_TOLERATIONS] 0=Equal 1=Exists
+    tol_empty_key: Any  # bool[P, MAX_TOLERATIONS] key=="" (Exists-all)
+    num_tols: Any  # i32[P]
+    # node selector (match_labels only; expressions handled host-side for now)
+    sel_key: Any  # i32[P, MAX_LABELS]
+    sel_value: Any  # i32[P, MAX_LABELS]
+    num_sel: Any  # i32[P]
+    # deterministic tie-break seed per pod
+    seed: Any  # u32[P]
+    valid: Any  # bool[P]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# Builders (host side, numpy)
+# ---------------------------------------------------------------------------
+
+
+def _name_suffix(name: str) -> int:
+    """Trailing single digit of an object name, -1 if absent — the
+    nodenumber plugin's key (nodenumber.go:21,50-64 parses the last rune)."""
+    if name and name[-1].isdigit():
+        return int(name[-1])
+    return -1
+
+
+def pod_seed(uid: str) -> int:
+    """Deterministic per-pod tie-break seed (unsigned 32-bit)."""
+    return fnv1a32(uid) & 0xFFFFFFFF
+
+
+def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = None,
+                     capacity: int = None) -> Tuple[NodeTable, List[str]]:
+    """Build a NodeTable from Node objects (+ already-assigned pods).
+
+    Returns (table, node_names) where node_names[i] is row i's name; the
+    order is the given order (callers sort for determinism).
+    """
+    pods_by_node = pods_by_node or {}
+    n = len(nodes)
+    cap = capacity or pad_to(n)
+    if n > cap:
+        raise ValueError(f"{n} nodes exceed table capacity {cap}")
+
+    def zeros(shape, dtype=np.int32):
+        return np.zeros(shape, dtype)
+
+    t = dict(
+        alloc_cpu=zeros(cap), alloc_mem=zeros(cap), alloc_pods=zeros(cap),
+        req_cpu=zeros(cap), req_mem=zeros(cap), req_pods=zeros(cap),
+        unschedulable=np.zeros(cap, bool), suffix=np.full(cap, -1, np.int32),
+        taint_key=zeros((cap, MAX_TAINTS)), taint_value=zeros((cap, MAX_TAINTS)),
+        taint_effect=zeros((cap, MAX_TAINTS)), num_taints=zeros(cap),
+        label_key=zeros((cap, MAX_LABELS)), label_value=zeros((cap, MAX_LABELS)),
+        num_labels=zeros(cap), valid=np.zeros(cap, bool),
+    )
+    names: List[str] = []
+    for i, node in enumerate(nodes):
+        names.append(node.metadata.name)
+        alloc = node.status.allocatable
+        t["alloc_cpu"][i] = alloc.milli_cpu
+        t["alloc_mem"][i] = alloc.memory // MIB
+        t["alloc_pods"][i] = alloc.pods
+        t["unschedulable"][i] = node.spec.unschedulable
+        t["suffix"][i] = _name_suffix(node.metadata.name)
+        taints = node.spec.taints
+        if len(taints) > MAX_TAINTS:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_TAINTS} taints")
+        for j, taint in enumerate(taints):
+            t["taint_key"][i, j] = fnv1a32(taint.key)
+            t["taint_value"][i, j] = fnv1a32(taint.value)
+            t["taint_effect"][i, j] = _EFFECT_CODES[taint.effect]
+        t["num_taints"][i] = len(taints)
+        labels = node.metadata.labels
+        if len(labels) > MAX_LABELS:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_LABELS} labels")
+        for j, (k, v) in enumerate(sorted(labels.items())):
+            t["label_key"][i, j] = fnv1a32(k)
+            t["label_value"][i, j] = fnv1a32(v)
+        t["num_labels"][i] = len(labels)
+        t["valid"][i] = True
+        for p in pods_by_node.get(node.metadata.name, ()):  # assigned pods
+            req = p.resource_requests()
+            t["req_cpu"][i] += req.milli_cpu
+            t["req_mem"][i] += req.memory // MIB
+            t["req_pods"][i] += 1
+    return NodeTable(**{k: jnp.asarray(v) for k, v in t.items()}), names
+
+
+def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable, List[str]]:
+    p = len(pods)
+    cap = capacity or pad_to(p)
+    if p > cap:
+        raise ValueError(f"{p} pods exceed table capacity {cap}")
+
+    def zeros(shape, dtype=np.int32):
+        return np.zeros(shape, dtype)
+
+    t = dict(
+        req_cpu=zeros(cap), req_mem=zeros(cap), req_pods=zeros(cap),
+        suffix=np.full(cap, -1, np.int32),
+        tol_key=zeros((cap, MAX_TOLERATIONS)), tol_value=zeros((cap, MAX_TOLERATIONS)),
+        tol_effect=zeros((cap, MAX_TOLERATIONS)), tol_op=zeros((cap, MAX_TOLERATIONS)),
+        tol_empty_key=np.zeros((cap, MAX_TOLERATIONS), bool), num_tols=zeros(cap),
+        sel_key=zeros((cap, MAX_LABELS)), sel_value=zeros((cap, MAX_LABELS)),
+        num_sel=zeros(cap),
+        seed=np.zeros(cap, np.uint32), valid=np.zeros(cap, bool),
+    )
+    names: List[str] = []
+    for i, pod in enumerate(pods):
+        names.append(pod.metadata.name)
+        req = pod.resource_requests()
+        t["req_cpu"][i] = req.milli_cpu
+        t["req_mem"][i] = req.memory // MIB
+        t["req_pods"][i] = 1
+        t["suffix"][i] = _name_suffix(pod.metadata.name)
+        tols = pod.spec.tolerations
+        if len(tols) > MAX_TOLERATIONS:
+            raise ValueError(f"pod {pod.metadata.name}: >{MAX_TOLERATIONS} tolerations")
+        for j, tol in enumerate(tols):
+            t["tol_key"][i, j] = fnv1a32(tol.key)
+            t["tol_value"][i, j] = fnv1a32(tol.value)
+            t["tol_effect"][i, j] = _EFFECT_CODES[tol.effect]
+            t["tol_op"][i, j] = (
+                TOLERATION_OP_EXISTS_CODE if tol.operator == "Exists"
+                else TOLERATION_OP_EQUAL_CODE
+            )
+            t["tol_empty_key"][i, j] = tol.key == ""
+        t["num_tols"][i] = len(tols)
+        sel = pod.spec.node_selector
+        if len(sel) > MAX_LABELS:
+            raise ValueError(f"pod {pod.metadata.name}: >{MAX_LABELS} selector terms")
+        for j, (k, v) in enumerate(sorted(sel.items())):
+            t["sel_key"][i, j] = fnv1a32(k)
+            t["sel_value"][i, j] = fnv1a32(v)
+        t["num_sel"][i] = len(sel)
+        t["seed"][i] = pod_seed(pod.metadata.uid or pod.metadata.name)
+        t["valid"][i] = True
+    return PodTable(**{k: jnp.asarray(v) for k, v in t.items()}), names

@@ -1,0 +1,74 @@
+"""NodeUnschedulable plugin — the reference's only active filter.
+
+Re-creates the in-tree ``nodeunschedulable`` plugin the reference imports
+(minisched/initialize.go:15,193-202; the sole member of the filter chain,
+initialize.go:80-93): reject nodes with ``spec.unschedulable`` unless the
+pod tolerates the ``node.kubernetes.io/unschedulable`` taint.
+
+Batch form: pure masking over NodeTable/PodTable columns — no per-object
+work at schedule time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from minisched_tpu.api.objects import Taint
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+from minisched_tpu.models import tables
+
+NAME = "NodeUnschedulable"
+
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+_UNSCHED_KEY_HASH = tables.fnv1a32(TAINT_NODE_UNSCHEDULABLE)
+
+REASON = "node(s) were unschedulable"
+
+
+class NodeUnschedulable(Plugin, BatchEvaluable):
+    def name(self) -> str:
+        return NAME
+
+    # -- scalar ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.unresolvable("node not found")
+        if not node.spec.unschedulable:
+            return Status.success()
+        taint = Taint(key=TAINT_NODE_UNSCHEDULABLE, effect="NoSchedule")
+        if any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return Status.success()
+        return Status.unresolvable(REASON).with_plugin(NAME)
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # upstream registers Node Add|UpdateNodeTaint (the reference wires
+        # this under the wrong plugin name, initialize.go:154 — fixed here)
+        return [
+            ClusterEvent(
+                GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_TAINT
+            )
+        ]
+
+    # -- batch -------------------------------------------------------------
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        """mask[p, n] = ~node.unschedulable | pod-tolerates-unschedulable."""
+        tol_slots = jnp.arange(pods.tol_key.shape[1])[None, :]
+        in_range = tol_slots < pods.num_tols[:, None]  # (P, T)
+        effect_ok = (pods.tol_effect == tables.EFFECT_NONE) | (
+            pods.tol_effect == tables.EFFECT_NO_SCHEDULE
+        )
+        key_matches = pods.tol_key == _UNSCHED_KEY_HASH
+        exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE
+        # Equal with empty value tolerates (taint value is ""), Exists always
+        value_ok = exists | (pods.tol_value == tables.fnv1a32(""))
+        wildcard = pods.tol_empty_key & exists
+        tolerates = jnp.any(
+            in_range & effect_ok & (wildcard | (key_matches & value_ok)), axis=1
+        )  # (P,)
+        return (~nodes.unschedulable)[None, :] | tolerates[:, None]

@@ -1,0 +1,95 @@
+"""Plugin registry: name → factory, and config → plugin chains.
+
+The role of the reference's registry + plugin wiring
+(scheduler/plugin/plugins.go:24-70's NewRegistry and
+minisched/initialize.go:80-138's create*Plugins): one factory per plugin
+name, instantiated once per scheduler even when the plugin serves several
+extension points (the reference shares its NodeNumber singleton the same
+way, initialize.go:188-213).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List
+
+from minisched_tpu.framework.plugin import (
+    implements_filter,
+    implements_permit,
+    implements_pre_score,
+    implements_score,
+)
+from minisched_tpu.service.config import SchedulerConfig
+
+# factory signature: (args: dict, time_scale: float) -> plugin instance
+Factory = Callable[[Dict[str, Any], float], Any]
+
+_REGISTRY: Dict[str, Factory] = {}
+
+
+def register(name: str, factory: Factory) -> None:
+    _REGISTRY[name] = factory
+
+
+def registered_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _ensure_builtins() -> None:
+    if "NodeUnschedulable" in _REGISTRY:
+        return
+    from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    register("NodeUnschedulable", lambda args, ts: NodeUnschedulable())
+    register("NodeNumber", lambda args, ts: NodeNumber(time_scale=ts))
+
+
+@dataclass
+class PluginChains:
+    filter: List[Any] = field(default_factory=list)
+    pre_score: List[Any] = field(default_factory=list)
+    score: List[Any] = field(default_factory=list)
+    permit: List[Any] = field(default_factory=list)
+    #: instances that need the waitingpod Handle injected (attribute ``h``)
+    needs_handle: List[Any] = field(default_factory=list)
+
+    def all_instances(self) -> List[Any]:
+        seen: Dict[int, Any] = {}
+        for chain in (self.filter, self.pre_score, self.score, self.permit):
+            for p in chain:
+                seen[id(p)] = p
+        return list(seen.values())
+
+
+_CAPABILITY_CHECKS = {
+    "filter": implements_filter,
+    "pre_score": implements_pre_score,
+    "score": implements_score,
+    "permit": implements_permit,
+}
+
+
+def build_plugins(cfg: SchedulerConfig) -> PluginChains:
+    _ensure_builtins()
+    chains = PluginChains()
+    instances: Dict[str, Any] = {}
+    for point, plugin_set in cfg.extension_points().items():
+        for entry in plugin_set.enabled:
+            if entry.name not in _REGISTRY:
+                raise KeyError(
+                    f"unknown plugin {entry.name!r}; registered: {registered_names()}"
+                )
+            if entry.name not in instances:
+                args = cfg.plugin_args.get(entry.name, {})
+                instances[entry.name] = _REGISTRY[entry.name](args, cfg.time_scale)
+            inst = instances[entry.name]
+            if not _CAPABILITY_CHECKS[point](inst):
+                raise TypeError(
+                    f"plugin {entry.name!r} does not implement {point}"
+                )
+            getattr(chains, point).append(inst)
+    for inst in instances.values():
+        if hasattr(inst, "h"):
+            chains.needs_handle.append(inst)
+    return chains

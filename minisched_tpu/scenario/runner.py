@@ -1,0 +1,119 @@
+"""Scenario runner: the scriptable L5 driver.
+
+Re-creates ``sched.go`` — ``start()`` boots the stack in order
+(config → control plane → PV-controller hook → scheduler service,
+sched.go:30-68) and ``scenario()`` drives it programmatically
+(sched.go:70-143).  The reference's timing-based sleeps (3s/5s,
+sched.go:109,134) are replaced with condition-based waits
+(``wait_for``), so scenarios are deterministic and fast (SURVEY.md §4
+"implication for the new build").
+
+Run the README scenario directly::
+
+    python -m minisched_tpu.scenario.runner
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.pvcontroller import start_pv_controller
+from minisched_tpu.service.config import SchedulerConfig, default_scheduler_config
+from minisched_tpu.service.service import SchedulerService
+
+
+class ScenarioTimeout(AssertionError):
+    pass
+
+
+class ScenarioHarness:
+    """Everything ``start()`` boots (sched.go:30-68), bundled."""
+
+    def __init__(self, cfg: Optional[SchedulerConfig] = None):
+        self.client = Client()
+        self.pv_controller = start_pv_controller(self.client)
+        self.service = SchedulerService(self.client)
+        self.cfg = cfg or default_scheduler_config()
+
+    def __enter__(self) -> "ScenarioHarness":
+        self.service.start_scheduler(self.cfg)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.service.shutdown_scheduler()
+        self.pv_controller.stop()
+
+    # condition-based wait (replaces sched.go's time.Sleep)
+    def wait_for(
+        self,
+        pred: Callable[[], bool],
+        timeout: float = 10.0,
+        interval: float = 0.01,
+        msg: str = "condition",
+    ) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(interval)
+        if pred():
+            return
+        raise ScenarioTimeout(f"timed out waiting for {msg}")
+
+    def pod_node(self, name: str, namespace: str = "default") -> str:
+        return self.client.pods().get(name, namespace).spec.node_name
+
+
+def readme_scenario(harness: ScenarioHarness, log: Callable[[str], None] = print) -> str:
+    """The reference's integration scenario (sched.go:70-143):
+
+    1. create nodes node0..node8, all unschedulable (+ pod1) — pod must
+       stay pending (asserted at sched.go:115-119);
+    2. create schedulable node10 — the Node/Add event requeues pod1 and it
+       binds to node10 (sched.go:121-140).
+
+    Returns the bound node name.
+    """
+    client = harness.client
+    for i in range(9):
+        client.nodes().create(make_node(f"node{i}", unschedulable=True))
+    log("created 9 unschedulable nodes")
+    client.pods().create(make_pod("pod1"))
+    log("created pod1")
+
+    # pod must stay pending: wait until it has been tried and parked
+    harness.wait_for(
+        lambda: harness.service.scheduler.queue.stats()["unschedulable"] == 1,
+        msg="pod1 parked in unschedulableQ",
+    )
+    assert harness.pod_node("pod1") == "", "pod1 should not be bound yet"
+    log("pod1 is pending (no feasible node)")
+
+    client.nodes().create(make_node("node10", unschedulable=False))
+    log("created schedulable node10")
+
+    harness.wait_for(
+        lambda: harness.pod_node("pod1") == "node10",
+        timeout=15.0,
+        msg="pod1 bound to node10",
+    )
+    bound = harness.pod_node("pod1")
+    log(f"pod1 is bound to {bound}")
+    return bound
+
+
+def main() -> None:
+    # time_scale compresses NodeNumber's permit delay (node10 suffix "0"
+    # → zero delay; timeout still armed) — full-speed reference timing
+    # works too, just slower.
+    with ScenarioHarness(default_scheduler_config(time_scale=0.1)) as h:
+        bound = readme_scenario(h)
+        assert bound == "node10"
+        print("scenario OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Configuration: env-style process config + scheduler configuration.
+
+Two tiers, mirroring the reference (SURVEY.md §5.6):
+
+* ``ProcessConfig`` — the required env vars (``config/config.go:22-75``:
+  PORT / KUBE_SCHEDULER_SIMULATOR_ETCD_URL / FRONTEND_URL).  Our in-memory
+  control plane needs no etcd, so the etcd URL becomes an *optional*
+  external-store URL; PORT/FRONTEND_URL keep their required-or-error
+  semantics for drop-in familiarity.
+
+* ``SchedulerConfig`` — the KubeSchedulerConfiguration analog: per-extension
+  -point plugin enable/disable lists with ``"*"`` wildcard semantics and
+  per-plugin weights + typed args (scheduler/plugin/plugins.go:77-202,
+  defaultconfig.go:10-33).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class EmptyEnvError(Exception):
+    """config/config.go:12's ErrEmptyEnv."""
+
+
+@dataclass
+class ProcessConfig:
+    port: int
+    frontend_url: str
+    external_store_url: str = ""
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "ProcessConfig":
+        env = env if env is not None else dict(os.environ)
+
+        def require(key: str) -> str:
+            v = env.get(key, "")
+            if not v:
+                raise EmptyEnvError(f"env variable {key} is required but empty")
+            return v
+
+        return ProcessConfig(
+            port=int(require("PORT")),
+            frontend_url=require("FRONTEND_URL"),
+            external_store_url=env.get("MINISCHED_TPU_STORE_URL", ""),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scheduler configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PluginEnabled:
+    name: str
+    weight: int = 1
+
+
+@dataclass
+class PluginSet:
+    enabled: List[PluginEnabled] = field(default_factory=list)
+    disabled: List[str] = field(default_factory=list)  # names or ["*"]
+
+
+@dataclass
+class SchedulerConfig:
+    filter: PluginSet = field(default_factory=PluginSet)
+    pre_score: PluginSet = field(default_factory=PluginSet)
+    score: PluginSet = field(default_factory=PluginSet)
+    permit: PluginSet = field(default_factory=PluginSet)
+    plugin_args: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    queue_opts: Dict[str, Any] = field(default_factory=dict)
+    time_scale: float = 1.0
+
+    def clone(self) -> "SchedulerConfig":
+        return copy.deepcopy(self)
+
+    def score_weights(self) -> Dict[str, int]:
+        return {e.name: e.weight for e in self.score.enabled}
+
+    def extension_points(self) -> Dict[str, PluginSet]:
+        return {
+            "filter": self.filter,
+            "pre_score": self.pre_score,
+            "score": self.score,
+            "permit": self.permit,
+        }
+
+
+def default_scheduler_config(time_scale: float = 1.0) -> SchedulerConfig:
+    """The minisched default wiring (initialize.go:44-66): filter
+    [NodeUnschedulable]; pre-score/score/permit [NodeNumber]."""
+    return SchedulerConfig(
+        filter=PluginSet(enabled=[PluginEnabled("NodeUnschedulable")]),
+        pre_score=PluginSet(enabled=[PluginEnabled("NodeNumber")]),
+        score=PluginSet(enabled=[PluginEnabled("NodeNumber", weight=1)]),
+        permit=PluginSet(enabled=[PluginEnabled("NodeNumber")]),
+        time_scale=time_scale,
+    )
+
+
+def apply_plugin_customization(
+    default: SchedulerConfig, custom: SchedulerConfig
+) -> SchedulerConfig:
+    """Merge a user's plugin enable/disable lists over the default config.
+
+    Semantics of convertConfigurationForSimulator + ConvertForSimulator
+    (scheduler/scheduler.go:97-142, plugins.go:146-202): only plugin
+    enablement/args are accepted from the custom config; ``disabled``
+    supports exact names and the ``"*"`` wildcard (drop all defaults);
+    enabled entries are appended in order after surviving defaults.
+    """
+    out = default.clone()
+    for point, merged in out.extension_points().items():
+        user: PluginSet = getattr(custom, point)
+        disabled = set(user.disabled)
+        if "*" in disabled:
+            merged.enabled = []
+        else:
+            merged.enabled = [e for e in merged.enabled if e.name not in disabled]
+        existing = {e.name for e in merged.enabled}
+        for e in user.enabled:
+            if e.name not in existing:
+                merged.enabled.append(copy.deepcopy(e))
+    # plugin args: user entries win over defaults (Raw-vs-Object precedence
+    # collapses to plain dicts here, plugins.go:77-141)
+    for name, args in custom.plugin_args.items():
+        out.plugin_args[name] = copy.deepcopy(args)
+    out.queue_opts.update(custom.queue_opts)
+    if custom.time_scale != 1.0:
+        out.time_scale = custom.time_scale
+    return out

@@ -1,0 +1,95 @@
+"""Scheduler service: lifecycle wrapper around the engine.
+
+Re-creates ``scheduler/scheduler.go:26-91`` — the ``Service`` owning
+informer-factory + event-recorder creation (:54-59), engine construction
+(:63), informer start/sync (:72-73), the run-loop spawn (:75), and
+Restart/Shutdown via cancellation (:40-47,82-87).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from minisched_tpu.controlplane.client import Client, EventRecorder
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.engine.scheduler import Scheduler, new_scheduler
+from minisched_tpu.service.config import SchedulerConfig, default_scheduler_config
+
+
+class SchedulerService:
+    def __init__(self, client: Client):
+        self._client = client
+        self._current_cfg: Optional[SchedulerConfig] = None
+        self._scheduler: Optional[Scheduler] = None
+        self._factory: Optional[SharedInformerFactory] = None
+        self.recorder = EventRecorder()
+
+    # scheduler/scheduler.go:50-80
+    def start_scheduler(self, cfg: Optional[SchedulerConfig] = None) -> Scheduler:
+        if self._scheduler is not None:
+            raise RuntimeError("scheduler already running; use restart_scheduler")
+        cfg = (cfg or default_scheduler_config()).clone()  # deep-copy, :61
+        self._factory = SharedInformerFactory(self._client.store)
+        sched = build_scheduler_from_config(self._client, self._factory, cfg)
+        self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")
+        self._factory.start()
+        if not self._factory.wait_for_cache_sync():
+            raise RuntimeError("informer caches failed to sync")
+        sched.run()
+        self._scheduler = sched
+        self._current_cfg = cfg
+        return sched
+
+    # scheduler/scheduler.go:40-47
+    def restart_scheduler(self, cfg: Optional[SchedulerConfig] = None) -> Scheduler:
+        self.shutdown_scheduler()
+        return self.start_scheduler(cfg or self._current_cfg)
+
+    # scheduler/scheduler.go:82-87
+    def shutdown_scheduler(self) -> None:
+        if self._scheduler is not None:
+            self.recorder.eventf(None, "Normal", "SchedulerStopped", "scheduler stopping")
+            self._scheduler.stop()
+            self._scheduler = None
+        if self._factory is not None:
+            self._factory.shutdown()
+            self._factory = None
+
+    # scheduler/scheduler.go:89-91
+    def get_scheduler_config(self) -> Optional[SchedulerConfig]:
+        return self._current_cfg
+
+    @property
+    def scheduler(self) -> Optional[Scheduler]:
+        return self._scheduler
+
+
+def build_scheduler_from_config(
+    client: Client, factory: SharedInformerFactory, cfg: SchedulerConfig
+) -> Scheduler:
+    """Construct the engine from a SchedulerConfig (plugin enablement +
+    weights) — the role of minisched.New + convertConfigurationForSimulator
+    (initialize.go:35-78, scheduler.go:97-142)."""
+    from minisched_tpu.plugins.registry import build_plugins
+
+    chains = build_plugins(cfg)
+    sched = Scheduler(
+        client,
+        factory,
+        filter_plugins=chains.filter,
+        pre_score_plugins=chains.pre_score,
+        score_plugins=chains.score,
+        permit_plugins=chains.permit,
+        score_weights=cfg.score_weights(),
+        queue_opts=cfg.queue_opts,
+    )
+    for p in chains.needs_handle:
+        p.h = sched
+    return sched
+
+
+__all__ = [
+    "SchedulerService",
+    "build_scheduler_from_config",
+    "new_scheduler",
+]

@@ -1,0 +1,206 @@
+"""Tests for the scheduling queue (active/backoff/unschedulable)."""
+
+import threading
+
+from minisched_tpu.api.objects import make_pod
+from minisched_tpu.framework.events import (
+    NODE_ADD,
+    ActionType,
+    ClusterEvent,
+    GVK,
+    merge_event_registrations,
+)
+from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+from minisched_tpu.queue.queue import SchedulingQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def qpi_for(pod, attempts=0, failed=()):
+    q = QueuedPodInfo(PodInfo(pod))
+    q.attempts = attempts
+    q.unschedulable_plugins = set(failed)
+    return q
+
+
+def make_queue(clock=None, **kw):
+    event_map = {}
+    merge_event_registrations([("NodeNumber", [NODE_ADD])], event_map)
+    return SchedulingQueue(event_map=event_map, clock=clock or FakeClock(), **kw)
+
+
+class TestBasicFlow:
+    def test_add_pop_fifo(self):
+        q = make_queue()
+        q.add(make_pod("a"))
+        q.add(make_pod("b"))
+        assert q.pop(0.1).pod.metadata.name == "a"
+        assert q.pop(0.1).pod.metadata.name == "b"
+
+    def test_pop_blocks_then_wakes(self):
+        q = make_queue(clock=None)
+        got = []
+
+        def consumer():
+            got.append(q.pop(timeout=5.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.add(make_pod("late"))
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert got[0].pod.metadata.name == "late"
+
+    def test_pop_increments_attempts(self):
+        q = make_queue()
+        q.add(make_pod("a"))
+        assert q.pop(0.1).attempts == 1
+
+    def test_duplicate_add_dropped(self):
+        q = make_queue()
+        p = make_pod("a")
+        p.metadata.uid = "u1"
+        q.add(p)
+        q.add(p)
+        assert q.stats()["active"] == 1
+
+    def test_pop_batch_drains_wave(self):
+        q = make_queue()
+        for i in range(5):
+            q.add(make_pod(f"p{i}"))
+        batch = q.pop_batch(max_pods=3, timeout=0.1)
+        assert [b.pod.metadata.name for b in batch] == ["p0", "p1", "p2"]
+        assert q.stats()["active"] == 2
+
+    def test_close_unblocks_pop(self):
+        q = make_queue(clock=None)
+        results = []
+        t = threading.Thread(target=lambda: results.append(q.pop(timeout=10)))
+        t.start()
+        q.close()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+        assert results == [None]
+
+
+class TestBackoffMath:
+    def test_backoff_doubles_and_caps(self):
+        # queue.go:218-235: 1s initial, doubling per attempt, 10s cap
+        q = make_queue()
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=1)) == 1.0
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=2)) == 2.0
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=3)) == 4.0
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=4)) == 8.0
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=5)) == 10.0
+        assert q._backoff_duration(qpi_for(make_pod("p"), attempts=9)) == 10.0
+
+
+class TestEventGatedRequeue:
+    def test_event_moves_matching_pod_to_active(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        pod = make_pod("p1")
+        pod.metadata.uid = "u1"
+        q.add_unschedulable(qpi_for(pod, attempts=1, failed=["NodeNumber"]))
+        clock.advance(2.0)  # past the 1s backoff
+        q.move_all_to_active_or_backoff(NODE_ADD)
+        s = q.stats()
+        assert s["unschedulable"] == 0 and s["active"] == 1
+
+    def test_event_ignores_nonmatching_pod(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        pod = make_pod("p1")
+        q.add_unschedulable(qpi_for(pod, attempts=1, failed=["SomethingElse"]))
+        clock.advance(2.0)
+        q.move_all_to_active_or_backoff(NODE_ADD)
+        s = q.stats()
+        assert s["unschedulable"] == 1 and s["active"] == 0
+
+    def test_backing_off_pod_goes_to_backoff_then_flushes(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        pod = make_pod("p1")
+        q.add_unschedulable(qpi_for(pod, attempts=3, failed=["NodeNumber"]))
+        clock.advance(1.0)  # attempts=3 → 4s backoff, not yet ready
+        q.move_all_to_active_or_backoff(NODE_ADD)
+        assert q.stats()["backoff"] == 1
+        clock.advance(10.0)
+        q.flush_backoff_completed()
+        assert q.stats() == {"active": 1, "backoff": 0, "unschedulable": 0}
+
+    def test_pop_flushes_expired_backoff(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        pod = make_pod("p1")
+        q.add_unschedulable(qpi_for(pod, attempts=2, failed=["NodeNumber"]))
+        clock.advance(0.5)
+        q.move_all_to_active_or_backoff(NODE_ADD)  # 2s backoff → backoffQ
+        assert q.stats()["backoff"] == 1
+        clock.advance(5.0)
+        got = q.pop(timeout=0.2)
+        assert got is not None and got.pod.metadata.name == "p1"
+
+
+class TestImplementedPanics:
+    """The reference panics on these (queue.go:109-146); we implement them."""
+
+    def test_delete_removes_everywhere(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        a, b, c = make_pod("a"), make_pod("b"), make_pod("c")
+        for p in (a, b, c):
+            p.metadata.uid = p.metadata.name
+        q.add(a)
+        q.add_unschedulable(qpi_for(b, attempts=1, failed=["NodeNumber"]))
+        q.add_unschedulable(qpi_for(c, attempts=5, failed=["NodeNumber"]))
+        q.move_all_to_active_or_backoff(NODE_ADD)  # c backing off → backoffQ
+        q.delete(a)
+        q.delete(b)
+        q.delete(c)
+        assert q.stats() == {"active": 0, "backoff": 0, "unschedulable": 0}
+
+    def test_update_unschedulable_spec_change_reactivates(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        old = make_pod("p1")
+        old.metadata.uid = "u1"
+        q.add_unschedulable(qpi_for(old, attempts=1))
+        clock.advance(2.0)
+        new = old.clone()
+        new.spec.node_selector = {"zone": "a"}
+        q.update(old, new)
+        s = q.stats()
+        assert s["active"] == 1 and s["unschedulable"] == 0
+
+    def test_update_in_active_refreshes_object(self):
+        q = make_queue()
+        old = make_pod("p1")
+        old.metadata.uid = "u1"
+        q.add(old)
+        new = old.clone()
+        new.metadata.labels["x"] = "y"
+        q.update(old, new)
+        got = q.pop(0.1)
+        assert got.pod.metadata.labels == {"x": "y"}
+
+    def test_flush_unschedulable_leftover(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock, unschedulable_timeout_s=60.0)
+        pod = make_pod("stale")
+        q.add_unschedulable(qpi_for(pod, attempts=1, failed=["NeverHelped"]))
+        q.flush_unschedulable_leftover()
+        assert q.stats()["unschedulable"] == 1  # not stale yet
+        clock.advance(61.0)
+        q.flush_unschedulable_leftover()
+        assert q.stats()["unschedulable"] == 0
+        assert q.stats()["active"] == 1
