@@ -50,6 +50,125 @@ from minisched_tpu.models.tables import pod_seed
 from minisched_tpu.queue.queue import SchedulingQueue
 
 
+# ---------------------------------------------------------------------------
+# Pure extension-point runners (minisched.go:115-199) — module-level so the
+# live engine and the stateless parity oracle share ONE implementation
+# ---------------------------------------------------------------------------
+
+
+def run_filter_plugins(
+    filter_plugins: List[Any], state: CycleState, pod: Pod, node_infos: List[NodeInfo]
+) -> Tuple[List[NodeInfo], Diagnosis]:
+    """Per node × per plugin with short-circuit on first failure
+    (minisched.go:115-151); collects Diagnosis for event-gated requeue."""
+    feasible: List[NodeInfo] = []
+    diagnosis = Diagnosis()
+    for ni in node_infos:
+        ok = True
+        for pl in filter_plugins:
+            status = pl.filter(state, pod, ni)
+            if not is_success(status):
+                ok = False
+                status.with_plugin(status.plugin or pl.name())
+                diagnosis.node_to_status[ni.name] = status
+                diagnosis.unschedulable_plugins.add(pl.name())
+                if status.code.name == "ERROR":
+                    raise status.as_error()
+                break  # short-circuit this node (minisched.go:136)
+        if ok:
+            feasible.append(ni)
+    return feasible, diagnosis
+
+
+def run_pre_score_plugins(
+    pre_score_plugins: List[Any], state: CycleState, pod: Pod, nodes: List[Any]
+) -> Status:
+    for pl in pre_score_plugins:
+        status = pl.pre_score(state, pod, nodes)
+        if not is_success(status):
+            return status.with_plugin(status.plugin or pl.name())
+    return Status.success()
+
+
+def run_score_plugins(
+    score_plugins: List[Any],
+    score_weights: Dict[str, int],
+    state: CycleState,
+    pod: Pod,
+    node_names: List[str],
+) -> Dict[str, int]:
+    """Score + normalize + weighted sum (minisched.go:164-199 — with the
+    weight TODO at :187 actually implemented)."""
+    totals: Dict[str, int] = {name: 0 for name in node_names}
+    for pl in score_plugins:
+        scores: List[int] = []
+        for name in node_names:
+            s, status = pl.score(state, pod, name)
+            if not is_success(status):
+                raise status.as_error()
+            scores.append(s)
+        ext = pl.score_extensions() if hasattr(pl, "score_extensions") else None
+        if ext is not None:
+            from minisched_tpu.framework.types import NodeScore
+
+            lst = [NodeScore(n, s) for n, s in zip(node_names, scores)]
+            status = ext.normalize_score(state, pod, lst)
+            if not is_success(status):
+                raise status.as_error()
+            scores = [ns.score for ns in lst]
+        weight = score_weights.get(pl.name(), 1)
+        for name, s in zip(node_names, scores):
+            totals[name] += s * weight
+    return totals
+
+
+def schedule_pod_once(
+    filter_plugins: List[Any],
+    pre_score_plugins: List[Any],
+    score_plugins: List[Any],
+    score_weights: Dict[str, int],
+    pod: Pod,
+    node_infos: List[NodeInfo],
+    state: Optional[CycleState] = None,
+) -> str:
+    """One stateless scheduling decision: filter → pre-score → score →
+    select host (minisched.go:50-80).  Raises FitError/plugin errors on
+    failure; returns the chosen node name.
+
+    This is the **parity oracle** the fused TPU kernel
+    (minisched_tpu.ops.fused) is tested against — the live engine's
+    ``_schedule_pod`` is this exact code path.
+    """
+    state = state if state is not None else CycleState()
+    feasible, diagnosis = run_filter_plugins(filter_plugins, state, pod, node_infos)
+    if not feasible:
+        raise FitError(pod, len(node_infos), diagnosis)
+
+    status = run_pre_score_plugins(
+        pre_score_plugins, state, pod, [ni.node for ni in feasible]
+    )
+    if not is_success(status):
+        raise status.as_error()
+
+    totals = run_score_plugins(
+        score_plugins, score_weights, state, pod, [ni.name for ni in feasible]
+    )
+
+    # deterministic seeded argmax (replaces reservoir sampling,
+    # minisched.go:304-325).  The tie-break hash is keyed on the node's
+    # GLOBAL index in the name-sorted snapshot — the same indexing the
+    # fused batch kernel uses (ops/fused.py) — so oracle and kernel
+    # agree bit-exactly even though scoring only ran on feasible nodes.
+    seed = pod_seed(pod.metadata.uid or pod.metadata.name)
+    feasible_names = {ni.name for ni in feasible}
+    idx = select_host(
+        [totals.get(ni.name, 0) for ni in node_infos],
+        [ni.name in feasible_names for ni in node_infos],
+        seed,
+    )
+    return node_infos[idx].name
+
+
 class Scheduler:
     """The engine (minisched/initialize.go:18-29's Scheduler struct)."""
 
@@ -185,85 +304,33 @@ class Scheduler:
         node_infos: List[NodeInfo],
         qpi: QueuedPodInfo,
     ) -> str:
-        """filter → pre-score → score → select host (minisched.go:50-80).
-        Raises on failure; returns the chosen node name."""
-        feasible, diagnosis = self.run_filter_plugins(state, pod, node_infos)
-        if not feasible:
-            raise FitError(pod, len(node_infos), diagnosis)
-
-        status = self.run_pre_score_plugins(state, pod, [ni.node for ni in feasible])
-        if not is_success(status):
-            raise status.as_error()
-
-        totals = self.run_score_plugins(state, pod, [ni.name for ni in feasible])
-
-        # deterministic seeded argmax (replaces reservoir sampling,
-        # minisched.go:304-325)
-        seed = pod_seed(pod.metadata.uid or pod.metadata.name)
-        idx = select_host(
-            [totals[ni.name] for ni in feasible], [True] * len(feasible), seed
+        return schedule_pod_once(
+            self.filter_plugins,
+            self.pre_score_plugins,
+            self.score_plugins,
+            self.score_weights,
+            pod,
+            node_infos,
+            state=state,
         )
-        return feasible[idx].name
 
-    # -- extension-point runners ---------------------------------------
+    # -- extension-point runners (thin wrappers over the module fns) ----
     def run_filter_plugins(
         self, state: CycleState, pod: Pod, node_infos: List[NodeInfo]
     ) -> Tuple[List[NodeInfo], Diagnosis]:
-        """Per node × per plugin with short-circuit on first failure
-        (minisched.go:115-151); collects Diagnosis for event-gated requeue."""
-        feasible: List[NodeInfo] = []
-        diagnosis = Diagnosis()
-        for ni in node_infos:
-            ok = True
-            for pl in self.filter_plugins:
-                status = pl.filter(state, pod, ni)
-                if not is_success(status):
-                    ok = False
-                    status.with_plugin(status.plugin or pl.name())
-                    diagnosis.node_to_status[ni.name] = status
-                    diagnosis.unschedulable_plugins.add(pl.name())
-                    if status.code.name == "ERROR":
-                        raise status.as_error()
-                    break  # short-circuit this node (minisched.go:136)
-            if ok:
-                feasible.append(ni)
-        return feasible, diagnosis
+        return run_filter_plugins(self.filter_plugins, state, pod, node_infos)
 
     def run_pre_score_plugins(
         self, state: CycleState, pod: Pod, nodes: List[Any]
     ) -> Status:
-        for pl in self.pre_score_plugins:
-            status = pl.pre_score(state, pod, nodes)
-            if not is_success(status):
-                return status.with_plugin(status.plugin or pl.name())
-        return Status.success()
+        return run_pre_score_plugins(self.pre_score_plugins, state, pod, nodes)
 
     def run_score_plugins(
         self, state: CycleState, pod: Pod, node_names: List[str]
     ) -> Dict[str, int]:
-        """Score + normalize + weighted sum (minisched.go:164-199 — with the
-        weight TODO at :187 actually implemented)."""
-        totals: Dict[str, int] = {name: 0 for name in node_names}
-        for pl in self.score_plugins:
-            scores: List[int] = []
-            for name in node_names:
-                s, status = pl.score(state, pod, name)
-                if not is_success(status):
-                    raise status.as_error()
-                scores.append(s)
-            ext = pl.score_extensions() if hasattr(pl, "score_extensions") else None
-            if ext is not None:
-                from minisched_tpu.framework.types import NodeScore
-
-                lst = [NodeScore(n, s) for n, s in zip(node_names, scores)]
-                status = ext.normalize_score(state, pod, lst)
-                if not is_success(status):
-                    raise status.as_error()
-                scores = [ns.score for ns in lst]
-            weight = self.score_weights.get(pl.name(), 1)
-            for name, s in zip(node_names, scores):
-                totals[name] += s * weight
-        return totals
+        return run_score_plugins(
+            self.score_plugins, self.score_weights, state, pod, node_names
+        )
 
     def run_permit_plugins(
         self, state: CycleState, pod: Pod, node_name: str

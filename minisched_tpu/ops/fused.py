@@ -1,0 +1,228 @@
+"""The fused (pods × nodes) device evaluator — the TPU hot path.
+
+This is the TPU-native re-design of the reference's per-pod scheduling
+cycle (minisched/minisched.go:32-113): instead of a sequential
+O(pods × nodes × plugins) CPU loop with a full node re-list per pod
+(minisched.go:40,124,167), every registered plugin evaluates as a
+vectorized predicate/score kernel over struct-of-arrays tables
+(minisched_tpu.models.tables), and the whole chain —
+
+    filter → pre-score → score → normalize → weighted-sum → masked-argmax
+
+— compiles into ONE jitted XLA computation (SURVEY.md §7 stage 6).
+``selectHost``'s reservoir-sampled random tie-break (minisched.go:304-325)
+becomes the deterministic seeded masked-argmax implemented here, bit-exact
+with the scalar oracle's ``engine.tiebreak.select_host``.
+
+Design rules (SURVEY.md §7 hard part 4): static shapes only — infeasible
+and padding entries are masked, never dropped; no python control flow on
+array values; everything is pure so XLA can fuse.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from minisched_tpu.framework.plugin import implements_batch
+
+UINT32_MAX = jnp.uint32(0xFFFFFFFF)
+NEG_INF_SCORE = jnp.iinfo(jnp.int32).min
+
+
+@dataclass(frozen=True)
+class BatchContext:
+    """Static per-compilation configuration handed to batch plugin kernels.
+
+    Everything here must be hashable / trace-constant; per-call array data
+    lives in the tables, not the context.
+    """
+
+    weights: Tuple[Tuple[str, int], ...] = ()
+
+    def weight_of(self, name: str) -> int:
+        for n, w in self.weights:
+            if n == name:
+                return w
+        return 1
+
+
+def mix32(seed, idx):
+    """Vector murmur3-finalizer-style mix of (seed, idx) → uint32.
+
+    Bit-for-bit identical to ``engine.tiebreak.mix32`` (same 32-bit ops,
+    evaluated in jnp's modular uint32 arithmetic).
+    """
+    seed = jnp.asarray(seed, jnp.uint32)
+    idx = jnp.asarray(idx, jnp.uint32)
+    x = seed ^ (idx * jnp.uint32(0x9E3779B9))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def select_hosts(scores, mask, seeds):
+    """Batched deterministic selectHost (minisched.go:304-325 re-designed).
+
+    scores: i32[P, N] weighted totals; mask: bool[P, N] feasibility;
+    seeds: u32[P] per-pod tie-break seeds.
+
+    Returns (choice i32[P] — node index or -1, best_score i32[P]).
+
+    Rule (== engine.tiebreak.select_host): among feasible max-score nodes,
+    pick the one minimizing mix32(seed, node_index); remaining ties (hash
+    collisions) go to the lowest index.
+    """
+    P, N = scores.shape
+    masked = jnp.where(mask, scores, NEG_INF_SCORE)
+    best = masked.max(axis=1)  # i32[P]
+    cand = mask & (masked == best[:, None])
+    h = mix32(seeds[:, None], jnp.arange(N, dtype=jnp.uint32)[None, :])
+    hkey = jnp.where(cand, h, UINT32_MAX)
+    minh = hkey.min(axis=1)
+    # among positions achieving the min hash, prefer real candidates (guards
+    # the pathological h == UINT32_MAX collision), then the lowest index
+    is_min = hkey == minh[:, None]
+    pref = is_min & cand
+    has_pref = pref.any(axis=1)
+    pick_from = jnp.where(has_pref[:, None], pref, is_min)
+    choice = jnp.argmax(pick_from, axis=1).astype(jnp.int32)
+    feasible_any = mask.any(axis=1)
+    choice = jnp.where(feasible_any, choice, jnp.int32(-1))
+    best = jnp.where(feasible_any, best, jnp.int32(0))
+    return choice, best
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PlacementResult:
+    """Device-side result of one fused evaluation."""
+
+    choice: Any  # i32[P] node index, -1 = unschedulable
+    best_score: Any  # i32[P]
+    feasible_count: Any  # i32[P]
+    #: bool[K, P, N] per-filter-plugin pass masks (diagnostics; K = number of
+    #: filter plugins).  Present only when the evaluator was built with
+    #: ``with_diagnostics=True``.
+    filter_masks: Optional[Any] = None
+    #: i32[K, P, N] per-score-plugin weighted matrices (diagnostics).
+    score_matrices: Optional[Any] = None
+
+    def tree_flatten(self):
+        return (
+            (
+                self.choice,
+                self.best_score,
+                self.feasible_count,
+                self.filter_masks,
+                self.score_matrices,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, _, leaves):
+        return cls(*leaves)
+
+
+def evaluate(
+    pods,
+    nodes,
+    filter_plugins: Sequence[Any],
+    pre_score_plugins: Sequence[Any],
+    score_plugins: Sequence[Any],
+    ctx: BatchContext,
+    with_diagnostics: bool = False,
+) -> PlacementResult:
+    """One fused scheduling evaluation (traceable; call under jit).
+
+    Mirrors the scalar oracle exactly:
+    * filter chain ANDs per-plugin masks (plugin-order short-circuiting,
+      minisched.go:130-137, affects only diagnostics, not the mask — the
+      conjunction is order-independent);
+    * pre-score produces per-plugin aux arrays (the CycleState analog,
+      nodenumber.go:58-61);
+    * score → per-plugin normalize (mask-aware) → weight → sum
+      (minisched.go:164-199, with the weight TODO at :187 implemented);
+    * deterministic seeded masked-argmax (select_hosts).
+    """
+    valid = pods.valid[:, None] & nodes.valid[None, :]
+    mask = valid
+    per_filter = []
+    for pl in filter_plugins:
+        m = pl.batch_filter(ctx, pods, nodes)
+        if with_diagnostics:
+            per_filter.append(m)
+        mask = mask & m
+
+    aux: Dict[str, Dict[str, Any]] = {}
+    for pl in pre_score_plugins:
+        aux[pl.name()] = pl.batch_pre_score(ctx, pods, nodes)
+
+    P, N = mask.shape
+    totals = jnp.zeros((P, N), jnp.int32)
+    per_score = []
+    for pl in score_plugins:
+        s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))
+        s = pl.batch_normalize(ctx, s, mask)
+        w = s.astype(jnp.int32) * jnp.int32(ctx.weight_of(pl.name()))
+        if with_diagnostics:
+            per_score.append(w)
+        totals = totals + w
+
+    choice, best = select_hosts(totals, mask, pods.seed)
+    return PlacementResult(
+        choice=choice,
+        best_score=best,
+        feasible_count=mask.sum(axis=1).astype(jnp.int32),
+        filter_masks=jnp.stack(per_filter) if per_filter else None,
+        score_matrices=jnp.stack(per_score) if per_score else None,
+    )
+
+
+class FusedEvaluator:
+    """Compiled wrapper: plugin chains fixed at construction; tables vary.
+
+    The jit caches one executable per (P, N) table capacity — capacities are
+    padded to lane multiples (models.tables.pad_to) precisely so this cache
+    stays small (SURVEY.md §7 hard part 4).
+    """
+
+    def __init__(
+        self,
+        filter_plugins: Sequence[Any],
+        pre_score_plugins: Sequence[Any],
+        score_plugins: Sequence[Any],
+        weights: Optional[Dict[str, int]] = None,
+        with_diagnostics: bool = False,
+    ):
+        for chain in (filter_plugins, pre_score_plugins, score_plugins):
+            for pl in chain:
+                if not implements_batch(pl):
+                    raise TypeError(
+                        f"plugin {pl.name()} has no batch form; "
+                        "scalar-only plugins must run through the engine"
+                    )
+        self.ctx = BatchContext(
+            weights=tuple(sorted((weights or {}).items()))
+        )
+        self._fn = jax.jit(
+            partial(
+                evaluate,
+                filter_plugins=tuple(filter_plugins),
+                pre_score_plugins=tuple(pre_score_plugins),
+                score_plugins=tuple(score_plugins),
+                ctx=self.ctx,
+                with_diagnostics=with_diagnostics,
+            )
+        )
+
+    def __call__(self, pods, nodes) -> PlacementResult:
+        return self._fn(pods, nodes)

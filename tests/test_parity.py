@@ -1,0 +1,175 @@
+"""Parity: fused TPU batch evaluator vs the scalar oracle.
+
+BASELINE.json demands bit-exact placement parity between the batched
+(pods × nodes) kernel (minisched_tpu.ops.fused) and the sequential
+filter→score→selectHost loop (the oracle: engine.scheduler.schedule_pod_once,
+which is the exact code path the live engine runs — SURVEY.md §7 stage 6).
+
+Each test builds a randomized cluster, places every pod with both paths
+(statelessly: no binds applied between pods, matching the one-shot
+evaluator's semantics), and asserts identical placements including
+"unschedulable" (-1) outcomes and tie-breaks.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from minisched_tpu.api.objects import Taint, Toleration, make_node, make_pod
+from minisched_tpu.engine.scheduler import schedule_pod_once
+from minisched_tpu.engine.tiebreak import mix32 as mix32_py
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import FitError
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.ops import fused
+from minisched_tpu.plugins.nodenumber import NodeNumber
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+
+def oracle_placements(pods, nodes, filters, pre_scores, scores, weights=None):
+    """Run the scalar oracle per pod; returns list of node names ('' = unsched)."""
+    node_infos = build_node_infos(sorted(nodes, key=lambda n: n.metadata.name), [])
+    out = []
+    for pod in pods:
+        try:
+            out.append(
+                schedule_pod_once(
+                    filters, pre_scores, scores, weights or {}, pod, node_infos
+                )
+            )
+        except FitError:
+            out.append("")
+    return out
+
+
+def batch_placements(pods, nodes, filters, pre_scores, scores, weights=None):
+    node_table, node_names = build_node_table(
+        sorted(nodes, key=lambda n: n.metadata.name)
+    )
+    pod_table, _ = build_pod_table(pods)
+    ev = fused.FusedEvaluator(filters, pre_scores, scores, weights)
+    result = ev(pod_table, node_table)
+    choice = result.choice.tolist()
+    return [node_names[c] if c >= 0 else "" for c in choice[: len(pods)]]
+
+
+def test_mix32_matches_python():
+    import jax.numpy as jnp
+
+    rng = random.Random(0)
+    for _ in range(200):
+        seed = rng.getrandbits(32)
+        idx = rng.randrange(0, 1 << 20)
+        assert int(fused.mix32(jnp.uint32(seed), jnp.uint32(idx))) == mix32_py(
+            seed, idx
+        )
+
+
+def test_readme_scenario_parity():
+    """BASELINE config 1: 9 unschedulable nodes + pod1 → unschedulable;
+    +node10 → bound to node10."""
+    filters = [NodeUnschedulable()]
+    nodes = [make_node(f"node{i}", unschedulable=True) for i in range(9)]
+    pods = [make_pod("pod1")]
+
+    assert oracle_placements(pods, nodes, filters, [], []) == [""]
+    assert batch_placements(pods, nodes, filters, [], []) == [""]
+
+    nodes.append(make_node("node10"))
+    assert oracle_placements(pods, nodes, filters, [], []) == ["node10"]
+    assert batch_placements(pods, nodes, filters, [], []) == ["node10"]
+
+
+def _random_cluster(rng: random.Random, n_nodes: int, n_pods: int):
+    nodes = []
+    for i in range(n_nodes):
+        taints = []
+        if rng.random() < 0.2:
+            taints.append(Taint(key="dedicated", value="infra", effect="NoSchedule"))
+        nodes.append(
+            make_node(
+                f"node{i}",
+                unschedulable=rng.random() < 0.4,
+                taints=taints,
+            )
+        )
+    pods = []
+    for i in range(n_pods):
+        tolerations = []
+        if rng.random() < 0.3:
+            # tolerate the unschedulable taint: NodeUnschedulable admits then
+            tolerations.append(
+                Toleration(
+                    key="node.kubernetes.io/unschedulable",
+                    operator="Exists",
+                    effect="NoSchedule",
+                )
+            )
+        if rng.random() < 0.2:
+            tolerations.append(Toleration(key="", operator="Exists"))
+        pods.append(make_pod(f"pod{i}", tolerations=tolerations))
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_randomized_parity_nodeunschedulable(seed):
+    rng = random.Random(seed)
+    nodes, pods = _random_cluster(rng, n_nodes=rng.randrange(3, 40), n_pods=17)
+    filters = [NodeUnschedulable()]
+    assert oracle_placements(pods, nodes, filters, [], []) == batch_placements(
+        pods, nodes, filters, [], []
+    )
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_randomized_parity_full_default_chain(seed):
+    """BASELINE config 2 shape: NodeUnschedulable filter + NodeNumber
+    PreScore+Score, with score ties broken identically."""
+    rng = random.Random(seed)
+    nodes, pods = _random_cluster(rng, n_nodes=rng.randrange(5, 60), n_pods=23)
+    nn = NodeNumber()
+    filters = [NodeUnschedulable()]
+    oracle = oracle_placements(pods, nodes, filters, [nn], [nn])
+    batch = batch_placements(pods, nodes, filters, [nn], [nn])
+    assert oracle == batch
+
+
+def test_tie_break_is_deterministic_and_seed_dependent():
+    """All nodes score equal → choice is stable across runs and differs
+    across pods (seed-dependent), never random."""
+    nodes = [make_node(f"n{i}") for i in range(16)]
+    pods = [make_pod(f"pod{i}") for i in range(8)]
+    nn = NodeNumber()
+    filters = [NodeUnschedulable()]
+    a = batch_placements(pods, nodes, filters, [nn], [nn])
+    b = batch_placements(pods, nodes, filters, [nn], [nn])
+    assert a == b
+    assert a == oracle_placements(pods, nodes, filters, [nn], [nn])
+    assert len(set(a)) > 1  # different pods break ties differently
+
+
+def test_weights_applied_in_both_paths():
+    nodes = [make_node("n1"), make_node("n7")]
+    pods = [make_pod("pod7")]
+    nn = NodeNumber()
+    weights = {"NodeNumber": 3}
+    oracle = oracle_placements(pods, nodes, [NodeUnschedulable()], [nn], [nn], weights)
+    batch = batch_placements(pods, nodes, [NodeUnschedulable()], [nn], [nn], weights)
+    assert oracle == batch == ["n7"]
+
+
+def test_diagnostics_masks():
+    """with_diagnostics exposes per-plugin filter masks for the requeue gate."""
+    nodes = [make_node("n0", unschedulable=True), make_node("n1")]
+    pods = [make_pod("p0")]
+    node_table, _ = build_node_table(sorted(nodes, key=lambda n: n.metadata.name))
+    pod_table, _ = build_pod_table(pods)
+    ev = fused.FusedEvaluator(
+        [NodeUnschedulable()], [], [], with_diagnostics=True
+    )
+    res = ev(pod_table, node_table)
+    assert res.filter_masks.shape[0] == 1
+    assert bool(res.filter_masks[0, 0, 0]) is False  # n0 rejected
+    assert bool(res.filter_masks[0, 0, 1]) is True  # n1 passes
