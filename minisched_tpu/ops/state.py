@@ -1,0 +1,63 @@
+"""On-device cluster-state updates: apply placements to the NodeTable.
+
+The reference re-lists every node from the apiserver on every scheduling
+cycle (minisched/minisched.go:40) — the #1 pattern not to copy (SURVEY.md §7
+stage 7).  Here bind results are applied to the resident NodeTable with a
+scatter-add, so scheduling 100k pods against 10k nodes never re-uploads
+cluster state: the host only streams pod waves in and placements out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from minisched_tpu.models.tables import NodeTable, PodTable
+
+
+def apply_placements(nodes: NodeTable, pods: PodTable, choice) -> NodeTable:
+    """Commit chosen placements: add each placed pod's resource requests to
+    its node's ``req_*`` accounting (the array analog of NodeInfo.AddPod).
+
+    choice: i32[P] node index per pod, -1 = unplaced (dropped).
+    Traceable; runs under jit as part of the wave step.
+    """
+    placed = (choice >= 0) & pods.valid
+    idx = jnp.where(placed, choice, 0)
+
+    def scatter(col, amount):
+        amount = jnp.where(placed, amount, 0).astype(col.dtype)
+        return col.at[idx].add(amount)
+
+    return replace(
+        nodes,
+        req_cpu=scatter(nodes.req_cpu, pods.req_cpu),
+        req_mem=scatter(nodes.req_mem, pods.req_mem),
+        req_pods=scatter(nodes.req_pods, jnp.ones_like(pods.req_pods)),
+    )
+
+
+def wave_step(
+    nodes: NodeTable,
+    pods: PodTable,
+    filter_plugins,
+    pre_score_plugins,
+    score_plugins,
+    ctx,
+) -> Tuple[NodeTable, Any, Any]:
+    """One full device step: evaluate a pod wave against the resident
+    NodeTable, then commit the placements (SURVEY.md §7 stage 7).
+
+    Returns (updated NodeTable, choice i32[P], best_score i32[P]).
+    Traceable — this is the function the driver's ``dryrun_multichip``
+    jits over a sharded Mesh and the benchmark loops over waves.
+    """
+    from minisched_tpu.ops.fused import evaluate
+
+    result = evaluate(
+        pods, nodes, filter_plugins, pre_score_plugins, score_plugins, ctx
+    )
+    nodes = apply_placements(nodes, pods, result.choice)
+    return nodes, result.choice, result.best_score

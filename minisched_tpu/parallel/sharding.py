@@ -1,0 +1,136 @@
+"""Device-mesh sharding for the batch evaluator (SURVEY.md §7 stage 9).
+
+The scaling axes of this domain are the pod and node dimensions of the
+(pods × nodes) scheduling matrices — the analog of data/model parallelism
+(SURVEY.md §5.7/§5.8).  Design, per the standard JAX recipe: pick a Mesh,
+annotate the tables' shardings, and let XLA's GSPMD partitioner insert the
+collectives (the masked-argmax reduction over sharded node columns rides
+ICI as tree-reduce; nothing NCCL-like is hand-written).
+
+Mesh axes:
+* ``"pods"``  — data-parallel axis: pod waves split across devices; each
+  device schedules its pod shard independently (decisions are per-pod).
+* ``"nodes"`` — model-parallel axis: the node table splits across devices;
+  per-pod reductions (max score, min tie-break hash) become cross-device
+  collectives inserted by XLA.
+
+The reference has no equivalent — its "fabric" is client-go informers +
+REST over loopback (k8sapiserver.go:45-62); multi-host scale-out there
+means nothing.  Here one chip holds ~10k nodes easily; the node axis is
+sharded when the cluster (or the pod wave) outgrows one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from minisched_tpu.models.tables import NodeTable, PodTable
+
+POD_AXIS = "pods"
+NODE_AXIS = "nodes"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    pod_shards: Optional[int] = None,
+    devices=None,
+) -> Mesh:
+    """A 2D (pods × nodes) Mesh over the first ``n_devices`` devices.
+
+    Factoring: pod axis gets the largest power-of-two divisor ≤ √n unless
+    ``pod_shards`` pins it — both matrix axes shrink per device, keeping
+    per-device tiles near-square (HBM-friendly for the (P, N) intermediates).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} available")
+    devices = devices[:n]
+    if pod_shards is None:
+        pod_shards = 1
+        while pod_shards * 2 <= math.isqrt(n) and n % (pod_shards * 2) == 0:
+            pod_shards *= 2
+    if n % pod_shards:
+        raise ValueError(f"{n} devices not divisible by pod_shards={pod_shards}")
+    grid = np.array(devices).reshape(pod_shards, n // pod_shards)
+    return Mesh(grid, (POD_AXIS, NODE_AXIS))
+
+
+def _table_sharding(mesh: Mesh, table: Any, axis: str) -> Any:
+    """NamedSharding pytree: leading dim on ``axis``, trailing dims replicated."""
+    def leaf_spec(leaf):
+        extra = (None,) * (leaf.ndim - 1)
+        return NamedSharding(mesh, P(axis, *extra))
+
+    return jax.tree_util.tree_map(leaf_spec, table)
+
+
+def pod_sharding(mesh: Mesh, table: PodTable):
+    return _table_sharding(mesh, table, POD_AXIS)
+
+
+def node_sharding(mesh: Mesh, table: NodeTable):
+    return _table_sharding(mesh, table, NODE_AXIS)
+
+
+def shard_tables(
+    mesh: Mesh, pods: PodTable, nodes: NodeTable
+) -> Tuple[PodTable, NodeTable]:
+    """Place tables on the mesh: pods split on the pod axis, nodes on the
+    node axis.  Capacities must divide the respective mesh axis sizes
+    (tables.pad_to(128) guarantees this for meshes up to 128-wide)."""
+    pods = jax.device_put(pods, pod_sharding(mesh, pods))
+    nodes = jax.device_put(nodes, node_sharding(mesh, nodes))
+    return pods, nodes
+
+
+def sharded_wave_step(
+    mesh: Mesh,
+    filter_plugins,
+    pre_score_plugins,
+    score_plugins,
+    ctx,
+):
+    """The full device step (evaluate + commit) jitted with explicit
+    input/output shardings over ``mesh``.
+
+    Input: (NodeTable sharded on nodes, PodTable sharded on pods).
+    Output: (NodeTable same sharding, choice/best replicated per pod shard).
+    XLA inserts the cross-node-shard argmax/argmin reductions and the
+    scatter-add's collectives; the node table stays resident and sharded
+    across waves (donated so updates are in-place).
+    """
+    from functools import partial
+
+    from minisched_tpu.ops.state import wave_step
+
+    step = partial(
+        wave_step,
+        filter_plugins=tuple(filter_plugins),
+        pre_score_plugins=tuple(pre_score_plugins),
+        score_plugins=tuple(score_plugins),
+        ctx=ctx,
+    )
+
+    def in_shardings(nodes, pods):
+        return (node_sharding(mesh, nodes), pod_sharding(mesh, pods))
+
+    class _Compiled:
+        def __init__(self):
+            self._jitted = None
+
+        def __call__(self, nodes, pods):
+            if self._jitted is None:
+                self._jitted = jax.jit(
+                    step,
+                    in_shardings=in_shardings(nodes, pods),
+                    donate_argnums=(0,),
+                )
+            return self._jitted(nodes, pods)
+
+    return _Compiled()

@@ -1,18 +1,30 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before jax is imported anywhere: tests never require TPU hardware;
-multi-chip sharding is validated on virtual CPU devices (the driver's
-``dryrun_multichip`` does the same).
+Tests never require TPU hardware; multi-chip sharding is validated on
+virtual CPU devices (the driver's ``dryrun_multichip`` does the same).
+
+The build environment pre-imports jax AND pre-sets ``JAX_PLATFORMS`` (to
+the tunneled TPU platform), so plain env-var edits here are too late /
+overridden — the platform must be forced through ``jax.config`` before the
+first backend initialization, and the virtual device count through
+``XLA_FLAGS`` (read lazily at CPU-client creation).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (pre-imported by the environment anyway)
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + repr(jax.devices())
+)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
