@@ -39,8 +39,10 @@ MEMORY = "memory"  # bytes
 PODS = "pods"  # count
 EPHEMERAL_STORAGE = "ephemeral-storage"  # bytes
 
+MIB = 1024 * 1024
+
 DEFAULT_POD_CPU_REQUEST = 100  # milli-CPU, mirrors upstream non-zero default
-DEFAULT_POD_MEMORY_REQUEST = 200 * 1024 * 1024  # bytes
+DEFAULT_POD_MEMORY_REQUEST = 200 * MIB  # bytes
 
 
 def parse_quantity(value: Any, resource: str) -> int:

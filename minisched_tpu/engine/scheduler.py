@@ -140,6 +140,12 @@ def schedule_pod_once(
     ``_schedule_pod`` is this exact code path.
     """
     state = state if state is not None else CycleState()
+    # snapshot lister: plugins read per-node aggregates from CycleState under
+    # "nodeinfo/<name>" and the full snapshot under "nodeinfos" (the role of
+    # upstream's SnapshotSharedLister handle)
+    for ni in node_infos:
+        state.write("nodeinfo/" + ni.name, ni)
+    state.write("nodeinfos", node_infos)
     feasible, diagnosis = run_filter_plugins(filter_plugins, state, pod, node_infos)
     if not feasible:
         raise FitError(pod, len(node_infos), diagnosis)

@@ -10,16 +10,52 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from minisched_tpu.api.objects import Node, Pod, ResourceList
+from minisched_tpu.api.objects import (
+    DEFAULT_POD_CPU_REQUEST,
+    DEFAULT_POD_MEMORY_REQUEST,
+    MIB,
+    Node,
+    Pod,
+    ResourceList,
+)
+
+
+def non_zero_requests(pod: Pod) -> ResourceList:
+    """Upstream GetNonzeroRequests: pods with no explicit cpu/memory request
+    count as 100m / 200Mi for the resource scorers (never the Fit filter)."""
+    req = pod.resource_requests()
+    nz = req.clone()
+    if nz.milli_cpu == 0:
+        nz.milli_cpu = DEFAULT_POD_CPU_REQUEST
+    if nz.memory == 0:
+        nz.memory = DEFAULT_POD_MEMORY_REQUEST
+    return nz
 
 
 class NodeInfo:
-    __slots__ = ("node", "pods", "requested")
+    """Aggregates use the device unit discipline (models/tables.py): memory
+    is accumulated as per-pod MiB-floored int (sum-of-floors), exactly the
+    way the NodeTable builder accumulates — bit-exact oracle/kernel parity
+    depends on the two paths quantizing identically."""
+
+    __slots__ = (
+        "node",
+        "pods",
+        "requested",
+        "non_zero_requested",
+        "req_mem_mib",
+        "req_eph_mib",
+        "nzreq_mem_mib",
+    )
 
     def __init__(self, node: Optional[Node] = None):
         self.node: Optional[Node] = node
         self.pods: List[Pod] = []
         self.requested: ResourceList = ResourceList()
+        self.non_zero_requested: ResourceList = ResourceList()
+        self.req_mem_mib: int = 0
+        self.req_eph_mib: int = 0
+        self.nzreq_mem_mib: int = 0
 
     @property
     def name(self) -> str:
@@ -27,19 +63,37 @@ class NodeInfo:
 
     def add_pod(self, pod: Pod) -> None:
         self.pods.append(pod)
-        self.requested.add(pod.resource_requests())
+        req = pod.resource_requests()
+        self.requested.add(req)
+        self.non_zero_requested.add(non_zero_requests(pod))
+        self.req_mem_mib += req.memory // MIB
+        self.req_eph_mib += req.ephemeral_storage // MIB
+        self.nzreq_mem_mib += (req.memory // MIB) or (
+            DEFAULT_POD_MEMORY_REQUEST // MIB
+        )
 
     def remove_pod(self, pod: Pod) -> None:
         for i, p in enumerate(self.pods):
             if p.metadata.uid == pod.metadata.uid:
                 del self.pods[i]
-                self.requested.sub(pod.resource_requests())
+                req = pod.resource_requests()
+                self.requested.sub(req)
+                self.non_zero_requested.sub(non_zero_requests(pod))
+                self.req_mem_mib -= req.memory // MIB
+                self.req_eph_mib -= req.ephemeral_storage // MIB
+                self.nzreq_mem_mib -= (req.memory // MIB) or (
+                    DEFAULT_POD_MEMORY_REQUEST // MIB
+                )
                 return
 
     def clone(self) -> "NodeInfo":
         ni = NodeInfo(self.node)
         ni.pods = list(self.pods)
         ni.requested = self.requested.clone()
+        ni.non_zero_requested = self.non_zero_requested.clone()
+        ni.req_mem_mib = self.req_mem_mib
+        ni.req_eph_mib = self.req_eph_mib
+        ni.nzreq_mem_mib = self.nzreq_mem_mib
         return ni
 
 

@@ -27,13 +27,31 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-MIB = 1024 * 1024
+from minisched_tpu.api.objects import (
+    DEFAULT_POD_CPU_REQUEST,
+    DEFAULT_POD_MEMORY_REQUEST,
+    MIB,
+)
+
+# upstream GetNonzeroRequests defaults in device units, applied by the
+# resource *scorers* (never the Fit filter) to pods with no explicit
+# request — derived from the canonical api.objects constants so the scalar
+# oracle and the tables can never quantize differently
+DEFAULT_NONZERO_CPU = DEFAULT_POD_CPU_REQUEST  # milli-CPU
+DEFAULT_NONZERO_MEM_MIB = DEFAULT_POD_MEMORY_REQUEST // MIB
 
 # Fixed per-object capacities for variable-length k8s fields; overflow raises
 # host-side at table-build time (static shapes are non-negotiable under jit).
 MAX_TAINTS = 8
 MAX_TOLERATIONS = 8
 MAX_LABELS = 16
+MAX_IMAGES = 8  # images cached per node (ImageLocality)
+MAX_CONTAINERS = 4  # containers per pod
+MAX_PORTS = 8  # host ports per pod / in-use ports tracked per node
+MAX_AFF_TERMS = 4  # required node-affinity NodeSelectorTerms per pod
+MAX_PREF_TERMS = 4  # preferred node-affinity terms per pod
+MAX_AFF_REQS = 4  # match expressions per term
+MAX_AFF_VALS = 4  # operand values per In/NotIn expression
 
 EFFECT_NONE = 0
 EFFECT_NO_SCHEDULE = 1
@@ -48,6 +66,26 @@ _EFFECT_CODES = {
 
 TOLERATION_OP_EQUAL_CODE = 0
 TOLERATION_OP_EXISTS_CODE = 1
+
+# node-affinity / label-selector expression operator codes
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_DOES_NOT_EXIST = 3
+OP_GT = 4
+OP_LT = 5
+#: encodes an expression that can never match (e.g. Gt/Lt with a
+#: non-integer or missing operand — the scalar path treats those as
+#: no-match, never as an error; api/objects.py:_match_expression)
+OP_INVALID = 6
+_OP_CODES = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_DOES_NOT_EXIST,
+    "Gt": OP_GT,
+    "Lt": OP_LT,
+}
 
 
 def fnv1a32(s: str) -> int:
@@ -84,13 +122,21 @@ def _register_table(cls):
 class NodeTable:
     """All scheduler-relevant node state, shape (N,) or (N, K)."""
 
+    # identity
+    name_hash: Any  # i32[N] fnv of node name (NodeName filter)
     # resources
     alloc_cpu: Any  # i32[N] allocatable milli-cpu
     alloc_mem: Any  # i32[N] allocatable MiB
+    alloc_eph: Any  # i32[N] allocatable ephemeral-storage MiB
     alloc_pods: Any  # i32[N] allocatable pod count
     req_cpu: Any  # i32[N] requested (sum of assigned pods)
     req_mem: Any  # i32[N]
+    req_eph: Any  # i32[N]
     req_pods: Any  # i32[N]
+    # NonZeroRequested aggregates (upstream applies 100m CPU / 200Mi memory
+    # defaults to request-less pods for the scorers only)
+    nzreq_cpu: Any  # i32[N]
+    nzreq_mem: Any  # i32[N]
     # flags
     unschedulable: Any  # bool[N] (spec.unschedulable)
     # nodenumber plugin
@@ -103,7 +149,16 @@ class NodeTable:
     # labels
     label_key: Any  # i32[N, MAX_LABELS]
     label_value: Any  # i32[N, MAX_LABELS]
+    label_numval: Any  # i32[N, MAX_LABELS] label value parsed as int (Gt/Lt)
+    label_num_ok: Any  # bool[N, MAX_LABELS] label value was an integer
     num_labels: Any  # i32[N]
+    # cached images (ImageLocality)
+    image_key: Any  # i32[N, MAX_IMAGES] fnv of image name
+    image_size_mb: Any  # i32[N, MAX_IMAGES]
+    num_images: Any  # i32[N]
+    # host ports claimed by assigned pods (NodePorts)
+    used_port: Any  # i32[N, MAX_PORTS]
+    num_used_ports: Any  # i32[N]
     # padding mask
     valid: Any  # bool[N]
 
@@ -119,8 +174,10 @@ class PodTable:
 
     req_cpu: Any  # i32[P] requested milli-cpu (sum of containers)
     req_mem: Any  # i32[P] MiB
+    req_eph: Any  # i32[P] MiB
     req_pods: Any  # i32[P] (1)
     suffix: Any  # i32[P] trailing digit of name, -1 if none
+    spec_node_name: Any  # i32[P] fnv of spec.node_name, 0 = unset (NodeName)
     # tolerations
     tol_key: Any  # i32[P, MAX_TOLERATIONS]
     tol_value: Any  # i32[P, MAX_TOLERATIONS]
@@ -128,10 +185,33 @@ class PodTable:
     tol_op: Any  # i32[P, MAX_TOLERATIONS] 0=Equal 1=Exists
     tol_empty_key: Any  # bool[P, MAX_TOLERATIONS] key=="" (Exists-all)
     num_tols: Any  # i32[P]
-    # node selector (match_labels only; expressions handled host-side for now)
+    # node selector (spec.nodeSelector match_labels)
     sel_key: Any  # i32[P, MAX_LABELS]
     sel_value: Any  # i32[P, MAX_LABELS]
     num_sel: Any  # i32[P]
+    # required node affinity: OR over terms, AND over requirements
+    aff_required: Any  # bool[P] required affinity present (even if 0 terms)
+    aff_key: Any  # i32[P, MAX_AFF_TERMS, MAX_AFF_REQS]
+    aff_op: Any  # i32[P, T, R] operator code (OP_*)
+    aff_vals: Any  # i32[P, T, R, MAX_AFF_VALS] value hashes (In/NotIn)
+    aff_nvals: Any  # i32[P, T, R]
+    aff_numval: Any  # i32[P, T, R] integer operand (Gt/Lt)
+    aff_nreqs: Any  # i32[P, T]
+    aff_nterms: Any  # i32[P] 0 = no required affinity
+    # preferred node affinity: weighted terms (NodeAffinity score)
+    pref_weight: Any  # i32[P, MAX_PREF_TERMS]
+    pref_key: Any  # i32[P, MAX_PREF_TERMS, MAX_AFF_REQS]
+    pref_op: Any  # i32[P, T, R]
+    pref_vals: Any  # i32[P, T, R, MAX_AFF_VALS]
+    pref_nvals: Any  # i32[P, T, R]
+    pref_numval: Any  # i32[P, T, R]
+    pref_nreqs: Any  # i32[P, T]
+    pref_nterms: Any  # i32[P]
+    # container images + host ports
+    image_key: Any  # i32[P, MAX_CONTAINERS]
+    num_containers: Any  # i32[P]
+    port: Any  # i32[P, MAX_PORTS]
+    num_ports: Any  # i32[P]
     # deterministic tie-break seed per pod
     seed: Any  # u32[P]
     valid: Any  # bool[P]
@@ -176,20 +256,31 @@ def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = 
         return np.zeros(shape, dtype)
 
     t = dict(
-        alloc_cpu=zeros(cap), alloc_mem=zeros(cap), alloc_pods=zeros(cap),
-        req_cpu=zeros(cap), req_mem=zeros(cap), req_pods=zeros(cap),
+        name_hash=zeros(cap),
+        alloc_cpu=zeros(cap), alloc_mem=zeros(cap), alloc_eph=zeros(cap),
+        alloc_pods=zeros(cap),
+        req_cpu=zeros(cap), req_mem=zeros(cap), req_eph=zeros(cap),
+        req_pods=zeros(cap), nzreq_cpu=zeros(cap), nzreq_mem=zeros(cap),
         unschedulable=np.zeros(cap, bool), suffix=np.full(cap, -1, np.int32),
         taint_key=zeros((cap, MAX_TAINTS)), taint_value=zeros((cap, MAX_TAINTS)),
         taint_effect=zeros((cap, MAX_TAINTS)), num_taints=zeros(cap),
         label_key=zeros((cap, MAX_LABELS)), label_value=zeros((cap, MAX_LABELS)),
-        num_labels=zeros(cap), valid=np.zeros(cap, bool),
+        label_numval=zeros((cap, MAX_LABELS)),
+        label_num_ok=np.zeros((cap, MAX_LABELS), bool),
+        num_labels=zeros(cap),
+        image_key=zeros((cap, MAX_IMAGES)), image_size_mb=zeros((cap, MAX_IMAGES)),
+        num_images=zeros(cap),
+        used_port=zeros((cap, MAX_PORTS)), num_used_ports=zeros(cap),
+        valid=np.zeros(cap, bool),
     )
     names: List[str] = []
     for i, node in enumerate(nodes):
         names.append(node.metadata.name)
+        t["name_hash"][i] = fnv1a32(node.metadata.name)
         alloc = node.status.allocatable
         t["alloc_cpu"][i] = alloc.milli_cpu
         t["alloc_mem"][i] = alloc.memory // MIB
+        t["alloc_eph"][i] = alloc.ephemeral_storage // MIB
         t["alloc_pods"][i] = alloc.pods
         t["unschedulable"][i] = node.spec.unschedulable
         t["suffix"][i] = _name_suffix(node.metadata.name)
@@ -207,14 +298,65 @@ def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = 
         for j, (k, v) in enumerate(sorted(labels.items())):
             t["label_key"][i, j] = fnv1a32(k)
             t["label_value"][i, j] = fnv1a32(v)
+            try:
+                t["label_numval"][i, j] = int(v)
+                t["label_num_ok"][i, j] = True
+            except ValueError:
+                pass
         t["num_labels"][i] = len(labels)
+        images = node.status.images
+        if len(images) > MAX_IMAGES:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_IMAGES} images")
+        for j, (img, size) in enumerate(sorted(images.items())):
+            t["image_key"][i, j] = fnv1a32(img)
+            t["image_size_mb"][i, j] = size // MIB
+        t["num_images"][i] = len(images)
         t["valid"][i] = True
+        used_ports: List[int] = []
         for p in pods_by_node.get(node.metadata.name, ()):  # assigned pods
             req = p.resource_requests()
             t["req_cpu"][i] += req.milli_cpu
             t["req_mem"][i] += req.memory // MIB
+            t["req_eph"][i] += req.ephemeral_storage // MIB
             t["req_pods"][i] += 1
+            t["nzreq_cpu"][i] += req.milli_cpu or DEFAULT_NONZERO_CPU
+            t["nzreq_mem"][i] += (req.memory // MIB) or DEFAULT_NONZERO_MEM_MIB
+            for c in p.spec.containers:
+                used_ports.extend(c.ports)
+        if len(used_ports) > MAX_PORTS:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_PORTS} used ports")
+        for j, port in enumerate(used_ports):
+            t["used_port"][i, j] = port
+        t["num_used_ports"][i] = len(used_ports)
     return NodeTable(**{k: jnp.asarray(v) for k, v in t.items()}), names
+
+
+def _encode_terms(t: Dict[str, Any], prefix: str, i: int, terms, max_terms: int,
+                  what: str) -> None:
+    """Encode NodeSelectorTerms (or preferred-term preferences) into the
+    ``{prefix}_*`` expression arrays of row ``i``."""
+    if len(terms) > max_terms:
+        raise ValueError(f"{what}: >{max_terms} node-affinity terms")
+    for j, term in enumerate(terms):
+        reqs = term.match_expressions
+        if len(reqs) > MAX_AFF_REQS:
+            raise ValueError(f"{what}: >{MAX_AFF_REQS} requirements per term")
+        for r, req in enumerate(reqs):
+            t[f"{prefix}_key"][i, j, r] = fnv1a32(req.key)
+            t[f"{prefix}_op"][i, j, r] = _OP_CODES[req.operator]
+            if req.operator in ("In", "NotIn"):
+                if len(req.values) > MAX_AFF_VALS:
+                    raise ValueError(f"{what}: >{MAX_AFF_VALS} values per expression")
+                for v, val in enumerate(req.values):
+                    t[f"{prefix}_vals"][i, j, r, v] = fnv1a32(val)
+                t[f"{prefix}_nvals"][i, j, r] = len(req.values)
+            elif req.operator in ("Gt", "Lt"):
+                try:
+                    t[f"{prefix}_numval"][i, j, r] = int(req.values[0])
+                except (ValueError, IndexError, OverflowError):
+                    t[f"{prefix}_op"][i, j, r] = OP_INVALID
+        t[f"{prefix}_nreqs"][i, j] = len(reqs)
+    t[f"{prefix}_nterms"][i] = len(terms)
 
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable, List[str]]:
@@ -226,14 +368,27 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)
 
+    TR = (cap, MAX_AFF_TERMS, MAX_AFF_REQS)
+    PR = (cap, MAX_PREF_TERMS, MAX_AFF_REQS)
     t = dict(
-        req_cpu=zeros(cap), req_mem=zeros(cap), req_pods=zeros(cap),
-        suffix=np.full(cap, -1, np.int32),
+        req_cpu=zeros(cap), req_mem=zeros(cap), req_eph=zeros(cap),
+        req_pods=zeros(cap),
+        suffix=np.full(cap, -1, np.int32), spec_node_name=zeros(cap),
         tol_key=zeros((cap, MAX_TOLERATIONS)), tol_value=zeros((cap, MAX_TOLERATIONS)),
         tol_effect=zeros((cap, MAX_TOLERATIONS)), tol_op=zeros((cap, MAX_TOLERATIONS)),
         tol_empty_key=np.zeros((cap, MAX_TOLERATIONS), bool), num_tols=zeros(cap),
         sel_key=zeros((cap, MAX_LABELS)), sel_value=zeros((cap, MAX_LABELS)),
         num_sel=zeros(cap),
+        aff_required=np.zeros(cap, bool),
+        aff_key=zeros(TR), aff_op=zeros(TR), aff_vals=zeros(TR + (MAX_AFF_VALS,)),
+        aff_nvals=zeros(TR), aff_numval=zeros(TR),
+        aff_nreqs=zeros(TR[:2]), aff_nterms=zeros(cap),
+        pref_weight=zeros((cap, MAX_PREF_TERMS)),
+        pref_key=zeros(PR), pref_op=zeros(PR), pref_vals=zeros(PR + (MAX_AFF_VALS,)),
+        pref_nvals=zeros(PR), pref_numval=zeros(PR),
+        pref_nreqs=zeros(PR[:2]), pref_nterms=zeros(cap),
+        image_key=zeros((cap, MAX_CONTAINERS)), num_containers=zeros(cap),
+        port=zeros((cap, MAX_PORTS)), num_ports=zeros(cap),
         seed=np.zeros(cap, np.uint32), valid=np.zeros(cap, bool),
     )
     names: List[str] = []
@@ -242,8 +397,11 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable
         req = pod.resource_requests()
         t["req_cpu"][i] = req.milli_cpu
         t["req_mem"][i] = req.memory // MIB
+        t["req_eph"][i] = req.ephemeral_storage // MIB
         t["req_pods"][i] = 1
         t["suffix"][i] = _name_suffix(pod.metadata.name)
+        if pod.spec.node_name:
+            t["spec_node_name"][i] = fnv1a32(pod.spec.node_name)
         tols = pod.spec.tolerations
         if len(tols) > MAX_TOLERATIONS:
             raise ValueError(f"pod {pod.metadata.name}: >{MAX_TOLERATIONS} tolerations")
@@ -264,6 +422,30 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable
             t["sel_key"][i, j] = fnv1a32(k)
             t["sel_value"][i, j] = fnv1a32(v)
         t["num_sel"][i] = len(sel)
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff is not None else None
+        if na is not None:
+            if na.required_terms is not None:
+                t["aff_required"][i] = True
+                _encode_terms(t, "aff", i, na.required_terms, MAX_AFF_TERMS,
+                              f"pod {pod.metadata.name}")
+            _encode_terms(t, "pref", i, [p.preference for p in na.preferred],
+                          MAX_PREF_TERMS, f"pod {pod.metadata.name}")
+            for j, pref in enumerate(na.preferred):
+                t["pref_weight"][i, j] = pref.weight
+        containers = pod.spec.containers
+        if len(containers) > MAX_CONTAINERS:
+            raise ValueError(f"pod {pod.metadata.name}: >{MAX_CONTAINERS} containers")
+        ports: List[int] = []
+        for j, c in enumerate(containers):
+            t["image_key"][i, j] = fnv1a32(c.image) if c.image else 0
+            ports.extend(c.ports)
+        t["num_containers"][i] = len(containers)
+        if len(ports) > MAX_PORTS:
+            raise ValueError(f"pod {pod.metadata.name}: >{MAX_PORTS} ports")
+        for j, port in enumerate(ports):
+            t["port"][i, j] = port
+        t["num_ports"][i] = len(ports)
         t["seed"][i] = pod_seed(pod.metadata.uid or pod.metadata.name)
         t["valid"][i] = True
     return PodTable(**{k: jnp.asarray(v) for k, v in t.items()}), names

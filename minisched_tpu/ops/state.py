@@ -12,14 +12,53 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Any, Tuple
 
+import jax
 import jax.numpy as jnp
 
+from minisched_tpu.models import tables
 from minisched_tpu.models.tables import NodeTable, PodTable
+
+
+def _commit_ports(nodes: NodeTable, pods: PodTable, placed, choice):
+    """Append each placed pod's host ports to its node's ``used_port`` slots.
+
+    Slot assignment needs a per-node *rank* for the incoming ports (two
+    ports landing on the same node must take consecutive free slots); rank
+    is computed sort-free-ranking style: sort all (node, port) pairs by
+    node, then rank = position − segment start.  Ports beyond a node's
+    MAX_PORTS slot capacity are dropped (the host-side builder enforces
+    the same ceiling with a ValueError).
+
+    Returns (used_port, num_used_ports).  O(K log K), K = P × MAX_PORTS.
+    """
+    P, W = pods.port.shape
+    N = nodes.valid.shape[0]
+    slot_in_range = jnp.arange(W)[None, :] < pods.num_ports[:, None]
+    pair_live = placed[:, None] & slot_in_range  # (P, W)
+    pair_node = jnp.where(pair_live, choice[:, None], N).reshape(-1)  # K
+    pair_port = jnp.where(pair_live, pods.port, 0).reshape(-1)
+    order = jnp.argsort(pair_node)  # dead pairs (node=N) sort last
+    snode = pair_node[order]
+    sport = pair_port[order]
+    pos = jnp.arange(snode.shape[0])
+    is_start = jnp.concatenate([jnp.array([True]), snode[1:] != snode[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
+    rank = pos - seg_start
+    slot = nodes.num_used_ports[jnp.minimum(snode, N - 1)] + rank
+    ok = (snode < N) & (slot < nodes.used_port.shape[1])
+    tgt_node = jnp.where(ok, snode, N)  # out-of-range → dropped
+    tgt_slot = jnp.where(ok, slot, 0)
+    used_port = nodes.used_port.at[tgt_node, tgt_slot].set(sport, mode="drop")
+    num_used = nodes.num_used_ports.at[tgt_node].add(
+        jnp.where(ok, 1, 0), mode="drop"
+    )
+    return used_port, num_used
 
 
 def apply_placements(nodes: NodeTable, pods: PodTable, choice) -> NodeTable:
     """Commit chosen placements: add each placed pod's resource requests to
-    its node's ``req_*`` accounting (the array analog of NodeInfo.AddPod).
+    its node's ``req_*`` accounting and its host ports to the node's
+    used-port slots (the array analog of NodeInfo.AddPod).
 
     choice: i32[P] node index per pod, -1 = unplaced (dropped).
     Traceable; runs under jit as part of the wave step.
@@ -31,11 +70,23 @@ def apply_placements(nodes: NodeTable, pods: PodTable, choice) -> NodeTable:
         amount = jnp.where(placed, amount, 0).astype(col.dtype)
         return col.at[idx].add(amount)
 
+    used_port, num_used_ports = _commit_ports(nodes, pods, placed, choice)
     return replace(
         nodes,
         req_cpu=scatter(nodes.req_cpu, pods.req_cpu),
         req_mem=scatter(nodes.req_mem, pods.req_mem),
+        req_eph=scatter(nodes.req_eph, pods.req_eph),
         req_pods=scatter(nodes.req_pods, jnp.ones_like(pods.req_pods)),
+        nzreq_cpu=scatter(
+            nodes.nzreq_cpu,
+            jnp.where(pods.req_cpu == 0, tables.DEFAULT_NONZERO_CPU, pods.req_cpu),
+        ),
+        nzreq_mem=scatter(
+            nodes.nzreq_mem,
+            jnp.where(pods.req_mem == 0, tables.DEFAULT_NONZERO_MEM_MIB, pods.req_mem),
+        ),
+        used_port=used_port,
+        num_used_ports=num_used_ports,
     )
 
 

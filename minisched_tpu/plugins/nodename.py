@@ -1,0 +1,38 @@
+"""NodeName filter: pods pinned via ``spec.nodeName`` only fit that node.
+
+Re-creates the in-tree ``nodename`` plugin from the reference's default
+roster (scheduler/scheduler_test.go:307-332).  Batch form: one hash
+comparison against the node-name column.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+NAME = "NodeName"
+
+
+class NodeName(Plugin, BatchEvaluable):
+    def name(self) -> str:
+        return NAME
+
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.unresolvable("node not found")
+        if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+            return Status.unresolvable(
+                "node(s) didn't match the requested node name"
+            ).with_plugin(NAME)
+        return Status.success()
+
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        pinned = pods.spec_node_name != 0
+        match = pods.spec_node_name[:, None] == nodes.name_hash[None, :]
+        return jnp.where(pinned[:, None], match, True)

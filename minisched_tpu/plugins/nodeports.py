@@ -1,0 +1,66 @@
+"""NodePorts filter: reject nodes where a requested host port is taken.
+
+Re-creates the in-tree ``nodeports`` plugin from the reference's default
+roster (scheduler/scheduler_test.go:307-332): a pod asking for host ports
+only fits nodes where none of those ports are claimed by assigned pods.
+
+Batch form: the NodeTable carries the ports claimed by assigned pods
+(models/tables.py ``used_port``); the check is a (P, N, ports, ports)
+broadcast-reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+NAME = "NodePorts"
+
+
+def _pod_ports(pod: Any) -> List[int]:
+    out: List[int] = []
+    for c in pod.spec.containers:
+        out.extend(c.ports)
+    return out
+
+
+class NodePorts(Plugin, BatchEvaluable):
+    def name(self) -> str:
+        return NAME
+
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        wanted = _pod_ports(pod)
+        if not wanted:
+            return Status.success()
+        in_use = set()
+        for p in node_info.pods:
+            in_use.update(_pod_ports(p))
+        if any(port in in_use for port in wanted):
+            return Status.unschedulable(
+                "node(s) didn't have free ports for the requested pod ports"
+            ).with_plugin(NAME)
+        return Status.success()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(GVK.POD, ActionType.DELETE)]
+
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        want_in_range = (
+            jnp.arange(pods.port.shape[1])[None, :] < pods.num_ports[:, None]
+        )  # (P, Wp)
+        used_in_range = (
+            jnp.arange(nodes.used_port.shape[1])[None, :]
+            < nodes.num_used_ports[:, None]
+        )  # (N, Wn)
+        clash = (
+            (pods.port[:, None, :, None] == nodes.used_port[None, :, None, :])
+            & want_in_range[:, None, :, None]
+            & used_in_range[None, :, None, :]
+        )  # (P, N, Wp, Wn)
+        return ~jnp.any(clash, axis=(2, 3))

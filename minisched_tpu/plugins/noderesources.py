@@ -1,0 +1,212 @@
+"""Node-resources plugins: Fit filter + LeastAllocated / BalancedAllocation
+scorers (BASELINE config 3).
+
+Re-creates the in-tree ``noderesources`` plugins the reference's default
+config enables (scheduler/defaultconfig/defaultconfig.go:10-33; rosters
+enumerated in scheduler/scheduler_test.go:307-332): ``NodeResourcesFit``
+(filter), ``NodeResourcesLeastAllocated`` and
+``NodeResourcesBalancedAllocation`` (score), with upstream's
+GetNonzeroRequests defaults (100m CPU / 200Mi memory) applied by the
+scorers only.
+
+Unit discipline (bit-exact oracle/kernel parity): all resource math is
+int32 in (milli-CPU, MiB) — scalar and batch paths quantize identically.
+BalancedAllocation's upstream float64 ``(1 - |cpuFrac - memFrac|) * 100``
+is re-derived in scaled integers (fractions quantized to 1e-4) so CPU
+oracle and TPU kernel agree to the bit; same floor-division rounding as
+upstream's int64 math everywhere else.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import MIB, NodeInfo, non_zero_requests
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, MAX_NODE_SCORE, Status
+from minisched_tpu.models import tables
+
+FIT_NAME = "NodeResourcesFit"
+LEAST_ALLOCATED_NAME = "NodeResourcesLeastAllocated"
+BALANCED_ALLOCATION_NAME = "NodeResourcesBalancedAllocation"
+
+# BalancedAllocation fraction quantum (1e-3).  Chosen so the int32 device
+# math ``min(requested, 2*alloc) * FRAC_SCALE`` cannot overflow for any
+# node up to ~1 TiB memory / ~1000 cores (2**31 / 1000 / 2 ≈ 1.07e6 MiB).
+FRAC_SCALE = 1_000
+
+
+def _nz_cpu(milli: int) -> int:
+    return milli or tables.DEFAULT_NONZERO_CPU
+
+
+def _nz_mem_mib(mib: int) -> int:
+    return mib or tables.DEFAULT_NONZERO_MEM_MIB
+
+
+class NodeResourcesFit(Plugin, BatchEvaluable):
+    """Filter: pod's requests fit the node's remaining allocatable.
+
+    Upstream semantics: pod-count headroom always checked; per-resource
+    checks only for resources the pod actually requests (a zero request
+    fits even an overcommitted node).
+    """
+
+    def name(self) -> str:
+        return FIT_NAME
+
+    # -- scalar ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.unresolvable("node not found")
+        alloc = node.status.allocatable
+        reasons: List[str] = []
+        if len(node_info.pods) + 1 > alloc.pods:
+            reasons.append("Too many pods")
+        req = pod.resource_requests()
+        if req.milli_cpu > 0 and req.milli_cpu > alloc.milli_cpu - node_info.requested.milli_cpu:
+            reasons.append("Insufficient cpu")
+        req_mem = req.memory // MIB
+        if req_mem > 0 and req_mem > alloc.memory // MIB - node_info.req_mem_mib:
+            reasons.append("Insufficient memory")
+        req_eph = req.ephemeral_storage // MIB
+        if req_eph > 0 and req_eph > alloc.ephemeral_storage // MIB - node_info.req_eph_mib:
+            reasons.append("Insufficient ephemeral-storage")
+        if reasons:
+            return Status.unschedulable(*reasons).with_plugin(FIT_NAME)
+        return Status.success()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(GVK.POD, ActionType.DELETE),
+            ClusterEvent(
+                GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE
+            ),
+        ]
+
+    # -- batch -------------------------------------------------------------
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        pods_ok = (nodes.req_pods + 1)[None, :] <= nodes.alloc_pods[None, :]
+
+        def fits(pod_req, node_req, node_alloc):
+            remaining = (node_alloc - node_req)[None, :]
+            r = pod_req[:, None]
+            return (r == 0) | (r <= remaining)
+
+        return (
+            pods_ok
+            & fits(pods.req_cpu, nodes.req_cpu, nodes.alloc_cpu)
+            & fits(pods.req_mem, nodes.req_mem, nodes.alloc_mem)
+            & fits(pods.req_eph, nodes.req_eph, nodes.alloc_eph)
+        )
+
+
+class NodeResourcesLeastAllocated(Plugin, BatchEvaluable):
+    """Score: favor nodes with the most free cpu+memory after placement.
+
+    Upstream formula per resource (equal weights cpu=1, mem=1):
+    ``(allocatable - requested) * 100 / allocatable`` (0 if over-allocated),
+    averaged — all in integer floor division.
+    """
+
+    def name(self) -> str:
+        return LEAST_ALLOCATED_NAME
+
+    # -- scalar ------------------------------------------------------------
+    def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
+        ni: NodeInfo = state.read("nodeinfo/" + node_name)
+        alloc = ni.node.status.allocatable
+        nz = non_zero_requests(pod)
+        cpu = self._least(
+            ni.non_zero_requested.milli_cpu + _nz_cpu(nz.milli_cpu), alloc.milli_cpu
+        )
+        mem = self._least(
+            ni.nzreq_mem_mib + _nz_mem_mib(nz.memory // MIB), alloc.memory // MIB
+        )
+        return (cpu + mem) // 2, Status.success()
+
+    @staticmethod
+    def _least(requested: int, allocatable: int) -> int:
+        if allocatable <= 0 or requested > allocatable:
+            return 0
+        return (allocatable - requested) * MAX_NODE_SCORE // allocatable
+
+    def score_extensions(self):
+        return None
+
+    # -- batch -------------------------------------------------------------
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        def least(pod_nz, node_nz, alloc):
+            requested = pod_nz[:, None] + node_nz[None, :]
+            a = alloc[None, :]
+            score = jnp.where(a > 0, (a - requested) * MAX_NODE_SCORE // jnp.maximum(a, 1), 0)
+            return jnp.where((a <= 0) | (requested > a), 0, score)
+
+        pod_cpu = jnp.where(pods.req_cpu == 0, tables.DEFAULT_NONZERO_CPU, pods.req_cpu)
+        pod_mem = jnp.where(pods.req_mem == 0, tables.DEFAULT_NONZERO_MEM_MIB, pods.req_mem)
+        cpu = least(pod_cpu, nodes.nzreq_cpu, nodes.alloc_cpu)
+        mem = least(pod_mem, nodes.nzreq_mem, nodes.alloc_mem)
+        return ((cpu + mem) // 2).astype(jnp.int32)
+
+
+class NodeResourcesBalancedAllocation(Plugin, BatchEvaluable):
+    """Score: favor nodes where cpu and memory utilization stay balanced.
+
+    Upstream: ``(1 - |cpuFraction - memFraction|) * 100`` with fractions of
+    allocatable after placement, 0 if either fraction >= 1.  Fractions are
+    quantized to 1e-4 (FRAC_SCALE) so the formula is pure int math.
+    """
+
+    def name(self) -> str:
+        return BALANCED_ALLOCATION_NAME
+
+    # -- scalar ------------------------------------------------------------
+    def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
+        ni: NodeInfo = state.read("nodeinfo/" + node_name)
+        alloc = ni.node.status.allocatable
+        nz = non_zero_requests(pod)
+        cpu_frac = self._frac(
+            ni.non_zero_requested.milli_cpu + _nz_cpu(nz.milli_cpu), alloc.milli_cpu
+        )
+        mem_frac = self._frac(
+            ni.nzreq_mem_mib + _nz_mem_mib(nz.memory // MIB), alloc.memory // MIB
+        )
+        if cpu_frac >= FRAC_SCALE or mem_frac >= FRAC_SCALE:
+            return 0, Status.success()
+        diff = abs(cpu_frac - mem_frac)
+        return (FRAC_SCALE - diff) * MAX_NODE_SCORE // FRAC_SCALE, Status.success()
+
+    @staticmethod
+    def _frac(requested: int, allocatable: int) -> int:
+        if allocatable <= 0:
+            return FRAC_SCALE  # treat as saturated
+        # clamp before scaling: any requested >= allocatable saturates the
+        # score to 0 anyway, and the clamp keeps the device-side int32
+        # multiply in range — scalar mirrors it exactly for parity
+        return min(requested, 2 * allocatable) * FRAC_SCALE // allocatable
+
+    def score_extensions(self):
+        return None
+
+    # -- batch -------------------------------------------------------------
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        def frac(pod_nz, node_nz, alloc):
+            requested = pod_nz[:, None] + node_nz[None, :]
+            a = alloc[None, :]
+            requested = jnp.minimum(requested, 2 * a)  # see scalar _frac
+            return jnp.where(
+                a > 0, requested * FRAC_SCALE // jnp.maximum(a, 1), FRAC_SCALE
+            )
+
+        pod_cpu = jnp.where(pods.req_cpu == 0, tables.DEFAULT_NONZERO_CPU, pods.req_cpu)
+        pod_mem = jnp.where(pods.req_mem == 0, tables.DEFAULT_NONZERO_MEM_MIB, pods.req_mem)
+        cpu_frac = frac(pod_cpu, nodes.nzreq_cpu, nodes.alloc_cpu)
+        mem_frac = frac(pod_mem, nodes.nzreq_mem, nodes.alloc_mem)
+        diff = jnp.abs(cpu_frac - mem_frac)
+        score = (FRAC_SCALE - diff) * MAX_NODE_SCORE // FRAC_SCALE
+        saturated = (cpu_frac >= FRAC_SCALE) | (mem_frac >= FRAC_SCALE)
+        return jnp.where(saturated, 0, score).astype(jnp.int32)

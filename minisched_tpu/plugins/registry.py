@@ -38,11 +38,35 @@ def registered_names() -> List[str]:
 def _ensure_builtins() -> None:
     if "NodeUnschedulable" in _REGISTRY:
         return
+    from minisched_tpu.plugins.imagelocality import ImageLocality
+    from minisched_tpu.plugins.nodeaffinity import NodeAffinity
+    from minisched_tpu.plugins.nodename import NodeName
     from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeports import NodePorts
+    from minisched_tpu.plugins.noderesources import (
+        NodeResourcesBalancedAllocation,
+        NodeResourcesFit,
+        NodeResourcesLeastAllocated,
+    )
     from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+    from minisched_tpu.plugins.tainttoleration import TaintToleration
 
     register("NodeUnschedulable", lambda args, ts: NodeUnschedulable())
     register("NodeNumber", lambda args, ts: NodeNumber(time_scale=ts))
+    register("NodeResourcesFit", lambda args, ts: NodeResourcesFit())
+    register(
+        "NodeResourcesLeastAllocated",
+        lambda args, ts: NodeResourcesLeastAllocated(),
+    )
+    register(
+        "NodeResourcesBalancedAllocation",
+        lambda args, ts: NodeResourcesBalancedAllocation(),
+    )
+    register("TaintToleration", lambda args, ts: TaintToleration())
+    register("NodeAffinity", lambda args, ts: NodeAffinity())
+    register("NodeName", lambda args, ts: NodeName())
+    register("NodePorts", lambda args, ts: NodePorts())
+    register("ImageLocality", lambda args, ts: ImageLocality())
 
 
 @dataclass

@@ -1,0 +1,159 @@
+"""TaintToleration plugin: filter + score over node taints.
+
+Re-creates the in-tree ``tainttoleration`` plugin from the reference's
+default roster (scheduler/scheduler_test.go:307-332; default score weight 3
+per defaultconfig): Filter rejects nodes carrying a NoSchedule/NoExecute
+taint the pod does not tolerate; Score counts intolerable PreferNoSchedule
+taints and normalizes reversed (more intolerable taints → lower score).
+
+Batch form: taint×toleration matching is a pure (P, N, taints, tols)
+broadcast-reduce — XLA fuses it without materializing the rank-4
+intermediate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from minisched_tpu.api.objects import (
+    TAINT_EFFECT_NO_EXECUTE,
+    TAINT_EFFECT_NO_SCHEDULE,
+    TAINT_EFFECT_PREFER_NO_SCHEDULE,
+    Toleration,
+)
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import (
+    CycleState,
+    MAX_NODE_SCORE,
+    NodeScoreList,
+    Status,
+)
+from minisched_tpu.models import tables
+
+NAME = "TaintToleration"
+
+
+def _tolerated(taint, tolerations: List[Toleration]) -> bool:
+    return any(t.tolerates(taint) for t in tolerations)
+
+
+class _Normalize:
+    """DefaultNormalizeScore with reverse=True: higher intolerable-taint
+    count → lower score; all-zero counts → everyone gets MaxNodeScore."""
+
+    def normalize_score(self, state: CycleState, pod: Any, scores: NodeScoreList) -> Status:
+        max_count = max((ns.score for ns in scores), default=0)
+        for ns in scores:
+            if max_count == 0:
+                ns.score = MAX_NODE_SCORE
+            else:
+                ns.score = MAX_NODE_SCORE - ns.score * MAX_NODE_SCORE // max_count
+        return Status.success()
+
+
+class TaintToleration(Plugin, BatchEvaluable):
+    def name(self) -> str:
+        return NAME
+
+    # -- scalar ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.unresolvable("node not found")
+        for taint in node.spec.taints:
+            if taint.effect not in (TAINT_EFFECT_NO_SCHEDULE, TAINT_EFFECT_NO_EXECUTE):
+                continue
+            if not _tolerated(taint, pod.spec.tolerations):
+                return Status.unresolvable(
+                    f"node(s) had untolerated taint {{{taint.key}: {taint.value}}}"
+                ).with_plugin(NAME)
+        return Status.success()
+
+    def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
+        ni: NodeInfo = state.read("nodeinfo/" + node_name)
+        # tolerations that can cover PreferNoSchedule taints (effect "" or
+        # PreferNoSchedule — upstream getAllTolerationPreferNoSchedule)
+        tols = [
+            t
+            for t in pod.spec.tolerations
+            if t.effect in ("", TAINT_EFFECT_PREFER_NO_SCHEDULE)
+        ]
+        count = sum(
+            1
+            for taint in ni.node.spec.taints
+            if taint.effect == TAINT_EFFECT_PREFER_NO_SCHEDULE
+            and not _tolerated(taint, tols)
+        )
+        return count, Status.success()
+
+    def score_extensions(self):
+        return _Normalize()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_TAINT)
+        ]
+
+    # -- batch -------------------------------------------------------------
+    @staticmethod
+    def _tolerates_matrix(pods: Any, nodes: Any, tol_effect_ok):
+        """bool[P, N, Tn]: pod p tolerates node n's taint slot t.
+
+        tol_effect_ok: bool[P, Tp] — which toleration slots are eligible
+        (filter vs score consider different effect classes).
+        """
+        # shapes: pods.tol_* (P, Tp); nodes.taint_* (N, Tn)
+        tol_in_range = (
+            jnp.arange(pods.tol_key.shape[1])[None, :] < pods.num_tols[:, None]
+        )  # (P, Tp)
+        tol_ok = tol_in_range & tol_effect_ok  # (P, Tp)
+        # effect compatibility: toleration effect "" matches all; else equal
+        eff_match = (pods.tol_effect[:, None, None, :] == tables.EFFECT_NONE) | (
+            pods.tol_effect[:, None, None, :] == nodes.taint_effect[None, :, :, None]
+        )  # (P, N, Tn, Tp)
+        exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE  # (P, Tp)
+        wildcard = (pods.tol_empty_key & exists)[:, None, None, :]
+        key_eq = (
+            pods.tol_key[:, None, None, :] == nodes.taint_key[None, :, :, None]
+        )
+        val_eq = (
+            pods.tol_value[:, None, None, :] == nodes.taint_value[None, :, :, None]
+        )
+        value_ok = exists[:, None, None, :] | val_eq
+        covers = eff_match & (wildcard | (key_eq & value_ok))
+        return jnp.any(covers & tol_ok[:, None, None, :], axis=3)  # (P, N, Tn)
+
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        taint_in_range = (
+            jnp.arange(nodes.taint_key.shape[1])[None, :] < nodes.num_taints[:, None]
+        )  # (N, Tn)
+        hard = (nodes.taint_effect == tables.EFFECT_NO_SCHEDULE) | (
+            nodes.taint_effect == tables.EFFECT_NO_EXECUTE
+        )  # (N, Tn)
+        all_tols_ok = jnp.ones(pods.tol_key.shape, bool)
+        tolerated = self._tolerates_matrix(pods, nodes, all_tols_ok)  # (P, N, Tn)
+        blocking = (taint_in_range & hard)[None, :, :] & ~tolerated
+        return ~jnp.any(blocking, axis=2)
+
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        taint_in_range = (
+            jnp.arange(nodes.taint_key.shape[1])[None, :] < nodes.num_taints[:, None]
+        )
+        prefer = nodes.taint_effect == tables.EFFECT_PREFER_NO_SCHEDULE
+        tol_eligible = (pods.tol_effect == tables.EFFECT_NONE) | (
+            pods.tol_effect == tables.EFFECT_PREFER_NO_SCHEDULE
+        )
+        tolerated = self._tolerates_matrix(pods, nodes, tol_eligible)
+        intolerable = (taint_in_range & prefer)[None, :, :] & ~tolerated
+        return jnp.sum(intolerable, axis=2).astype(jnp.int32)
+
+    def batch_normalize(self, ctx: Any, scores, mask):
+        max_count = jnp.max(jnp.where(mask, scores, 0), axis=1, keepdims=True)
+        normalized = MAX_NODE_SCORE - scores * MAX_NODE_SCORE // jnp.maximum(
+            max_count, 1
+        )
+        return jnp.where(max_count == 0, MAX_NODE_SCORE, normalized).astype(jnp.int32)

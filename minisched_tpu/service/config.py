@@ -103,6 +103,38 @@ def default_scheduler_config(time_scale: float = 1.0) -> SchedulerConfig:
     )
 
 
+def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
+    """The upstream default plugin roster, as far as this framework
+    implements it — the rosters the reference's defaultconfig produces
+    (scheduler/defaultconfig/defaultconfig.go:17-33, enumerated with their
+    weights in scheduler/scheduler_test.go:307-332).  Weights follow
+    upstream defaults (TaintToleration 3, PodTopologySpread 2, rest 1).
+    """
+    return SchedulerConfig(
+        filter=PluginSet(
+            enabled=[
+                PluginEnabled("NodeUnschedulable"),
+                PluginEnabled("NodeName"),
+                PluginEnabled("TaintToleration"),
+                PluginEnabled("NodeAffinity"),
+                PluginEnabled("NodePorts"),
+                PluginEnabled("NodeResourcesFit"),
+            ]
+        ),
+        pre_score=PluginSet(enabled=[PluginEnabled("ImageLocality")]),
+        score=PluginSet(
+            enabled=[
+                PluginEnabled("NodeResourcesBalancedAllocation", weight=1),
+                PluginEnabled("ImageLocality", weight=1),
+                PluginEnabled("NodeResourcesLeastAllocated", weight=1),
+                PluginEnabled("NodeAffinity", weight=1),
+                PluginEnabled("TaintToleration", weight=3),
+            ]
+        ),
+        time_scale=time_scale,
+    )
+
+
 def apply_plugin_customization(
     default: SchedulerConfig, custom: SchedulerConfig
 ) -> SchedulerConfig:

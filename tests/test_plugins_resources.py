@@ -1,0 +1,141 @@
+"""NodeResources plugins: unit behavior + oracle/kernel parity
+(BASELINE config 3: NodeResourcesFit filter + LeastAllocated score,
+CPU/mem bin-packing)."""
+
+from __future__ import annotations
+
+import random
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import CycleState
+from minisched_tpu.plugins.noderesources import (
+    NodeResourcesBalancedAllocation,
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+)
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+from tests.test_parity import batch_placements, oracle_placements
+
+
+def _state_with(node_infos):
+    state = CycleState()
+    for ni in node_infos:
+        state.write("nodeinfo/" + ni.name, ni)
+    return state
+
+
+def test_fit_filter_rejects_insufficient_cpu():
+    fit = NodeResourcesFit()
+    node = make_node("n0", capacity={"cpu": "1", "memory": "1Gi", "pods": 10})
+    [ni] = build_node_infos([node], [])
+    big = make_pod("big", requests={"cpu": "2"})
+    small = make_pod("small", requests={"cpu": "500m"})
+    assert not fit.filter(CycleState(), big, ni).is_success()
+    assert fit.filter(CycleState(), small, ni).is_success()
+
+
+def test_fit_filter_counts_assigned_pods():
+    fit = NodeResourcesFit()
+    node = make_node("n0", capacity={"cpu": "4", "memory": "8Gi", "pods": 2})
+    assigned = [make_pod(f"a{i}") for i in range(2)]
+    for p in assigned:
+        p.spec.node_name = "n0"
+        p.metadata.uid = f"a{i}" if (i := assigned.index(p)) >= 0 else ""
+    [ni] = build_node_infos([node], assigned)
+    st = fit.filter(CycleState(), make_pod("p"), ni)
+    assert not st.is_success()
+    assert "Too many pods" in st.reasons
+
+
+def test_fit_zero_request_fits_overcommitted_node():
+    """A pod requesting nothing passes even when the node is over capacity."""
+    fit = NodeResourcesFit()
+    node = make_node("n0", capacity={"cpu": "1", "memory": "1Gi", "pods": 100})
+    hog = make_pod("hog", requests={"cpu": "2"})  # overcommit
+    hog.spec.node_name = "n0"
+    [ni] = build_node_infos([node], [hog])
+    assert fit.filter(CycleState(), make_pod("free"), ni).is_success()
+    assert not fit.filter(
+        CycleState(), make_pod("p", requests={"cpu": "100m"}), ni
+    ).is_success()
+
+
+def test_least_allocated_prefers_empty_node():
+    la = NodeResourcesLeastAllocated()
+    empty = make_node("empty", capacity={"cpu": "4", "memory": "8Gi", "pods": 100})
+    busy = make_node("busy", capacity={"cpu": "4", "memory": "8Gi", "pods": 100})
+    hog = make_pod("hog", requests={"cpu": "3", "memory": "6Gi"})
+    hog.spec.node_name = "busy"
+    infos = build_node_infos([empty, busy], [hog])
+    state = _state_with(infos)
+    pod = make_pod("p", requests={"cpu": "1", "memory": "1Gi"})
+    s_empty, _ = la.score(state, pod, "empty")
+    s_busy, _ = la.score(state, pod, "busy")
+    assert s_empty > s_busy
+
+
+def test_balanced_allocation_prefers_balanced_usage():
+    ba = NodeResourcesBalancedAllocation()
+    node = make_node("n0", capacity={"cpu": "4", "memory": "8Gi", "pods": 100})
+    state = _state_with(build_node_infos([node], []))
+    balanced = make_pod("b", requests={"cpu": "2", "memory": "4Gi"})
+    skewed = make_pod("s", requests={"cpu": "4", "memory": "1Gi"})
+    s_bal, _ = ba.score(state, balanced, "n0")
+    s_skew, _ = ba.score(state, skewed, "n0")
+    assert s_bal > s_skew
+    assert s_bal == 100  # perfectly balanced: both fractions equal
+
+
+def _resource_cluster(rng: random.Random, n_nodes: int, n_pods: int):
+    nodes = []
+    for i in range(n_nodes):
+        cpu = rng.choice(["1", "2", "4", "8"])
+        mem = rng.choice(["2Gi", "4Gi", "16Gi"])
+        nodes.append(
+            make_node(
+                f"node{i}",
+                capacity={"cpu": cpu, "memory": mem, "pods": rng.choice([2, 10, 110])},
+                unschedulable=rng.random() < 0.1,
+            )
+        )
+    pods = []
+    for i in range(n_pods):
+        if rng.random() < 0.2:
+            pods.append(make_pod(f"pod{i}"))  # no requests
+        else:
+            pods.append(
+                make_pod(
+                    f"pod{i}",
+                    requests={
+                        "cpu": rng.choice(["100m", "500m", "1", "3", "9"]),
+                        "memory": rng.choice(["128Mi", "1Gi", "5Gi", "30Gi"]),
+                    },
+                )
+            )
+    return nodes, pods
+
+
+def test_parity_config3_fit_least_allocated():
+    """BASELINE config 3: NodeResourcesFit + LeastAllocated, randomized."""
+    rng = random.Random(33)
+    nodes, pods = _resource_cluster(rng, 48, 40)
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    scores = [NodeResourcesLeastAllocated()]
+    oracle = oracle_placements(pods, nodes, filters, [], scores)
+    batch = batch_placements(pods, nodes, filters, [], scores)
+    assert oracle == batch
+    assert any(p == "" for p in oracle)  # some pods must be unschedulable
+    assert any(p != "" for p in oracle)
+
+
+def test_parity_config3_with_balanced_and_weights():
+    rng = random.Random(34)
+    nodes, pods = _resource_cluster(rng, 24, 30)
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    scores = [NodeResourcesLeastAllocated(), NodeResourcesBalancedAllocation()]
+    weights = {"NodeResourcesLeastAllocated": 1, "NodeResourcesBalancedAllocation": 2}
+    oracle = oracle_placements(pods, nodes, filters, [], scores, weights)
+    batch = batch_placements(pods, nodes, filters, [], scores, weights)
+    assert oracle == batch

@@ -1,0 +1,328 @@
+"""Default-roster plugins (TaintToleration, NodeAffinity, NodeName,
+NodePorts, ImageLocality): unit behavior + oracle/kernel parity under the
+full default filter+score chain."""
+
+from __future__ import annotations
+
+import random
+
+from minisched_tpu.api.objects import (
+    Affinity,
+    Container,
+    LabelSelectorRequirement,
+    NodeAffinity as NodeAffinitySpec,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    ResourceList,
+    Taint,
+    Toleration,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import CycleState
+from minisched_tpu.plugins.imagelocality import ImageLocality
+from minisched_tpu.plugins.nodeaffinity import NodeAffinity
+from minisched_tpu.plugins.nodename import NodeName
+from minisched_tpu.plugins.nodeports import NodePorts
+from minisched_tpu.plugins.noderesources import (
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+)
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from minisched_tpu.plugins.tainttoleration import TaintToleration
+
+from tests.test_parity import batch_placements, oracle_placements
+
+
+def test_taint_toleration_filter():
+    tt = TaintToleration()
+    tainted = make_node("t", taints=[Taint(key="dedicated", value="gpu")])
+    [ni] = build_node_infos([tainted], [])
+    plain = make_pod("p")
+    tolerant = make_pod(
+        "q", tolerations=[Toleration(key="dedicated", operator="Exists")]
+    )
+    assert not tt.filter(CycleState(), plain, ni).is_success()
+    assert tt.filter(CycleState(), tolerant, ni).is_success()
+
+
+def test_taint_toleration_prefer_no_schedule_scores():
+    tt = TaintToleration()
+    soft = make_node(
+        "soft", taints=[Taint(key="x", value="y", effect="PreferNoSchedule")]
+    )
+    clean = make_node("clean")
+    infos = build_node_infos([clean, soft], [])
+    state = CycleState()
+    for ni in infos:
+        state.write("nodeinfo/" + ni.name, ni)
+    pod = make_pod("p")
+    assert tt.score(state, pod, "soft")[0] == 1
+    assert tt.score(state, pod, "clean")[0] == 0
+
+
+def test_node_name_filter():
+    nn = NodeName()
+    [a, b] = build_node_infos([make_node("a"), make_node("b")], [])
+    pod = make_pod("p", node_name="a")
+    assert nn.filter(CycleState(), pod, a).is_success()
+    assert not nn.filter(CycleState(), pod, b).is_success()
+
+
+def test_node_ports_filter():
+    np_ = NodePorts()
+    node = make_node("n")
+    holder = make_pod("holder")
+    holder.spec.containers = [Container(ports=[8080])]
+    holder.spec.node_name = "n"
+    [ni] = build_node_infos([node], [holder])
+    clash = make_pod("clash")
+    clash.spec.containers = [Container(ports=[8080])]
+    free = make_pod("free")
+    free.spec.containers = [Container(ports=[9090])]
+    assert not np_.filter(CycleState(), clash, ni).is_success()
+    assert np_.filter(CycleState(), free, ni).is_success()
+
+
+def test_node_affinity_required_terms():
+    na = NodeAffinity()
+    gpu = make_node("gpu", labels={"accel": "tpu", "zone": "us-1"})
+    cpu = make_node("cpu", labels={"zone": "us-2"})
+    [ni_gpu, ni_cpu] = build_node_infos([gpu, cpu], [])
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinitySpec(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        LabelSelectorRequirement(key="accel", operator="In", values=["tpu"])
+                    ]
+                )
+            ]
+        )
+    )
+    assert na.filter(CycleState(), pod, ni_gpu).is_success()
+    assert not na.filter(CycleState(), pod, ni_cpu).is_success()
+
+
+def test_node_affinity_preferred_scoring_parity():
+    nodes = [
+        make_node("n0", labels={"zone": "a"}),
+        make_node("n1", labels={"zone": "b"}),
+    ]
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinitySpec(
+            preferred=[
+                PreferredSchedulingTerm(
+                    weight=50,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            LabelSelectorRequirement(
+                                key="zone", operator="In", values=["b"]
+                            )
+                        ]
+                    ),
+                )
+            ]
+        )
+    )
+    na = NodeAffinity()
+    filters = [NodeUnschedulable(), na]
+    assert oracle_placements([pod], nodes, filters, [], [na]) == ["n1"]
+    assert batch_placements([pod], nodes, filters, [], [na]) == ["n1"]
+
+
+def test_image_locality_prefers_cached_node():
+    il = ImageLocality()
+    warm = make_node("warm")
+    warm.status.images = {"repo/model:v1": 800 * 1024 * 1024}
+    cold = make_node("cold")
+    pod = make_pod("p")
+    pod.spec.containers = [Container(image="repo/model:v1")]
+    filters = [NodeUnschedulable()]
+    oracle = oracle_placements([pod], [warm, cold], filters, [il], [il])
+    batch = batch_placements([pod], [warm, cold], filters, [il], [il])
+    assert oracle == batch == ["warm"]
+
+
+def _gt_label_cluster():
+    nodes = [
+        make_node("small", labels={"disks": "2"}),
+        make_node("big", labels={"disks": "8"}),
+    ]
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinitySpec(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        LabelSelectorRequirement(key="disks", operator="Gt", values=["4"])
+                    ]
+                )
+            ]
+        )
+    )
+    return nodes, pod
+
+
+def test_node_affinity_gt_operator_parity():
+    nodes, pod = _gt_label_cluster()
+    na = NodeAffinity()
+    filters = [NodeUnschedulable(), na]
+    assert oracle_placements([pod], nodes, filters, [], []) == ["big"]
+    assert batch_placements([pod], nodes, filters, [], []) == ["big"]
+
+
+def _roster_cluster(rng: random.Random, n_nodes: int, n_pods: int):
+    zones = ["a", "b", "c"]
+    images = [f"img{i}" for i in range(5)]
+    nodes = []
+    for i in range(n_nodes):
+        taints = []
+        if rng.random() < 0.15:
+            taints.append(Taint(key="dedicated", value="infra"))
+        if rng.random() < 0.2:
+            taints.append(
+                Taint(key="soft", value="x", effect="PreferNoSchedule")
+            )
+        node = make_node(
+            f"node{i}",
+            labels={"zone": rng.choice(zones), "disks": str(rng.randrange(10))},
+            capacity={"cpu": rng.choice(["2", "4"]), "memory": "8Gi", "pods": 110},
+            taints=taints,
+            unschedulable=rng.random() < 0.1,
+        )
+        for img in rng.sample(images, rng.randrange(0, 3)):
+            node.status.images[img] = rng.randrange(50, 900) * 1024 * 1024
+        nodes.append(node)
+    pods = []
+    for i in range(n_pods):
+        pod = make_pod(
+            f"pod{i}",
+            requests={"cpu": rng.choice(["100m", "1"]), "memory": "512Mi"},
+        )
+        if rng.random() < 0.4:
+            pod.spec.containers[0].image = rng.choice(images)
+        if rng.random() < 0.3:
+            pod.spec.tolerations.append(
+                Toleration(key="dedicated", operator="Exists")
+            )
+        if rng.random() < 0.3:
+            pod.spec.affinity = Affinity(
+                node_affinity=NodeAffinitySpec(
+                    required_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                LabelSelectorRequirement(
+                                    key="zone",
+                                    operator=rng.choice(["In", "NotIn"]),
+                                    values=[rng.choice(zones)],
+                                )
+                            ]
+                        )
+                    ],
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=rng.randrange(1, 100),
+                            preference=NodeSelectorTerm(
+                                match_expressions=[
+                                    LabelSelectorRequirement(
+                                        key="disks",
+                                        operator=rng.choice(["Gt", "Lt"]),
+                                        values=[str(rng.randrange(10))],
+                                    )
+                                ]
+                            ),
+                        )
+                    ],
+                )
+            )
+        if rng.random() < 0.2:
+            pod.spec.node_selector = {"zone": rng.choice(zones)}
+        pods.append(pod)
+    return nodes, pods
+
+
+def test_empty_required_terms_reject_everywhere_in_both_paths():
+    """required_terms=[] (present but empty) matches nothing — upstream
+    MatchNodeSelectorTerms semantics; regression for a batch/scalar split."""
+    nodes = [make_node("n0"), make_node("n1")]
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity(node_affinity=NodeAffinitySpec(required_terms=[]))
+    na = NodeAffinity()
+    filters = [NodeUnschedulable(), na]
+    assert oracle_placements([pod], nodes, filters, [], []) == [""]
+    assert batch_placements([pod], nodes, filters, [], []) == [""]
+
+
+def test_gt_with_unparsable_operand_is_no_match_not_error():
+    nodes = [make_node("n0", labels={"disks": "5"})]
+    pod = make_pod("p")
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinitySpec(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        LabelSelectorRequirement(key="disks", operator="Gt", values=["abc"])
+                    ]
+                )
+            ]
+        )
+    )
+    na = NodeAffinity()
+    filters = [NodeUnschedulable(), na]
+    assert oracle_placements([pod], nodes, filters, [], []) == [""]
+    assert batch_placements([pod], nodes, filters, [], []) == [""]
+
+
+def test_port_commit_survives_across_waves():
+    """apply_placements must append placed pods' host ports to the node
+    table so the NodePorts filter stays truthful in later waves."""
+    import jax.numpy as jnp
+
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.fused import FusedEvaluator
+    from minisched_tpu.ops.state import apply_placements
+
+    node_table, _ = build_node_table([make_node("n0")])
+    wave1 = make_pod("w1")
+    wave1.spec.containers = [Container(ports=[8080, 9090])]
+    pod_table, _ = build_pod_table([wave1])
+    ev = FusedEvaluator([NodeUnschedulable(), NodePorts()], [], [])
+    res = ev(pod_table, node_table)
+    assert int(res.choice[0]) == 0
+    node_table = apply_placements(node_table, pod_table, res.choice)
+    assert int(node_table.num_used_ports[0]) == 2
+    assert sorted(jnp.asarray(node_table.used_port[0, :2]).tolist()) == [8080, 9090]
+
+    wave2 = make_pod("w2")
+    wave2.spec.containers = [Container(ports=[9090])]
+    pod_table2, _ = build_pod_table([wave2])
+    res2 = ev(pod_table2, node_table)
+    assert int(res2.choice[0]) == -1  # port already taken
+
+
+def test_parity_full_default_roster():
+    """Full default chain: all filter plugins + all score plugins with
+    upstream weights, randomized clusters."""
+    rng = random.Random(77)
+    nodes, pods = _roster_cluster(rng, 40, 60)
+    na = NodeAffinity()
+    tt = TaintToleration()
+    il = ImageLocality()
+    filters = [
+        NodeUnschedulable(),
+        NodeName(),
+        tt,
+        na,
+        NodePorts(),
+        NodeResourcesFit(),
+    ]
+    scores = [NodeResourcesLeastAllocated(), il, na, tt]
+    weights = {"TaintToleration": 3}
+    oracle = oracle_placements(pods, nodes, filters, [il], scores, weights)
+    batch = batch_placements(pods, nodes, filters, [il], scores, weights)
+    assert oracle == batch
+    assert any(p != "" for p in oracle)
