@@ -36,7 +36,7 @@ from minisched_tpu.framework.events import (
     unioned_gvks,
 )
 from minisched_tpu.framework.nodeinfo import NodeInfo, build_node_infos
-from minisched_tpu.framework.plugin import implements_enqueue
+from minisched_tpu.framework.plugin import implements_enqueue, implements_pre_filter
 from minisched_tpu.framework.types import (
     CycleState,
     Diagnosis,
@@ -54,6 +54,20 @@ from minisched_tpu.queue.queue import SchedulingQueue
 # Pure extension-point runners (minisched.go:115-199) — module-level so the
 # live engine and the stateless parity oracle share ONE implementation
 # ---------------------------------------------------------------------------
+
+
+def run_pre_filter_plugins(
+    filter_plugins: List[Any], state: CycleState, pod: Pod, node_infos: List[NodeInfo]
+) -> Tuple[Status, str]:
+    """Once-per-pod PreFilter pass (upstream framework.PreFilterPlugin) for
+    filter plugins that aggregate cluster-wide state.  Returns the first
+    non-success status and the plugin that produced it."""
+    for pl in filter_plugins:
+        if implements_pre_filter(pl):
+            status = pl.pre_filter(state, pod, node_infos)
+            if not is_success(status):
+                return status.with_plugin(status.plugin or pl.name()), pl.name()
+    return Status.success(), ""
 
 
 def run_filter_plugins(
@@ -146,6 +160,15 @@ def schedule_pod_once(
     for ni in node_infos:
         state.write("nodeinfo/" + ni.name, ni)
     state.write("nodeinfos", node_infos)
+    pf_status, pf_plugin = run_pre_filter_plugins(
+        filter_plugins, state, pod, node_infos
+    )
+    if not is_success(pf_status):
+        if pf_status.code.name == "ERROR":
+            raise pf_status.as_error()
+        diagnosis = Diagnosis()
+        diagnosis.unschedulable_plugins.add(pf_plugin)
+        raise FitError(pod, len(node_infos), diagnosis)
     feasible, diagnosis = run_filter_plugins(filter_plugins, state, pod, node_infos)
     if not feasible:
         raise FitError(pod, len(node_infos), diagnosis)

@@ -44,6 +44,19 @@ class Plugin:
 
 
 @runtime_checkable
+class PreFilterPlugin(Protocol):
+    """Once-per-pod prep before the per-node filter loop (the upstream
+    framework.PreFilterPlugin — needed by cross-pod plugins that aggregate
+    cluster-wide state, e.g. PodTopologySpread's per-domain match counts)."""
+
+    def name(self) -> str: ...
+
+    def pre_filter(
+        self, state: CycleState, pod: Any, node_infos: List[NodeInfo]
+    ) -> Status: ...
+
+
+@runtime_checkable
 class FilterPlugin(Protocol):
     def name(self) -> str: ...
 
@@ -128,6 +141,10 @@ class BatchEvaluable:
 
     #: set False for plugins that have no scalar counterpart (none today)
     has_batch = True
+    #: plugins whose kernels read cross-pod constraint tables (an ``extra``
+    #: pytree built per wave, models/constraints.py) set this True; their
+    #: batch_filter/batch_score take a trailing ``extra`` argument
+    needs_extra = False
 
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
         raise NotImplementedError
@@ -146,6 +163,10 @@ class BatchEvaluable:
 # ---------------------------------------------------------------------------
 # Capability probing helpers
 # ---------------------------------------------------------------------------
+
+
+def implements_pre_filter(p: Any) -> bool:
+    return callable(getattr(p, "pre_filter", None))
 
 
 def implements_filter(p: Any) -> bool:

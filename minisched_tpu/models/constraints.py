@@ -1,0 +1,335 @@
+"""Cross-pod constraint tables: the pod↔pod×node coupling arrays.
+
+InterPodAffinity and PodTopologySpread couple pending pods to *assigned*
+pods through label selectors and topology domains — the scheduling analog
+of attention's token↔token coupling (SURVEY.md §5.7, §7 stage 8).  The
+TPU-native factoring separates the two halves:
+
+* **Host side** (this module): every distinct (namespaces, label-selector,
+  topology-key) triple appearing in the wave's constraints becomes a
+  **combo**; assigned pods are matched against each combo ONCE, and the
+  per-node domain sums land in a dense ``combo_dsum[C, N]`` matrix.  The
+  reverse direction (assigned pods' required anti-affinity) becomes a
+  ``pod_matches_ex[P, T] × ex_domain[T, N]`` pair.
+
+* **Device side** (plugins/interpodaffinity.py, podtopologyspread.py):
+  kernels only gather combo rows and reduce — no string or object work.
+  The reverse anti-affinity check is one bool matmul (MXU-shaped).
+
+Semantics follow upstream v1.22 ``interpodaffinity`` / ``podtopologyspread``
+(the reference's default roster enables both — scheduler_test.go:307-332),
+including the affinity bootstrap special case (a pod matching its own
+affinity term may land anywhere with the topology key when no pod matches
+cluster-wide) and spread's eligible-node gating.  Preferred-term scoring
+covers the incoming pod's terms (both signs); symmetric scoring of
+*existing* pods' preferred terms is intentionally out of scope for now and
+documented here so the scalar oracle and kernels agree on ONE semantic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from minisched_tpu.api.objects import LabelSelector, PodAffinityTerm
+from minisched_tpu.models.tables import _register_table, pad_to
+
+MAX_TSC = 4  # topology spread constraints per pod
+MAX_PA = 4  # required pod-affinity terms per pod
+MAX_PAN = 4  # required pod-anti-affinity terms per pod
+MAX_PPA = 8  # preferred (anti-)affinity terms per pod, both signs pooled
+
+#: topology keys used by DoNotSchedule spread constraints must either have
+#: at most this many distinct values (zone-like) or be unique-per-node
+#: (hostname-like) — the two real-world shapes.  The one-hot domain
+#: encoding the eligibility-aware filter kernel needs is O(D × N) per key.
+MAX_DOMAINS = 64
+
+TS_DO_NOT_SCHEDULE = 0
+TS_SCHEDULE_ANYWAY = 1
+
+
+@_register_table
+@dataclass
+class ConstraintTables:
+    """Device-side cross-pod coupling state for one wave."""
+
+    # per-combo (selector-group × topology-key), shape (C, N) / (C,)
+    combo_dsum: Any  # i32[C, N] matching assigned pods in n's topo domain
+    combo_haskey: Any  # bool[C, N] node carries the combo's topology key
+    combo_global: Any  # i32[C] matching assigned pods cluster-wide
+    combo_here: Any  # i32[C, N] matching assigned pods ON node n
+    combo_key: Any  # i32[C] index into the topology-key axis below
+    # per-topology-key domain encoding (spread's eligibility-aware filter:
+    # upstream counts domains only over nodes passing the pod's
+    # nodeSelector/required affinity, so domain sums are per-pod on device)
+    topo_domain: Any  # i32[K, N] dense domain id; == D sentinel when keyless
+    topo_onehot: Any  # bool[K, D, N] node ∈ domain d of key k (zone-like keys)
+    topo_unique: Any  # bool[K] key is unique-per-node (hostname-like)
+    # incoming pods' topology spread constraints
+    ts_combo: Any  # i32[P, MAX_TSC]
+    ts_skew: Any  # i32[P, MAX_TSC] max skew
+    ts_mode: Any  # i32[P, MAX_TSC] 0=DoNotSchedule 1=ScheduleAnyway
+    ts_n: Any  # i32[P]
+    # incoming pods' required pod affinity
+    pa_combo: Any  # i32[P, MAX_PA]
+    pa_self: Any  # bool[P, MAX_PA] pod matches its own term selector
+    pa_n: Any  # i32[P]
+    # incoming pods' required pod anti-affinity
+    pan_combo: Any  # i32[P, MAX_PAN]
+    pan_n: Any  # i32[P]
+    # incoming pods' preferred terms (weight < 0 encodes anti-affinity)
+    ppa_combo: Any  # i32[P, MAX_PPA]
+    ppa_w: Any  # i32[P, MAX_PPA]
+    ppa_n: Any  # i32[P]
+    # reverse direction: assigned pods' required anti-affinity terms
+    ex_domain: Any  # bool[T, N] nodes in the owning pod's topo domain
+    pod_matches_ex: Any  # bool[P, T] pending pod matches term selector
+
+
+def _selector_sig(sel: LabelSelector) -> Tuple:
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            (r.key, r.operator, tuple(r.values)) for r in sel.match_expressions
+        ),
+    )
+
+
+def _term_namespaces(term: PodAffinityTerm, pod_ns: str) -> Tuple[str, ...]:
+    return tuple(sorted(term.namespaces)) if term.namespaces else (pod_ns,)
+
+
+class _ComboRegistry:
+    def __init__(self):
+        self.ids: Dict[Tuple, int] = {}
+        self.combos: List[Tuple[Tuple[str, ...], LabelSelector, str]] = []
+
+    def get(self, namespaces: Tuple[str, ...], sel: LabelSelector, topo: str) -> int:
+        key = (namespaces, _selector_sig(sel), topo)
+        if key not in self.ids:
+            self.ids[key] = len(self.combos)
+            self.combos.append((namespaces, sel, topo))
+        return self.ids[key]
+
+
+def _topo_key_axis(combos, nodes) -> Tuple[Dict[str, int], Any, Any, Any]:
+    """Dense domain encoding per distinct topology key.
+
+    Returns (key→index, topo_domain i32[K, N], topo_onehot bool[K, D, N],
+    topo_unique bool[K]).  Keys whose cardinality exceeds MAX_DOMAINS must
+    be unique-per-node (hostname-like) — their one-hot plane is unused (the
+    kernel short-circuits to per-node counts); anything in between raises.
+    """
+    N = len(nodes)
+    keys = sorted({topo for (_, _, topo) in combos})
+    key_ids = {k: i for i, k in enumerate(keys)}
+    K = max(len(keys), 1)
+    values: List[Dict[str, int]] = [{} for _ in range(K)]
+    vals_per_node: List[List[Optional[int]]] = [[None] * N for _ in range(K)]
+    for k, key in enumerate(keys):
+        for i, node in enumerate(nodes):
+            v = node.metadata.labels.get(key)
+            if v is None:
+                continue
+            if v not in values[k]:
+                values[k][v] = len(values[k])
+            vals_per_node[k][i] = values[k][v]
+    unique = np.zeros(K, bool)
+    for k, key in enumerate(keys):
+        n_domains = len(values[k])
+        n_keyed = sum(1 for v in vals_per_node[k] if v is not None)
+        unique[k] = n_domains == n_keyed and n_domains > 0
+        if n_domains > MAX_DOMAINS and not unique[k]:
+            raise ValueError(
+                f"topology key {key!r}: {n_domains} domains exceed "
+                f"MAX_DOMAINS={MAX_DOMAINS} and the key is not unique-per-node"
+            )
+    D = MAX_DOMAINS
+    Ncap = N  # caller re-pads below
+    topo_domain = np.full((K, Ncap), D, np.int32)
+    topo_onehot = np.zeros((K, D, Ncap), bool)
+    for k in range(len(keys)):
+        for i, dom in enumerate(vals_per_node[k]):
+            if dom is None:
+                continue
+            if unique[k]:
+                topo_domain[k, i] = 0  # unused by the unique path; != D marks haskey
+            else:
+                topo_domain[k, i] = dom
+                topo_onehot[k, dom, i] = True
+    return key_ids, topo_domain, topo_onehot, unique
+
+
+def _matches(sel: LabelSelector, namespaces: Tuple[str, ...], pod: Any) -> bool:
+    return pod.metadata.namespace in namespaces and sel.matches(pod.metadata.labels)
+
+
+def build_constraint_tables(
+    pending_pods: Sequence[Any],
+    nodes: Sequence[Any],
+    assigned_pods: Sequence[Any],
+    pod_capacity: Optional[int] = None,
+    node_capacity: Optional[int] = None,
+) -> ConstraintTables:
+    """Build the wave's coupling tables.
+
+    ``nodes`` must be in the SAME order as the NodeTable build (name-sorted)
+    so node indices line up.  ``assigned_pods`` are pods with
+    ``spec.node_name`` set; others are ignored.
+    """
+    P = pod_capacity or pad_to(len(pending_pods))
+    N = node_capacity or pad_to(len(nodes))
+    node_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+    assigned = [p for p in assigned_pods if p.spec.node_name in node_idx]
+
+    reg = _ComboRegistry()
+    pod_rows: List[Dict[str, List]] = []
+    for pod in pending_pods:
+        row: Dict[str, List] = {"ts": [], "pa": [], "pan": [], "ppa": []}
+        ns = pod.metadata.namespace
+        for c in pod.spec.topology_spread_constraints:
+            cid = reg.get((ns,), c.label_selector, c.topology_key)
+            mode = (
+                TS_DO_NOT_SCHEDULE
+                if c.when_unsatisfiable == "DoNotSchedule"
+                else TS_SCHEDULE_ANYWAY
+            )
+            row["ts"].append((cid, c.max_skew, mode))
+        aff = pod.spec.affinity
+        if aff is not None and aff.pod_affinity is not None:
+            for term in aff.pod_affinity.required:
+                nss = _term_namespaces(term, ns)
+                cid = reg.get(nss, term.label_selector, term.topology_key)
+                row["pa"].append((cid, _matches(term.label_selector, nss, pod)))
+            for wt in aff.pod_affinity.preferred:
+                nss = _term_namespaces(wt.term, ns)
+                cid = reg.get(nss, wt.term.label_selector, wt.term.topology_key)
+                row["ppa"].append((cid, wt.weight))
+        if aff is not None and aff.pod_anti_affinity is not None:
+            for term in aff.pod_anti_affinity.required:
+                nss = _term_namespaces(term, ns)
+                cid = reg.get(nss, term.label_selector, term.topology_key)
+                row["pan"].append(cid)
+            for wt in aff.pod_anti_affinity.preferred:
+                nss = _term_namespaces(wt.term, ns)
+                cid = reg.get(nss, wt.term.label_selector, wt.term.topology_key)
+                row["ppa"].append((cid, -wt.weight))
+        for kind, cap in (("ts", MAX_TSC), ("pa", MAX_PA), ("pan", MAX_PAN),
+                          ("ppa", MAX_PPA)):
+            if len(row[kind]) > cap:
+                raise ValueError(
+                    f"pod {pod.metadata.name}: >{cap} {kind} constraints"
+                )
+        pod_rows.append(row)
+
+    # --- combo matrices ----------------------------------------------------
+    C = pad_to(max(len(reg.combos), 1), 8)
+    combo_dsum = np.zeros((C, N), np.int32)
+    combo_haskey = np.zeros((C, N), bool)
+    combo_global = np.zeros(C, np.int32)
+    combo_here = np.zeros((C, N), np.int32)
+    combo_key = np.zeros(C, np.int32)
+    key_ids, topo_domain_, topo_onehot_, topo_unique = _topo_key_axis(
+        reg.combos, nodes
+    )
+    # pad the node axis of the key-domain planes to capacity N
+    K, D = topo_onehot_.shape[0], topo_onehot_.shape[1]
+    topo_domain = np.full((K, N), D, np.int32)
+    topo_domain[:, : topo_domain_.shape[1]] = topo_domain_
+    topo_onehot = np.zeros((K, D, N), bool)
+    topo_onehot[:, :, : topo_onehot_.shape[2]] = topo_onehot_
+    for cid, (nss, sel, topo) in enumerate(reg.combos):
+        combo_key[cid] = key_ids[topo]
+        matching = [p for p in assigned if _matches(sel, nss, p)]
+        combo_global[cid] = len(matching)
+        domain_count: Dict[str, int] = {}
+        for p in matching:
+            i = node_idx[p.spec.node_name]
+            combo_here[cid, i] += 1
+            val = nodes[i].metadata.labels.get(topo)
+            if val is not None:
+                domain_count[val] = domain_count.get(val, 0) + 1
+        for i, node in enumerate(nodes):
+            val = node.metadata.labels.get(topo)
+            if val is not None:
+                combo_haskey[cid, i] = True
+                combo_dsum[cid, i] = domain_count.get(val, 0)
+
+    # --- reverse anti-affinity terms (deduped: replicas sharing one term
+    # and one topology domain collapse to a single row) --------------------
+    ex_ids: Dict[Tuple, int] = {}
+    ex_terms: List[Tuple[Tuple[str, ...], LabelSelector, str, str]] = []
+    for p in assigned:
+        aff = p.spec.affinity
+        if aff is None or aff.pod_anti_affinity is None:
+            continue
+        for term in aff.pod_anti_affinity.required:
+            owner_val = nodes[node_idx[p.spec.node_name]].metadata.labels.get(
+                term.topology_key
+            )
+            if owner_val is None:
+                continue  # owner's node lacks the key: term can't be violated
+            nss = _term_namespaces(term, p.metadata.namespace)
+            key = (nss, _selector_sig(term.label_selector), term.topology_key,
+                   owner_val)
+            if key not in ex_ids:
+                ex_ids[key] = len(ex_terms)
+                ex_terms.append(
+                    (nss, term.label_selector, term.topology_key, owner_val)
+                )
+    T = pad_to(max(len(ex_terms), 1), 8)
+    ex_domain = np.zeros((T, N), bool)
+    pod_matches_ex = np.zeros((P, T), bool)
+    for t, (nss, sel, topo, owner_val) in enumerate(ex_terms):
+        for i, node in enumerate(nodes):
+            if node.metadata.labels.get(topo) == owner_val:
+                ex_domain[t, i] = True
+        for i, pod in enumerate(pending_pods):
+            pod_matches_ex[i, t] = _matches(sel, nss, pod)
+
+    # --- per-pod constraint arrays ----------------------------------------
+    ts_combo = np.zeros((P, MAX_TSC), np.int32)
+    ts_skew = np.zeros((P, MAX_TSC), np.int32)
+    ts_mode = np.zeros((P, MAX_TSC), np.int32)
+    ts_n = np.zeros(P, np.int32)
+    pa_combo = np.zeros((P, MAX_PA), np.int32)
+    pa_self = np.zeros((P, MAX_PA), bool)
+    pa_n = np.zeros(P, np.int32)
+    pan_combo = np.zeros((P, MAX_PAN), np.int32)
+    pan_n = np.zeros(P, np.int32)
+    ppa_combo = np.zeros((P, MAX_PPA), np.int32)
+    ppa_w = np.zeros((P, MAX_PPA), np.int32)
+    ppa_n = np.zeros(P, np.int32)
+    for i, row in enumerate(pod_rows):
+        for j, (cid, skew, mode) in enumerate(row["ts"]):
+            ts_combo[i, j], ts_skew[i, j], ts_mode[i, j] = cid, skew, mode
+        ts_n[i] = len(row["ts"])
+        for j, (cid, self_match) in enumerate(row["pa"]):
+            pa_combo[i, j], pa_self[i, j] = cid, self_match
+        pa_n[i] = len(row["pa"])
+        for j, cid in enumerate(row["pan"]):
+            pan_combo[i, j] = cid
+        pan_n[i] = len(row["pan"])
+        for j, (cid, w) in enumerate(row["ppa"]):
+            ppa_combo[i, j], ppa_w[i, j] = cid, w
+        ppa_n[i] = len(row["ppa"])
+
+    as_j = {
+        k: jnp.asarray(v)
+        for k, v in dict(
+            combo_dsum=combo_dsum, combo_haskey=combo_haskey,
+            combo_global=combo_global, combo_here=combo_here,
+            combo_key=combo_key, topo_domain=topo_domain,
+            topo_onehot=topo_onehot, topo_unique=topo_unique,
+            ts_combo=ts_combo, ts_skew=ts_skew, ts_mode=ts_mode, ts_n=ts_n,
+            pa_combo=pa_combo, pa_self=pa_self, pa_n=pa_n,
+            pan_combo=pan_combo, pan_n=pan_n,
+            ppa_combo=ppa_combo, ppa_w=ppa_w, ppa_n=ppa_n,
+            ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
+        ).items()
+    }
+    return ConstraintTables(**as_j)

@@ -140,6 +140,7 @@ def evaluate(
     score_plugins: Sequence[Any],
     ctx: BatchContext,
     with_diagnostics: bool = False,
+    extra: Any = None,
 ) -> PlacementResult:
     """One fused scheduling evaluation (traceable; call under jit).
 
@@ -157,7 +158,10 @@ def evaluate(
     mask = valid
     per_filter = []
     for pl in filter_plugins:
-        m = pl.batch_filter(ctx, pods, nodes)
+        if getattr(pl, "needs_extra", False):
+            m = pl.batch_filter(ctx, pods, nodes, extra)
+        else:
+            m = pl.batch_filter(ctx, pods, nodes)
         if with_diagnostics:
             per_filter.append(m)
         mask = mask & m
@@ -170,7 +174,10 @@ def evaluate(
     totals = jnp.zeros((P, N), jnp.int32)
     per_score = []
     for pl in score_plugins:
-        s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))
+        if getattr(pl, "needs_extra", False):
+            s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}), extra)
+        else:
+            s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))
         s = pl.batch_normalize(ctx, s, mask)
         w = s.astype(jnp.int32) * jnp.int32(ctx.weight_of(pl.name()))
         if with_diagnostics:
@@ -224,5 +231,5 @@ class FusedEvaluator:
             )
         )
 
-    def __call__(self, pods, nodes) -> PlacementResult:
-        return self._fn(pods, nodes)
+    def __call__(self, pods, nodes, extra: Any = None) -> PlacementResult:
+        return self._fn(pods, nodes, extra=extra)

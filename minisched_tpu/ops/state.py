@@ -97,6 +97,7 @@ def wave_step(
     pre_score_plugins,
     score_plugins,
     ctx,
+    extra=None,
 ) -> Tuple[NodeTable, Any, Any]:
     """One full device step: evaluate a pod wave against the resident
     NodeTable, then commit the placements (SURVEY.md §7 stage 7).
@@ -108,7 +109,8 @@ def wave_step(
     from minisched_tpu.ops.fused import evaluate
 
     result = evaluate(
-        pods, nodes, filter_plugins, pre_score_plugins, score_plugins, ctx
+        pods, nodes, filter_plugins, pre_score_plugins, score_plugins, ctx,
+        extra=extra,
     )
     nodes = apply_placements(nodes, pods, result.choice)
     return nodes, result.choice, result.best_score

@@ -78,6 +78,43 @@ def node_sharding(mesh: Mesh, table: NodeTable):
     return _table_sharding(mesh, table, NODE_AXIS)
 
 
+#: ConstraintTables field → which axis carries the mesh dimension.  Fields
+#: with a leading pod dim split on "pods"; fields whose LAST dim is the
+#: node axis split there; small per-combo/key vectors replicate.
+_CONSTRAINT_AXES = {
+    "combo_dsum": ("last", NODE_AXIS),
+    "combo_haskey": ("last", NODE_AXIS),
+    "combo_here": ("last", NODE_AXIS),
+    "combo_global": ("rep", None),
+    "combo_key": ("rep", None),
+    "topo_domain": ("last", NODE_AXIS),
+    "topo_onehot": ("last", NODE_AXIS),
+    "topo_unique": ("rep", None),
+    "ex_domain": ("last", NODE_AXIS),
+    "pod_matches_ex": ("first", POD_AXIS),
+}
+
+
+def constraint_sharding(mesh: Mesh, extra: Any) -> Any:
+    """NamedSharding pytree for a ConstraintTables bundle: node-axis planes
+    split with the node table, per-pod constraint arrays with the pod table,
+    small combo metadata replicated."""
+    from dataclasses import fields as dc_fields
+
+    specs = {}
+    for f in dc_fields(type(extra)):
+        leaf = getattr(extra, f.name)
+        kind, axis = _CONSTRAINT_AXES.get(f.name, ("first", POD_AXIS))
+        if kind == "rep":
+            spec = P()
+        elif kind == "last":
+            spec = P(*((None,) * (leaf.ndim - 1)), axis)
+        else:
+            spec = P(axis, *((None,) * (leaf.ndim - 1)))
+        specs[f.name] = NamedSharding(mesh, spec)
+    return type(extra)(**specs)
+
+
 def shard_tables(
     mesh: Mesh, pods: PodTable, nodes: NodeTable
 ) -> Tuple[PodTable, NodeTable]:
@@ -105,32 +142,33 @@ def sharded_wave_step(
     scatter-add's collectives; the node table stays resident and sharded
     across waves (donated so updates are in-place).
     """
-    from functools import partial
-
     from minisched_tpu.ops.state import wave_step
 
-    step = partial(
-        wave_step,
-        filter_plugins=tuple(filter_plugins),
-        pre_score_plugins=tuple(pre_score_plugins),
-        score_plugins=tuple(score_plugins),
-        ctx=ctx,
+    chains = (
+        tuple(filter_plugins),
+        tuple(pre_score_plugins),
+        tuple(score_plugins),
     )
 
-    def in_shardings(nodes, pods):
-        return (node_sharding(mesh, nodes), pod_sharding(mesh, pods))
+    def step(nodes, pods, extra=None):
+        return wave_step(nodes, pods, *chains, ctx, extra=extra)
 
     class _Compiled:
         def __init__(self):
             self._jitted = None
 
-        def __call__(self, nodes, pods):
+        def __call__(self, nodes, pods, extra=None):
             if self._jitted is None:
+                shardings = [node_sharding(mesh, nodes), pod_sharding(mesh, pods)]
+                if extra is not None:
+                    shardings.append(constraint_sharding(mesh, extra))
                 self._jitted = jax.jit(
                     step,
-                    in_shardings=in_shardings(nodes, pods),
+                    in_shardings=tuple(shardings),
                     donate_argnums=(0,),
                 )
+            if extra is not None:
+                return self._jitted(nodes, pods, extra)
             return self._jitted(nodes, pods)
 
     return _Compiled()

@@ -29,6 +29,22 @@ from minisched_tpu.models import tables
 NAME = "NodeAffinity"
 
 
+def node_affinity_eligible(pod: Any, node: Any) -> Tuple[bool, str]:
+    """Does ``node`` pass the pod's spec.nodeSelector + required affinity?
+    Returns (eligible, reason) — also used by PodTopologySpread's
+    eligible-node gating (upstream requiredSchedulingTerm.Match)."""
+    labels = node.metadata.labels
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False, "node(s) didn't match Pod's node selector"
+    aff = pod.spec.affinity
+    na = aff.node_affinity if aff is not None else None
+    if na is not None and na.required_terms is not None:
+        if not any(term.matches(labels) for term in na.required_terms):
+            return False, "node(s) didn't match Pod's node affinity"
+    return True, ""
+
+
 class NodeAffinity(Plugin, BatchEvaluable):
     def name(self) -> str:
         return NAME
@@ -38,19 +54,9 @@ class NodeAffinity(Plugin, BatchEvaluable):
         node = node_info.node
         if node is None:
             return Status.unresolvable("node not found")
-        labels = node.metadata.labels
-        for k, v in pod.spec.node_selector.items():
-            if labels.get(k) != v:
-                return Status.unresolvable(
-                    "node(s) didn't match Pod's node selector"
-                ).with_plugin(NAME)
-        aff = pod.spec.affinity
-        na = aff.node_affinity if aff is not None else None
-        if na is not None and na.required_terms is not None:
-            if not any(term.matches(labels) for term in na.required_terms):
-                return Status.unresolvable(
-                    "node(s) didn't match Pod's node affinity"
-                ).with_plugin(NAME)
+        ok, reason = node_affinity_eligible(pod, node)
+        if not ok:
+            return Status.unresolvable(reason).with_plugin(NAME)
         return Status.success()
 
     def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
@@ -74,93 +80,11 @@ class NodeAffinity(Plugin, BatchEvaluable):
         ]
 
     # -- batch -------------------------------------------------------------
-    @staticmethod
-    def _terms_match(prefix_arrays, nodes: Any):
-        """bool[P, T]: does term t of pod p match node n — returns (P, T, N).
-
-        prefix_arrays: (key, op, vals, nvals, numval, nreqs) with shapes
-        (P,T,R), (P,T,R), (P,T,R,V), (P,T,R), (P,T,R), (P,T).
-        """
-        key, op, vals, nvals, numval, nreqs = prefix_arrays
-        P, T, R = key.shape
-        N, L = nodes.label_key.shape
-        # label lookup over (P,T,R,N,L), reduced immediately over L.  Node
-        # label keys are unique, so a masked sum *selects* the value of the
-        # (at most one) label slot matching the requirement's key — keeping
-        # every intermediate at rank ≤ 5 with the smallest axes innermost.
-        lab_in_range = (jnp.arange(L)[None, :] < nodes.num_labels[:, None])  # (N,L)
-        key_eq = key[:, :, :, None, None] == nodes.label_key[None, None, None, :, :]
-        present = key_eq & lab_in_range[None, None, None, :, :]  # (P,T,R,N,L)
-        has_key = jnp.any(present, axis=4)  # (P,T,R,N)
-        node_val = jnp.sum(
-            jnp.where(present, nodes.label_value[None, None, None, :, :], 0), axis=4
-        )  # (P,T,R,N) — the node's value-hash for this key (0 if absent)
-        num_ok = present & nodes.label_num_ok[None, None, None, :, :]
-        has_num = jnp.any(num_ok, axis=4)  # (P,T,R,N)
-        node_num = jnp.sum(
-            jnp.where(num_ok, nodes.label_numval[None, None, None, :, :], 0), axis=4
-        )
-        # value-set membership: node's value ∈ operand set (V is tiny)
-        v_in_range = jnp.arange(vals.shape[3])[None, None, None, :] < nvals[:, :, :, None]
-        in_set = has_key & jnp.any(
-            (node_val[:, :, :, :, None] == vals[:, :, :, None, :])
-            & v_in_range[:, :, :, None, :],
-            axis=4,
-        )  # (P,T,R,N)
-        num_gt = has_num & (node_num > numval[:, :, :, None])
-        num_lt = has_num & (node_num < numval[:, :, :, None])
-        op_b = op[:, :, :, None]
-        req_ok = (
-            ((op_b == tables.OP_IN) & in_set)
-            | ((op_b == tables.OP_NOT_IN) & ~in_set)
-            | ((op_b == tables.OP_EXISTS) & has_key)
-            | ((op_b == tables.OP_DOES_NOT_EXIST) & ~has_key)
-            | ((op_b == tables.OP_GT) & num_gt)
-            | ((op_b == tables.OP_LT) & num_lt)
-        )  # (P,T,R,N)
-        req_in_range = (jnp.arange(R)[None, None, :] < nreqs[:, :, None])  # (P,T,R)
-        term_match = jnp.all(req_ok | ~req_in_range[:, :, :, None], axis=2)  # (P,T,N)
-        return term_match
-
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
-        # spec.nodeSelector: AND over (key, value) pairs
-        S = pods.sel_key.shape[1]
-        sel_in_range = jnp.arange(S)[None, :] < pods.num_sel[:, None]  # (P,S)
-        lab_in_range = (
-            jnp.arange(nodes.label_key.shape[1])[None, :]
-            < nodes.num_labels[:, None]
-        )  # (N,L)
-        pair_ok = jnp.any(
-            (pods.sel_key[:, None, :, None] == nodes.label_key[None, :, None, :])
-            & (pods.sel_value[:, None, :, None] == nodes.label_value[None, :, None, :])
-            & lab_in_range[None, :, None, :],
-            axis=3,
-        )  # (P,N,S)
-        sel_ok = jnp.all(pair_ok | ~sel_in_range[:, None, :], axis=2)  # (P,N)
-
-        # required affinity: OR over terms (no terms → pass)
-        term_match = self._terms_match(
-            (
-                pods.aff_key,
-                pods.aff_op,
-                pods.aff_vals,
-                pods.aff_nvals,
-                pods.aff_numval,
-                pods.aff_nreqs,
-            ),
-            nodes,
-        )  # (P,T,N)
-        T = pods.aff_key.shape[1]
-        term_in_range = jnp.arange(T)[None, :] < pods.aff_nterms[:, None]  # (P,T)
-        any_term = jnp.any(term_match & term_in_range[:, :, None], axis=1)  # (P,N)
-        # a required affinity with an empty term list matches nothing —
-        # any_term over zero in-range terms is already False, so gate only
-        # on the requirement's *presence* (upstream MatchNodeSelectorTerms)
-        aff_ok = jnp.where(pods.aff_required[:, None], any_term, True)
-        return sel_ok & aff_ok
+        return required_node_affinity_mask(pods, nodes)
 
     def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
-        term_match = self._terms_match(
+        term_match = terms_match(
             (
                 pods.pref_key,
                 pods.pref_op,
@@ -177,3 +101,99 @@ class NodeAffinity(Plugin, BatchEvaluable):
             term_match & term_in_range[:, :, None], pods.pref_weight[:, :, None], 0
         )
         return jnp.sum(weights, axis=1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Module-level batch helpers — also used by PodTopologySpread's node
+# eligibility (upstream computes spread domains only over nodes passing the
+# pod's nodeSelector/required affinity)
+# ---------------------------------------------------------------------------
+
+
+def terms_match(prefix_arrays, nodes: Any):
+    """Evaluate encoded NodeSelectorTerms against the node label table.
+
+    prefix_arrays: (key, op, vals, nvals, numval, nreqs) with shapes
+    (P,T,R), (P,T,R), (P,T,R,V), (P,T,R), (P,T,R), (P,T).
+    Returns bool[P, T, N]: term t of pod p matches node n.
+    """
+    key, op, vals, nvals, numval, nreqs = prefix_arrays
+    P, T, R = key.shape
+    N, L = nodes.label_key.shape
+    # label lookup over (P,T,R,N,L), reduced immediately over L.  Node
+    # label keys are unique, so a masked sum *selects* the value of the
+    # (at most one) label slot matching the requirement's key — keeping
+    # every intermediate at rank ≤ 5 with the smallest axes innermost.
+    lab_in_range = (jnp.arange(L)[None, :] < nodes.num_labels[:, None])  # (N,L)
+    key_eq = key[:, :, :, None, None] == nodes.label_key[None, None, None, :, :]
+    present = key_eq & lab_in_range[None, None, None, :, :]  # (P,T,R,N,L)
+    has_key = jnp.any(present, axis=4)  # (P,T,R,N)
+    node_val = jnp.sum(
+        jnp.where(present, nodes.label_value[None, None, None, :, :], 0), axis=4
+    )  # (P,T,R,N) — the node's value-hash for this key (0 if absent)
+    num_ok = present & nodes.label_num_ok[None, None, None, :, :]
+    has_num = jnp.any(num_ok, axis=4)  # (P,T,R,N)
+    node_num = jnp.sum(
+        jnp.where(num_ok, nodes.label_numval[None, None, None, :, :], 0), axis=4
+    )
+    # value-set membership: node's value ∈ operand set (V is tiny)
+    v_in_range = jnp.arange(vals.shape[3])[None, None, None, :] < nvals[:, :, :, None]
+    in_set = has_key & jnp.any(
+        (node_val[:, :, :, :, None] == vals[:, :, :, None, :])
+        & v_in_range[:, :, :, None, :],
+        axis=4,
+    )  # (P,T,R,N)
+    num_gt = has_num & (node_num > numval[:, :, :, None])
+    num_lt = has_num & (node_num < numval[:, :, :, None])
+    op_b = op[:, :, :, None]
+    req_ok = (
+        ((op_b == tables.OP_IN) & in_set)
+        | ((op_b == tables.OP_NOT_IN) & ~in_set)
+        | ((op_b == tables.OP_EXISTS) & has_key)
+        | ((op_b == tables.OP_DOES_NOT_EXIST) & ~has_key)
+        | ((op_b == tables.OP_GT) & num_gt)
+        | ((op_b == tables.OP_LT) & num_lt)
+    )  # (P,T,R,N)
+    req_in_range = (jnp.arange(R)[None, None, :] < nreqs[:, :, None])  # (P,T,R)
+    term_match = jnp.all(req_ok | ~req_in_range[:, :, :, None], axis=2)  # (P,T,N)
+    return term_match
+
+
+def required_node_affinity_mask(pods: Any, nodes: Any):
+    """bool[P, N]: node passes the pod's spec.nodeSelector AND required
+    node affinity (the NodeAffinity filter predicate)."""
+    # spec.nodeSelector: AND over (key, value) pairs
+    S = pods.sel_key.shape[1]
+    sel_in_range = jnp.arange(S)[None, :] < pods.num_sel[:, None]  # (P,S)
+    lab_in_range = (
+        jnp.arange(nodes.label_key.shape[1])[None, :]
+        < nodes.num_labels[:, None]
+    )  # (N,L)
+    pair_ok = jnp.any(
+        (pods.sel_key[:, None, :, None] == nodes.label_key[None, :, None, :])
+        & (pods.sel_value[:, None, :, None] == nodes.label_value[None, :, None, :])
+        & lab_in_range[None, :, None, :],
+        axis=3,
+    )  # (P,N,S)
+    sel_ok = jnp.all(pair_ok | ~sel_in_range[:, None, :], axis=2)  # (P,N)
+
+    # required affinity: OR over terms (no terms → pass)
+    term_match = terms_match(
+        (
+            pods.aff_key,
+            pods.aff_op,
+            pods.aff_vals,
+            pods.aff_nvals,
+            pods.aff_numval,
+            pods.aff_nreqs,
+        ),
+        nodes,
+    )  # (P,T,N)
+    T = pods.aff_key.shape[1]
+    term_in_range = jnp.arange(T)[None, :] < pods.aff_nterms[:, None]  # (P,T)
+    any_term = jnp.any(term_match & term_in_range[:, :, None], axis=1)  # (P,N)
+    # a required affinity with an empty term list matches nothing —
+    # any_term over zero in-range terms is already False, so gate only
+    # on the requirement's *presence* (upstream MatchNodeSelectorTerms)
+    aff_ok = jnp.where(pods.aff_required[:, None], any_term, True)
+    return sel_ok & aff_ok

@@ -39,7 +39,9 @@ def _ensure_builtins() -> None:
     if "NodeUnschedulable" in _REGISTRY:
         return
     from minisched_tpu.plugins.imagelocality import ImageLocality
+    from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
     from minisched_tpu.plugins.nodeaffinity import NodeAffinity
+    from minisched_tpu.plugins.podtopologyspread import PodTopologySpread
     from minisched_tpu.plugins.nodename import NodeName
     from minisched_tpu.plugins.nodenumber import NodeNumber
     from minisched_tpu.plugins.nodeports import NodePorts
@@ -67,6 +69,8 @@ def _ensure_builtins() -> None:
     register("NodeName", lambda args, ts: NodeName())
     register("NodePorts", lambda args, ts: NodePorts())
     register("ImageLocality", lambda args, ts: ImageLocality())
+    register("InterPodAffinity", lambda args, ts: InterPodAffinity())
+    register("PodTopologySpread", lambda args, ts: PodTopologySpread())
 
 
 @dataclass

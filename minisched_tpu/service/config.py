@@ -119,15 +119,25 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
                 PluginEnabled("NodeAffinity"),
                 PluginEnabled("NodePorts"),
                 PluginEnabled("NodeResourcesFit"),
+                PluginEnabled("InterPodAffinity"),
+                PluginEnabled("PodTopologySpread"),
             ]
         ),
-        pre_score=PluginSet(enabled=[PluginEnabled("ImageLocality")]),
+        pre_score=PluginSet(
+            enabled=[
+                PluginEnabled("ImageLocality"),
+                PluginEnabled("InterPodAffinity"),
+                PluginEnabled("PodTopologySpread"),
+            ]
+        ),
         score=PluginSet(
             enabled=[
                 PluginEnabled("NodeResourcesBalancedAllocation", weight=1),
                 PluginEnabled("ImageLocality", weight=1),
+                PluginEnabled("InterPodAffinity", weight=1),
                 PluginEnabled("NodeResourcesLeastAllocated", weight=1),
                 PluginEnabled("NodeAffinity", weight=1),
+                PluginEnabled("PodTopologySpread", weight=2),
                 PluginEnabled("TaintToleration", weight=3),
             ]
         ),

@@ -1,0 +1,334 @@
+"""Cross-pod constraint plugins (InterPodAffinity + PodTopologySpread):
+unit behavior + oracle/kernel parity — BASELINE config 4."""
+
+from __future__ import annotations
+
+import random
+
+from minisched_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from minisched_tpu.plugins.podtopologyspread import PodTopologySpread
+
+from tests.test_parity import batch_placements, oracle_placements
+
+
+def _zone_nodes(n_per_zone=2, zones=("a", "b", "c")):
+    nodes = []
+    for z in zones:
+        for i in range(n_per_zone):
+            nodes.append(
+                make_node(f"node-{z}{i}", labels={"zone": z, "kubernetes.io/hostname": f"node-{z}{i}"})
+            )
+    return nodes
+
+
+def _assigned(name, node, labels):
+    p = make_pod(name, labels=labels)
+    p.metadata.uid = name
+    p.spec.node_name = node
+    return p
+
+
+def _affinity_pod(name, required=None, anti=None, preferred=None, anti_preferred=None):
+    p = make_pod(name)
+    p.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(
+            required=required or [], preferred=preferred or []
+        ),
+        pod_anti_affinity=PodAntiAffinity(
+            required=anti or [], preferred=anti_preferred or []
+        ),
+    )
+    return p
+
+
+def _term(match_labels, topo="zone"):
+    return PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=match_labels), topology_key=topo
+    )
+
+
+def test_required_affinity_follows_existing_pod():
+    nodes = _zone_nodes()
+    assigned = [_assigned("db", "node-b0", {"app": "db"})]
+    pod = _affinity_pod("web", required=[_term({"app": "db"})])
+    filters = [NodeUnschedulable(), InterPodAffinity()]
+    oracle = oracle_placements([pod], nodes, filters, [], [], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [], [], assigned=assigned)
+    assert oracle == batch
+    assert oracle[0].startswith("node-b")  # must land in db's zone
+
+
+def test_required_affinity_bootstrap_self_match():
+    """No pod matches anywhere but the pod matches its own selector →
+    any node with the topology key qualifies (upstream special case)."""
+    nodes = _zone_nodes()
+    pod = _affinity_pod("first", required=[_term({"app": "web"})])
+    pod.metadata.labels = {"app": "web"}
+    filters = [NodeUnschedulable(), InterPodAffinity()]
+    oracle = oracle_placements([pod], nodes, filters, [], [])
+    batch = batch_placements([pod], nodes, filters, [], [])
+    assert oracle == batch
+    assert oracle[0] != ""
+
+
+def test_required_anti_affinity_avoids_domain():
+    nodes = _zone_nodes()
+    assigned = [_assigned("noisy", "node-a0", {"app": "noisy"})]
+    pod = _affinity_pod("quiet", anti=[_term({"app": "noisy"})])
+    filters = [NodeUnschedulable(), InterPodAffinity()]
+    oracle = oracle_placements([pod], nodes, filters, [], [], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [], [], assigned=assigned)
+    assert oracle == batch
+    assert not oracle[0].startswith("node-a")
+
+
+def test_reverse_anti_affinity_of_existing_pod():
+    """An ASSIGNED pod's anti-affinity term must keep matching incoming
+    pods out of its domain (the reverse direction)."""
+    nodes = _zone_nodes()
+    guard = _affinity_pod("guard", anti=[_term({"app": "web"})])
+    guard.metadata.uid = "guard"
+    guard.spec.node_name = "node-c0"
+    pod = make_pod("web-1", labels={"app": "web"})
+    filters = [NodeUnschedulable(), InterPodAffinity()]
+    oracle = oracle_placements([pod], nodes, filters, [], [], assigned=[guard])
+    batch = batch_placements([pod], nodes, filters, [], [], assigned=[guard])
+    assert oracle == batch
+    assert not oracle[0].startswith("node-c")
+
+
+def test_preferred_affinity_scoring_parity():
+    nodes = _zone_nodes()
+    assigned = [_assigned("cache", "node-b1", {"app": "cache"})]
+    pod = _affinity_pod(
+        "web",
+        preferred=[WeightedPodAffinityTerm(weight=10, term=_term({"app": "cache"}))],
+    )
+    ipa = InterPodAffinity()
+    filters = [NodeUnschedulable(), ipa]
+    oracle = oracle_placements([pod], nodes, filters, [ipa], [ipa], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [ipa], [ipa], assigned=assigned)
+    assert oracle == batch
+    assert oracle[0].startswith("node-b")
+
+
+def test_topology_spread_do_not_schedule():
+    """maxSkew=1 over zones: with 2 pods in zone a and none elsewhere, new
+    matching pods must land in b or c."""
+    nodes = _zone_nodes()
+    assigned = [
+        _assigned("w0", "node-a0", {"app": "web"}),
+        _assigned("w1", "node-a1", {"app": "web"}),
+    ]
+    pod = make_pod("w2", labels={"app": "web"})
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+    ]
+    filters = [NodeUnschedulable(), PodTopologySpread()]
+    oracle = oracle_placements([pod], nodes, filters, [], [], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [], [], assigned=assigned)
+    assert oracle == batch
+    assert oracle[0][5] in ("b", "c")
+
+
+def test_topology_spread_missing_key_rejects():
+    nodes = _zone_nodes() + [make_node("keyless")]
+    pod = make_pod("w", labels={"app": "web"})
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+    ]
+    ts = PodTopologySpread()
+    filters = [NodeUnschedulable(), ts]
+    oracle = oracle_placements([pod], nodes, filters, [], [])
+    batch = batch_placements([pod], nodes, filters, [], [])
+    assert oracle == batch
+    assert oracle[0] != "keyless" and oracle[0] != ""
+
+
+def test_topology_spread_schedule_anyway_scoring():
+    nodes = _zone_nodes()
+    assigned = [
+        _assigned("w0", "node-a0", {"app": "web"}),
+        _assigned("w1", "node-b0", {"app": "web"}),
+    ]
+    pod = make_pod("w2", labels={"app": "web"})
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+    ]
+    ts = PodTopologySpread()
+    filters = [NodeUnschedulable(), ts]
+    oracle = oracle_placements([pod], nodes, filters, [ts], [ts], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [ts], [ts], assigned=assigned)
+    assert oracle == batch
+    assert oracle[0].startswith("node-c")  # the empty zone wins
+
+
+def test_spread_ignores_pods_on_ineligible_nodes():
+    """Upstream PreFilter skips nodes failing the pod's nodeSelector when
+    counting domains: pods piled on an ineligible node must not skew the
+    constraint (regression for an eligibility-gating bug)."""
+    nodes = [
+        make_node("ssd-a", labels={"zone": "a", "disktype": "ssd"}),
+        make_node("ssd-b", labels={"zone": "b", "disktype": "ssd"}),
+        make_node("hdd-b", labels={"zone": "b", "disktype": "hdd"}),
+    ]
+    assigned = [
+        _assigned(f"w{i}", "hdd-b", {"app": "web"}) for i in range(3)
+    ]
+    pod = make_pod("new", labels={"app": "web"})
+    pod.spec.node_selector = {"disktype": "ssd"}
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+    ]
+    from minisched_tpu.plugins.nodeaffinity import NodeAffinity
+
+    ts = PodTopologySpread()
+    filters = [NodeUnschedulable(), NodeAffinity(), ts]
+    oracle = oracle_placements([pod], nodes, filters, [], [], assigned=assigned)
+    batch = batch_placements([pod], nodes, filters, [], [], assigned=assigned)
+    assert oracle == batch
+    # both ssd nodes are feasible (the hdd pods don't count); placement on
+    # either is legal — it must NOT be unschedulable
+    assert oracle[0] in ("ssd-a", "ssd-b")
+
+
+def test_sharded_wave_step_with_constraints():
+    """The mesh path must accept and shard the ConstraintTables."""
+    import jax
+
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.fused import BatchContext
+    from minisched_tpu.parallel.sharding import (
+        make_mesh,
+        shard_tables,
+        sharded_wave_step,
+    )
+
+    nodes = sorted(_zone_nodes(), key=lambda n: n.metadata.name)
+    assigned = [_assigned("noisy", "node-a0", {"app": "noisy"})]
+    pods = [_affinity_pod(f"q{i}", anti=[_term({"app": "noisy"})]) for i in range(6)]
+    by_node = {"node-a0": assigned}
+    node_table, node_names = build_node_table(nodes, by_node)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned,
+        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    )
+    mesh = make_mesh(len(jax.devices()))
+    pod_table, node_table = shard_tables(mesh, pod_table, node_table)
+    ipa = InterPodAffinity()
+    step = sharded_wave_step(mesh, [NodeUnschedulable(), ipa], [], [], BatchContext())
+    _, choice, _ = step(node_table, pod_table, extra)
+    placed = [node_names[c] for c in choice.tolist()[: len(pods)] if c >= 0]
+    assert len(placed) == len(pods)
+    assert all(not n.startswith("node-a") for n in placed)
+
+
+def _random_cross_pod_cluster(rng: random.Random, n_nodes: int, n_assigned: int,
+                              n_pods: int):
+    zones = ["a", "b", "c", "d"]
+    apps = ["web", "db", "cache"]
+    nodes = [
+        make_node(f"node{i:03d}", labels={"zone": rng.choice(zones)})
+        for i in range(n_nodes)
+    ]
+    assigned = []
+    for i in range(n_assigned):
+        p = _assigned(
+            f"asg{i}", rng.choice(nodes).metadata.name, {"app": rng.choice(apps)}
+        )
+        if rng.random() < 0.2:
+            p.spec.affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required=[_term({"app": rng.choice(apps)})]
+                )
+            )
+        assigned.append(p)
+    pods = []
+    for i in range(n_pods):
+        pod = make_pod(f"pod{i}", labels={"app": rng.choice(apps)})
+        r = rng.random()
+        if r < 0.3:
+            pod.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(required=[_term({"app": rng.choice(apps)})])
+            )
+        elif r < 0.5:
+            pod.spec.affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required=[_term({"app": rng.choice(apps)})]
+                )
+            )
+        elif r < 0.7:
+            pod.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randrange(1, 100),
+                            term=_term({"app": rng.choice(apps)}),
+                        )
+                    ]
+                )
+            )
+        if rng.random() < 0.4:
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=rng.choice([1, 2]),
+                    topology_key="zone",
+                    when_unsatisfiable=rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                    label_selector=LabelSelector(match_labels={"app": pod.metadata.labels["app"]}),
+                )
+            ]
+        pods.append(pod)
+    return nodes, assigned, pods
+
+
+def test_parity_config4_randomized():
+    """BASELINE config 4: InterPodAffinity + PodTopologySpread randomized,
+    stateless wave against a pre-populated cluster."""
+    rng = random.Random(44)
+    nodes, assigned, pods = _random_cross_pod_cluster(rng, 24, 30, 40)
+    ipa = InterPodAffinity()
+    ts = PodTopologySpread()
+    filters = [NodeUnschedulable(), ipa, ts]
+    pre_scores = [ipa, ts]
+    scores = [ipa, ts]
+    weights = {"PodTopologySpread": 2}
+    oracle = oracle_placements(pods, nodes, filters, pre_scores, scores, weights,
+                               assigned=assigned)
+    batch = batch_placements(pods, nodes, filters, pre_scores, scores, weights,
+                             assigned=assigned)
+    assert oracle == batch
+    assert any(p != "" for p in oracle)

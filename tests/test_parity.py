@@ -28,9 +28,12 @@ from minisched_tpu.plugins.nodenumber import NodeNumber
 from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
 
 
-def oracle_placements(pods, nodes, filters, pre_scores, scores, weights=None):
+def oracle_placements(pods, nodes, filters, pre_scores, scores, weights=None,
+                      assigned=None):
     """Run the scalar oracle per pod; returns list of node names ('' = unsched)."""
-    node_infos = build_node_infos(sorted(nodes, key=lambda n: n.metadata.name), [])
+    node_infos = build_node_infos(
+        sorted(nodes, key=lambda n: n.metadata.name), list(assigned or [])
+    )
     out = []
     for pod in pods:
         try:
@@ -44,13 +47,25 @@ def oracle_placements(pods, nodes, filters, pre_scores, scores, weights=None):
     return out
 
 
-def batch_placements(pods, nodes, filters, pre_scores, scores, weights=None):
-    node_table, node_names = build_node_table(
-        sorted(nodes, key=lambda n: n.metadata.name)
-    )
+def batch_placements(pods, nodes, filters, pre_scores, scores, weights=None,
+                     assigned=None):
+    from minisched_tpu.models.constraints import build_constraint_tables
+
+    nodes_sorted = sorted(nodes, key=lambda n: n.metadata.name)
+    assigned = list(assigned or [])
+    by_node = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    node_table, node_names = build_node_table(nodes_sorted, by_node)
     pod_table, _ = build_pod_table(pods)
+    extra = None
+    if any(getattr(pl, "needs_extra", False) for pl in filters + scores):
+        extra = build_constraint_tables(
+            pods, nodes_sorted, assigned,
+            pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+        )
     ev = fused.FusedEvaluator(filters, pre_scores, scores, weights)
-    result = ev(pod_table, node_table)
+    result = ev(pod_table, node_table, extra)
     choice = result.choice.tolist()
     return [node_names[c] if c >= 0 else "" for c in choice[: len(pods)]]
 
