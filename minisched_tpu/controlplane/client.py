@@ -69,6 +69,12 @@ class _PodAPI:
     def delete(self, name: str, namespace: Optional[str] = None) -> None:
         self._store.delete(KIND_POD, namespace or self._ns, name)
 
+    def mutate(self, name: str, fn, namespace: Optional[str] = None) -> Pod:
+        """Atomic read-modify-write under the store lock — the safe form of
+        get→clone→update for concurrent writers (e.g. the resultstore's
+        annotation flush racing the binding goroutine)."""
+        return self._store.mutate(KIND_POD, namespace or self._ns, name, fn)
+
     def bind(self, binding: Binding) -> Pod:
         """The binding subresource: sets spec.nodeName exactly once.
 

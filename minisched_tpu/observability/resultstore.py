@@ -1,0 +1,180 @@
+"""Result store: per-decision scheduling results flushed to pod annotations.
+
+Re-creates ``scheduler/plugin/resultstore/store.go`` — the reference's one
+genuinely novel observability mechanism (SURVEY.md §5.5): a thread-safe
+map of pod → node → plugin → {filter reason, raw score, final (normalized ×
+weight) score}; on every pod Update event the pod's accumulated results are
+JSON-serialized onto its own annotations (annotation.py keys) with an
+exponential-backoff-retried update, then dropped from the store
+(store.go:90-135) — "the scheduling framework doesn't have any phase to
+hook scheduling finished" (store.go:60-61), so the pod's own update event
+is the flush trigger.
+
+TPU twist: ``record_batch_result`` ingests a fused-kernel
+``PlacementResult`` produced with diagnostics (ops/fused.py), so the batch
+path emits the SAME per-decision artifact as the scalar path — it doubles
+as the parity-checking record (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from minisched_tpu.observability import annotation
+from minisched_tpu.utils.retry import retry_with_exponential_backoff
+
+PASSED_FILTER_MESSAGE = "passed"  # store.go's success marker
+SUCCESS_MESSAGE = "success"
+
+
+class Store:
+    """store.go:24-69.  All three result kinds keyed [pod key][node][plugin]."""
+
+    def __init__(self, client: Optional[Any] = None):
+        self._mu = threading.Lock()
+        self._filter: Dict[str, Dict[str, Dict[str, str]]] = {}
+        self._score: Dict[str, Dict[str, Dict[str, int]]] = {}
+        self._final: Dict[str, Dict[str, Dict[str, int]]] = {}
+        self._client = client
+
+    # ------------------------------------------------------------------
+    # recording (store.go:171-229)
+    # ------------------------------------------------------------------
+    def add_filter_result(
+        self, pod_key: str, node: str, plugin: str, reason: str
+    ) -> None:
+        with self._mu:
+            self._filter.setdefault(pod_key, {}).setdefault(node, {})[plugin] = reason
+
+    def add_score_result(
+        self, pod_key: str, node: str, plugin: str, score: int
+    ) -> None:
+        with self._mu:
+            self._score.setdefault(pod_key, {}).setdefault(node, {})[plugin] = int(
+                score
+            )
+
+    def add_normalized_score_result(
+        self, pod_key: str, node: str, plugin: str, score: int, weight: int = 1
+    ) -> None:
+        """Final score = normalized score × plugin weight (store.go:208-234)."""
+        with self._mu:
+            self._final.setdefault(pod_key, {}).setdefault(node, {})[plugin] = (
+                int(score) * weight
+            )
+
+    # ------------------------------------------------------------------
+    # reading / lifecycle
+    # ------------------------------------------------------------------
+    def get_data(self, pod_key: str):
+        with self._mu:
+            return (
+                {n: dict(v) for n, v in self._filter.get(pod_key, {}).items()},
+                {n: dict(v) for n, v in self._score.get(pod_key, {}).items()},
+                {n: dict(v) for n, v in self._final.get(pod_key, {}).items()},
+            )
+
+    def has_data(self, pod_key: str) -> bool:
+        with self._mu:
+            return (
+                pod_key in self._filter
+                or pod_key in self._score
+                or pod_key in self._final
+            )
+
+    def delete_data(self, pod_key: str) -> None:
+        """store.go:134's DeleteData."""
+        with self._mu:
+            self._filter.pop(pod_key, None)
+            self._score.pop(pod_key, None)
+            self._final.pop(pod_key, None)
+
+    # ------------------------------------------------------------------
+    # annotation flush (store.go:90-168)
+    # ------------------------------------------------------------------
+    def add_scheduling_result_to_pod(self, old: Any, new: Any) -> None:
+        """Pod-update handler: write the pod's accumulated results onto its
+        annotations with retried updates, then drop them (store.go:90-135).
+        Wire via ``informer_for("Pod").add_event_handlers(on_update=...)``.
+        """
+        if self._client is None:
+            return
+        pod_key = new.metadata.key
+        if not self.has_data(pod_key):
+            return
+        filter_r, score_r, final_r = self.get_data(pod_key)
+
+        def apply(pod: Any) -> Any:
+            pod.metadata.annotations[annotation.FILTER_RESULT] = json.dumps(
+                filter_r, sort_keys=True
+            )
+            pod.metadata.annotations[annotation.SCORE_RESULT] = json.dumps(
+                score_r, sort_keys=True
+            )
+            pod.metadata.annotations[annotation.FINAL_SCORE_RESULT] = json.dumps(
+                final_r, sort_keys=True
+            )
+            return pod
+
+        def try_update() -> bool:
+            # atomic read-modify-write: a plain get→clone→update here would
+            # silently clobber a concurrent bind (last-writer-wins store)
+            try:
+                self._client.pods().mutate(
+                    new.metadata.name, apply, new.metadata.namespace
+                )
+                return True
+            except KeyError:
+                return True  # pod gone; nothing to annotate
+            except Exception:
+                return False  # transient store error: retry (util/retry.go)
+
+        retry_with_exponential_backoff(try_update)
+        self.delete_data(pod_key)
+
+    # ------------------------------------------------------------------
+    # batch (TPU) ingestion
+    # ------------------------------------------------------------------
+    def record_batch_result(
+        self,
+        result: Any,
+        pod_keys: Sequence[str],
+        node_names: Sequence[str],
+        filter_plugin_names: Sequence[str],
+        score_plugin_names: Sequence[str],
+        reasons: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Ingest a diagnostics-enabled fused evaluation (ops/fused.py
+        ``PlacementResult`` with ``filter_masks``/``score_matrices``) so a
+        wave's decisions carry the same per-plugin record as scalar cycles.
+
+        ``reasons``: plugin name → rejection reason string (defaults to the
+        plugin name itself).
+        """
+        reasons = reasons or {}
+        masks = (
+            None if result.filter_masks is None else result.filter_masks.tolist()
+        )
+        scores = (
+            None if result.score_matrices is None else result.score_matrices.tolist()
+        )
+        for pi, pod_key in enumerate(pod_keys):
+            for ni, node in enumerate(node_names):
+                if masks is not None:
+                    for ki, plugin in enumerate(filter_plugin_names):
+                        ok = masks[ki][pi][ni]
+                        self.add_filter_result(
+                            pod_key,
+                            node,
+                            plugin,
+                            PASSED_FILTER_MESSAGE
+                            if ok
+                            else reasons.get(plugin, plugin),
+                        )
+                if scores is not None:
+                    for ki, plugin in enumerate(score_plugin_names):
+                        self.add_normalized_score_result(
+                            pod_key, node, plugin, scores[ki][pi][ni]
+                        )

@@ -154,21 +154,25 @@ def sharded_wave_step(
         return wave_step(nodes, pods, *chains, ctx, extra=extra)
 
     class _Compiled:
+        """One jitted executable per call signature (with/without the
+        constraint tables) — waves may alternate between the two."""
+
         def __init__(self):
-            self._jitted = None
+            self._jitted = {}
 
         def __call__(self, nodes, pods, extra=None):
-            if self._jitted is None:
+            key = extra is not None
+            if key not in self._jitted:
                 shardings = [node_sharding(mesh, nodes), pod_sharding(mesh, pods)]
                 if extra is not None:
                     shardings.append(constraint_sharding(mesh, extra))
-                self._jitted = jax.jit(
+                self._jitted[key] = jax.jit(
                     step,
                     in_shardings=tuple(shardings),
                     donate_argnums=(0,),
                 )
             if extra is not None:
-                return self._jitted(nodes, pods, extra)
-            return self._jitted(nodes, pods)
+                return self._jitted[key](nodes, pods, extra)
+            return self._jitted[key](nodes, pods)
 
     return _Compiled()

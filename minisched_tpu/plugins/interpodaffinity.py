@@ -40,6 +40,10 @@ from minisched_tpu.framework.types import (
     Status,
 )
 from minisched_tpu.models.constraints import _matches, _term_namespaces
+from minisched_tpu.plugins.normalize import (
+    minmax_normalize_batch,
+    minmax_normalize_scalar,
+)
 
 NAME = "InterPodAffinity"
 PRE_FILTER_KEY = "PreFilter" + NAME
@@ -77,14 +81,7 @@ class _Normalize:
     equal → 0."""
 
     def normalize_score(self, state: CycleState, pod: Any, scores: NodeScoreList) -> Status:
-        if not scores:
-            return Status.success()
-        lo = min(ns.score for ns in scores)
-        hi = max(ns.score for ns in scores)
-        for ns in scores:
-            ns.score = (
-                MAX_NODE_SCORE * (ns.score - lo) // (hi - lo) if hi > lo else 0
-            )
+        minmax_normalize_scalar(scores, reverse=False, fill=0)
         return Status.success()
 
 
@@ -243,9 +240,4 @@ class InterPodAffinity(Plugin, BatchEvaluable):
         ).astype(jnp.int32)
 
     def batch_normalize(self, ctx: Any, scores, mask):
-        big = jnp.iinfo(jnp.int32).max
-        lo = jnp.min(jnp.where(mask, scores, big), axis=1, keepdims=True)
-        hi = jnp.max(jnp.where(mask, scores, -big), axis=1, keepdims=True)
-        spread = hi - lo
-        out = MAX_NODE_SCORE * (scores - lo) // jnp.maximum(spread, 1)
-        return jnp.where(spread > 0, out, 0).astype(jnp.int32)
+        return minmax_normalize_batch(scores, mask, reverse=False, fill=0)

@@ -1,0 +1,47 @@
+"""Shared min-max score normalization — scalar and batch forms.
+
+Both cross-pod plugins rescale raw scores to [0, MAX_NODE_SCORE] with a
+min-max over the feasible nodes; they differ only in direction (InterPod-
+Affinity: higher raw is better; PodTopologySpread: fewer co-located
+matches is better) and the all-equal fill value.  One implementation per
+form keeps the two plugins' rounding identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.types import MAX_NODE_SCORE, NodeScoreList
+
+
+def minmax_normalize_scalar(
+    scores: NodeScoreList, reverse: bool, fill: int
+) -> None:
+    """In-place min-max rescale of a NodeScoreList; all-equal → ``fill``."""
+    if not scores:
+        return
+    lo = min(ns.score for ns in scores)
+    hi = max(ns.score for ns in scores)
+    for ns in scores:
+        if hi == lo:
+            ns.score = fill
+        elif reverse:
+            ns.score = MAX_NODE_SCORE * (hi - ns.score) // (hi - lo)
+        else:
+            ns.score = MAX_NODE_SCORE * (ns.score - lo) // (hi - lo)
+
+
+def minmax_normalize_batch(scores: Any, mask: Any, reverse: bool, fill: int):
+    """Mask-aware batch form: min/max taken over feasible nodes only;
+    identical floor-division rounding to the scalar form."""
+    big = jnp.iinfo(jnp.int32).max
+    lo = jnp.min(jnp.where(mask, scores, big), axis=1, keepdims=True)
+    hi = jnp.max(jnp.where(mask, scores, -big), axis=1, keepdims=True)
+    spread = hi - lo
+    if reverse:
+        out = MAX_NODE_SCORE * (hi - scores) // jnp.maximum(spread, 1)
+    else:
+        out = MAX_NODE_SCORE * (scores - lo) // jnp.maximum(spread, 1)
+    return jnp.where(spread > 0, out, fill).astype(jnp.int32)

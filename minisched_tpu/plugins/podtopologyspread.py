@@ -24,7 +24,7 @@ eligibility kernel.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -38,6 +38,10 @@ from minisched_tpu.framework.types import (
     Status,
 )
 from minisched_tpu.models.constraints import TS_DO_NOT_SCHEDULE, _matches
+from minisched_tpu.plugins.normalize import (
+    minmax_normalize_batch,
+    minmax_normalize_scalar,
+)
 from minisched_tpu.plugins.nodeaffinity import (
     node_affinity_eligible,
     required_node_affinity_mask,
@@ -56,13 +60,14 @@ _INF = 1 << 30
 
 
 def _constraint_counts(constraint, pod, node_infos: List[NodeInfo],
-                       eligible_only: bool = False):
+                       eligible: Optional[Dict[str, bool]] = None):
     """Count assigned pods matching the constraint's selector (same
     namespace) per topology-domain value.
 
-    ``eligible_only`` restricts counting to nodes passing the pod's
-    nodeSelector/required node affinity — upstream's PreFilter skips
-    ineligible nodes entirely (its Score pass does not).
+    ``eligible`` (node name → bool, precomputed once per pod) restricts
+    counting to nodes passing the pod's nodeSelector/required node
+    affinity — upstream's PreFilter skips ineligible nodes entirely (its
+    Score pass does not).
     """
     nss = (pod.metadata.namespace,)
     counts: Dict[str, int] = {}
@@ -70,7 +75,7 @@ def _constraint_counts(constraint, pod, node_infos: List[NodeInfo],
         val = ni.node.metadata.labels.get(constraint.topology_key)
         if val is None:
             continue
-        if eligible_only and not node_affinity_eligible(pod, ni.node)[0]:
+        if eligible is not None and not eligible.get(ni.name, False):
             continue
         n = sum(1 for p in ni.pods if _matches(constraint.label_selector, nss, p))
         if n:
@@ -83,16 +88,7 @@ class _Normalize:
     all equal → MAX_NODE_SCORE."""
 
     def normalize_score(self, state: CycleState, pod: Any, scores: NodeScoreList) -> Status:
-        if not scores:
-            return Status.success()
-        lo = min(ns.score for ns in scores)
-        hi = max(ns.score for ns in scores)
-        for ns in scores:
-            ns.score = (
-                MAX_NODE_SCORE * (hi - ns.score) // (hi - lo)
-                if hi > lo
-                else MAX_NODE_SCORE
-            )
+        minmax_normalize_scalar(scores, reverse=True, fill=MAX_NODE_SCORE)
         return Status.success()
 
 
@@ -107,14 +103,24 @@ class PodTopologySpread(Plugin, BatchEvaluable):
         self, state: CycleState, pod: Any, node_infos: List[NodeInfo]
     ) -> Status:
         hard = []  # (constraint, counts, min_count or None)
+        eligible = None
+        if any(
+            c.when_unsatisfiable == "DoNotSchedule"
+            for c in pod.spec.topology_spread_constraints
+        ):
+            # one eligibility evaluation per node, shared by all constraints
+            eligible = {
+                ni.name: node_affinity_eligible(pod, ni.node)[0]
+                for ni in node_infos
+            }
         for c in pod.spec.topology_spread_constraints:
             if c.when_unsatisfiable != "DoNotSchedule":
                 continue
-            counts = _constraint_counts(c, pod, node_infos, eligible_only=True)
+            counts = _constraint_counts(c, pod, node_infos, eligible=eligible)
             # min over domains represented among ELIGIBLE nodes with the key
             min_count = None
             for ni in node_infos:
-                if not node_affinity_eligible(pod, ni.node)[0]:
+                if not eligible.get(ni.name, False):
                     continue
                 val = ni.node.metadata.labels.get(c.topology_key)
                 if val is None:
@@ -236,9 +242,6 @@ class PodTopologySpread(Plugin, BatchEvaluable):
         ).astype(jnp.int32)
 
     def batch_normalize(self, ctx: Any, scores, mask):
-        big = jnp.iinfo(jnp.int32).max
-        lo = jnp.min(jnp.where(mask, scores, big), axis=1, keepdims=True)
-        hi = jnp.max(jnp.where(mask, scores, -big), axis=1, keepdims=True)
-        spread = hi - lo
-        out = MAX_NODE_SCORE * (hi - scores) // jnp.maximum(spread, 1)
-        return jnp.where(spread > 0, out, MAX_NODE_SCORE).astype(jnp.int32)
+        return minmax_normalize_batch(
+            scores, mask, reverse=True, fill=MAX_NODE_SCORE
+        )

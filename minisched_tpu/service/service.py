@@ -23,13 +23,47 @@ class SchedulerService:
         self._scheduler: Optional[Scheduler] = None
         self._factory: Optional[SharedInformerFactory] = None
         self.recorder = EventRecorder()
+        self.result_store = None  # set by start_scheduler(record_results=True)
+        self._record_results = False
 
     # scheduler/scheduler.go:50-80
-    def start_scheduler(self, cfg: Optional[SchedulerConfig] = None) -> Scheduler:
+    def start_scheduler(
+        self,
+        cfg: Optional[SchedulerConfig] = None,
+        record_results: bool = False,
+    ) -> Scheduler:
+        """``record_results=True`` swaps plugins for their simulator-wrapped
+        versions and flushes per-decision results onto pod annotations —
+        the reference ships this layer but never wires it into
+        StartScheduler (SURVEY.md §2 row 8: test-only); here it's opt-in.
+        The store is exposed as ``self.result_store``.
+        """
         if self._scheduler is not None:
             raise RuntimeError("scheduler already running; use restart_scheduler")
         cfg = (cfg or default_scheduler_config()).clone()  # deep-copy, :61
+        orig_cfg = cfg.clone()  # pre-conversion: what restart re-applies
         self._factory = SharedInformerFactory(self._client.store)
+        if record_results:
+            from minisched_tpu.controlplane.informer import ResourceEventHandlers
+            from minisched_tpu.observability.resultstore import Store
+            from minisched_tpu.plugins.simulator import (
+                convert_configuration_for_simulator,
+                register_simulator_plugins,
+            )
+
+            self.result_store = Store(self._client)
+            register_simulator_plugins(
+                self.result_store,
+                {e.name: e.weight for e in cfg.score.enabled},
+            )
+            cfg = convert_configuration_for_simulator(cfg)
+            # flush hook: pod Update events write results to annotations
+            # (store.go:62-67)
+            self._factory.informer_for("Pod").add_event_handlers(
+                ResourceEventHandlers(
+                    on_update=self.result_store.add_scheduling_result_to_pod
+                )
+            )
         sched = build_scheduler_from_config(self._client, self._factory, cfg)
         self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")
         self._factory.start()
@@ -37,13 +71,16 @@ class SchedulerService:
             raise RuntimeError("informer caches failed to sync")
         sched.run()
         self._scheduler = sched
-        self._current_cfg = cfg
+        self._current_cfg = orig_cfg
+        self._record_results = record_results
         return sched
 
     # scheduler/scheduler.go:40-47
     def restart_scheduler(self, cfg: Optional[SchedulerConfig] = None) -> Scheduler:
         self.shutdown_scheduler()
-        return self.start_scheduler(cfg or self._current_cfg)
+        return self.start_scheduler(
+            cfg or self._current_cfg, record_results=self._record_results
+        )
 
     # scheduler/scheduler.go:82-87
     def shutdown_scheduler(self) -> None:

@@ -1,0 +1,41 @@
+"""Retry with exponential backoff.
+
+Re-creates ``util/retry.go:18-26`` (RetryWithExponentialBackOff wrapping
+wait.ExponentialBackoff): 100ms initial delay, factor 3, 6 steps — the
+policy the resultstore uses to flush annotations (store.go:120-128).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+INITIAL_DURATION_S = 0.1  # util/retry.go:11
+FACTOR = 3.0  # util/retry.go:12
+JITTER = 0.0  # util/retry.go:13 (jitter 0.1 upstream; 0 keeps tests exact)
+STEPS = 6  # util/retry.go:14
+
+
+class RetryTimeoutError(Exception):
+    """All backoff steps exhausted without the fn reporting success."""
+
+
+def retry_with_exponential_backoff(
+    fn: Callable[[], bool],
+    initial_duration_s: float = INITIAL_DURATION_S,
+    factor: float = FACTOR,
+    steps: int = STEPS,
+    sleep: Callable[[float], None] = time.sleep,
+) -> None:
+    """Call ``fn`` until it returns True; sleep initial*factor^i between
+    attempts; raise RetryTimeoutError after ``steps`` attempts.  ``fn``
+    raising propagates immediately (matches wait.ExponentialBackoff's
+    error passthrough)."""
+    delay = initial_duration_s
+    for step in range(steps):
+        if fn():
+            return
+        if step < steps - 1:
+            sleep(delay)
+            delay *= factor
+    raise RetryTimeoutError(f"retry exhausted after {steps} steps")
