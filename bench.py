@@ -1,23 +1,31 @@
-"""Benchmark: pods scheduled/sec at 10k nodes × 100k pods (BASELINE.json).
+"""Benchmark: the five BASELINE.json configs on whatever device JAX gives.
 
-Runs the fused TPU scheduling step (filter → score → seeded argmax →
-commit) over pod waves against a resident 10k-node table, on whatever
-device JAX provides (the driver runs this on one real TPU chip).
+Headline (the ONE stdout JSON line the driver records): pods scheduled/sec
+at 10k nodes × 100k pods — the fused wave evaluator (filter → score →
+seeded argmax → commit) against a resident node table.  ``vs_baseline`` is
+the speedup over the sequential scalar oracle, the faithful re-creation of
+the reference's Go filter→score→selectHost loop (the reference publishes
+no numbers of its own — BASELINE.md), measured on a pod subsample and
+extrapolated.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is the speedup over the sequential scalar oracle — the
-faithful re-creation of the reference's Go filter→score→selectHost loop
-(the reference publishes no numbers of its own, BASELINE.md) — measured
-here on a pod subsample against the same 10k nodes and extrapolated.
+Secondary configs (BASELINE.json:6-12), reported on stderr:
+  1. README scenario (9 unschedulable nodes, event-driven bind)
+  2. 1k × 1k nodenumber wave
+  3. resource bin-packing (Fit + LeastAllocated) in SEQUENTIAL scan mode —
+     bind-dependent scores need sequential semantics for parity; prefix-
+     checked against the stateful oracle
+  4. InterPodAffinity + PodTopologySpread wave with constraint tables
+  5. the headline run
 
 Knobs (env): BENCH_NODES (10000), BENCH_PODS (100000), BENCH_WAVE (8192),
-BENCH_ORACLE_PODS (30).
+BENCH_ORACLE_PODS (30), BENCH_SECONDARY (1 = run configs 1-4).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import sys
 import time
 from functools import partial
@@ -27,7 +35,207 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def _mk_cluster(n_nodes: int, n_pods: int, seed: int = 1234, unsched: float = 0.2):
+    from minisched_tpu.api.objects import make_node, make_pod
+
+    rng = random.Random(seed)
+    nodes = sorted(
+        (
+            make_node(f"node{i:05d}", unschedulable=rng.random() < unsched)
+            for i in range(n_nodes)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    pods = [make_pod(f"pod{i}") for i in range(n_pods)]
+    return nodes, pods
+
+
+def bench_config1() -> None:
+    """README scenario via the live engine (sched.go:70-143)."""
+    from minisched_tpu.scenario.runner import ScenarioHarness, readme_scenario
+    from minisched_tpu.service.config import default_scheduler_config
+
+    t0 = time.monotonic()
+    with ScenarioHarness(default_scheduler_config(time_scale=0.01)) as h:
+        bound = readme_scenario(h, log=lambda *_: None)
+    assert bound == "node10"
+    log(f"[config1] README scenario (event-driven bind): {time.monotonic() - t0:.2f}s")
+
+
+def bench_config2() -> None:
+    """1k nodes × 1k pods, nodenumber chain, one wave."""
+    import jax
+
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.fused import FusedEvaluator
+    from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    nodes, pods = _mk_cluster(1000, 1000, seed=2)
+    node_table, _ = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    nn = NodeNumber()
+    ev = FusedEvaluator([NodeUnschedulable()], [nn], [nn])
+    jax.block_until_ready(ev(pod_table, node_table).choice)  # compile
+    t0 = time.monotonic()
+    res = ev(pod_table, node_table)
+    jax.block_until_ready(res.choice)
+    dt = time.monotonic() - t0
+    log(f"[config2] 1k×1k nodenumber wave: {dt*1e3:.1f}ms → {1000/dt:,.0f} pods/s")
+
+
+def bench_config3() -> None:
+    """Resource bin-packing, sequential scan (bind-exact), 4k nodes."""
+    import jax
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.sequential import SequentialScheduler
+    from minisched_tpu.plugins.noderesources import (
+        NodeResourcesFit,
+        NodeResourcesLeastAllocated,
+    )
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    rng = random.Random(3)
+    n_nodes, n_pods = 4096, int(os.environ.get("BENCH_SCAN_PODS", 4096))
+    nodes = sorted(
+        (
+            make_node(
+                f"node{i:05d}",
+                capacity={"cpu": rng.choice(["4", "8"]), "memory": "16Gi", "pods": 110},
+            )
+            for i in range(n_nodes)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    pods = [
+        make_pod(
+            f"pod{i}",
+            requests={"cpu": rng.choice(["500m", "1", "2"]), "memory": "2Gi"},
+        )
+        for i in range(n_pods)
+    ]
+    node_table, node_names = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    sched = SequentialScheduler(
+        [NodeUnschedulable(), NodeResourcesFit()], [], [NodeResourcesLeastAllocated()]
+    )
+    t0 = time.monotonic()
+    _, choice, _ = sched(node_table, pod_table)
+    jax.block_until_ready(choice)
+    compile_dt = time.monotonic() - t0
+    t0 = time.monotonic()
+    _, choice, _ = sched(node_table, pod_table)
+    jax.block_until_ready(choice)
+    dt = time.monotonic() - t0
+    placed = int((choice >= 0).sum())
+    log(
+        f"[config3] {n_nodes} nodes × {n_pods} pods Fit+LeastAllocated "
+        f"SEQUENTIAL scan: {dt:.2f}s → {n_pods/dt:,.0f} pods/s "
+        f"({placed} placed; compile {compile_dt:.1f}s)"
+    )
+
+    # prefix parity vs the stateful oracle (scan placements only depend on
+    # earlier pods, so a prefix check is exact)
+    k = int(os.environ.get("BENCH_PARITY_PODS", 24))
+    from tests.test_sequential import oracle_sequential  # reuse the harness
+
+    oracle = oracle_sequential(
+        pods[:k], nodes, [NodeUnschedulable(), NodeResourcesFit()], [],
+        [NodeResourcesLeastAllocated()],
+    )
+    got = [node_names[c] if c >= 0 else "" for c in choice.tolist()[:k]]
+    assert oracle == got, f"config3 parity FAILED: {oracle} != {got}"
+    log(f"[config3] prefix parity vs stateful oracle OK ({k} pods)")
+
+
+def bench_config4() -> None:
+    """InterPodAffinity + PodTopologySpread wave with constraint tables."""
+    import jax
+
+    from minisched_tpu.api.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+        make_node,
+        make_pod,
+    )
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.fused import FusedEvaluator
+    from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+    from minisched_tpu.plugins.podtopologyspread import PodTopologySpread
+
+    rng = random.Random(4)
+    zones = [f"z{i}" for i in range(8)]
+    n_nodes, n_pods = 2048, 2048
+    nodes = sorted(
+        (
+            make_node(f"node{i:05d}", labels={"zone": rng.choice(zones)})
+            for i in range(n_nodes)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    assigned = []
+    for i in range(512):
+        p = make_pod(f"asg{i}", labels={"app": f"app{rng.randrange(8)}"})
+        p.metadata.uid = f"asg{i}"
+        p.spec.node_name = rng.choice(nodes).metadata.name
+        assigned.append(p)
+    pods = []
+    for i in range(n_pods):
+        app = f"app{rng.randrange(8)}"
+        pod = make_pod(f"pod{i}", labels={"app": app})
+        pod.spec.affinity = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels={"app": app}),
+                        topology_key="zone",
+                    )
+                ]
+            )
+        )
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=2,
+                topology_key="zone",
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector(match_labels={"app": app}),
+            )
+        ]
+        pods.append(pod)
+    by_node = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    t0 = time.monotonic()
+    node_table, _ = build_node_table(nodes, by_node)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned,
+        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    )
+    build_dt = time.monotonic() - t0
+    ipa, ts = InterPodAffinity(), PodTopologySpread()
+    ev = FusedEvaluator([NodeUnschedulable(), ipa, ts], [], [ipa, ts])
+    jax.block_until_ready(ev(pod_table, node_table, extra).choice)  # compile
+    t0 = time.monotonic()
+    res = ev(pod_table, node_table, extra)
+    jax.block_until_ready(res.choice)
+    dt = time.monotonic() - t0
+    placed = int((res.choice >= 0).sum())
+    log(
+        f"[config4] {n_nodes} nodes × {n_pods} pods affinity+spread wave: "
+        f"{dt*1e3:.1f}ms → {n_pods/dt:,.0f} pods/s ({placed} placed; "
+        f"host constraint build {build_dt:.1f}s)"
+    )
+
+
+def bench_headline() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
     n_pods = int(os.environ.get("BENCH_PODS", 100_000))
     wave = int(os.environ.get("BENCH_WAVE", 8_192))
@@ -35,7 +243,6 @@ def main() -> None:
 
     import jax
 
-    from minisched_tpu.api.objects import make_node, make_pod
     from minisched_tpu.engine.scheduler import schedule_pod_once
     from minisched_tpu.framework.nodeinfo import build_node_infos
     from minisched_tpu.framework.types import FitError
@@ -45,20 +252,8 @@ def main() -> None:
     from minisched_tpu.plugins.nodenumber import NodeNumber
     from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
 
-    log(f"devices: {jax.devices()}")
-
-    import random
-
-    rng = random.Random(1234)
     log(f"building cluster: {n_nodes} nodes, {n_pods} pods ...")
-    nodes = sorted(
-        (
-            make_node(f"node{i:05d}", unschedulable=rng.random() < 0.2)
-            for i in range(n_nodes)
-        ),
-        key=lambda n: n.metadata.name,
-    )
-    pods = [make_pod(f"pod{i}") for i in range(n_pods)]
+    nodes, pods = _mk_cluster(n_nodes, n_pods)
 
     t0 = time.monotonic()
     node_table, node_names = build_node_table(nodes)
@@ -90,7 +285,6 @@ def main() -> None:
     del warm_nodes
     log(f"compile+warmup: {time.monotonic() - t0:.1f}s")
 
-    # timed run: device wall-clock over all waves, placements fetched
     node_table = jax.device_put(node_host)
     t0 = time.monotonic()
     placed = 0
@@ -104,8 +298,8 @@ def main() -> None:
         placed += int((c >= 0).sum())
     pods_per_sec = n_pods / elapsed
     log(
-        f"scheduled {n_pods} pods ({placed} placed) against {n_nodes} nodes "
-        f"in {elapsed:.3f}s → {pods_per_sec:,.0f} pods/s"
+        f"[config5/headline] scheduled {n_pods} pods ({placed} placed) against "
+        f"{n_nodes} nodes in {elapsed:.3f}s → {pods_per_sec:,.0f} pods/s"
     )
 
     # baseline: the sequential scalar oracle (the Go-loop re-creation) on a
@@ -135,6 +329,18 @@ def main() -> None:
             }
         )
     )
+
+
+def main() -> None:
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    if os.environ.get("BENCH_SECONDARY", "1") != "0":
+        bench_config1()
+        bench_config2()
+        bench_config3()
+        bench_config4()
+    bench_headline()
 
 
 if __name__ == "__main__":

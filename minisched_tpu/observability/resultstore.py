@@ -23,7 +23,10 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from minisched_tpu.observability import annotation
-from minisched_tpu.utils.retry import retry_with_exponential_backoff
+from minisched_tpu.utils.retry import (
+    RetryTimeoutError,
+    retry_with_exponential_backoff,
+)
 
 PASSED_FILTER_MESSAGE = "passed"  # store.go's success marker
 SUCCESS_MESSAGE = "success"
@@ -91,6 +94,18 @@ class Store:
             self._score.pop(pod_key, None)
             self._final.pop(pod_key, None)
 
+    def take_data(self, pod_key: str):
+        """Atomically pop the pod's results (one lock hold) — the flush
+        takes its snapshot out of the store FIRST so results recorded
+        concurrently (a re-scheduling attempt racing the flush) are never
+        silently discarded: they stay for the next flush trigger."""
+        with self._mu:
+            return (
+                self._filter.pop(pod_key, {}),
+                self._score.pop(pod_key, {}),
+                self._final.pop(pod_key, {}),
+            )
+
     # ------------------------------------------------------------------
     # annotation flush (store.go:90-168)
     # ------------------------------------------------------------------
@@ -104,7 +119,10 @@ class Store:
         pod_key = new.metadata.key
         if not self.has_data(pod_key):
             return
-        filter_r, score_r, final_r = self.get_data(pod_key)
+        # pop-then-flush: on retry exhaustion the snapshot is dropped (and
+        # logged) rather than left behind — a persistently-failing pod must
+        # not re-stall the informer dispatch thread on every later event
+        filter_r, score_r, final_r = self.take_data(pod_key)
 
         def apply(pod: Any) -> Any:
             pod.metadata.annotations[annotation.FILTER_RESULT] = json.dumps(
@@ -131,8 +149,16 @@ class Store:
             except Exception:
                 return False  # transient store error: retry (util/retry.go)
 
-        retry_with_exponential_backoff(try_update)
-        self.delete_data(pod_key)
+        try:
+            retry_with_exponential_backoff(try_update)
+        except RetryTimeoutError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "dropping scheduling results for %s: annotation flush "
+                "retries exhausted",
+                pod_key,
+            )
 
     # ------------------------------------------------------------------
     # batch (TPU) ingestion
@@ -152,29 +178,59 @@ class Store:
 
         ``reasons``: plugin name → rejection reason string (defaults to the
         plugin name itself).
+
+        Cost note: the record is O(pods × nodes × plugins) of Python dict
+        entries by design — the reference's artifact has the same shape
+        (a full node map per pod, store.go:90-135).  Dicts are built
+        outside the lock and installed with ONE lock hold per pod; at
+        headline wave sizes (8k × 10k) record selectively, not every wave.
         """
+        import numpy as np
+
         reasons = reasons or {}
         masks = (
-            None if result.filter_masks is None else result.filter_masks.tolist()
+            None
+            if result.filter_masks is None
+            else np.asarray(result.filter_masks)
         )
-        scores = (
-            None if result.score_matrices is None else result.score_matrices.tolist()
+        finals = (
+            None
+            if result.score_matrices is None
+            else np.asarray(result.score_matrices)
+        )
+        raws = (
+            None
+            if result.raw_score_matrices is None
+            else np.asarray(result.raw_score_matrices)
         )
         for pi, pod_key in enumerate(pod_keys):
+            filt: Dict[str, Dict[str, str]] = {}
+            score: Dict[str, Dict[str, int]] = {}
+            final: Dict[str, Dict[str, int]] = {}
             for ni, node in enumerate(node_names):
                 if masks is not None:
-                    for ki, plugin in enumerate(filter_plugin_names):
-                        ok = masks[ki][pi][ni]
-                        self.add_filter_result(
-                            pod_key,
-                            node,
-                            plugin,
+                    filt[node] = {
+                        plugin: (
                             PASSED_FILTER_MESSAGE
-                            if ok
-                            else reasons.get(plugin, plugin),
+                            if masks[ki, pi, ni]
+                            else reasons.get(plugin, plugin)
                         )
-                if scores is not None:
-                    for ki, plugin in enumerate(score_plugin_names):
-                        self.add_normalized_score_result(
-                            pod_key, node, plugin, scores[ki][pi][ni]
-                        )
+                        for ki, plugin in enumerate(filter_plugin_names)
+                    }
+                if raws is not None:
+                    score[node] = {
+                        plugin: int(raws[ki, pi, ni])
+                        for ki, plugin in enumerate(score_plugin_names)
+                    }
+                if finals is not None:
+                    final[node] = {
+                        plugin: int(finals[ki, pi, ni])
+                        for ki, plugin in enumerate(score_plugin_names)
+                    }
+            with self._mu:
+                if filt:
+                    self._filter.setdefault(pod_key, {}).update(filt)
+                if score:
+                    self._score.setdefault(pod_key, {}).update(score)
+                if final:
+                    self._final.setdefault(pod_key, {}).update(final)

@@ -112,8 +112,12 @@ class PlacementResult:
     #: filter plugins).  Present only when the evaluator was built with
     #: ``with_diagnostics=True``.
     filter_masks: Optional[Any] = None
-    #: i32[K, P, N] per-score-plugin weighted matrices (diagnostics).
+    #: i32[K, P, N] per-score-plugin normalized × weighted matrices
+    #: (diagnostics).
     score_matrices: Optional[Any] = None
+    #: i32[K, P, N] per-score-plugin RAW matrices, pre-normalize/pre-weight
+    #: (diagnostics) — the batch analog of the scalar AddScoreResult record.
+    raw_score_matrices: Optional[Any] = None
 
     def tree_flatten(self):
         return (
@@ -123,6 +127,7 @@ class PlacementResult:
                 self.feasible_count,
                 self.filter_masks,
                 self.score_matrices,
+                self.raw_score_matrices,
             ),
             None,
         )
@@ -173,11 +178,14 @@ def evaluate(
     P, N = mask.shape
     totals = jnp.zeros((P, N), jnp.int32)
     per_score = []
+    per_raw = []
     for pl in score_plugins:
         if getattr(pl, "needs_extra", False):
             s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}), extra)
         else:
             s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))
+        if with_diagnostics:
+            per_raw.append(s.astype(jnp.int32))
         s = pl.batch_normalize(ctx, s, mask)
         w = s.astype(jnp.int32) * jnp.int32(ctx.weight_of(pl.name()))
         if with_diagnostics:
@@ -191,6 +199,7 @@ def evaluate(
         feasible_count=mask.sum(axis=1).astype(jnp.int32),
         filter_masks=jnp.stack(per_filter) if per_filter else None,
         score_matrices=jnp.stack(per_score) if per_score else None,
+        raw_score_matrices=jnp.stack(per_raw) if per_raw else None,
     )
 
 

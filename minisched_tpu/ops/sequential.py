@@ -1,0 +1,104 @@
+"""Sequential device engine: bind-exact scheduling via ``lax.scan``.
+
+The reference's loop schedules ONE pod per cycle, so every pod sees the
+binds of all pods before it (minisched/minisched.go:32-113).  The wave
+evaluator (ops/fused.py + ops/state.py) is the throughput mode — all pods
+against the pre-wave state — which is bit-exact only for plugin chains
+whose decisions don't depend on earlier binds (e.g. NodeUnschedulable +
+NodeNumber).  For bind-dependent chains (NodeResourcesFit/LeastAllocated,
+NodePorts, …) THIS module is the parity mode: a ``lax.scan`` over the pod
+axis where each step evaluates one pod row (still fully vectorized over
+nodes — the per-step kernel is a (1, N) slice of the same fused chain) and
+commits the placement into the carried NodeTable before the next step.
+
+One compiled program schedules the whole table: 100k pods = one scan of
+100k fused steps, no host round-trips (SURVEY.md §7 hard part 2 — the
+sequential-bind-vs-batch semantic, solved by making the device loop
+sequential rather than approximating with repair passes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from minisched_tpu.models.tables import NodeTable, PodTable
+from minisched_tpu.ops.fused import BatchContext, evaluate
+from minisched_tpu.ops.state import apply_placements
+
+
+def _slice_pod(pods: PodTable, i) -> PodTable:
+    """One-row PodTable view at index i (dynamic, traceable)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0), pods
+    )
+
+
+def scan_schedule(
+    nodes: NodeTable,
+    pods: PodTable,
+    filter_plugins: Sequence[Any],
+    pre_score_plugins: Sequence[Any],
+    score_plugins: Sequence[Any],
+    ctx: BatchContext,
+) -> Tuple[NodeTable, Any, Any]:
+    """Schedule every pod in order with sequential-bind semantics.
+
+    Returns (final NodeTable, choice i32[P], best_score i32[P]) — the
+    placements the reference's one-pod-at-a-time loop would produce,
+    computed in one jitted scan.  Cross-pod (``needs_extra``) plugins are
+    not supported here yet — their coupling state would need per-step
+    updates; use the wave path with per-wave table rebuilds for those.
+    """
+    for pl in (*filter_plugins, *score_plugins):
+        if getattr(pl, "needs_extra", False):
+            raise NotImplementedError(
+                f"sequential scan does not support cross-pod plugin "
+                f"{pl.name()} yet"
+            )
+
+    def step(carry_nodes, i):
+        pod_row = _slice_pod(pods, i)
+        result = evaluate(
+            pod_row,
+            carry_nodes,
+            filter_plugins,
+            pre_score_plugins,
+            score_plugins,
+            ctx,
+        )
+        carry_nodes = apply_placements(carry_nodes, pod_row, result.choice)
+        return carry_nodes, (result.choice[0], result.best_score[0])
+
+    nodes, (choice, best) = jax.lax.scan(
+        step, nodes, jnp.arange(pods.valid.shape[0])
+    )
+    return nodes, choice, best
+
+
+class SequentialScheduler:
+    """Compiled wrapper (the scan analog of FusedEvaluator)."""
+
+    def __init__(
+        self,
+        filter_plugins: Sequence[Any],
+        pre_score_plugins: Sequence[Any],
+        score_plugins: Sequence[Any],
+        weights: Optional[dict] = None,
+    ):
+        ctx = BatchContext(weights=tuple(sorted((weights or {}).items())))
+        self._fn = jax.jit(
+            partial(
+                scan_schedule,
+                filter_plugins=tuple(filter_plugins),
+                pre_score_plugins=tuple(pre_score_plugins),
+                score_plugins=tuple(score_plugins),
+                ctx=ctx,
+            )
+        )
+
+    def __call__(self, nodes: NodeTable, pods: PodTable):
+        return self._fn(nodes, pods)

@@ -389,7 +389,8 @@ def test_record_batch_result_from_diagnostics():
         ["NodeNumber"],
         reasons={"NodeUnschedulable": "node(s) were unschedulable"},
     )
-    filt, _, final = store.get_data("default/p1")
+    filt, score, final = store.get_data("default/p1")
     assert filt["n0"]["NodeUnschedulable"] == "node(s) were unschedulable"
     assert filt["n1"]["NodeUnschedulable"] == PASSED_FILTER_MESSAGE
+    assert score["n1"]["NodeNumber"] == 10  # raw score (pre-normalize)
     assert final["n1"]["NodeNumber"] == 10

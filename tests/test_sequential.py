@@ -1,0 +1,120 @@
+"""Sequential scan engine: bind-exact parity with the stateful oracle.
+
+The wave evaluator is stateless within a wave; the reference's loop is
+sequential — each pod sees all earlier binds.  These tests run the scalar
+oracle WITH binds applied between pods, and assert the device scan
+produces identical placements (BASELINE config 3/5 semantics)."""
+
+from __future__ import annotations
+
+import random
+
+from minisched_tpu.api.objects import Container, make_node, make_pod
+from minisched_tpu.engine.scheduler import schedule_pod_once
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import FitError
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.ops.sequential import SequentialScheduler
+from minisched_tpu.plugins.nodenumber import NodeNumber
+from minisched_tpu.plugins.nodeports import NodePorts
+from minisched_tpu.plugins.noderesources import (
+    NodeResourcesBalancedAllocation,
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+)
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+from tests.test_plugins_resources import _resource_cluster
+
+
+def oracle_sequential(pods, nodes, filters, pre_scores, scores, weights=None):
+    """Scalar oracle with sequential-bind semantics: each placement is
+    committed to the NodeInfo snapshot before the next pod."""
+    node_infos = build_node_infos(sorted(nodes, key=lambda n: n.metadata.name), [])
+    by_name = {ni.name: ni for ni in node_infos}
+    out = []
+    for pod in pods:
+        try:
+            name = schedule_pod_once(
+                filters, pre_scores, scores, weights or {}, pod, node_infos
+            )
+        except FitError:
+            out.append("")
+            continue
+        out.append(name)
+        bound = pod.clone()
+        bound.spec.node_name = name
+        by_name[name].add_pod(bound)
+    return out
+
+
+def scan_sequential(pods, nodes, filters, pre_scores, scores, weights=None):
+    node_table, node_names = build_node_table(
+        sorted(nodes, key=lambda n: n.metadata.name)
+    )
+    pod_table, _ = build_pod_table(pods)
+    sched = SequentialScheduler(filters, pre_scores, scores, weights)
+    _, choice, _ = sched(node_table, pod_table)
+    return [node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]]
+
+
+def test_sequential_binds_fill_nodes_in_order():
+    """Three 1-cpu pods onto two 1-cpu nodes: the third must be rejected —
+    a stateless wave would place all three."""
+    nodes = [
+        make_node(f"n{i}", capacity={"cpu": "1", "memory": "4Gi", "pods": 10})
+        for i in range(2)
+    ]
+    pods = [make_pod(f"p{i}", requests={"cpu": "1"}) for i in range(3)]
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    scores = [NodeResourcesLeastAllocated()]
+    oracle = oracle_sequential(pods, nodes, filters, [], scores)
+    scan = scan_sequential(pods, nodes, filters, [], scores)
+    assert oracle == scan
+    assert sorted([oracle[0], oracle[1]]) == ["n0", "n1"]
+    assert oracle[2] == ""
+
+
+def test_sequential_port_claims_are_seen_by_later_pods():
+    nodes = [make_node("n0"), make_node("n1")]
+    pods = []
+    for i in range(3):
+        p = make_pod(f"p{i}")
+        p.spec.containers = [Container(ports=[8080])]
+        pods.append(p)
+    filters = [NodeUnschedulable(), NodePorts()]
+    oracle = oracle_sequential(pods, nodes, filters, [], [])
+    scan = scan_sequential(pods, nodes, filters, [], [])
+    assert oracle == scan
+    assert sorted([oracle[0], oracle[1]]) == ["n0", "n1"]
+    assert oracle[2] == ""  # both nodes' port taken
+
+
+def test_sequential_parity_config3_randomized():
+    """BASELINE config 3 semantics: Fit + LeastAllocated + Balanced with
+    binds applied — scores shift as nodes fill; placements must match the
+    stateful oracle bit-exactly."""
+    rng = random.Random(55)
+    nodes, pods = _resource_cluster(rng, 24, 60)
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    scores = [NodeResourcesLeastAllocated(), NodeResourcesBalancedAllocation()]
+    weights = {"NodeResourcesBalancedAllocation": 2}
+    oracle = oracle_sequential(pods, nodes, filters, [], scores, weights)
+    scan = scan_sequential(pods, nodes, filters, [], scores, weights)
+    assert oracle == scan
+    assert any(p == "" for p in oracle) and any(p != "" for p in oracle)
+
+
+def test_sequential_matches_wave_for_bind_independent_chain():
+    """For the NodeNumber chain (decisions independent of binds) the scan
+    and the wave evaluator agree — the wave mode's parity precondition."""
+    from tests.test_parity import batch_placements
+
+    rng = random.Random(56)
+    nodes = [make_node(f"node{i}") for i in range(20)]
+    pods = [make_pod(f"pod{rng.randrange(1000)}{i % 10}") for i in range(30)]
+    nn = NodeNumber()
+    filters = [NodeUnschedulable()]
+    scan = scan_sequential(pods, nodes, filters, [nn], [nn])
+    wave = batch_placements(pods, nodes, filters, [nn], [nn])
+    assert scan == wave
