@@ -1,0 +1,29 @@
+# Build/run harness (the reference's Makefile:1-27 + hack/ scripts, minus
+# etcd — the fast path runs on the in-memory control plane).
+
+NATIVE_SRC := native/tablebuilder.cc
+NATIVE_SO  := minisched_tpu/native/libminisched_native.so
+
+.PHONY: test native start bench clean
+
+test: native
+	python -m pytest tests/ -q
+
+# native host-table kernels (auto-built on first import too; this target
+# is for explicit/offline builds)
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_SRC)
+	g++ -O2 -shared -fPIC -o $@ $<
+
+# the README scenario on the live engine (the reference's `make start`,
+# hack/start_simulator.sh:35 — no etcd/env vars needed here)
+start: native
+	python -m minisched_tpu.scenario.runner
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(NATIVE_SO)
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -122,11 +122,11 @@ def bench_config3() -> None:
         [NodeUnschedulable(), NodeResourcesFit()], [], [NodeResourcesLeastAllocated()]
     )
     t0 = time.monotonic()
-    _, choice, _ = sched(node_table, pod_table)
+    _, choice, _ = sched(pod_table, node_table)
     jax.block_until_ready(choice)
     compile_dt = time.monotonic() - t0
     t0 = time.monotonic()
-    _, choice, _ = sched(node_table, pod_table)
+    _, choice, _ = sched(pod_table, node_table)
     jax.block_until_ready(choice)
     dt = time.monotonic() - t0
     placed = int((choice >= 0).sum())
@@ -139,14 +139,17 @@ def bench_config3() -> None:
     # prefix parity vs the stateful oracle (scan placements only depend on
     # earlier pods, so a prefix check is exact)
     k = int(os.environ.get("BENCH_PARITY_PODS", 24))
-    from tests.test_sequential import oracle_sequential  # reuse the harness
+    from minisched_tpu.engine.scheduler import schedule_pods_sequentially
+    from minisched_tpu.framework.nodeinfo import build_node_infos
 
-    oracle = oracle_sequential(
-        pods[:k], nodes, [NodeUnschedulable(), NodeResourcesFit()], [],
-        [NodeResourcesLeastAllocated()],
+    oracle = schedule_pods_sequentially(
+        [NodeUnschedulable(), NodeResourcesFit()], [],
+        [NodeResourcesLeastAllocated()], {}, pods[:k],
+        build_node_infos(nodes, []),
     )
     got = [node_names[c] if c >= 0 else "" for c in choice.tolist()[:k]]
-    assert oracle == got, f"config3 parity FAILED: {oracle} != {got}"
+    if oracle != got:
+        raise SystemExit(f"config3 parity FAILED: {oracle} != {got}")
     log(f"[config3] prefix parity vs stateful oracle OK ({k} pods)")
 
 

@@ -198,6 +198,42 @@ def schedule_pod_once(
     return node_infos[idx].name
 
 
+def schedule_pods_sequentially(
+    filter_plugins: List[Any],
+    pre_score_plugins: List[Any],
+    score_plugins: List[Any],
+    score_weights: Dict[str, int],
+    pods: List[Pod],
+    node_infos: List[NodeInfo],
+) -> List[str]:
+    """Scalar oracle with sequential-bind semantics: each placement is
+    committed into the NodeInfo snapshot before the next pod — exactly the
+    reference loop's visibility (minisched.go:32-113, one pod per cycle).
+    Returns one node name per pod ('' = unschedulable).  This is the
+    parity ground truth for the device scan engine (ops/sequential.py).
+    """
+    by_name = {ni.name: ni for ni in node_infos}
+    out: List[str] = []
+    for pod in pods:
+        try:
+            name = schedule_pod_once(
+                filter_plugins,
+                pre_score_plugins,
+                score_plugins,
+                score_weights,
+                pod,
+                node_infos,
+            )
+        except FitError:
+            out.append("")
+            continue
+        out.append(name)
+        bound = pod.clone()
+        bound.spec.node_name = name
+        by_name[name].add_pod(bound)
+    return out
+
+
 class Scheduler:
     """The engine (minisched/initialize.go:18-29's Scheduler struct)."""
 

