@@ -1,0 +1,112 @@
+"""Checkpoint / resume: durable snapshots of the cluster state store.
+
+The reference delegates durability entirely to etcd behind the apiserver
+(SURVEY.md §5.4: k8sapiserver.go:93-105; docker-compose.yml volume) —
+scheduler-internal state is in-memory and a restart repopulates from the
+store via informer re-list (scheduler.go:40-47).  This module is the
+in-memory control plane's equivalent of that durable layer: the ObjectStore
+serializes to a language-neutral JSON document and restores from it; device
+tables are never checkpointed — they are reconstructed from the store
+(SURVEY.md §5.4 "cluster state store is the checkpoint; device arrays are
+reconstructable").
+
+Serialization is generic over the api.objects dataclasses via type-hint
+recursion, so new spec fields checkpoint automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Dict, Optional, get_args, get_origin, get_type_hints
+
+from minisched_tpu.api import objects
+from minisched_tpu.controlplane.store import ObjectStore
+
+CHECKPOINT_VERSION = 1
+
+#: kind string → top-level dataclass
+KIND_TYPES = {
+    "Node": objects.Node,
+    "Pod": objects.Pod,
+    "PersistentVolume": objects.PersistentVolume,
+    "PersistentVolumeClaim": objects.PersistentVolumeClaim,
+}
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _decode(args[0], data)
+    if origin in (list, tuple):
+        (item_tp,) = get_args(tp)[:1] or (Any,)
+        return [_decode(item_tp, v) for v in data]
+    if origin is dict:
+        _, val_tp = get_args(tp) or (Any, Any)
+        return {k: _decode(val_tp, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(tp):
+        hints = get_type_hints(tp)
+        kwargs = {
+            f.name: _decode(hints[f.name], data[f.name])
+            for f in dataclasses.fields(tp)
+            if f.name in data
+        }
+        return tp(**kwargs)
+    return data
+
+
+def snapshot_store(store: ObjectStore) -> Dict[str, Any]:
+    """Serialize every object (all kinds) + the resource version."""
+    doc: Dict[str, Any] = {
+        "version": CHECKPOINT_VERSION,
+        "resource_version": store.resource_version,
+        "objects": {},
+    }
+    for kind in KIND_TYPES:
+        objs = store.list(kind)
+        if objs:
+            doc["objects"][kind] = [_encode(o) for o in objs]
+    return doc
+
+
+def save_checkpoint(store: ObjectStore, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(snapshot_store(store), f)
+
+
+def restore_store(
+    doc: Dict[str, Any], store: Optional[ObjectStore] = None
+) -> ObjectStore:
+    """Rebuild an ObjectStore from a snapshot document.  Objects are
+    re-created through ``create`` so watchers attached afterwards replay a
+    consistent cache (informer re-list semantics, scheduler.go:72-73)."""
+    if doc.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {doc.get('version')!r}")
+    store = store or ObjectStore()
+    for kind, items in doc.get("objects", {}).items():
+        tp = KIND_TYPES[kind]
+        for data in items:
+            store.create(kind, _decode(tp, data))
+    return store
+
+
+def load_checkpoint(path: str, store: Optional[ObjectStore] = None) -> ObjectStore:
+    with open(path) as f:
+        return restore_store(json.load(f), store)

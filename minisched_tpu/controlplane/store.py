@@ -99,8 +99,17 @@ class ObjectStore:
         self._objects: Dict[str, Dict[str, Any]] = {}  # kind -> key -> obj
         self._watches: Dict[str, List[Watch]] = {}
         self._rv = 0
+        #: fault-injection hook (SURVEY.md §5.3 — the reference has none):
+        #: called as (op, kind, key) before every mutation; raising makes
+        #: the mutation fail exactly as a flaky apiserver/etcd would.
+        self.fault_injector: Optional[Callable[[str, str, str], None]] = None
 
     # -- helpers -----------------------------------------------------------
+    def _maybe_fault(self, op: str, kind: str, key: str) -> None:
+        fi = self.fault_injector  # one read: the hook may be cleared mid-call
+        if fi is not None:
+            fi(op, kind, key)
+
     @staticmethod
     def _key(obj: Any) -> str:
         return obj.metadata.key
@@ -118,6 +127,7 @@ class ObjectStore:
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = self._key(obj)
+            self._maybe_fault("create", kind, key)
             if key in objs:
                 raise KeyError(f"{kind} {key!r} already exists")
             stored = obj.clone()
@@ -146,6 +156,7 @@ class ObjectStore:
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = self._key(obj)
+            self._maybe_fault("update", kind, key)
             old = objs.get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
@@ -161,6 +172,7 @@ class ObjectStore:
         with self._lock:
             objs = self._objects.get(kind, {})
             key = f"{namespace}/{name}"
+            self._maybe_fault("delete", kind, key)
             old = objs.pop(key, None)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")

@@ -22,6 +22,7 @@ instead of a full re-list per cycle (minisched.go:40).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from minisched_tpu.api.objects import Binding, Pod
@@ -280,8 +281,13 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._bind_threads: List[threading.Thread] = []
-        # observability hook: fn(pod, node_name_or_None, status)
+        # observability hooks: fn(pod, node_name_or_None, status), and
+        # per-phase timing — assign a profiling.CycleMetrics to collect
+        # (the default is a no-op null object)
         self.on_decision: Optional[Callable[[Any, Optional[str], Status], None]] = None
+        from minisched_tpu.observability.profiling import NULL_METRICS
+
+        self.metrics: Any = NULL_METRICS
 
         eventhandlers.add_all_event_handlers(
             self, informer_factory, unioned_gvks(self.event_map)
@@ -333,23 +339,30 @@ class Scheduler:
             return False
         pod = qpi.pod
         state = CycleState()
-        node_infos = self.snapshot_nodes()
+        t_cycle = time.monotonic()
+        with self.metrics.timed("snapshot"):
+            node_infos = self.snapshot_nodes()
 
         try:
-            node_name = self._schedule_pod(state, pod, node_infos, qpi)
+            with self.metrics.timed("schedule"):
+                node_name = self._schedule_pod(state, pod, node_infos, qpi)
         except Exception as err:
             self.error_func(qpi, err)
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))
+            self.metrics.observe("cycle_failed", time.monotonic() - t_cycle)
             return True
 
         # permit phase (minisched.go:89-94)
-        status = self.run_permit_plugins(state, pod, node_name)
+        with self.metrics.timed("permit"):
+            status = self.run_permit_plugins(state, pod, node_name)
         if not status.is_success() and not status.is_wait():
             self.error_func(qpi, status.as_error(), plugin=status.plugin)
             if self.on_decision:
                 self.on_decision(pod, None, status)
+            self.metrics.observe("cycle_failed", time.monotonic() - t_cycle)
             return True
+        self.metrics.observe("cycle", time.monotonic() - t_cycle)
 
         # binding cycle forked; the loop continues (minisched.go:96-112)
         t = threading.Thread(
@@ -452,13 +465,15 @@ class Scheduler:
 
     def _binding_cycle(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
         try:
-            status = self.wait_on_permit(pod)
+            with self.metrics.timed("wait_on_permit"):
+                status = self.wait_on_permit(pod)
             if not status.is_success():
                 self.error_func(qpi, status.as_error(), plugin=status.plugin)
                 if self.on_decision:
                     self.on_decision(pod, None, status)
                 return
-            self.bind(pod, node_name)
+            with self.metrics.timed("bind"):
+                self.bind(pod, node_name)
             if self.on_decision:
                 self.on_decision(pod, node_name, Status.success())
         except Exception as err:

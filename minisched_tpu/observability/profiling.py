@@ -1,0 +1,113 @@
+"""Profiling: per-cycle phase timings + device tracing.
+
+The reference has NO tracing/profiling at all (SURVEY.md §5.1 — only klog
+prints in the loop, minisched/minisched.go:33-87).  This module supplies
+the missing layer: a lock-protected per-phase timing aggregator the engine
+feeds (scheduling latency is the product metric — it's what the headline
+benchmark reports), plus a thin wrapper over the JAX profiler for device
+traces of the fused kernels.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class PhaseStats:
+    __slots__ = ("count", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def observe(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class CycleMetrics:
+    """Per-phase wall-clock aggregates for the scheduling loop.
+
+    Attach to an engine: ``sched.metrics = CycleMetrics()`` — schedule_one
+    then times snapshot / schedule / permit (and binds report themselves).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._phases: Dict[str, PhaseStats] = {}
+
+    def observe(self, phase: str, dt: float) -> None:
+        with self._mu:
+            self._phases.setdefault(phase, PhaseStats()).observe(dt)
+
+    @contextlib.contextmanager
+    def timed(self, phase: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(phase, time.monotonic() - t0)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._mu:
+            return {
+                name: {
+                    "count": s.count,
+                    "total_s": s.total_s,
+                    "mean_s": s.mean_s,
+                    "max_s": s.max_s,
+                }
+                for name, s in self._phases.items()
+            }
+
+    def report(self) -> str:
+        lines = []
+        for name, s in sorted(self.snapshot().items()):
+            lines.append(
+                f"{name}: n={s['count']} mean={s['mean_s']*1e3:.2f}ms "
+                f"max={s['max_s']*1e3:.2f}ms total={s['total_s']:.3f}s"
+            )
+        return "\n".join(lines)
+
+
+class NullMetrics:
+    """No-op stand-in so the engine can call ``metrics.timed(...)``
+    unconditionally (assign a real CycleMetrics to start collecting)."""
+
+    def observe(self, phase: str, dt: float) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def timed(self, phase: str) -> Iterator[None]:
+        yield
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {}
+
+    def report(self) -> str:
+        return ""
+
+
+NULL_METRICS = NullMetrics()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """JAX profiler trace around device work (no-op when log_dir is None).
+    View with TensorBoard / xprof."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,181 @@
+"""Auxiliary subsystems (SURVEY.md §5): profiling hooks, checkpoint /
+resume, fault injection.  The reference has none of these (§5.1-5.3) —
+they are required additions for the new build."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from minisched_tpu.api.objects import (
+    Affinity,
+    LabelSelectorRequirement,
+    NodeAffinity,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.controlplane.checkpoint import (
+    load_checkpoint,
+    restore_store,
+    save_checkpoint,
+    snapshot_store,
+)
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.observability.profiling import CycleMetrics
+from minisched_tpu.service.config import default_scheduler_config
+from minisched_tpu.service.service import SchedulerService
+
+
+def _wait(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# profiling (§5.1)
+# ---------------------------------------------------------------------------
+
+
+def test_cycle_metrics_record_phases():
+    client = Client()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    sched.metrics = CycleMetrics()
+    client.nodes().create(make_node("node1"))
+    client.pods().create(make_pod("pod1"))
+    assert _wait(lambda: client.pods().get("pod1").spec.node_name == "node1")
+    snap = sched.metrics.snapshot()
+    svc.shutdown_scheduler()
+    assert snap["cycle"]["count"] >= 1
+    assert snap["schedule"]["count"] >= 1
+    assert snap["snapshot"]["count"] >= 1
+    assert snap["permit"]["count"] >= 1
+    assert snap["bind"]["count"] >= 1
+    assert "cycle" in sched.metrics.report()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume (§5.4)
+# ---------------------------------------------------------------------------
+
+
+def _populated_client() -> Client:
+    client = Client()
+    client.nodes().create(
+        make_node(
+            "node1",
+            labels={"zone": "a", "disks": "3"},
+            taints=[Taint(key="dedicated", value="infra")],
+        )
+    )
+    client.nodes().create(make_node("node2", unschedulable=True))
+    pod = make_pod(
+        "bound", tolerations=[Toleration(key="dedicated", operator="Exists")]
+    )
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinity(
+            required_terms=[
+                NodeSelectorTerm(
+                    match_expressions=[
+                        LabelSelectorRequirement(key="zone", operator="In", values=["a"])
+                    ]
+                )
+            ]
+        )
+    )
+    pod.spec.node_name = "node1"
+    client.pods().create(pod)
+    client.pods().create(make_pod("pending3"))
+    return client
+
+
+def test_checkpoint_roundtrip_preserves_objects(tmp_path):
+    client = _populated_client()
+    path = os.path.join(tmp_path, "ckpt.json")
+    save_checkpoint(client.store, path)
+    with open(path) as f:
+        doc = json.load(f)  # language-neutral JSON, not pickles
+    assert doc["version"] == 1
+
+    restored = load_checkpoint(path)
+    node = restored.get("Node", "", "node1")
+    assert node.spec.taints[0].key == "dedicated"
+    assert node.metadata.labels == {"zone": "a", "disks": "3"}
+    pod = restored.get("Pod", "default", "bound")
+    assert pod.spec.node_name == "node1"
+    assert pod.spec.tolerations[0].operator == "Exists"
+    req = pod.spec.affinity.node_affinity.required_terms[0].match_expressions[0]
+    assert (req.key, req.operator, req.values) == ("zone", "In", ["a"])
+    assert restored.get("Pod", "default", "pending3").spec.node_name == ""
+
+
+def test_scheduler_resumes_from_checkpoint():
+    """Restart-from-checkpoint: a fresh control plane + scheduler over the
+    restored store schedules the still-pending pod (informer re-list
+    repopulates everything — scheduler.go:40-47 semantics)."""
+    doc = snapshot_store(_populated_client().store)
+
+    client = Client(restore_store(doc))
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    # the pending pod can only go to node1 (node2 unschedulable); the bound
+    # pod must stay where it was
+    assert _wait(lambda: client.pods().get("pending3").spec.node_name == "node1")
+    assert client.pods().get("bound").spec.node_name == "node1"
+    svc.shutdown_scheduler()
+
+
+# ---------------------------------------------------------------------------
+# fault injection (§5.3)
+# ---------------------------------------------------------------------------
+
+
+def test_bind_failure_requeues_and_recovers():
+    """An injected apiserver failure on the bind write sends the pod back
+    through ErrorFunc → unschedulableQ; the next cluster event retries it
+    and it binds (failure detection / elastic recovery path)."""
+    client = Client()
+    failures = {"n": 0}
+
+    def flaky(op, kind, key):
+        if op == "update" and kind == "Pod" and failures["n"] < 1:
+            failures["n"] += 1
+            raise RuntimeError("injected: apiserver unavailable")
+
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    client.nodes().create(make_node("node1"))
+    client.store.fault_injector = flaky
+    client.pods().create(make_pod("pod1"))
+    assert _wait(lambda: failures["n"] == 1)
+    # pod parked; a node event makes it schedulable again
+    assert _wait(
+        lambda: svc.scheduler.queue.stats()["unschedulable"] == 1, timeout=5
+    )
+    client.store.fault_injector = None
+    client.nodes().create(make_node("node2"))
+    assert _wait(lambda: client.pods().get("pod1").spec.node_name != "")
+    svc.shutdown_scheduler()
+
+
+def test_create_failure_surfaces_to_caller():
+    client = Client()
+    client.store.fault_injector = lambda op, kind, key: (_ for _ in ()).throw(
+        RuntimeError("injected")
+    ) if op == "create" and kind == "Node" else None
+    try:
+        client.nodes().create(make_node("n1"))
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+    client.store.fault_injector = None
+    client.nodes().create(make_node("n1"))  # recovers
