@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import typing
 from typing import Any, Dict, Optional, get_args, get_origin, get_type_hints
 
@@ -73,37 +74,49 @@ def _decode(tp: Any, data: Any) -> Any:
 
 
 def snapshot_store(store: ObjectStore) -> Dict[str, Any]:
-    """Serialize every object (all kinds) + the resource version."""
-    doc: Dict[str, Any] = {
-        "version": CHECKPOINT_VERSION,
-        "resource_version": store.resource_version,
-        "objects": {},
-    }
-    for kind in KIND_TYPES:
-        objs = store.list(kind)
-        if objs:
-            doc["objects"][kind] = [_encode(o) for o in objs]
+    """Serialize every object (all kinds) + the resource version, under ONE
+    lock hold — a torn snapshot (pod bound to a node the snapshot missed)
+    would silently lose resource accounting after restore."""
+    with store.locked():
+        doc: Dict[str, Any] = {
+            "version": CHECKPOINT_VERSION,
+            "resource_version": store.resource_version,
+            "objects": {},
+        }
+        for kind in KIND_TYPES:
+            objs = store.list(kind)
+            if objs:
+                doc["objects"][kind] = [_encode(o) for o in objs]
     return doc
 
 
 def save_checkpoint(store: ObjectStore, path: str) -> None:
-    with open(path, "w") as f:
+    """Durable write: temp file + atomic rename, so a crash mid-dump never
+    destroys the previous good checkpoint."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(snapshot_store(store), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def restore_store(
     doc: Dict[str, Any], store: Optional[ObjectStore] = None
 ) -> ObjectStore:
-    """Rebuild an ObjectStore from a snapshot document.  Objects are
-    re-created through ``create`` so watchers attached afterwards replay a
-    consistent cache (informer re-list semantics, scheduler.go:72-73)."""
+    """Rebuild an ObjectStore from a snapshot document, preserving every
+    object's uid/resourceVersion and the global version counter (RV
+    bookmarks taken before a resume must stay monotonic).  ADDED events
+    fan out so watchers attached afterwards replay a consistent cache
+    (informer re-list semantics, scheduler.go:72-73)."""
     if doc.get("version") != CHECKPOINT_VERSION:
         raise ValueError(f"unsupported checkpoint version {doc.get('version')!r}")
     store = store or ObjectStore()
     for kind, items in doc.get("objects", {}).items():
         tp = KIND_TYPES[kind]
         for data in items:
-            store.create(kind, _decode(tp, data))
+            store.restore_object(kind, _decode(tp, data))
+    store.set_resource_version(int(doc.get("resource_version", 0)))
     return store
 
 

@@ -194,6 +194,31 @@ class ObjectStore:
         with self._lock:
             return self._rv
 
+    def locked(self):
+        """The store's RLock as a context manager — for multi-call
+        operations that need one consistent view (checkpoint snapshots)."""
+        return self._lock
+
+    def restore_object(self, kind: str, obj: Any) -> None:
+        """Checkpoint-restore insert: preserves the object's uid and
+        resource_version (create() would re-stamp both).  Fans out ADDED so
+        watchers attached afterwards replay a consistent cache."""
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in objs:
+                raise KeyError(f"{kind} {key!r} already exists")
+            stored = obj.clone()
+            objs[key] = stored
+            self._rv = max(self._rv, stored.metadata.resource_version)
+            self._fanout(kind, WatchEvent(EventType.ADDED, stored.clone()))
+
+    def set_resource_version(self, rv: int) -> None:
+        """Fast-forward the version counter (checkpoint restore) — never
+        backwards, so bookmarks taken before a resume stay monotonic."""
+        with self._lock:
+            self._rv = max(self._rv, rv)
+
     # -- watch -------------------------------------------------------------
     def watch(self, kind: str, send_initial: bool = True) -> Tuple[Watch, List[Any]]:
         """Open a watch; returns (watch, current snapshot).

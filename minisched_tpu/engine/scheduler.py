@@ -280,6 +280,7 @@ class Scheduler:
         self._waiting_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._bind_lock = threading.Lock()
         self._bind_threads: List[threading.Thread] = []
         # observability hooks: fn(pod, node_name_or_None, status), and
         # per-phase timing — assign a profiling.CycleMetrics to collect
@@ -316,7 +317,9 @@ class Scheduler:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-        for t in list(self._bind_threads):
+        with self._bind_lock:
+            binds = list(self._bind_threads)
+        for t in binds:
             t.join(timeout=2.0)
 
     # ------------------------------------------------------------------
@@ -371,7 +374,8 @@ class Scheduler:
             name=f"bind-{pod.metadata.name}",
             daemon=True,
         )
-        self._bind_threads.append(t)
+        with self._bind_lock:
+            self._bind_threads.append(t)
         t.start()
         return True
 
@@ -481,9 +485,12 @@ class Scheduler:
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))
         finally:
-            self._bind_threads = [
-                t for t in self._bind_threads if t is not threading.current_thread()
-            ]
+            with self._bind_lock:
+                self._bind_threads = [
+                    t
+                    for t in self._bind_threads
+                    if t is not threading.current_thread()
+                ]
 
     # -- failure path (minisched.go:283-298) ----------------------------
     def error_func(

@@ -203,6 +203,18 @@ def evaluate(
     )
 
 
+def validate_batch_chains(*chains: Sequence[Any]) -> None:
+    """Every plugin in a device chain must implement the batch protocol —
+    fail at construction with a clear error, not at trace time."""
+    for chain in chains:
+        for pl in chain:
+            if not implements_batch(pl):
+                raise TypeError(
+                    f"plugin {pl.name()} has no batch form; "
+                    "scalar-only plugins must run through the engine"
+                )
+
+
 class FusedEvaluator:
     """Compiled wrapper: plugin chains fixed at construction; tables vary.
 
@@ -219,13 +231,7 @@ class FusedEvaluator:
         weights: Optional[Dict[str, int]] = None,
         with_diagnostics: bool = False,
     ):
-        for chain in (filter_plugins, pre_score_plugins, score_plugins):
-            for pl in chain:
-                if not implements_batch(pl):
-                    raise TypeError(
-                        f"plugin {pl.name()} has no batch form; "
-                        "scalar-only plugins must run through the engine"
-                    )
+        validate_batch_chains(filter_plugins, pre_score_plugins, score_plugins)
         self.ctx = BatchContext(
             weights=tuple(sorted((weights or {}).items()))
         )

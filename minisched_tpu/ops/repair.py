@@ -1,0 +1,220 @@
+"""Wave scheduling with conflict repair: throughput mode that never
+double-books.
+
+The stateless wave (ops/state.wave_step) evaluates every pod against the
+pre-wave state and commits all placements — two pods can double-book a
+node that single-pod semantics would have caught (SURVEY.md §7 hard part
+2).  The sequential scan (ops/sequential.py) is bind-exact but serial.
+This module is the middle mode: per round, evaluate all uncommitted pods,
+then ACCEPT the conflict-free subset under a deterministic rule — pods in
+index order per node, while cumulative demand still fits (cpu / memory /
+ephemeral / pod count) and no same-round host-port collision — commit
+them, and re-evaluate the rejected remainder against the updated table.
+Every round commits at least the lowest-indexed contender per node, so the
+``lax.while_loop`` converges; infeasible pods (choice −1) are terminal
+because commits only consume resources.
+
+Placements are NOT bit-exact with the sequential loop (scores within a
+round see round-start state); the guarantee is safety: the final table
+never exceeds any node's allocatable, verified by tests/test_repair.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from minisched_tpu.models.tables import NodeTable, PodTable
+from minisched_tpu.ops.fused import BatchContext, evaluate
+from minisched_tpu.ops.state import apply_placements
+
+_INF32 = jnp.int32(2**31 - 1)
+
+
+def _segment_starts(sorted_keys):
+    """positions of each segment's first element under a sorted key array."""
+    pos = jnp.arange(sorted_keys.shape[0])
+    is_start = jnp.concatenate(
+        [jnp.array([True]), sorted_keys[1:] != sorted_keys[:-1]]
+    )
+    return jax.lax.cummax(jnp.where(is_start, pos, 0))
+
+
+def accept_placements(
+    nodes: NodeTable,
+    pods: PodTable,
+    choice,
+    active,
+    check_resources: bool = True,
+    check_ports: bool = True,
+):
+    """bool[P]: which tentative placements commit this round.
+
+    Deterministic rule: group pods by chosen node, take them in pod-index
+    order while the node's remaining allocatable covers the cumulative
+    demand; among same-round claims of one host port on one node only the
+    first pod survives.
+
+    ``check_resources`` / ``check_ports`` mirror whether NodeResourcesFit /
+    NodePorts are in the filter chain — acceptance must enforce exactly
+    what the chain enforces (a config without the Fit filter over-commits
+    on purpose, like the reference would), and with the Fit filter present
+    the first candidate per node always fits, which is what guarantees a
+    commit per contested node per round (convergence).
+    """
+    P = choice.shape[0]
+    live = active & (choice >= 0)
+    if not check_resources and not check_ports:
+        return live
+    # sort by (node, pod index): key groups node segments, index-ordered
+    key = jnp.where(live, choice, _INF32 // (P + 1)) * (P + 1) + jnp.arange(P)
+    order = jnp.argsort(key)
+    s_choice = choice[order]
+    s_live = live[order]
+    seg = _segment_starts(jnp.where(s_live, s_choice, -2))
+
+    # same-round port dedup: claims of (node, port) keep the first pod
+    if check_ports:
+        W = pods.port.shape[1]
+        slot_in_range = jnp.arange(W)[None, :] < pods.num_ports[:, None]
+        # a pod repeating one port across its own containers is a single
+        # claim — drop intra-pod duplicate slots so it can't lose to itself
+        dup_within = jnp.any(
+            (pods.port[:, :, None] == pods.port[:, None, :])
+            & (jnp.arange(W)[None, None, :] < jnp.arange(W)[None, :, None])
+            & slot_in_range[:, None, :],
+            axis=2,
+        )  # (P, W): an earlier slot already claims this port
+        pair_key = (
+            jnp.where(live, choice, -1)[:, None] * jnp.int32(65536) + pods.port
+        )  # (P, W); ports < 65536
+        pair_live = live[:, None] & slot_in_range & ~dup_within
+        flat_key = jnp.where(pair_live, pair_key, _INF32).reshape(-1)
+        # jnp.argsort is stable: pod-index order survives within equal keys
+        porder = jnp.argsort(flat_key)
+        sflat = flat_key[porder]
+        first = jnp.concatenate([jnp.array([True]), sflat[1:] != sflat[:-1]])
+        loses = jnp.zeros(P * W, bool).at[porder].set(~first & (sflat < _INF32))
+        port_ok = ~jnp.any(loses.reshape(P, W), axis=1)  # (P,)
+    else:
+        port_ok = jnp.ones(P, bool)
+
+    eligible = s_live & port_ok[order]
+    if not check_resources:
+        return jnp.zeros(P, bool).at[order].set(eligible) & live
+
+    def prefix_fits(pod_amt, node_req, node_alloc):
+        amt = jnp.where(eligible, pod_amt[order], 0)
+        incl = jnp.cumsum(amt)
+        ex = incl - amt  # exclusive cumsum
+        within_ex = ex - ex[seg]  # demand of earlier accepted-candidates
+        idx = jnp.where(s_live, s_choice, 0)
+        headroom = (node_alloc - node_req)[idx]
+        return within_ex + amt <= headroom
+
+    ones = jnp.ones(P, jnp.int32)
+    fits = (
+        prefix_fits(pods.req_cpu, nodes.req_cpu, nodes.alloc_cpu)
+        & prefix_fits(pods.req_mem, nodes.req_mem, nodes.alloc_mem)
+        & prefix_fits(pods.req_eph, nodes.req_eph, nodes.alloc_eph)
+        & prefix_fits(ones, nodes.req_pods, nodes.alloc_pods)
+        & eligible
+    )
+    # NOTE: the prefix rule is conservative only w.r.t. earlier *candidates*
+    # that themselves fit — an earlier pod that does NOT fit still occupies
+    # prefix demand this round; it is rejected and retried next round, so
+    # convergence and safety both hold (never over-commit: the prefix is an
+    # upper bound on what actually commits ahead of a pod).
+    accept = jnp.zeros(P, bool).at[order].set(fits)
+    return accept & live
+
+
+def repair_wave_step(
+    nodes: NodeTable,
+    pods: PodTable,
+    filter_plugins: Sequence[Any],
+    pre_score_plugins: Sequence[Any],
+    score_plugins: Sequence[Any],
+    ctx: BatchContext,
+    extra: Any = None,
+    max_rounds: int = 16,
+) -> Tuple[NodeTable, Any, Any]:
+    """Evaluate-accept-commit rounds until every pod is placed or
+    infeasible (bounded by ``max_rounds``).  Traceable; call under jit.
+
+    Returns (updated NodeTable, choice i32[P] with −1 = unplaced,
+    rounds_used i32).
+    """
+    P = pods.valid.shape[0]
+    names = {pl.name() for pl in filter_plugins}
+    check_resources = "NodeResourcesFit" in names
+    check_ports = "NodePorts" in names
+
+    def cond(carry):
+        nodes_, committed, final, rnd, progress = carry
+        return progress & (rnd < max_rounds)
+
+    def body(carry):
+        nodes_, committed, final, rnd, _ = carry
+        import dataclasses
+
+        active_pods = dataclasses.replace(
+            pods, valid=pods.valid & ~committed
+        )
+        result = evaluate(
+            active_pods, nodes_, filter_plugins, pre_score_plugins,
+            score_plugins, ctx, extra=extra,
+        )
+        accept = accept_placements(
+            nodes_, active_pods, result.choice, active_pods.valid,
+            check_resources=check_resources, check_ports=check_ports,
+        )
+        nodes_ = apply_placements(
+            nodes_, active_pods, jnp.where(accept, result.choice, -1)
+        )
+        final = jnp.where(accept, result.choice, final)
+        committed = committed | accept
+        # stop when nothing committed AND no uncommitted pod is feasible
+        retryable = active_pods.valid & (result.choice >= 0) & ~accept
+        progress = jnp.any(accept) & jnp.any(retryable)
+        return nodes_, committed, final, rnd + 1, progress
+
+    committed0 = ~pods.valid  # padding rows never schedule
+    final0 = jnp.full((P,), -1, jnp.int32)
+    nodes, committed, final, rounds, _ = jax.lax.while_loop(
+        cond, body, (nodes, committed0, final0, jnp.int32(0), jnp.bool_(True))
+    )
+    return nodes, final, rounds
+
+
+class RepairingEvaluator:
+    """Compiled wrapper (argument order matches FusedEvaluator)."""
+
+    def __init__(
+        self,
+        filter_plugins: Sequence[Any],
+        pre_score_plugins: Sequence[Any],
+        score_plugins: Sequence[Any],
+        weights: Optional[dict] = None,
+        max_rounds: int = 16,
+    ):
+        from minisched_tpu.ops.fused import validate_batch_chains
+
+        validate_batch_chains(filter_plugins, pre_score_plugins, score_plugins)
+        ctx = BatchContext(weights=tuple(sorted((weights or {}).items())))
+        self._fn = jax.jit(
+            partial(
+                repair_wave_step,
+                filter_plugins=tuple(filter_plugins),
+                pre_score_plugins=tuple(pre_score_plugins),
+                score_plugins=tuple(score_plugins),
+                ctx=ctx,
+                max_rounds=max_rounds,
+            ),
+        )
+
+    def __call__(self, pods: PodTable, nodes: NodeTable, extra: Any = None):
+        return self._fn(nodes, pods, extra=extra)

@@ -89,6 +89,9 @@ class SequentialScheduler:
         score_plugins: Sequence[Any],
         weights: Optional[dict] = None,
     ):
+        from minisched_tpu.ops.fused import validate_batch_chains
+
+        validate_batch_chains(filter_plugins, pre_score_plugins, score_plugins)
         ctx = BatchContext(weights=tuple(sorted((weights or {}).items())))
         self._fn = jax.jit(
             partial(

@@ -52,8 +52,8 @@ def test_cycle_metrics_record_phases():
     client.nodes().create(make_node("node1"))
     client.pods().create(make_pod("pod1"))
     assert _wait(lambda: client.pods().get("pod1").spec.node_name == "node1")
+    svc.shutdown_scheduler()  # joins bind threads: all phases observed
     snap = sched.metrics.snapshot()
-    svc.shutdown_scheduler()
     assert snap["cycle"]["count"] >= 1
     assert snap["schedule"]["count"] >= 1
     assert snap["snapshot"]["count"] >= 1

@@ -1,0 +1,143 @@
+"""Wave repair mode: conflict-free commits, convergence, safety invariants."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from minisched_tpu.api.objects import Container, make_node, make_pod
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.ops.repair import RepairingEvaluator
+from minisched_tpu.plugins.nodenumber import NodeNumber
+from minisched_tpu.plugins.nodeports import NodePorts
+from minisched_tpu.plugins.noderesources import (
+    NodeResourcesFit,
+    NodeResourcesLeastAllocated,
+)
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+
+def _run(pods, nodes, filters, pre_scores, scores, weights=None):
+    node_table, node_names = build_node_table(
+        sorted(nodes, key=lambda n: n.metadata.name)
+    )
+    pod_table, _ = build_pod_table(pods)
+    ev = RepairingEvaluator(filters, pre_scores, scores, weights)
+    new_nodes, choice, rounds = ev(pod_table, node_table)
+    placements = [
+        node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]
+    ]
+    return new_nodes, placements, int(rounds)
+
+
+def test_no_double_booking_on_contested_node():
+    """Three 1-cpu pods, two 1-cpu nodes: a plain wave would put all three
+    on nodes; repair places exactly two and leaves no node over-committed."""
+    nodes = [
+        make_node(f"n{i}", capacity={"cpu": "1", "memory": "4Gi", "pods": 10})
+        for i in range(2)
+    ]
+    pods = [make_pod(f"p{i}", requests={"cpu": "1"}) for i in range(3)]
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    new_nodes, placements, rounds = _run(
+        pods, nodes, filters, [], [NodeResourcesLeastAllocated()]
+    )
+    placed = [p for p in placements if p]
+    assert sorted(placed) == ["n0", "n1"]
+    assert placements.count("") == 1
+    assert (np.asarray(new_nodes.req_cpu) <= np.asarray(new_nodes.alloc_cpu)).all()
+    assert rounds >= 2  # the loser needed a re-evaluation round
+
+
+def test_port_conflicts_within_one_round():
+    nodes = [make_node("n0"), make_node("n1")]
+    pods = []
+    for i in range(3):
+        p = make_pod(f"p{i}")
+        p.spec.containers = [Container(ports=[8080])]
+        pods.append(p)
+    filters = [NodeUnschedulable(), NodePorts()]
+    _, placements, _ = _run(pods, nodes, filters, [], [])
+    placed = [p for p in placements if p]
+    assert sorted(placed) == ["n0", "n1"]  # one per node, third unplaced
+    assert placements.count("") == 1
+
+
+def test_pod_repeating_its_own_port_is_one_claim():
+    """Two containers of ONE pod sharing a host port must not make the pod
+    lose the same-round dedup to itself (regression)."""
+    nodes = [make_node("n0")]
+    pod = make_pod("p0")
+    pod.spec.containers = [Container(ports=[8080]), Container(ports=[8080])]
+    filters = [NodeUnschedulable(), NodePorts()]
+    _, placements, _ = _run([pod], nodes, filters, [], [])
+    assert placements == ["n0"]
+
+
+def test_bind_independent_chain_converges_in_one_round():
+    """With no resource/port filters acceptance is unconditional — the
+    repair mode degenerates to the plain wave (same placements, 1 round)."""
+    from tests.test_parity import batch_placements
+
+    rng = random.Random(9)
+    nodes = [make_node(f"node{i}") for i in range(16)]
+    pods = [make_pod(f"pod{rng.randrange(100)}{i % 10}") for i in range(24)]
+    nn = NodeNumber()
+    filters = [NodeUnschedulable()]
+    _, placements, rounds = _run(pods, nodes, filters, [nn], [nn])
+    assert rounds == 1
+    assert placements == batch_placements(pods, nodes, filters, [nn], [nn])
+
+
+def test_randomized_safety_invariants():
+    """Random overcommit-heavy clusters: the final table never exceeds any
+    allocatable, every placed pod respected the per-node arithmetic, and
+    every unplaced pod is genuinely infeasible against the FINAL state."""
+    rng = random.Random(77)
+    nodes = [
+        make_node(
+            f"node{i:02d}",
+            capacity={
+                "cpu": rng.choice(["1", "2", "4"]),
+                "memory": rng.choice(["2Gi", "4Gi"]),
+                "pods": rng.choice([2, 5, 110]),
+            },
+        )
+        for i in range(12)
+    ]
+    pods = [
+        make_pod(
+            f"pod{i}",
+            requests={"cpu": rng.choice(["500m", "1", "2"]), "memory": "1Gi"},
+        )
+        for i in range(64)
+    ]
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    new_nodes, placements, _ = _run(
+        pods, nodes, filters, [], [NodeResourcesLeastAllocated()]
+    )
+    req_cpu = np.asarray(new_nodes.req_cpu)
+    req_mem = np.asarray(new_nodes.req_mem)
+    req_pods = np.asarray(new_nodes.req_pods)
+    assert (req_cpu <= np.asarray(new_nodes.alloc_cpu)).all()
+    assert (req_mem <= np.asarray(new_nodes.alloc_mem)).all()
+    assert (req_pods <= np.asarray(new_nodes.alloc_pods)).all()
+    assert any(placements) and "" in placements  # mixed outcome
+    # unplaced pods must not fit ANY node of the final state
+    node_names = sorted(n.metadata.name for n in nodes)
+    name_to_i = {n: i for i, n in enumerate(node_names)}
+    alloc_cpu = np.asarray(new_nodes.alloc_cpu)
+    alloc_mem = np.asarray(new_nodes.alloc_mem)
+    alloc_pods = np.asarray(new_nodes.alloc_pods)
+    for pod, where in zip(pods, placements):
+        if where:
+            continue
+        req = pod.resource_requests()
+        for i in range(len(node_names)):
+            fits = (
+                req.milli_cpu <= alloc_cpu[i] - req_cpu[i]
+                and (req.memory // (1024 * 1024)) <= alloc_mem[i] - req_mem[i]
+                and req_pods[i] + 1 <= alloc_pods[i]
+            )
+            assert not fits, f"{pod.metadata.name} still fits {node_names[i]}"
