@@ -68,6 +68,19 @@ def mix32(seed, idx):
     return x
 
 
+import os as _os
+
+#: route select_hosts through the one-pass Pallas kernel
+#: (ops/pallas_kernels.py).  Env MINISCHED_TPU_PALLAS=1 or set_pallas(True);
+#: trace-time constant, so toggle before building evaluators.
+_USE_PALLAS = _os.environ.get("MINISCHED_TPU_PALLAS", "") == "1"
+
+
+def set_pallas(enabled: bool) -> None:
+    global _USE_PALLAS
+    _USE_PALLAS = enabled
+
+
 def select_hosts(scores, mask, seeds):
     """Batched deterministic selectHost (minisched.go:304-325 re-designed).
 
@@ -80,6 +93,16 @@ def select_hosts(scores, mask, seeds):
     pick the one minimizing mix32(seed, node_index); remaining ties (hash
     collisions) go to the lowest index.
     """
+    if _USE_PALLAS:
+        import jax as _jax
+
+        # only route to Pallas where it compiles natively — interpreter
+        # mode off-TPU would be far slower than the XLA path below (tests
+        # exercise the kernel directly with interpret=True)
+        if _jax.default_backend() == "tpu":
+            from minisched_tpu.ops.pallas_kernels import select_hosts_pallas
+
+            return select_hosts_pallas(scores, mask, seeds)
     P, N = scores.shape
     masked = jnp.where(mask, scores, NEG_INF_SCORE)
     best = masked.max(axis=1)  # i32[P]

@@ -58,17 +58,24 @@ class NodeUnschedulable(Plugin, BatchEvaluable):
     # -- batch -------------------------------------------------------------
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
         """mask[p, n] = ~node.unschedulable | pod-tolerates-unschedulable."""
-        tol_slots = jnp.arange(pods.tol_key.shape[1])[None, :]
-        in_range = tol_slots < pods.num_tols[:, None]  # (P, T)
-        effect_ok = (pods.tol_effect == tables.EFFECT_NONE) | (
-            pods.tol_effect == tables.EFFECT_NO_SCHEDULE
-        )
-        key_matches = pods.tol_key == _UNSCHED_KEY_HASH
-        exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE
-        # Equal with empty value tolerates (taint value is ""), Exists always
-        value_ok = exists | (pods.tol_value == tables.fnv1a32(""))
-        wildcard = pods.tol_empty_key & exists
-        tolerates = jnp.any(
-            in_range & effect_ok & (wildcard | (key_matches & value_ok)), axis=1
-        )  # (P,)
-        return (~nodes.unschedulable)[None, :] | tolerates[:, None]
+        return (~nodes.unschedulable)[None, :] | tolerates_unschedulable(pods)[
+            :, None
+        ]
+
+
+def tolerates_unschedulable(pods: Any):
+    """bool[P]: pod tolerates the node.kubernetes.io/unschedulable taint —
+    the pod-only half of the filter (also feeds the fused Pallas kernel)."""
+    tol_slots = jnp.arange(pods.tol_key.shape[1])[None, :]
+    in_range = tol_slots < pods.num_tols[:, None]  # (P, T)
+    effect_ok = (pods.tol_effect == tables.EFFECT_NONE) | (
+        pods.tol_effect == tables.EFFECT_NO_SCHEDULE
+    )
+    key_matches = pods.tol_key == _UNSCHED_KEY_HASH
+    exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE
+    # Equal with empty value tolerates (taint value is ""), Exists always
+    value_ok = exists | (pods.tol_value == tables.fnv1a32(""))
+    wildcard = pods.tol_empty_key & exists
+    return jnp.any(
+        in_range & effect_ok & (wildcard | (key_matches & value_ok)), axis=1
+    )  # (P,)

@@ -1,0 +1,128 @@
+"""Pallas select_hosts kernel: bit-exact with the XLA reference.
+
+Runs in interpreter mode on the CPU test mesh; the same kernel compiles
+to Mosaic on TPU (the benchmark exercises that)."""
+
+from __future__ import annotations
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minisched_tpu.ops import fused
+from minisched_tpu.ops.pallas_kernels import select_hosts_pallas
+
+
+def _random_case(rng: random.Random, P: int, N: int, tie_heavy: bool):
+    if tie_heavy:
+        scores = np.array(
+            [[rng.choice([0, 10]) for _ in range(N)] for _ in range(P)], np.int32
+        )
+    else:
+        scores = np.array(
+            [[rng.randrange(-50, 500) for _ in range(N)] for _ in range(P)],
+            np.int32,
+        )
+    mask = np.array(
+        [[rng.random() < 0.7 for _ in range(N)] for _ in range(P)], bool
+    )
+    mask[0, :] = False  # one pod with no feasible node
+    seeds = np.array([rng.getrandbits(32) for _ in range(P)], np.uint32)
+    return jnp.asarray(scores), jnp.asarray(mask), jnp.asarray(seeds)
+
+
+@pytest.mark.parametrize("seed,tie_heavy", [(1, False), (2, True), (3, True)])
+def test_pallas_select_hosts_matches_xla(seed, tie_heavy):
+    rng = random.Random(seed)
+    P, N = 128, 256
+    scores, mask, seeds = _random_case(rng, P, N, tie_heavy)
+    ref_choice, ref_best = fused.select_hosts(scores, mask, seeds)
+    got_choice, got_best = select_hosts_pallas(scores, mask, seeds, interpret=True)
+    assert got_choice.tolist() == ref_choice.tolist()
+    assert got_best.tolist() == ref_best.tolist()
+
+
+def test_fused_nodenumber_kernel_matches_evaluator():
+    """The benchmark's fully-fused flagship kernel must be bit-exact with
+    the generic FusedEvaluator on the NodeUnschedulable+NodeNumber chain."""
+    from minisched_tpu.api.objects import Toleration, make_node, make_pod
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.pallas_kernels import nodenumber_select_hosts
+    from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    rng = random.Random(6)
+    nodes = [
+        make_node(f"node{i}", unschedulable=rng.random() < 0.4) for i in range(200)
+    ]
+    pods = []
+    for i in range(100):
+        tols = (
+            [
+                Toleration(
+                    key="node.kubernetes.io/unschedulable",
+                    operator="Exists",
+                    effect="NoSchedule",
+                )
+            ]
+            if rng.random() < 0.3
+            else []
+        )
+        pods.append(make_pod(f"pod{i}", tolerations=tols))
+    node_table, _ = build_node_table(sorted(nodes, key=lambda n: n.metadata.name))
+    pod_table, _ = build_pod_table(pods)
+    nn = NodeNumber()
+    ref = fused.FusedEvaluator([NodeUnschedulable()], [nn], [nn])(
+        pod_table, node_table
+    )
+    choice, best = nodenumber_select_hosts(pod_table, node_table, interpret=True)
+    assert choice.tolist() == ref.choice.tolist()
+    assert best.tolist() == ref.best_score.tolist()
+
+
+def test_pallas_rejects_non_divisible_shapes():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        select_hosts_pallas(
+            jnp.zeros((12, 64), jnp.int32),
+            jnp.ones((12, 64), bool),
+            jnp.zeros((12,), jnp.uint32),
+            interpret=True,
+        )
+
+
+def test_pallas_flag_routes_evaluator():
+    """set_pallas(True) must keep the full evaluator bit-identical (on
+    non-TPU backends the flag falls back to the XLA path)."""
+    from minisched_tpu.api.objects import make_node, make_pod
+    from tests.test_parity import batch_placements
+    from minisched_tpu.plugins.nodenumber import NodeNumber
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    rng = random.Random(4)
+    nodes = [
+        make_node(f"node{i}", unschedulable=rng.random() < 0.3) for i in range(40)
+    ]
+    pods = [make_pod(f"pod{i}") for i in range(24)]
+    nn = NodeNumber()
+    chain = ([NodeUnschedulable()], [nn], [nn])
+    baseline = batch_placements(pods, nodes, *chain)
+    fused.set_pallas(True)
+    try:
+        got = batch_placements(pods, nodes, *chain)
+    finally:
+        fused.set_pallas(False)
+    assert got == baseline
+
+
+def test_pallas_multiple_of_512_and_small_n():
+    rng = random.Random(5)
+    for P, N in ((8, 128), (16, 1024)):
+        scores, mask, seeds = _random_case(rng, P, N, tie_heavy=True)
+        ref = fused.select_hosts(scores, mask, seeds)
+        got = select_hosts_pallas(scores, mask, seeds, interpret=True)
+        assert got[0].tolist() == ref[0].tolist()
+        assert got[1].tolist() == ref[1].tolist()
