@@ -1,0 +1,338 @@
+"""HTTP API façade: the control plane served over REST.
+
+Re-creates the reference's L1 boundary — a real kube-apiserver served
+through an ``httptest.Server`` with health polling
+(k8sapiserver/k8sapiserver.go:43-71, :231-249) — as a stdlib
+ThreadingHTTPServer over the in-memory ObjectStore.  Kubernetes-shaped
+routes:
+
+    GET    /healthz                                   → 200 "ok"
+    GET    /api/v1/nodes                              → list
+    GET    /api/v1/nodes/{name}                       → get
+    POST   /api/v1/nodes                              → create
+    PUT    /api/v1/nodes/{name}                       → update
+    DELETE /api/v1/nodes/{name}                       → delete
+    (same under /api/v1/namespaces/{ns}/pods)
+    POST   /api/v1/namespaces/{ns}/pods/{name}/binding → bind subresource
+    GET    /api/v1/...?watch=true                     → JSON-lines stream
+
+Objects serialize with the checkpoint codec (language-neutral JSON).
+``start_api_server`` mirrors ``StartAPIServer(etcdURL) → (config,
+shutdownFn)``: returns (server, base_url, shutdown_fn) after polling
+/healthz until it answers, exactly like the reference does
+(k8sapiserver.go:232-244).  ``HTTPClient`` gives scenarios the same
+facade as the in-process Client, over the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional, Tuple
+
+from minisched_tpu.api.objects import Binding, Node, Pod
+from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
+from minisched_tpu.controlplane.client import AlreadyBound, Client
+from minisched_tpu.controlplane.store import ObjectStore
+
+
+def _kind_for(collection: str) -> str:
+    return {"nodes": "Node", "pods": "Pod",
+            "persistentvolumes": "PersistentVolume",
+            "persistentvolumeclaims": "PersistentVolumeClaim"}[collection]
+
+
+def _route(path: str):
+    """→ (kind, namespace, name, subresource) — name/sub may be ''."""
+    parts = [p for p in path.split("/") if p]
+    # api/v1/nodes[/name]  |  api/v1/namespaces/ns/pods[/name[/binding]]
+    if parts[:2] != ["api", "v1"] or len(parts) < 3:
+        raise KeyError(path)
+    rest = parts[2:]
+    try:
+        if rest[0] == "namespaces":
+            ns, collection, *tail = rest[1:]
+        else:
+            ns, (collection, *tail) = "", rest
+    except (IndexError, ValueError):
+        raise KeyError(path)
+    name = tail[0] if tail else ""
+    sub = tail[1] if len(tail) > 1 else ""
+    return _kind_for(collection), ns, name, sub
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: ObjectStore = None  # set by start_api_server
+    active_watches = None  # set by start_api_server (set + lock)
+    watch_lock = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def _send(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Any:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def _error(self, code: int, msg: str) -> None:
+        self._send(code, {"error": msg})
+
+    def do_GET(self) -> None:
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            self._send(200, "ok")
+            return
+        try:
+            kind, ns, name, _ = _route(path)
+        except (KeyError, ValueError):
+            self._error(404, f"no route {path}")
+            return
+        if "watch=true" in query:
+            self._watch(kind, ns)
+            return
+        try:
+            if name:
+                obj = self.store.get(kind, ns, name)
+                self._send(200, _encode(obj))
+            else:
+                self._send(200, {"items": [_encode(o) for o in self.store.list(kind)]})
+        except KeyError as e:
+            self._error(404, str(e))
+
+    def _watch(self, kind: str, ns: str) -> None:
+        """JSON-lines event stream (chunked) until the client hangs up or
+        the server shuts down — the apiserver watch verb the informer
+        machinery rides.  A namespaced path filters to that namespace."""
+        watch, snapshot = self.store.watch(kind, send_initial=True)
+        with self.watch_lock:
+            self.active_watches.add(watch)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while True:
+                ev = watch.next(timeout=0.5)
+                if ev is None:
+                    if watch.stopped:
+                        break
+                    chunk(b"\n")  # keepalive
+                    continue
+                if ns and ev.obj.metadata.namespace != ns:
+                    continue
+                line = json.dumps(
+                    {"type": ev.type.value, "object": _encode(ev.obj)}
+                ).encode() + b"\n"
+                chunk(line)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            watch.stop()
+            with self.watch_lock:
+                self.active_watches.discard(watch)
+
+    def do_POST(self) -> None:
+        try:
+            kind, ns, name, sub = _route(self.path)
+        except (KeyError, ValueError):
+            self._error(404, f"no route {self.path}")
+            return
+        if sub == "binding":
+            data = self._body()
+            node_name = data.get("node_name")
+            if not node_name:
+                self._error(400, "binding body requires node_name")
+                return
+            try:
+                pod = Client(self.store).pods(ns or "default").bind(
+                    Binding(name, ns or "default", node_name)
+                )
+                self._send(201, _encode(pod))
+            except AlreadyBound as e:
+                self._error(409, str(e))
+            except KeyError as e:
+                self._error(404, str(e))
+            return
+        obj = _decode(KIND_TYPES[kind], self._body())
+        if kind == "Node":
+            obj.metadata.namespace = ""
+        elif ns:
+            obj.metadata.namespace = ns  # the URL namespace wins (kube semantics)
+        elif not obj.metadata.namespace:
+            obj.metadata.namespace = "default"
+        try:
+            self._send(201, _encode(self.store.create(kind, obj)))
+        except KeyError as e:
+            self._error(409, str(e))
+
+    def do_PUT(self) -> None:
+        try:
+            kind, ns, name, _ = _route(self.path)
+        except (KeyError, ValueError):
+            self._error(404, f"no route {self.path}")
+            return
+        obj = _decode(KIND_TYPES[kind], self._body())
+        # the URL is authoritative: a body naming a different object is a
+        # client error, not a silent update of the other object
+        if name and obj.metadata.name != name:
+            self._error(400, f"body names {obj.metadata.name!r}, path names {name!r}")
+            return
+        if ns and obj.metadata.namespace != ns:
+            self._error(400, f"body namespace {obj.metadata.namespace!r} != {ns!r}")
+            return
+        try:
+            self._send(200, _encode(self.store.update(kind, obj)))
+        except KeyError as e:
+            self._error(404, str(e))
+
+    def do_DELETE(self) -> None:
+        try:
+            kind, ns, name, _ = _route(self.path)
+            self.store.delete(kind, ns, name)
+            self._send(200, {})
+        except (KeyError, ValueError) as e:
+            self._error(404, str(e))
+
+
+def start_api_server(
+    store: Optional[ObjectStore] = None, port: int = 0
+) -> Tuple[ThreadingHTTPServer, str, Callable[[], None]]:
+    """Boot the REST façade on an ephemeral port and poll /healthz until it
+    answers (k8sapiserver.go:231-249's readiness loop).  Returns
+    (server, base_url, shutdown_fn)."""
+    store = store or ObjectStore()
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {"store": store, "active_watches": set(), "watch_lock": threading.Lock()},
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    deadline = time.monotonic() + 30.0  # 100ms interval, 30s timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=1.0) as r:
+                if r.status == 200:
+                    break
+        except OSError:
+            pass
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("API server failed /healthz within 30s")
+
+    def shutdown() -> None:
+        # stop active watch streams first: their handler threads would
+        # otherwise loop (and hold store watch registrations) forever
+        with handler.watch_lock:
+            watches = list(handler.active_watches)
+        for w in watches:
+            w.stop()
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=2.0)
+
+    return server, base, shutdown
+
+
+class HTTPClient:
+    """The Client facade over the wire — what the reference's scenario
+    does with client-go against the httptest server (sched.go:70-143)."""
+
+    def __init__(self, base_url: str):
+        self._base = base_url.rstrip("/")
+
+    def _req(self, method: str, path: str, payload: Any = None) -> Any:
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self._base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            if e.code == 409 and "already bound" in body:
+                raise AlreadyBound(body)
+            if e.code == 404:
+                raise KeyError(body)
+            raise RuntimeError(f"HTTP {e.code}: {body}")
+
+    class _Nodes:
+        def __init__(self, c: "HTTPClient"):
+            self._c = c
+
+        def create(self, node: Node) -> Node:
+            return _decode(Node, self._c._req("POST", "/api/v1/nodes", _encode(node)))
+
+        def get(self, name: str) -> Node:
+            return _decode(Node, self._c._req("GET", f"/api/v1/nodes/{name}"))
+
+        def list(self):
+            out = self._c._req("GET", "/api/v1/nodes")
+            return [_decode(Node, o) for o in out["items"]]
+
+        def delete(self, name: str) -> None:
+            self._c._req("DELETE", f"/api/v1/nodes/{name}")
+
+    class _Pods:
+        def __init__(self, c: "HTTPClient", ns: str):
+            self._c = c
+            self._ns = ns
+
+        def _path(self, name: str = "") -> str:
+            p = f"/api/v1/namespaces/{self._ns}/pods"
+            return f"{p}/{name}" if name else p
+
+        def create(self, pod: Pod) -> Pod:
+            return _decode(Pod, self._c._req("POST", self._path(), _encode(pod)))
+
+        def get(self, name: str, namespace: Optional[str] = None) -> Pod:
+            return _decode(Pod, self._c._req("GET", self._path(name)))
+
+        def list(self):
+            out = self._c._req("GET", self._path())
+            return [_decode(Pod, o) for o in out["items"]]
+
+        def update(self, pod: Pod) -> Pod:
+            return _decode(
+                Pod, self._c._req("PUT", self._path(pod.metadata.name), _encode(pod))
+            )
+
+        def delete(self, name: str, namespace: Optional[str] = None) -> None:
+            self._c._req("DELETE", self._path(name))
+
+        def bind(self, binding: Binding) -> Pod:
+            return _decode(
+                Pod,
+                self._c._req(
+                    "POST",
+                    self._path(binding.pod_name) + "/binding",
+                    {"node_name": binding.node_name},
+                ),
+            )
+
+    def nodes(self) -> "_Nodes":
+        return HTTPClient._Nodes(self)
+
+    def pods(self, namespace: str = "default") -> "_Pods":
+        return HTTPClient._Pods(self, namespace)

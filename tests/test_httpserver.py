@@ -1,0 +1,152 @@
+"""HTTP API façade: CRUD + bind subresource + watch stream over REST, and
+the README scenario driven entirely through the HTTP boundary (the
+reference's topology: scenario ↔ client-go ↔ httptest apiserver)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.client import AlreadyBound, Client
+from minisched_tpu.controlplane.httpserver import HTTPClient, start_api_server
+
+
+@pytest.fixture()
+def api():
+    store_client = Client()
+    server, base, shutdown = start_api_server(store_client.store)
+    try:
+        yield store_client, HTTPClient(base), base
+    finally:
+        shutdown()
+
+
+def test_crud_over_http(api):
+    _, http, _ = api
+    http.nodes().create(make_node("n1", labels={"zone": "a"}))
+    node = http.nodes().get("n1")
+    assert node.metadata.labels == {"zone": "a"}
+    assert [n.metadata.name for n in http.nodes().list()] == ["n1"]
+
+    pod = make_pod("p1", requests={"cpu": "500m"})
+    http.pods().create(pod)
+    got = http.pods().get("p1")
+    assert got.spec.containers[0].requests.milli_cpu == 500
+    http.pods().delete("p1")
+    with pytest.raises(KeyError):
+        http.pods().get("p1")
+
+
+def test_bind_subresource_and_conflict(api):
+    _, http, _ = api
+    http.nodes().create(make_node("n1"))
+    http.pods().create(make_pod("p1"))
+    bound = http.pods().bind(Binding("p1", "default", "n1"))
+    assert bound.spec.node_name == "n1"
+    with pytest.raises(AlreadyBound):
+        http.pods().bind(Binding("p1", "default", "n1"))
+    with pytest.raises(KeyError):
+        http.pods().bind(Binding("ghost", "default", "n1"))
+
+
+def test_namespaced_create_uses_url_namespace(api):
+    """The URL namespace wins over the body's (kube semantics) —
+    regression: pods('team-a') silently stored under 'default'."""
+    _, http, _ = api
+    http.pods("team-a").create(make_pod("x"))
+    got = http.pods("team-a").get("x")
+    assert got.metadata.namespace == "team-a"
+
+
+def test_put_rejects_path_body_mismatch(api):
+    _, http, _ = api
+    http.pods().create(make_pod("p1"))
+    other = make_pod("p2")
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="400"):
+        http._req("PUT", "/api/v1/namespaces/default/pods/p1",
+                  __import__("minisched_tpu.controlplane.checkpoint",
+                             fromlist=["_encode"])._encode(other))
+
+
+def test_bare_api_v1_is_404_not_dropped_connection(api):
+    _, _, base = api
+    try:
+        urllib.request.urlopen(base + "/api/v1")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_healthz_and_404(api):
+    _, _, base = api
+    with urllib.request.urlopen(base + "/healthz") as r:
+        assert r.status == 200
+    try:
+        urllib.request.urlopen(base + "/api/v1/bogus")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_watch_streams_events(api):
+    store_client, http, base = api
+    events = []
+
+    def reader():
+        req = urllib.request.urlopen(
+            base + "/api/v1/namespaces/default/pods?watch=true", timeout=10
+        )
+        for raw in req:
+            line = raw.strip()
+            if line:
+                events.append(json.loads(line))
+            if len(events) >= 2:
+                break
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    http.pods().create(make_pod("w1"))
+    store_client.pods().bind(Binding("w1", "default", "x"))  # MODIFIED event
+    t.join(timeout=5)
+    assert [e["type"] for e in events[:2]] == ["ADDED", "MODIFIED"]
+    assert events[0]["object"]["metadata"]["name"] == "w1"
+
+
+def test_readme_scenario_over_http(api):
+    """sched.go:70-143 with the driver on the REST boundary: the scheduler
+    runs in-process against the same store the server fronts (the
+    reference's in-proc apiserver topology)."""
+    store_client, http, _ = api
+    from minisched_tpu.service.config import default_scheduler_config
+    from minisched_tpu.service.service import SchedulerService
+
+    svc = SchedulerService(store_client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    try:
+        for i in range(9):
+            http.nodes().create(make_node(f"node{i}", unschedulable=True))
+        http.pods().create(make_pod("pod1"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if svc.scheduler.queue.stats()["unschedulable"] == 1:
+                break
+            time.sleep(0.02)
+        assert http.pods().get("pod1").spec.node_name == ""
+
+        http.nodes().create(make_node("node10"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if http.pods().get("pod1").spec.node_name == "node10":
+                break
+            time.sleep(0.02)
+        assert http.pods().get("pod1").spec.node_name == "node10"
+    finally:
+        svc.shutdown_scheduler()

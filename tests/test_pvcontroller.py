@@ -1,0 +1,101 @@
+"""PV controller: PVC→PV binding scenarios (the reference runs the real
+upstream PersistentVolume controller so these work — pvcontroller.go:16-44)."""
+
+from __future__ import annotations
+
+import time
+
+from minisched_tpu.api.objects import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PVCSpec,
+    PVSpec,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.controlplane.client import KIND_PV, KIND_PVC, Client
+from minisched_tpu.controlplane.pvcontroller import start_pv_controller
+
+GI = 1024**3
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _pv(name, capacity):
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=PVSpec(capacity=capacity),
+    )
+
+
+def _pvc(name, request):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name), spec=PVCSpec(request=request)
+    )
+
+
+def test_pvc_binds_to_sufficient_pv():
+    client = Client()
+    ctrl = start_pv_controller(client)
+    try:
+        client.store.create(KIND_PV, _pv("small", 1 * GI))
+        client.store.create(KIND_PV, _pv("big", 10 * GI))
+        client.store.create(KIND_PVC, _pvc("claim", 5 * GI))
+        assert _wait(
+            lambda: client.store.get(KIND_PVC, "default", "claim").status.phase
+            == "Bound"
+        )
+        pvc = client.store.get(KIND_PVC, "default", "claim")
+        assert pvc.spec.volume_name == "big"  # 1Gi PV too small
+        pv = client.store.get(KIND_PV, "", "big")
+        assert pv.spec.claim_ref == "default/claim"
+    finally:
+        ctrl.stop()
+
+
+def test_pvc_waits_for_pv_created_later():
+    """The reference scenario shape: a pending claim binds when a feasible
+    PV appears (event-driven rescan)."""
+    client = Client()
+    ctrl = start_pv_controller(client)
+    try:
+        client.store.create(KIND_PVC, _pvc("claim", 2 * GI))
+        time.sleep(0.1)
+        assert (
+            client.store.get(KIND_PVC, "default", "claim").status.phase
+            == "Pending"
+        )
+        client.store.create(KIND_PV, _pv("late", 4 * GI))
+        assert _wait(
+            lambda: client.store.get(KIND_PVC, "default", "claim").spec.volume_name
+            == "late"
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_bound_pv_not_double_claimed():
+    client = Client()
+    ctrl = start_pv_controller(client)
+    try:
+        client.store.create(KIND_PV, _pv("only", 4 * GI))
+        client.store.create(KIND_PVC, _pvc("first", 1 * GI))
+        assert _wait(
+            lambda: client.store.get(KIND_PVC, "default", "first").spec.volume_name
+            == "only"
+        )
+        client.store.create(KIND_PVC, _pvc("second", 1 * GI))
+        time.sleep(0.15)
+        assert (
+            client.store.get(KIND_PVC, "default", "second").spec.volume_name == ""
+        )
+    finally:
+        ctrl.stop()
