@@ -46,6 +46,10 @@ def _kind_for(collection: str) -> str:
             "persistentvolumeclaims": "PersistentVolumeClaim"}[collection]
 
 
+#: kinds stored under namespace "" regardless of URL/body (kube semantics)
+_CLUSTER_SCOPED = {"Node", "PersistentVolume"}
+
+
 def _route(path: str):
     """→ (kind, namespace, name, subresource) — name/sub may be ''."""
     parts = [p for p in path.split("/") if p]
@@ -107,7 +111,10 @@ class _Handler(BaseHTTPRequestHandler):
                 obj = self.store.get(kind, ns, name)
                 self._send(200, _encode(obj))
             else:
-                self._send(200, {"items": [_encode(o) for o in self.store.list(kind)]})
+                items = self.store.list(kind)
+                if ns:  # namespaced list filters, matching the watch verb
+                    items = [o for o in items if o.metadata.namespace == ns]
+                self._send(200, {"items": [_encode(o) for o in items]})
         except KeyError as e:
             self._error(404, str(e))
 
@@ -141,9 +148,14 @@ class _Handler(BaseHTTPRequestHandler):
                     {"type": ev.type.value, "object": _encode(ev.obj)}
                 ).encode() + b"\n"
                 chunk(line)
+            # orderly end-of-stream: terminal chunk, then drop keep-alive so
+            # neither side blocks waiting for the other
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:
+            self.close_connection = True
             watch.stop()
             with self.watch_lock:
                 self.active_watches.discard(watch)
@@ -170,8 +182,12 @@ class _Handler(BaseHTTPRequestHandler):
             except KeyError as e:
                 self._error(404, str(e))
             return
-        obj = _decode(KIND_TYPES[kind], self._body())
-        if kind == "Node":
+        try:
+            obj = _decode(KIND_TYPES[kind], self._body())
+        except Exception as e:
+            self._error(400, f"malformed body: {e}")
+            return
+        if kind in _CLUSTER_SCOPED:
             obj.metadata.namespace = ""
         elif ns:
             obj.metadata.namespace = ns  # the URL namespace wins (kube semantics)
@@ -188,7 +204,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, ValueError):
             self._error(404, f"no route {self.path}")
             return
-        obj = _decode(KIND_TYPES[kind], self._body())
+        try:
+            obj = _decode(KIND_TYPES[kind], self._body())
+        except Exception as e:
+            self._error(400, f"malformed body: {e}")
+            return
         # the URL is authoritative: a body naming a different object is a
         # client error, not a silent update of the other object
         if name and obj.metadata.name != name:
@@ -273,6 +293,8 @@ class HTTPClient:
             body = e.read().decode(errors="replace")
             if e.code == 409 and "already bound" in body:
                 raise AlreadyBound(body)
+            if e.code == 409 and "already exists" in body:
+                raise KeyError(body)  # == in-process store.create semantics
             if e.code == 404:
                 raise KeyError(body)
             raise RuntimeError(f"HTTP {e.code}: {body}")
@@ -299,15 +321,17 @@ class HTTPClient:
             self._c = c
             self._ns = ns
 
-        def _path(self, name: str = "") -> str:
-            p = f"/api/v1/namespaces/{self._ns}/pods"
+        def _path(self, name: str = "", namespace: Optional[str] = None) -> str:
+            p = f"/api/v1/namespaces/{namespace or self._ns}/pods"
             return f"{p}/{name}" if name else p
 
         def create(self, pod: Pod) -> Pod:
             return _decode(Pod, self._c._req("POST", self._path(), _encode(pod)))
 
         def get(self, name: str, namespace: Optional[str] = None) -> Pod:
-            return _decode(Pod, self._c._req("GET", self._path(name)))
+            return _decode(
+                Pod, self._c._req("GET", self._path(name, namespace))
+            )
 
         def list(self):
             out = self._c._req("GET", self._path())
@@ -319,7 +343,7 @@ class HTTPClient:
             )
 
         def delete(self, name: str, namespace: Optional[str] = None) -> None:
-            self._c._req("DELETE", self._path(name))
+            self._c._req("DELETE", self._path(name, namespace))
 
         def bind(self, binding: Binding) -> Pod:
             return _decode(

@@ -1,0 +1,156 @@
+"""Device-backed scheduling engine: the TPU path wired to the live
+control plane.
+
+The scalar engine (engine/scheduler.py) is the reference-shaped loop: one
+pod per cycle.  This engine is the TPU-native alternative behind the same
+control-plane contract: it drains the scheduling queue in WAVES
+(queue.pop_batch), builds the struct-of-arrays tables for the snapshot,
+evaluates the whole wave on device in repair mode (ops/repair.py — commits
+are conflict-free), then runs the host-side permit machinery and binds
+each placed pod.  Unplaced pods flow through the same ErrorFunc →
+unschedulableQ → event-gated requeue path as the scalar engine.
+
+Cross-pod plugins get per-wave constraint tables (models/constraints.py);
+the informer/event machinery, waiting-pod registry, and queue are shared
+with the scalar engine via subclassing — the device part replaces only
+the evaluate step, exactly the boundary SURVEY.md §7's design stance
+draws (host control plane / device batch evaluator).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from minisched_tpu.api.objects import Pod
+from minisched_tpu.engine.scheduler import Scheduler
+from minisched_tpu.framework.types import (
+    Diagnosis,
+    FitError,
+    QueuedPodInfo,
+    Status,
+)
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_node_table, build_pod_table, pad_to
+from minisched_tpu.ops.repair import RepairingEvaluator
+
+
+class DeviceScheduler(Scheduler):
+    """Scheduler whose evaluation step runs on device, a wave at a time."""
+
+    def __init__(self, *args, max_wave: int = 1024, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.max_wave = max_wave
+        self._needs_extra = any(
+            getattr(p, "needs_extra", False)
+            for p in (*self.filter_plugins, *self.score_plugins)
+        )
+        self._evaluator: Optional[RepairingEvaluator] = None
+
+    def _get_evaluator(self) -> RepairingEvaluator:
+        if self._evaluator is None:
+            self._evaluator = RepairingEvaluator(
+                self.filter_plugins,
+                self.pre_score_plugins,
+                self.score_plugins,
+                weights=self.score_weights,
+            )
+        return self._evaluator
+
+    # the loop: one wave per iteration instead of one pod ------------------
+    def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
+        qpis = self.queue.pop_batch(self.max_wave, timeout=timeout)
+        if not qpis:
+            return False
+        self.schedule_wave(qpis)
+        return True
+
+    def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
+        pods = [qpi.pod for qpi in qpis]
+        node_infos = self.snapshot_nodes()
+        if not node_infos:
+            for qpi in qpis:
+                self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
+            return
+        nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
+        assigned = [p for ni in node_infos for p in ni.pods]
+        by_node = {ni.name: list(ni.pods) for ni in node_infos}
+
+        node_table, node_names = build_node_table(nodes, by_node)
+        pod_table, _ = build_pod_table(
+            pods, capacity=pad_to(max(len(pods), self.max_wave))
+        )
+        extra = None
+        if self._needs_extra:
+            extra = build_constraint_tables(
+                pods, nodes, assigned,
+                pod_capacity=pod_table.capacity,
+                node_capacity=node_table.capacity,
+            )
+        _, choice, _ = self._get_evaluator()(pod_table, node_table, extra)
+        placements = choice.tolist()[: len(pods)]
+
+        for qpi, pod, c in zip(qpis, pods, placements):
+            if c < 0:
+                diagnosis = Diagnosis()
+                diagnosis.unschedulable_plugins = {
+                    p.name() for p in self.filter_plugins
+                }
+                self.error_func(qpi, FitError(pod, len(nodes), diagnosis))
+                if self.on_decision:
+                    self.on_decision(
+                        pod, None, Status.unschedulable("no feasible node")
+                    )
+                continue
+            self._permit_and_bind(qpi, pod, node_names[c])
+
+    def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
+        """Host-side tail of the cycle: permit plugins + detached bind —
+        identical to the scalar engine's (minisched.go:89-112)."""
+        from minisched_tpu.framework.types import CycleState
+
+        state = CycleState()
+        status = self.run_permit_plugins(state, pod, node_name)
+        if not status.is_success() and not status.is_wait():
+            self.error_func(qpi, status.as_error(), plugin=status.plugin)
+            if self.on_decision:
+                self.on_decision(pod, None, status)
+            return
+        t = threading.Thread(
+            target=self._binding_cycle,
+            args=(qpi, pod, node_name),
+            name=f"bind-{pod.metadata.name}",
+            daemon=True,
+        )
+        with self._bind_lock:
+            self._bind_threads.append(t)
+        t.start()
+
+
+def new_device_scheduler(
+    client: Any,
+    informer_factory: Any,
+    cfg: Any = None,
+    max_wave: int = 1024,
+) -> DeviceScheduler:
+    """Build a DeviceScheduler from a SchedulerConfig (default: the full
+    roster) — the device-mode analog of service.build_scheduler_from_config."""
+    from minisched_tpu.plugins.registry import build_plugins
+    from minisched_tpu.service.config import default_full_roster_config
+
+    cfg = cfg or default_full_roster_config()
+    chains = build_plugins(cfg)
+    sched = DeviceScheduler(
+        client,
+        informer_factory,
+        filter_plugins=chains.filter,
+        pre_score_plugins=chains.pre_score,
+        score_plugins=chains.score,
+        permit_plugins=chains.permit,
+        score_weights=cfg.score_weights(),
+        queue_opts=cfg.queue_opts,
+        max_wave=max_wave,
+    )
+    for p in chains.needs_handle:
+        p.h = sched
+    return sched

@@ -25,18 +25,27 @@ class SchedulerService:
         self.recorder = EventRecorder()
         self.result_store = None  # set by start_scheduler(record_results=True)
         self._record_results = False
+        self._device_mode = False
+        self._max_wave = 1024
 
     # scheduler/scheduler.go:50-80
     def start_scheduler(
         self,
         cfg: Optional[SchedulerConfig] = None,
         record_results: bool = False,
+        device_mode: bool = False,
+        max_wave: int = 1024,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
         the reference ships this layer but never wires it into
         StartScheduler (SURVEY.md §2 row 8: test-only); here it's opt-in.
         The store is exposed as ``self.result_store``.
+
+        ``device_mode=True`` runs the TPU wave engine
+        (engine/device_scheduler.py) instead of the scalar loop: queue
+        drained in waves of up to ``max_wave``, evaluated on device in
+        conflict-repairing mode.
         """
         if self._scheduler is not None:
             raise RuntimeError("scheduler already running; use restart_scheduler")
@@ -64,7 +73,14 @@ class SchedulerService:
                     on_update=self.result_store.add_scheduling_result_to_pod
                 )
             )
-        sched = build_scheduler_from_config(self._client, self._factory, cfg)
+        if device_mode:
+            from minisched_tpu.engine.device_scheduler import new_device_scheduler
+
+            sched = new_device_scheduler(
+                self._client, self._factory, cfg, max_wave=max_wave
+            )
+        else:
+            sched = build_scheduler_from_config(self._client, self._factory, cfg)
         self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")
         self._factory.start()
         if not self._factory.wait_for_cache_sync():
@@ -73,13 +89,18 @@ class SchedulerService:
         self._scheduler = sched
         self._current_cfg = orig_cfg
         self._record_results = record_results
+        self._device_mode = device_mode
+        self._max_wave = max_wave
         return sched
 
     # scheduler/scheduler.go:40-47
     def restart_scheduler(self, cfg: Optional[SchedulerConfig] = None) -> Scheduler:
         self.shutdown_scheduler()
         return self.start_scheduler(
-            cfg or self._current_cfg, record_results=self._record_results
+            cfg or self._current_cfg,
+            record_results=self._record_results,
+            device_mode=self._device_mode,
+            max_wave=self._max_wave,
         )
 
     # scheduler/scheduler.go:82-87

@@ -84,6 +84,48 @@ def test_bare_api_v1_is_404_not_dropped_connection(api):
         assert e.code == 404
 
 
+def test_pv_create_then_get_roundtrips(api):
+    """PVs are cluster-scoped: create-then-get through the same API must
+    work (regression: create forced namespace 'default', get used '')."""
+    from minisched_tpu.api.objects import ObjectMeta, PersistentVolume, PVSpec
+    from minisched_tpu.controlplane.checkpoint import _decode, _encode
+
+    _, http, _ = api
+    pv = PersistentVolume(metadata=ObjectMeta(name="pv1"), spec=PVSpec(capacity=5))
+    created = http._req("POST", "/api/v1/persistentvolumes", _encode(pv))
+    got = _decode(PersistentVolume, http._req("GET", "/api/v1/persistentvolumes/pv1"))
+    assert got.spec.capacity == 5
+    http._req("DELETE", "/api/v1/persistentvolumes/pv1")
+
+
+def test_namespaced_list_filters(api):
+    _, http, _ = api
+    http.pods("team-a").create(make_pod("a"))
+    http.pods().create(make_pod("b"))
+    assert [p.metadata.name for p in http.pods("team-a").list()] == ["a"]
+    assert [p.metadata.name for p in http.pods().list()] == ["b"]
+
+
+def test_duplicate_create_raises_keyerror_like_in_process(api):
+    _, http, _ = api
+    http.nodes().create(make_node("dup"))
+    with pytest.raises(KeyError):
+        http.nodes().create(make_node("dup"))
+
+
+def test_malformed_body_is_400(api):
+    _, http, base = api
+    req = urllib.request.Request(
+        base + "/api/v1/nodes", data=b"not json", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
 def test_healthz_and_404(api):
     _, _, base = api
     with urllib.request.urlopen(base + "/healthz") as r:
