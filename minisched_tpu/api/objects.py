@@ -336,6 +336,9 @@ class PodSpec:
     topology_spread_constraints: List[TopologySpreadConstraint] = field(
         default_factory=list
     )
+    #: names of PersistentVolumeClaims this pod mounts (the volumes list,
+    #: collapsed to its scheduler-relevant content)
+    volumes: List[str] = field(default_factory=list)
     priority: int = 0
     scheduler_name: str = "default-scheduler"
 
@@ -381,6 +384,9 @@ class Pod:
 class PVSpec:
     capacity: int = 0  # bytes
     claim_ref: str = ""  # namespace/name of bound PVC
+    #: node labels a consuming pod's node must carry (the PV nodeAffinity
+    #: required terms, collapsed to match-labels form)
+    required_node_labels: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

@@ -46,6 +46,60 @@ class DeviceScheduler(Scheduler):
             for p in (*self.filter_plugins, *self.score_plugins)
         )
         self._evaluator: Optional[RepairingEvaluator] = None
+        # assume-pod cache (upstream's scheduler cache AssumePod): a placed
+        # pod counts against its node IMMEDIATELY, before the async bind
+        # lands in the informer cache — without it, the next wave snapshots
+        # stale state and can double-book the capacity wave N just used
+        self._assumed: dict = {}  # uid → pod clone with node_name set
+        self._assumed_lock = threading.Lock()
+
+    # -- assume-pod cache ---------------------------------------------------
+    def _assume(self, pod: Pod, node_name: str) -> None:
+        assumed = pod.clone()
+        assumed.spec.node_name = node_name
+        with self._assumed_lock:
+            self._assumed[pod.metadata.uid] = assumed
+
+    def _forget(self, uid: str) -> None:
+        with self._assumed_lock:
+            self._assumed.pop(uid, None)
+
+    def snapshot_nodes(self):
+        # ONE pod-lister read feeds both the snapshot and the assumption
+        # pruning — a second read could observe a bind the snapshot missed
+        # and prune the assumption while counting the pod nowhere
+        from minisched_tpu.framework.nodeinfo import build_node_infos
+
+        nodes = sorted(
+            self.informer_factory.informer_for("Node").lister(),
+            key=lambda n: n.metadata.name,
+        )
+        cached_pods = self.informer_factory.informer_for("Pod").lister()
+        infos = build_node_infos(nodes, cached_pods)
+        with self._assumed_lock:
+            if not self._assumed:
+                return infos
+            all_uids = {p.metadata.uid for p in cached_pods}
+            cache_assigned = {
+                p.metadata.uid for p in cached_pods if p.spec.node_name
+            }
+            by_name = {ni.name: ni for ni in infos}
+            for uid in list(self._assumed):
+                assumed = self._assumed[uid]
+                if uid in cache_assigned or uid not in all_uids:
+                    # confirmed by the cache, or the pod was deleted —
+                    # either way the assumption must not count again
+                    del self._assumed[uid]
+                    continue
+                ni = by_name.get(assumed.spec.node_name)
+                if ni is not None:
+                    ni.add_pod(assumed)
+        return infos
+
+    def error_func(self, qpi: QueuedPodInfo, err, plugin: str = "") -> None:
+        # a failed permit/bind releases the assumed capacity
+        self._forget(qpi.pod.metadata.uid)
+        super().error_func(qpi, err, plugin)
 
     def _get_evaluator(self) -> RepairingEvaluator:
         if self._evaluator is None:
@@ -66,7 +120,6 @@ class DeviceScheduler(Scheduler):
         return True
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
-        pods = [qpi.pod for qpi in qpis]
         node_infos = self.snapshot_nodes()
         if not node_infos:
             for qpi in qpis:
@@ -76,19 +129,44 @@ class DeviceScheduler(Scheduler):
         assigned = [p for ni in node_infos for p in ni.pods]
         by_node = {ni.name: list(ni.pods) for ni in node_infos}
 
-        node_table, node_names = build_node_table(nodes, by_node)
-        pod_table, _ = build_pod_table(
-            pods, capacity=pad_to(max(len(pods), self.max_wave))
-        )
-        extra = None
-        if self._needs_extra:
-            extra = build_constraint_tables(
-                pods, nodes, assigned,
-                pod_capacity=pod_table.capacity,
-                node_capacity=node_table.capacity,
+        def build_and_evaluate(qpis_):
+            pods_ = [qpi.pod for qpi in qpis_]
+            node_table, node_names = build_node_table(nodes, by_node)
+            pod_table, _ = build_pod_table(
+                pods_, capacity=pad_to(max(len(pods_), self.max_wave))
             )
-        _, choice, _ = self._get_evaluator()(pod_table, node_table, extra)
-        placements = choice.tolist()[: len(pods)]
+            extra = None
+            if self._needs_extra:
+                extra = build_constraint_tables(
+                    pods_, nodes, assigned,
+                    pod_capacity=pod_table.capacity,
+                    node_capacity=node_table.capacity,
+                    pvcs=self.client.store.list("PersistentVolumeClaim"),
+                    pvs=self.client.store.list("PersistentVolume"),
+                )
+            _, choice, _ = self._get_evaluator()(pod_table, node_table, extra)
+            return node_names, choice.tolist()[: len(pods_)]
+
+        try:
+            node_names, placements = build_and_evaluate(qpis)
+        except ValueError:
+            # a pod exceeding a static table capacity (MAX_* in
+            # models/tables.py, MAX_VOLUMES in constraints.py) must be
+            # parked alone — not take the whole popped wave down
+            qpis = self._drop_unencodable(qpis)
+            if not qpis:
+                return
+            try:
+                node_names, placements = build_and_evaluate(qpis)
+            except Exception as err:
+                for qpi in qpis:  # never lose a popped wave: requeue all
+                    self.error_func(qpi, err)
+                return
+        except Exception as err:
+            for qpi in qpis:
+                self.error_func(qpi, err)
+            return
+        pods = [qpi.pod for qpi in qpis]
 
         for qpi, pod, c in zip(qpis, pods, placements):
             if c < 0:
@@ -102,7 +180,27 @@ class DeviceScheduler(Scheduler):
                         pod, None, Status.unschedulable("no feasible node")
                     )
                 continue
+            self._assume(pod, node_names[c])
             self._permit_and_bind(qpi, pod, node_names[c])
+
+    def _drop_unencodable(self, qpis: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
+        """Park pods whose specs exceed the static table capacities (they
+        can never be device-scheduled; the scalar engine could still take
+        them).  Each offender goes through error_func with its encode
+        error; the rest of the wave proceeds."""
+        good: List[QueuedPodInfo] = []
+        for qpi in qpis:
+            try:
+                build_pod_table([qpi.pod], capacity=128)
+                build_constraint_tables([qpi.pod], [], [], pod_capacity=128,
+                                        node_capacity=128)
+            except ValueError as err:
+                self.error_func(qpi, err)
+                if self.on_decision:
+                    self.on_decision(qpi.pod, None, Status.from_error(err))
+                continue
+            good.append(qpi)
+        return good
 
     def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
         """Host-side tail of the cycle: permit plugins + detached bind —
@@ -151,6 +249,10 @@ def new_device_scheduler(
         queue_opts=cfg.queue_opts,
         max_wave=max_wave,
     )
+    from minisched_tpu.service.service import _inject
+
     for p in chains.needs_handle:
-        p.h = sched
+        _inject(p, "h", sched)
+    for p in chains.needs_client:
+        _inject(p, "store_client", client)
     return sched

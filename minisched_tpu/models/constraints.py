@@ -37,6 +37,7 @@ import numpy as np
 from minisched_tpu.api.objects import LabelSelector, PodAffinityTerm
 from minisched_tpu.models.tables import _register_table, pad_to
 
+MAX_VOLUMES = 4  # PVC references per pod
 MAX_TSC = 4  # topology spread constraints per pod
 MAX_PA = 4  # required pod-affinity terms per pod
 MAX_PAN = 4  # required pod-anti-affinity terms per pod
@@ -88,6 +89,13 @@ class ConstraintTables:
     # reverse direction: assigned pods' required anti-affinity terms
     ex_domain: Any  # bool[T, N] nodes in the owning pod's topo domain
     pod_matches_ex: Any  # bool[P, T] pending pod matches term selector
+    # volume coupling (VolumeBinding / NodeVolumeLimits)
+    claim_mask: Any  # bool[C2, N] nodes OK for referenced claim c (bound
+    #                  PV's node labels, or ∃ bindable free PV)
+    pod_claims: Any  # i32[P, MAX_VOLUMES] indices into claim_mask
+    vol_ok: Any  # bool[P] every referenced PVC exists
+    node_vol_count: Any  # i32[N] volumes mounted by assigned pods
+    pod_n_vols: Any  # i32[P] volumes this pod mounts
 
 
 def _selector_sig(sel: LabelSelector) -> Tuple:
@@ -174,12 +182,15 @@ def build_constraint_tables(
     assigned_pods: Sequence[Any],
     pod_capacity: Optional[int] = None,
     node_capacity: Optional[int] = None,
+    pvcs: Sequence[Any] = (),
+    pvs: Sequence[Any] = (),
 ) -> ConstraintTables:
     """Build the wave's coupling tables.
 
     ``nodes`` must be in the SAME order as the NodeTable build (name-sorted)
     so node indices line up.  ``assigned_pods`` are pods with
-    ``spec.node_name`` set; others are ignored.
+    ``spec.node_name`` set; others are ignored.  ``pvcs``/``pvs`` feed the
+    volume coupling planes (VolumeBinding / NodeVolumeLimits).
     """
     P = pod_capacity or pad_to(len(pending_pods))
     N = node_capacity or pad_to(len(nodes))
@@ -291,6 +302,42 @@ def build_constraint_tables(
         for i, pod in enumerate(pending_pods):
             pod_matches_ex[i, t] = _matches(sel, nss, pod)
 
+    # --- volume coupling ---------------------------------------------------
+    # feasibility semantics come from ONE place — the VolumeBinding plugin —
+    # so the host-side tables can never drift from the scalar filter
+    from minisched_tpu.plugins.volumebinding import claim_node_mask
+
+    pvc_by_key = {pvc.metadata.key: pvc for pvc in pvcs}
+
+    claim_ids: Dict[str, int] = {}
+    claim_rows: List[List[bool]] = []
+    vol_ok = np.zeros(P, bool)
+    pod_claims = np.zeros((P, MAX_VOLUMES), np.int32)
+    pod_n_vols = np.zeros(P, np.int32)
+    for i, pod in enumerate(pending_pods):
+        vols = pod.spec.volumes
+        if len(vols) > MAX_VOLUMES:
+            raise ValueError(f"pod {pod.metadata.name}: >{MAX_VOLUMES} volumes")
+        pod_n_vols[i] = len(vols)
+        ok = True
+        for j, vol in enumerate(vols):
+            key = f"{pod.metadata.namespace}/{vol}"
+            if key not in pvc_by_key:
+                ok = False
+                continue
+            if key not in claim_ids:
+                claim_ids[key] = len(claim_rows)
+                claim_rows.append(claim_node_mask(pvc_by_key[key], pvs, nodes))
+            pod_claims[i, j] = claim_ids[key]
+        vol_ok[i] = ok
+    C2 = pad_to(max(len(claim_rows), 1), 8)
+    claim_mask = np.zeros((C2, N), bool)
+    for cid, row in enumerate(claim_rows):
+        claim_mask[cid, : len(row)] = row
+    node_vol_count = np.zeros(N, np.int32)
+    for p in assigned:
+        node_vol_count[node_idx[p.spec.node_name]] += len(p.spec.volumes)
+
     # --- per-pod constraint arrays ----------------------------------------
     ts_combo = np.zeros((P, MAX_TSC), np.int32)
     ts_skew = np.zeros((P, MAX_TSC), np.int32)
@@ -330,6 +377,8 @@ def build_constraint_tables(
             pan_combo=pan_combo, pan_n=pan_n,
             ppa_combo=ppa_combo, ppa_w=ppa_w, ppa_n=ppa_n,
             ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
+            claim_mask=claim_mask, pod_claims=pod_claims, vol_ok=vol_ok,
+            node_vol_count=node_vol_count, pod_n_vols=pod_n_vols,
         ).items()
     }
     return ConstraintTables(**as_j)

@@ -50,6 +50,7 @@ def accept_placements(
     active,
     check_resources: bool = True,
     check_ports: bool = True,
+    vol_state=None,
 ):
     """bool[P]: which tentative placements commit this round.
 
@@ -64,10 +65,17 @@ def accept_placements(
     on purpose, like the reference would), and with the Fit filter present
     the first candidate per node always fits, which is what guarantees a
     commit per contested node per round (convergence).
+
+    ``vol_state``: (pod_n_vols i32[P], node_vol_count i32[N], max_volumes)
+    when NodeVolumeLimits is in the chain — volume counts then join the
+    cumulative-demand rule.  (Same-round double-booking of one FREE
+    PersistentVolume is out of acceptance's scope: the PV controller binds
+    a claim exactly once, so the loser fails at bind time and requeues —
+    the same race two racing schedulers have upstream.)
     """
     P = choice.shape[0]
     live = active & (choice >= 0)
-    if not check_resources and not check_ports:
+    if not check_resources and not check_ports and vol_state is None:
         return live
     # sort by (node, pod index): key groups node segments, index-ordered
     key = jnp.where(live, choice, _INF32 // (P + 1)) * (P + 1) + jnp.arange(P)
@@ -103,7 +111,7 @@ def accept_placements(
         port_ok = jnp.ones(P, bool)
 
     eligible = s_live & port_ok[order]
-    if not check_resources:
+    if not check_resources and vol_state is None:
         return jnp.zeros(P, bool).at[order].set(eligible) & live
 
     def prefix_fits(pod_amt, node_req, node_alloc):
@@ -116,13 +124,20 @@ def accept_placements(
         return within_ex + amt <= headroom
 
     ones = jnp.ones(P, jnp.int32)
-    fits = (
-        prefix_fits(pods.req_cpu, nodes.req_cpu, nodes.alloc_cpu)
-        & prefix_fits(pods.req_mem, nodes.req_mem, nodes.alloc_mem)
-        & prefix_fits(pods.req_eph, nodes.req_eph, nodes.alloc_eph)
-        & prefix_fits(ones, nodes.req_pods, nodes.alloc_pods)
-        & eligible
-    )
+    fits = eligible
+    if check_resources:
+        fits = (
+            fits
+            & prefix_fits(pods.req_cpu, nodes.req_cpu, nodes.alloc_cpu)
+            & prefix_fits(pods.req_mem, nodes.req_mem, nodes.alloc_mem)
+            & prefix_fits(pods.req_eph, nodes.req_eph, nodes.alloc_eph)
+            & prefix_fits(ones, nodes.req_pods, nodes.alloc_pods)
+        )
+    if vol_state is not None:
+        pod_n_vols, node_vol_count, max_volumes = vol_state
+        fits = fits & prefix_fits(
+            pod_n_vols, node_vol_count, jnp.full_like(node_vol_count, max_volumes)
+        )
     # NOTE: the prefix rule is conservative only w.r.t. earlier *candidates*
     # that themselves fit — an earlier pod that does NOT fit still occupies
     # prefix demand this round; it is rejected and retried next round, so
@@ -152,40 +167,72 @@ def repair_wave_step(
     names = {pl.name() for pl in filter_plugins}
     check_resources = "NodeResourcesFit" in names
     check_ports = "NodePorts" in names
+    vol_limit = None
+    if extra is not None:
+        for pl in filter_plugins:
+            if pl.name() == "NodeVolumeLimits":
+                vol_limit = pl.max_volumes
 
     def cond(carry):
-        nodes_, committed, final, rnd, progress = carry
+        nodes_, committed, final, rnd, progress, vol_count = carry
         return progress & (rnd < max_rounds)
 
     def body(carry):
-        nodes_, committed, final, rnd, _ = carry
+        nodes_, committed, final, rnd, _, vol_count = carry
         import dataclasses
 
         active_pods = dataclasses.replace(
             pods, valid=pods.valid & ~committed
         )
+        # feed committed volume counts back into the FILTER too — otherwise
+        # a node filled to its volume limit in an earlier round keeps
+        # winning the argmax and the contender never moves to its runner-up
+        extra_ = (
+            dataclasses.replace(extra, node_vol_count=vol_count)
+            if vol_limit is not None
+            else extra
+        )
         result = evaluate(
             active_pods, nodes_, filter_plugins, pre_score_plugins,
-            score_plugins, ctx, extra=extra,
+            score_plugins, ctx, extra=extra_,
         )
         accept = accept_placements(
             nodes_, active_pods, result.choice, active_pods.valid,
             check_resources=check_resources, check_ports=check_ports,
+            vol_state=(
+                (extra.pod_n_vols, vol_count, vol_limit)
+                if vol_limit is not None
+                else None
+            ),
         )
         nodes_ = apply_placements(
             nodes_, active_pods, jnp.where(accept, result.choice, -1)
         )
+        if vol_limit is not None:
+            # carry the committed volume counts so later rounds (which see
+            # the static extra tables) can't blow the per-node limit
+            idx = jnp.where(accept, result.choice, 0)
+            vol_count = vol_count.at[idx].add(
+                jnp.where(accept, extra.pod_n_vols, 0)
+            )
         final = jnp.where(accept, result.choice, final)
         committed = committed | accept
         # stop when nothing committed AND no uncommitted pod is feasible
         retryable = active_pods.valid & (result.choice >= 0) & ~accept
         progress = jnp.any(accept) & jnp.any(retryable)
-        return nodes_, committed, final, rnd + 1, progress
+        return nodes_, committed, final, rnd + 1, progress, vol_count
 
     committed0 = ~pods.valid  # padding rows never schedule
     final0 = jnp.full((P,), -1, jnp.int32)
-    nodes, committed, final, rounds, _ = jax.lax.while_loop(
-        cond, body, (nodes, committed0, final0, jnp.int32(0), jnp.bool_(True))
+    vol_count0 = (
+        extra.node_vol_count
+        if vol_limit is not None
+        else jnp.zeros((nodes.valid.shape[0],), jnp.int32)
+    )
+    nodes, committed, final, rounds, _, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (nodes, committed0, final0, jnp.int32(0), jnp.bool_(True), vol_count0),
     )
     return nodes, final, rounds
 

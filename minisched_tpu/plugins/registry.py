@@ -52,6 +52,7 @@ def _ensure_builtins() -> None:
     )
     from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
     from minisched_tpu.plugins.tainttoleration import TaintToleration
+    from minisched_tpu.plugins.volumebinding import NodeVolumeLimits, VolumeBinding
 
     register("NodeUnschedulable", lambda args, ts: NodeUnschedulable())
     register("NodeNumber", lambda args, ts: NodeNumber(time_scale=ts))
@@ -71,6 +72,15 @@ def _ensure_builtins() -> None:
     register("ImageLocality", lambda args, ts: ImageLocality())
     register("InterPodAffinity", lambda args, ts: InterPodAffinity())
     register("PodTopologySpread", lambda args, ts: PodTopologySpread())
+    from minisched_tpu.plugins.volumebinding import DEFAULT_MAX_VOLUMES
+
+    register("VolumeBinding", lambda args, ts: VolumeBinding())
+    register(
+        "NodeVolumeLimits",
+        lambda args, ts: NodeVolumeLimits(
+            max_volumes=args.get("max_volumes", DEFAULT_MAX_VOLUMES)
+        ),
+    )
 
 
 @dataclass
@@ -81,6 +91,9 @@ class PluginChains:
     permit: List[Any] = field(default_factory=list)
     #: instances that need the waitingpod Handle injected (attribute ``h``)
     needs_handle: List[Any] = field(default_factory=list)
+    #: instances that need the control-plane client injected (attribute
+    #: ``store_client`` — volume plugins read the PV/PVC store)
+    needs_client: List[Any] = field(default_factory=list)
 
     def all_instances(self) -> List[Any]:
         seen: Dict[int, Any] = {}
@@ -120,4 +133,6 @@ def build_plugins(cfg: SchedulerConfig) -> PluginChains:
     for inst in instances.values():
         if hasattr(inst, "h"):
             chains.needs_handle.append(inst)
+        if hasattr(inst, "store_client"):
+            chains.needs_client.append(inst)
     return chains

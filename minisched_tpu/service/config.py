@@ -119,6 +119,8 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
                 PluginEnabled("NodeAffinity"),
                 PluginEnabled("NodePorts"),
                 PluginEnabled("NodeResourcesFit"),
+                PluginEnabled("VolumeBinding"),
+                PluginEnabled("NodeVolumeLimits"),
                 PluginEnabled("InterPodAffinity"),
                 PluginEnabled("PodTopologySpread"),
             ]

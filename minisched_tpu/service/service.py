@@ -8,7 +8,7 @@ Restart/Shutdown via cancellation (:40-47,82-87).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 from minisched_tpu.controlplane.client import Client, EventRecorder
 from minisched_tpu.controlplane.informer import SharedInformerFactory
@@ -142,8 +142,18 @@ def build_scheduler_from_config(
         queue_opts=cfg.queue_opts,
     )
     for p in chains.needs_handle:
-        p.h = sched
+        _inject(p, "h", sched)
+    for p in chains.needs_client:
+        _inject(p, "store_client", client)
     return sched
+
+
+def _inject(plugin: Any, attr: str, value: Any) -> None:
+    """Set an injected dependency on the REAL plugin: simulator wrappers
+    delegate reads through ``__getattr__`` but a plain setattr would land
+    on the wrapper, leaving the wrapped instance's attribute None."""
+    target = plugin._inner if hasattr(plugin, "_inner") else plugin
+    setattr(target, attr, value)
 
 
 __all__ = [

@@ -18,7 +18,7 @@ from minisched_tpu.service.config import (
 from minisched_tpu.service.service import SchedulerService
 
 
-def _wait(pred, timeout=15.0, interval=0.02):
+def _wait(pred, timeout=60.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():
@@ -40,7 +40,8 @@ def test_readme_scenario_on_device_engine():
             client.nodes().create(make_node(f"node{i}", unschedulable=True))
         client.pods().create(make_pod("pod1"))
         assert _wait(
-            lambda: svc.scheduler.queue.stats()["unschedulable"] == 1
+            lambda: svc.scheduler.queue.stats()["unschedulable"] == 1,
+            timeout=300.0,  # first wait absorbs the evaluator compile
         ), "pod1 should park in unschedulableQ"
         assert client.pods().get("pod1").spec.node_name == ""
 
@@ -72,7 +73,8 @@ def test_resource_wave_fills_cluster_without_overcommit():
         assert _wait(
             lambda: sum(
                 1 for p in client.pods().list() if p.spec.node_name
-            ) == 8
+            ) == 8,
+            timeout=300.0,  # first wait absorbs the evaluator compile
         ), "exactly the fitting 8 pods must bind"
         # accounting: no node exceeds 2 cpu
         usage = {}
@@ -123,7 +125,8 @@ def test_device_engine_matches_scalar_engine_placements():
                 )
             assert _wait(
                 lambda: sum(1 for p in client.pods().list() if p.spec.node_name) == 4
-                or svc.scheduler.queue.stats()["unschedulable"] > 0
+                or svc.scheduler.queue.stats()["unschedulable"] > 0,
+                timeout=300.0,  # first wait absorbs the evaluator compile
             )
             time.sleep(0.3)
             return sorted(

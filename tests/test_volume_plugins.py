@@ -1,0 +1,326 @@
+"""Volume plugins (VolumeBinding + NodeVolumeLimits): scalar behavior,
+batch parity, and the live PVC-gated scheduling scenario."""
+
+from __future__ import annotations
+
+import time
+
+from minisched_tpu.api.objects import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PVCSpec,
+    PVSpec,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.controlplane.client import KIND_PV, KIND_PVC, Client
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import CycleState
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.ops.fused import FusedEvaluator
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from minisched_tpu.plugins.volumebinding import NodeVolumeLimits, VolumeBinding
+
+GI = 1024**3
+
+
+def _pv(name, capacity=GI, claim="", labels=None):
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=PVSpec(
+            capacity=capacity, claim_ref=claim,
+            required_node_labels=dict(labels or {}),
+        ),
+    )
+
+
+def _pvc(name, request=GI, volume=""):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name),
+        spec=PVCSpec(request=request, volume_name=volume),
+    )
+
+
+def _client_with(nodes=(), pvs=(), pvcs=()):
+    client = Client()
+    for n in nodes:
+        client.nodes().create(n)
+    for pv in pvs:
+        client.store.create(KIND_PV, pv)
+    for pvc in pvcs:
+        client.store.create(KIND_PVC, pvc)
+    return client
+
+
+def _vb(client):
+    vb = VolumeBinding()
+    vb.store_client = client
+    return vb
+
+
+def test_missing_pvc_is_unresolvable():
+    client = _client_with(nodes=[make_node("n1")])
+    [ni] = build_node_infos([client.nodes().get("n1")], [])
+    pod = make_pod("p", volumes=["ghost"])
+    st = _vb(client).filter(CycleState(), pod, ni)
+    assert st.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE"
+
+
+def test_bound_claim_pins_to_pv_node_labels():
+    zone_a = make_node("a", labels={"zone": "a"})
+    zone_b = make_node("b", labels={"zone": "b"})
+    client = _client_with(
+        nodes=[zone_a, zone_b],
+        pvs=[_pv("pv1", claim="default/data", labels={"zone": "a"})],
+        pvcs=[_pvc("data", volume="pv1")],
+    )
+    infos = build_node_infos([zone_a, zone_b], [])
+    pod = make_pod("p", volumes=["data"])
+    vb = _vb(client)
+    assert vb.filter(CycleState(), pod, infos[0]).is_success()
+    assert not vb.filter(CycleState(), pod, infos[1]).is_success()
+
+
+def test_unbound_claim_needs_bindable_free_pv():
+    node = make_node("n1", labels={"zone": "a"})
+    client = _client_with(
+        nodes=[node],
+        pvs=[_pv("small", capacity=GI // 2), _pv("taken", claim="x/y")],
+        pvcs=[_pvc("want", request=GI)],
+    )
+    [ni] = build_node_infos([node], [])
+    pod = make_pod("p", volumes=["want"])
+    assert not _vb(client).filter(CycleState(), pod, ni).is_success()
+    client.store.create(KIND_PV, _pv("big", capacity=2 * GI))
+    assert _vb(client).filter(CycleState(), pod, ni).is_success()
+
+
+def test_node_volume_limits():
+    node = make_node("n1")
+    holder = make_pod("holder", volumes=["v1", "v2"])
+    holder.metadata.uid = "holder"
+    holder.spec.node_name = "n1"
+    [ni] = build_node_infos([node], [holder])
+    nvl = NodeVolumeLimits(max_volumes=3)
+    ok = make_pod("ok", volumes=["v3"])
+    over = make_pod("over", volumes=["v3", "v4"])
+    assert nvl.filter(CycleState(), ok, ni).is_success()
+    assert not nvl.filter(CycleState(), over, ni).is_success()
+
+
+def test_batch_parity_volume_chain():
+    """Oracle vs fused kernel with the volume planes in ConstraintTables."""
+    nodes = [
+        make_node("a", labels={"zone": "a"}),
+        make_node("b", labels={"zone": "b"}),
+    ]
+    pvs = [
+        _pv("pv-a", claim="default/bound-a", labels={"zone": "a"}),
+        _pv("free-b", capacity=2 * GI, labels={"zone": "b"}),
+    ]
+    pvcs = [_pvc("bound-a", volume="pv-a"), _pvc("loose", request=GI)]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [
+        make_pod("p-bound", volumes=["bound-a"]),   # → zone a only
+        make_pod("p-loose", volumes=["loose"]),      # → zone b only (free PV)
+        make_pod("p-ghost", volumes=["nope"]),       # → unschedulable
+        make_pod("p-free"),                          # → anywhere
+    ]
+    vb = _vb(client)
+    nvl = NodeVolumeLimits()
+    infos = build_node_infos(nodes, [])
+    # scalar oracle
+    from minisched_tpu.engine.scheduler import schedule_pod_once
+    from minisched_tpu.framework.types import FitError
+
+    oracle = []
+    for pod in pods:
+        try:
+            oracle.append(
+                schedule_pod_once([NodeUnschedulable(), vb, nvl], [], [], {},
+                                  pod, infos)
+            )
+        except FitError:
+            oracle.append("")
+    # batch
+    node_table, node_names = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    ev = FusedEvaluator([NodeUnschedulable(), vb, nvl], [], [])
+    res = ev(pod_table, node_table, extra)
+    batch = [
+        node_names[c] if c >= 0 else "" for c in res.choice.tolist()[: len(pods)]
+    ]
+    assert oracle == batch
+    assert batch[0] == "a" and batch[1] == "b" and batch[2] == ""
+
+
+def test_record_results_injects_client_through_wrapper():
+    """With record_results=True the VolumeBinding filter is simulator-
+    wrapped; the store client must reach the INNER plugin (regression:
+    setattr landed on the wrapper and the filter errored)."""
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(time_scale=0.01), record_results=True
+    )
+    try:
+        client.nodes().create(make_node("node1", labels={"zone": "a"}))
+        client.store.create(KIND_PV, _pv("pv1", claim="default/data"))
+        client.store.create(KIND_PVC, _pvc("data", volume="pv1"))
+        client.pods().create(make_pod("pod1", volumes=["data"]))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.pods().get("pod1").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert client.pods().get("pod1").spec.node_name == "node1"
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_claim_bound_to_missing_pv_unschedulable_in_both_paths():
+    """A PVC pointing at a deleted PV: scalar says unresolvable, batch
+    must agree the pod is unschedulable everywhere (regression: batch
+    placed it anywhere)."""
+    nodes = [make_node("n1")]
+    pvcs = [_pvc("orphan", volume="gone")]
+    client = _client_with(nodes=nodes, pvcs=pvcs)
+    pod = make_pod("p", volumes=["orphan"])
+    vb = _vb(client)
+    [ni] = build_node_infos(nodes, [])
+    assert not vb.filter(CycleState(), pod, ni).is_success()
+    node_table, _ = build_node_table(nodes)
+    pod_table, _ = build_pod_table([pod])
+    extra = build_constraint_tables(
+        [pod], nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=[],
+    )
+    res = FusedEvaluator([vb], [], [])(pod_table, node_table, extra)
+    assert int(res.choice[0]) == -1
+
+
+def test_repair_rounds_respect_volume_limits():
+    """One wave of volume-heavy pods must not exceed max_volumes on a node
+    (regression: acceptance ignored volume counts across rounds)."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes = [make_node("n1")]
+    pvcs = [_pvc(f"v{i}", volume=f"pv{i}") for i in range(10)]
+    pvs = [_pv(f"pv{i}", claim=f"default/v{i}") for i in range(10)]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [make_pod(f"p{i}", volumes=[f"v{2*i}", f"v{2*i+1}"]) for i in range(5)]
+    vb = _vb(client)
+    nvl = NodeVolumeLimits(max_volumes=4)  # only 2 two-volume pods fit
+    node_table, _ = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    ev = RepairingEvaluator([NodeUnschedulable(), vb, nvl], [], [])
+    _, choice, _ = ev(pod_table, node_table, extra)
+    placed = sum(1 for c in choice.tolist()[: len(pods)] if c >= 0)
+    assert placed == 2
+
+
+def test_repair_moves_to_runner_up_when_volumes_fill():
+    """When earlier rounds fill a node's volume limit, later rounds must
+    re-route contenders to other feasible nodes (regression: the filter
+    saw static counts, so the contender stuck to the full node forever)."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes = [make_node("n1"), make_node("n2")]
+    pvcs = [_pvc(f"v{i}", volume=f"pv{i}") for i in range(3)]
+    pvs = [_pv(f"pv{i}", claim=f"default/v{i}") for i in range(3)]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [make_pod(f"p{i}", volumes=[f"v{i}"]) for i in range(3)]
+    vb = _vb(client)
+    nvl = NodeVolumeLimits(max_volumes=2)
+    node_table, node_names = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    ev = RepairingEvaluator([NodeUnschedulable(), vb, nvl], [], [])
+    _, choice, _ = ev(pod_table, node_table, extra)
+    placements = [
+        node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]
+    ]
+    # ALL three pods place: two on one node, the third on the other
+    assert "" not in placements
+    assert len(set(placements)) == 2
+
+
+def test_device_wave_survives_overcap_pod():
+    """A pod with more volumes than the static table cap parks alone; the
+    rest of its wave still schedules (regression: whole wave dropped)."""
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(time_scale=0.01),
+        device_mode=True,
+        max_wave=16,
+    )
+    try:
+        client.nodes().create(make_node("node1"))
+        monster = make_pod("monster", volumes=[f"v{i}" for i in range(9)])
+        client.pods().create(monster)
+        client.pods().create(make_pod("normal1"))
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if client.pods().get("normal1").spec.node_name == "node1":
+                break
+            time.sleep(0.05)
+        assert client.pods().get("normal1").spec.node_name == "node1"
+        assert client.pods().get("monster").spec.node_name == ""
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_live_pod_waits_for_pv_then_schedules():
+    """Full loop: a pod with an unbound PVC parks; a feasible PV appears →
+    the PV event requeues it, the PV controller binds the claim, the pod
+    schedules (the reference's volume scenario shape)."""
+    from minisched_tpu.controlplane.pvcontroller import start_pv_controller
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    ctrl = start_pv_controller(client)
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_full_roster_config(time_scale=0.01))
+    try:
+        client.nodes().create(make_node("node1", labels={"zone": "a"}))
+        client.store.create(KIND_PVC, _pvc("data", request=GI))
+        client.pods().create(make_pod("pod1", volumes=["data"]))
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if svc.scheduler.queue.stats()["unschedulable"] == 1:
+                break
+            time.sleep(0.02)
+        assert client.pods().get("pod1").spec.node_name == ""
+
+        client.store.create(KIND_PV, _pv("late", capacity=2 * GI))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.pods().get("pod1").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert client.pods().get("pod1").spec.node_name == "node1"
+    finally:
+        svc.shutdown_scheduler()
+        ctrl.stop()
