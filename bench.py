@@ -238,7 +238,7 @@ def bench_config4() -> None:
     )
 
 
-def bench_headline() -> None:
+def bench_headline() -> dict:
     n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
     n_pods = int(os.environ.get("BENCH_PODS", 100_000))
     wave = int(os.environ.get("BENCH_WAVE", 8_192))
@@ -351,28 +351,29 @@ def bench_headline() -> None:
         f"→ {oracle_pods_per_sec:,.1f} pods/s"
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": "pods_scheduled_per_sec_10k_nodes_100k_pods",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(pods_per_sec / oracle_pods_per_sec, 2),
-            }
-        )
-    )
+    return {
+        "metric": "pods_scheduled_per_sec_10k_nodes_100k_pods",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / oracle_pods_per_sec, 2),
+    }
 
 
 def main() -> None:
     import jax
 
     log(f"devices: {jax.devices()}")
+    # the headline runs FIRST on a clean device: on the tunneled runtime,
+    # earlier evaluator executions leave the backend in a state where every
+    # later dispatch pays ~16ms (observed; survives clear_caches + gc), two
+    # orders of magnitude over the clean-device wave step
+    headline = bench_headline()
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         bench_config1()
         bench_config2()
         bench_config3()
         bench_config4()
-    bench_headline()
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

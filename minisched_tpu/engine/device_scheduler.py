@@ -192,8 +192,9 @@ class DeviceScheduler(Scheduler):
         for qpi in qpis:
             try:
                 build_pod_table([qpi.pod], capacity=128)
-                build_constraint_tables([qpi.pod], [], [], pod_capacity=128,
-                                        node_capacity=128)
+                if self._needs_extra:  # only caps the wave actually encodes
+                    build_constraint_tables([qpi.pod], [], [], pod_capacity=128,
+                                            node_capacity=128)
             except ValueError as err:
                 self.error_func(qpi, err)
                 if self.on_decision:
@@ -203,26 +204,11 @@ class DeviceScheduler(Scheduler):
         return good
 
     def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
-        """Host-side tail of the cycle: permit plugins + detached bind —
-        identical to the scalar engine's (minisched.go:89-112)."""
+        """Host-side tail of the cycle — the scalar engine's shared
+        reserve → permit → detached-bind helper (minisched.go:89-112)."""
         from minisched_tpu.framework.types import CycleState
 
-        state = CycleState()
-        status = self.run_permit_plugins(state, pod, node_name)
-        if not status.is_success() and not status.is_wait():
-            self.error_func(qpi, status.as_error(), plugin=status.plugin)
-            if self.on_decision:
-                self.on_decision(pod, None, status)
-            return
-        t = threading.Thread(
-            target=self._binding_cycle,
-            args=(qpi, pod, node_name),
-            name=f"bind-{pod.metadata.name}",
-            daemon=True,
-        )
-        with self._bind_lock:
-            self._bind_threads.append(t)
-        t.start()
+        self._reserve_permit_and_fork(qpi, pod, node_name, CycleState())
 
 
 def new_device_scheduler(
@@ -245,6 +231,7 @@ def new_device_scheduler(
         pre_score_plugins=chains.pre_score,
         score_plugins=chains.score,
         permit_plugins=chains.permit,
+        reserve_plugins=chains.reserve,
         score_weights=cfg.score_weights(),
         queue_opts=cfg.queue_opts,
         max_wave=max_wave,

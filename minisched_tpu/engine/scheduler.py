@@ -248,6 +248,7 @@ class Scheduler:
         permit_plugins: List[Any],
         score_weights: Optional[Dict[str, int]] = None,
         queue_opts: Optional[dict] = None,
+        reserve_plugins: Optional[List[Any]] = None,
     ):
         self.client = client
         self.informer_factory = informer_factory
@@ -255,6 +256,7 @@ class Scheduler:
         self.pre_score_plugins = pre_score_plugins
         self.score_plugins = score_plugins
         self.permit_plugins = permit_plugins
+        self.reserve_plugins = reserve_plugins or []
         self.score_weights = score_weights or {}
 
         # EventsToRegister → ClusterEventMap (initialize.go:68-75)
@@ -356,21 +358,39 @@ class Scheduler:
             self.metrics.observe("cycle_failed", time.monotonic() - t_cycle)
             return True
 
-        # permit phase (minisched.go:89-94)
-        with self.metrics.timed("permit"):
-            status = self.run_permit_plugins(state, pod, node_name)
-        if not status.is_success() and not status.is_wait():
+        forked = self._reserve_permit_and_fork(qpi, pod, node_name, state)
+        self.metrics.observe(
+            "cycle" if forked else "cycle_failed", time.monotonic() - t_cycle
+        )
+        return True
+
+    def _reserve_permit_and_fork(
+        self, qpi: QueuedPodInfo, pod: Pod, node_name: str, state: CycleState
+    ) -> bool:
+        """The host-side tail every engine shares: reserve (upstream
+        RunReservePlugins — rolled back on any later failure) → permit
+        (minisched.go:89-94) → detach the binding cycle (minisched.go:96-112).
+        Returns False when the pod failed (already sent through error_func).
+        """
+        status = self.run_reserve_plugins(state, pod, node_name)
+        if not status.is_success():
             self.error_func(qpi, status.as_error(), plugin=status.plugin)
             if self.on_decision:
                 self.on_decision(pod, None, status)
-            self.metrics.observe("cycle_failed", time.monotonic() - t_cycle)
-            return True
-        self.metrics.observe("cycle", time.monotonic() - t_cycle)
+            return False
 
-        # binding cycle forked; the loop continues (minisched.go:96-112)
+        with self.metrics.timed("permit"):
+            status = self.run_permit_plugins(state, pod, node_name)
+        if not status.is_success() and not status.is_wait():
+            self.run_unreserve_plugins(state, pod, node_name)
+            self.error_func(qpi, status.as_error(), plugin=status.plugin)
+            if self.on_decision:
+                self.on_decision(pod, None, status)
+            return False
+
         t = threading.Thread(
             target=self._binding_cycle,
-            args=(qpi, pod, node_name),
+            args=(qpi, pod, node_name, state),
             name=f"bind-{pod.metadata.name}",
             daemon=True,
         )
@@ -447,6 +467,27 @@ class Scheduler:
             return Status.success()
         return Status.wait()
 
+    def run_reserve_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> Status:
+        """Upstream RunReservePlugins: first failure unreserves, in reverse,
+        every plugin that already reserved (including the failing one)."""
+        done: List[Any] = []
+        for pl in self.reserve_plugins:
+            done.append(pl)
+            status = pl.reserve(state, pod, node_name)
+            if status is not None and not status.is_success():
+                for prev in reversed(done):
+                    prev.unreserve(state, pod, node_name)
+                return status.with_plugin(status.plugin or pl.name())
+        return Status.success()
+
+    def run_unreserve_plugins(
+        self, state: CycleState, pod: Pod, node_name: str
+    ) -> None:
+        for pl in reversed(self.reserve_plugins):
+            pl.unreserve(state, pod, node_name)
+
     def get_waiting_pod(self, uid: str) -> Optional[WaitingPod]:
         with self._waiting_lock:
             return self._waiting_pods.get(uid)
@@ -467,11 +508,19 @@ class Scheduler:
             Binding(pod.metadata.name, pod.metadata.namespace, node_name)
         )
 
-    def _binding_cycle(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
+    def _binding_cycle(
+        self,
+        qpi: QueuedPodInfo,
+        pod: Pod,
+        node_name: str,
+        state: Optional[CycleState] = None,
+    ) -> None:
+        state = state if state is not None else CycleState()
         try:
             with self.metrics.timed("wait_on_permit"):
                 status = self.wait_on_permit(pod)
             if not status.is_success():
+                self.run_unreserve_plugins(state, pod, node_name)
                 self.error_func(qpi, status.as_error(), plugin=status.plugin)
                 if self.on_decision:
                     self.on_decision(pod, None, status)
@@ -481,6 +530,7 @@ class Scheduler:
             if self.on_decision:
                 self.on_decision(pod, node_name, Status.success())
         except Exception as err:
+            self.run_unreserve_plugins(state, pod, node_name)
             self.error_func(qpi, err)
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))

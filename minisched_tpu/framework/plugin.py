@@ -98,6 +98,19 @@ class ScorePlugin(Protocol):
 
 
 @runtime_checkable
+class ReservePlugin(Protocol):
+    """Reserve/Unreserve (upstream framework.ReservePlugin): claim
+    plugin-held resources for a chosen (pod, node) before permit/bind;
+    Unreserve rolls the claim back when a later phase fails."""
+
+    def name(self) -> str: ...
+
+    def reserve(self, state: CycleState, pod: Any, node_name: str) -> Status: ...
+
+    def unreserve(self, state: CycleState, pod: Any, node_name: str) -> None: ...
+
+
+@runtime_checkable
 class PermitPlugin(Protocol):
     def name(self) -> str: ...
 
@@ -183,6 +196,10 @@ def implements_score(p: Any) -> bool:
 
 def implements_permit(p: Any) -> bool:
     return callable(getattr(p, "permit", None))
+
+
+def implements_reserve(p: Any) -> bool:
+    return callable(getattr(p, "reserve", None))
 
 
 def implements_enqueue(p: Any) -> bool:

@@ -121,7 +121,10 @@ def accept_placements(
         within_ex = ex - ex[seg]  # demand of earlier accepted-candidates
         idx = jnp.where(s_live, s_choice, 0)
         headroom = (node_alloc - node_req)[idx]
-        return within_ex + amt <= headroom
+        # zero-demand pods always pass, mirroring the filters (a pod that
+        # requests nothing fits even an over-committed node — the scalar
+        # NodeResourcesFit/NodeVolumeLimits semantics)
+        return (amt == 0) | (within_ex + amt <= headroom)
 
     ones = jnp.ones(P, jnp.int32)
     fits = eligible

@@ -17,6 +17,7 @@ from minisched_tpu.framework.plugin import (
     implements_filter,
     implements_permit,
     implements_pre_score,
+    implements_reserve,
     implements_score,
 )
 from minisched_tpu.service.config import SchedulerConfig
@@ -88,6 +89,7 @@ class PluginChains:
     filter: List[Any] = field(default_factory=list)
     pre_score: List[Any] = field(default_factory=list)
     score: List[Any] = field(default_factory=list)
+    reserve: List[Any] = field(default_factory=list)
     permit: List[Any] = field(default_factory=list)
     #: instances that need the waitingpod Handle injected (attribute ``h``)
     needs_handle: List[Any] = field(default_factory=list)
@@ -97,7 +99,8 @@ class PluginChains:
 
     def all_instances(self) -> List[Any]:
         seen: Dict[int, Any] = {}
-        for chain in (self.filter, self.pre_score, self.score, self.permit):
+        for chain in (self.filter, self.pre_score, self.score, self.reserve,
+                      self.permit):
             for p in chain:
                 seen[id(p)] = p
         return list(seen.values())
@@ -107,6 +110,7 @@ _CAPABILITY_CHECKS = {
     "filter": implements_filter,
     "pre_score": implements_pre_score,
     "score": implements_score,
+    "reserve": implements_reserve,
     "permit": implements_permit,
 }
 

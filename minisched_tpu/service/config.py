@@ -71,6 +71,7 @@ class SchedulerConfig:
     filter: PluginSet = field(default_factory=PluginSet)
     pre_score: PluginSet = field(default_factory=PluginSet)
     score: PluginSet = field(default_factory=PluginSet)
+    reserve: PluginSet = field(default_factory=PluginSet)
     permit: PluginSet = field(default_factory=PluginSet)
     plugin_args: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     queue_opts: Dict[str, Any] = field(default_factory=dict)
@@ -87,6 +88,7 @@ class SchedulerConfig:
             "filter": self.filter,
             "pre_score": self.pre_score,
             "score": self.score,
+            "reserve": self.reserve,
             "permit": self.permit,
         }
 

@@ -138,6 +138,7 @@ def build_scheduler_from_config(
         pre_score_plugins=chains.pre_score,
         score_plugins=chains.score,
         permit_plugins=chains.permit,
+        reserve_plugins=chains.reserve,
         score_weights=cfg.score_weights(),
         queue_opts=cfg.queue_opts,
     )

@@ -90,6 +90,26 @@ def test_bind_independent_chain_converges_in_one_round():
     assert placements == batch_placements(pods, nodes, filters, [nn], [nn])
 
 
+def test_zero_demand_pod_accepted_on_overcommitted_node():
+    """A pod requesting nothing passes the filters even on a node already
+    over capacity — acceptance must mirror that (regression: negative
+    headroom rejected zero-demand pods forever)."""
+    node = make_node("n0", capacity={"cpu": "1", "memory": "1Gi", "pods": 100})
+    hog = make_pod("hog", requests={"cpu": "2"})  # overcommitted already
+    hog.metadata.uid = "hog"
+    hog.spec.node_name = "n0"
+    from minisched_tpu.models.tables import build_node_table as bnt
+
+    node_table, node_names = bnt([node], {"n0": [hog]})
+    free = make_pod("free")  # zero requests
+    pod_table, _ = build_pod_table([free])
+    ev = RepairingEvaluator(
+        [NodeUnschedulable(), NodeResourcesFit()], [], [NodeResourcesLeastAllocated()]
+    )
+    _, choice, _ = ev(pod_table, node_table)
+    assert int(choice[0]) == 0  # placed despite negative cpu headroom
+
+
 def test_randomized_safety_invariants():
     """Random overcommit-heavy clusters: the final table never exceeds any
     allocatable, every placed pod respected the per-node arithmetic, and

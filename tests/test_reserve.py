@@ -1,0 +1,176 @@
+"""Reserve/Unreserve extension point (upstream framework.ReservePlugin):
+claim ordering, rollback on reserve failure, and rollback on permit/bind
+failure — plus a concurrency stress test of the scheduling queue (the
+race-detector-equivalent coverage SURVEY.md §5.2 calls for)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.engine.scheduler import Scheduler
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.types import QueuedPodInfo, Status
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from minisched_tpu.queue.queue import SchedulingQueue
+
+
+class RecordingReserve:
+    def __init__(self, name: str, fail: bool = False):
+        self._name = name
+        self.fail = fail
+        self.events = []
+
+    def name(self):
+        return self._name
+
+    def reserve(self, state, pod, node_name):
+        self.events.append(("reserve", pod.metadata.name, node_name))
+        if self.fail:
+            return Status.unschedulable("reserve refused")
+        return Status.success()
+
+    def unreserve(self, state, pod, node_name):
+        self.events.append(("unreserve", pod.metadata.name, node_name))
+
+
+class RejectingPermit:
+    def name(self):
+        return "RejectingPermit"
+
+    def permit(self, state, pod, node_name):
+        return Status.unschedulable("permit says no"), 0.0
+
+
+def _sched(client, **kwargs):
+    factory = SharedInformerFactory(client.store)
+    sched = Scheduler(
+        client,
+        factory,
+        filter_plugins=[NodeUnschedulable()],
+        pre_score_plugins=[],
+        score_plugins=[],
+        permit_plugins=kwargs.pop("permit_plugins", []),
+        reserve_plugins=kwargs.pop("reserve_plugins", []),
+    )
+    factory.start()
+    factory.wait_for_cache_sync()
+    return sched, factory
+
+
+def test_reserve_runs_before_bind_and_sticks_on_success():
+    client = Client()
+    r = RecordingReserve("R")
+    sched, factory = _sched(client, reserve_plugins=[r])
+    try:
+        client.nodes().create(make_node("n1"))
+        client.pods().create(make_pod("p1"))
+        assert sched.schedule_one(timeout=2.0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if client.pods().get("p1").spec.node_name:
+                break
+            time.sleep(0.02)
+        assert client.pods().get("p1").spec.node_name == "n1"
+        assert r.events == [("reserve", "p1", "n1")]  # no rollback
+    finally:
+        sched.stop()
+        factory.shutdown()
+
+
+def test_reserve_failure_rolls_back_in_reverse():
+    client = Client()
+    a = RecordingReserve("A")
+    b = RecordingReserve("B", fail=True)
+    sched, factory = _sched(client, reserve_plugins=[a, b])
+    try:
+        client.nodes().create(make_node("n1"))
+        client.pods().create(make_pod("p1"))
+        assert sched.schedule_one(timeout=2.0)
+        assert client.pods().get("p1").spec.node_name == ""
+        assert b.events == [("reserve", "p1", "n1"), ("unreserve", "p1", "n1")]
+        assert a.events == [("reserve", "p1", "n1"), ("unreserve", "p1", "n1")]
+        assert sched.queue.stats()["unschedulable"] == 1
+    finally:
+        sched.stop()
+        factory.shutdown()
+
+
+def test_permit_rejection_unreserves():
+    client = Client()
+    r = RecordingReserve("R")
+    sched, factory = _sched(
+        client, reserve_plugins=[r], permit_plugins=[RejectingPermit()]
+    )
+    try:
+        client.nodes().create(make_node("n1"))
+        client.pods().create(make_pod("p1"))
+        assert sched.schedule_one(timeout=2.0)
+        assert client.pods().get("p1").spec.node_name == ""
+        assert r.events == [("reserve", "p1", "n1"), ("unreserve", "p1", "n1")]
+    finally:
+        sched.stop()
+        factory.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# queue concurrency stress (SURVEY.md §5.2: the reference's NextPod busy-
+# wait/unlocked-pop race, fixed here — prove it under contention)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_concurrent_producers_consumers_and_events():
+    event_map = {
+        ClusterEvent(GVK.NODE, ActionType.ADD): {"X"},
+    }
+    q = SchedulingQueue(event_map=event_map)
+    n_pods = 300
+    popped = []
+    popped_lock = threading.Lock()
+    stop_consumers = threading.Event()
+
+    def producer(start):
+        rng = random.Random(start)
+        for i in range(start, start + n_pods // 3):
+            q.add(make_pod(f"pod{i}", namespace=f"ns{rng.randrange(3)}"))
+            if rng.random() < 0.2:
+                time.sleep(0.001)
+
+    def consumer():
+        while not stop_consumers.is_set():
+            qpi = q.pop(timeout=0.05)
+            if qpi is None:
+                continue
+            with popped_lock:
+                popped.append(qpi.pod.metadata.key)
+
+    def event_storm():
+        for _ in range(50):
+            q.move_all_to_active_or_backoff(ClusterEvent(GVK.NODE, ActionType.ADD))
+            time.sleep(0.001)
+
+    producers = [threading.Thread(target=producer, args=(i * 100,)) for i in range(3)]
+    consumers = [threading.Thread(target=consumer) for _ in range(4)]
+    storm = threading.Thread(target=event_storm)
+    for t in (*producers, *consumers, storm):
+        t.start()
+    for t in producers:
+        t.join(timeout=10)
+    storm.join(timeout=10)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with popped_lock:
+            if len(popped) >= n_pods:
+                break
+        time.sleep(0.01)
+    stop_consumers.set()
+    for t in consumers:
+        t.join(timeout=5)
+    # every produced pod popped exactly once — no loss, no duplication
+    assert len(popped) == n_pods
+    assert len(set(popped)) == n_pods
+    assert sum(q.stats().values()) == 0
