@@ -368,12 +368,14 @@ def main() -> None:
     # later dispatch pays ~16ms (observed; survives clear_caches + gc), two
     # orders of magnitude over the clean-device wave step
     headline = bench_headline()
+    # emit the JSON immediately: a crash in a secondary config must not
+    # discard the completed headline measurement
+    print(json.dumps(headline), flush=True)
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         bench_config1()
         bench_config2()
         bench_config3()
         bench_config4()
-    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

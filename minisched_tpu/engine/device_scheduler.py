@@ -20,6 +20,7 @@ draws (host control plane / device batch evaluator).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, List, Optional
 
 from minisched_tpu.api.objects import Pod
@@ -120,6 +121,8 @@ class DeviceScheduler(Scheduler):
         return True
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
+        t_wave = time.monotonic()
+        self.metrics.observe("wave_size", float(len(qpis)))
         node_infos = self.snapshot_nodes()
         if not node_infos:
             for qpi in qpis:
@@ -148,7 +151,8 @@ class DeviceScheduler(Scheduler):
             return node_names, choice.tolist()[: len(pods_)]
 
         try:
-            node_names, placements = build_and_evaluate(qpis)
+            with self.metrics.timed("wave_evaluate"):
+                node_names, placements = build_and_evaluate(qpis)
         except ValueError:
             # a pod exceeding a static table capacity (MAX_* in
             # models/tables.py, MAX_VOLUMES in constraints.py) must be
@@ -182,6 +186,7 @@ class DeviceScheduler(Scheduler):
                 continue
             self._assume(pod, node_names[c])
             self._permit_and_bind(qpi, pod, node_names[c])
+        self.metrics.observe("wave", time.monotonic() - t_wave)
 
     def _drop_unencodable(self, qpis: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
         """Park pods whose specs exceed the static table capacities (they

@@ -266,6 +266,7 @@ class Scheduler:
             for p in filter_plugins
             + pre_score_plugins
             + score_plugins
+            + self.reserve_plugins
             + permit_plugins
         }
         merge_event_registrations(

@@ -199,7 +199,11 @@ def implements_permit(p: Any) -> bool:
 
 
 def implements_reserve(p: Any) -> bool:
-    return callable(getattr(p, "reserve", None))
+    # both halves: a reserve without its rollback would crash the
+    # unguarded unreserve path on the first permit/bind failure
+    return callable(getattr(p, "reserve", None)) and callable(
+        getattr(p, "unreserve", None)
+    )
 
 
 def implements_enqueue(p: Any) -> bool:

@@ -85,6 +85,22 @@ class SchedulerService:
         self._factory.start()
         if not self._factory.wait_for_cache_sync():
             raise RuntimeError("informer caches failed to sync")
+        # per-decision cluster events (the reference's events broadcaster,
+        # scheduler.go:55-59: upstream emits Scheduled/FailedScheduling)
+        if sched.on_decision is None:
+            def emit(pod, node_name, status):
+                if node_name:
+                    self.recorder.eventf(
+                        pod, "Normal", "Scheduled",
+                        f"Successfully assigned {pod.metadata.key} to {node_name}",
+                    )
+                else:
+                    self.recorder.eventf(
+                        pod, "Warning", "FailedScheduling",
+                        "; ".join(status.reasons) or status.code.name,
+                    )
+
+            sched.on_decision = emit
         sched.run()
         self._scheduler = sched
         self._current_cfg = orig_cfg

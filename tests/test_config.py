@@ -1,0 +1,126 @@
+"""Config customization semantics — the reference's config-conversion test
+pillar (scheduler/scheduler_test.go:18-300): plugin enable/disable with the
+"*" wildcard, append ordering, weights, typed-args precedence — plus the
+scheduler event stream (the events-broadcaster role)."""
+
+from __future__ import annotations
+
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.service.config import (
+    PluginEnabled,
+    PluginSet,
+    SchedulerConfig,
+    apply_plugin_customization,
+    default_full_roster_config,
+    default_scheduler_config,
+)
+from minisched_tpu.service.service import SchedulerService
+
+
+def _names(ps: PluginSet):
+    return [e.name for e in ps.enabled]
+
+
+def test_empty_custom_keeps_defaults():
+    out = apply_plugin_customization(default_full_roster_config(), SchedulerConfig())
+    assert _names(out.filter) == _names(default_full_roster_config().filter)
+    assert _names(out.score) == _names(default_full_roster_config().score)
+
+
+def test_disable_specific_plugin():
+    custom = SchedulerConfig(filter=PluginSet(disabled=["NodePorts"]))
+    out = apply_plugin_customization(default_full_roster_config(), custom)
+    assert "NodePorts" not in _names(out.filter)
+    assert "NodeResourcesFit" in _names(out.filter)
+
+
+def test_wildcard_disable_then_enable():
+    """plugins.go:146-202's "*" semantics: drop all defaults, then the
+    custom enabled list applies in order."""
+    custom = SchedulerConfig(
+        score=PluginSet(
+            enabled=[PluginEnabled("NodeNumber", weight=7)], disabled=["*"]
+        )
+    )
+    out = apply_plugin_customization(default_full_roster_config(), custom)
+    assert _names(out.score) == ["NodeNumber"]
+    assert out.score.enabled[0].weight == 7
+
+
+def test_custom_enabled_appends_after_surviving_defaults():
+    custom = SchedulerConfig(filter=PluginSet(enabled=[PluginEnabled("NodeNumber")]))
+    out = apply_plugin_customization(default_full_roster_config(), custom)
+    assert _names(out.filter)[-1] == "NodeNumber"
+    assert _names(out.filter)[:-1] == _names(default_full_roster_config().filter)
+
+
+def test_duplicate_enable_not_doubled():
+    custom = SchedulerConfig(
+        filter=PluginSet(enabled=[PluginEnabled("NodeResourcesFit")])
+    )
+    out = apply_plugin_customization(default_full_roster_config(), custom)
+    assert _names(out.filter).count("NodeResourcesFit") == 1
+
+
+def test_plugin_args_user_wins():
+    """NewPluginConfig's Raw-vs-Object precedence collapses to plain dicts:
+    user entries replace default entries wholesale (plugins.go:77-141)."""
+    default = default_full_roster_config()
+    default.plugin_args["NodeVolumeLimits"] = {"max_volumes": 16}
+    custom = SchedulerConfig(
+        plugin_args={"NodeVolumeLimits": {"max_volumes": 4}}
+    )
+    out = apply_plugin_customization(default, custom)
+    assert out.plugin_args["NodeVolumeLimits"] == {"max_volumes": 4}
+
+
+def test_plugin_args_reach_the_instance():
+    from minisched_tpu.plugins.registry import build_plugins
+
+    cfg = default_full_roster_config()
+    cfg.plugin_args["NodeVolumeLimits"] = {"max_volumes": 5}
+    chains = build_plugins(cfg)
+    nvl = next(p for p in chains.filter if p.name() == "NodeVolumeLimits")
+    assert nvl.max_volumes == 5
+
+
+def test_reserve_extension_point_in_config():
+    from minisched_tpu.plugins.registry import build_plugins
+
+    cfg = default_scheduler_config()
+    cfg.reserve = PluginSet(enabled=[])  # present, empty by default
+    chains = build_plugins(cfg)
+    assert chains.reserve == []
+
+
+def test_scheduler_emits_scheduled_and_failed_events():
+    """The events-broadcaster role (scheduler.go:55-59): decisions land in
+    the recorder as Scheduled / FailedScheduling events."""
+    client = Client()
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    try:
+        client.nodes().create(make_node("node0", unschedulable=True))
+        client.pods().create(make_pod("pod1"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(e["reason"] == "FailedScheduling" for e in svc.recorder.events):
+                break
+            time.sleep(0.02)
+        assert any(
+            e["reason"] == "FailedScheduling" and e["object"] == "default/pod1"
+            for e in svc.recorder.events
+        )
+        client.nodes().create(make_node("node1"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(e["reason"] == "Scheduled" for e in svc.recorder.events):
+                break
+            time.sleep(0.02)
+        scheduled = [e for e in svc.recorder.events if e["reason"] == "Scheduled"]
+        assert scheduled and "node1" in scheduled[0]["message"]
+    finally:
+        svc.shutdown_scheduler()
