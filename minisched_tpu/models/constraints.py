@@ -365,9 +365,10 @@ def build_constraint_tables(
             ppa_combo[i, j], ppa_w[i, j] = cid, w
         ppa_n[i] = len(row["ppa"])
 
-    as_j = {
-        k: jnp.asarray(v)
-        for k, v in dict(
+    # one batched transfer (per-array device_put pays a dispatch RTT each)
+    from minisched_tpu.models.tables import batched_device_put
+
+    as_j = batched_device_put(dict(
             combo_dsum=combo_dsum, combo_haskey=combo_haskey,
             combo_global=combo_global, combo_here=combo_here,
             combo_key=combo_key, topo_domain=topo_domain,
@@ -379,6 +380,5 @@ def build_constraint_tables(
             ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
             claim_mask=claim_mask, pod_claims=pod_claims, vol_ok=vol_ok,
             node_vol_count=node_vol_count, pod_n_vols=pod_n_vols,
-        ).items()
-    }
+        ))
     return ConstraintTables(**as_j)

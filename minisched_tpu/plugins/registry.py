@@ -130,8 +130,14 @@ def build_plugins(cfg: SchedulerConfig) -> PluginChains:
                 instances[entry.name] = _REGISTRY[entry.name](args, cfg.time_scale)
             inst = instances[entry.name]
             if not _CAPABILITY_CHECKS[point](inst):
+                hint = (
+                    " (reserve plugins must define both reserve() and "
+                    "unreserve())"
+                    if point == "reserve"
+                    else ""
+                )
                 raise TypeError(
-                    f"plugin {entry.name!r} does not implement {point}"
+                    f"plugin {entry.name!r} does not implement {point}{hint}"
                 )
             getattr(chains, point).append(inst)
     for inst in instances.values():
