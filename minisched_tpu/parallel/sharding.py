@@ -126,6 +126,71 @@ def shard_tables(
     return pods, nodes
 
 
+class _CompiledShardedStep:
+    """One jitted executable per call signature (with/without the
+    constraint tables) — waves may alternate between the two.  ``fn`` is
+    ``fn(nodes, pods, extra=None)``; the node table is donated so updates
+    are in-place across waves."""
+
+    def __init__(self, mesh: Mesh, fn):
+        self._mesh = mesh
+        self._fn = fn
+        self._jitted = {}
+
+    def __call__(self, nodes, pods, extra=None):
+        key = extra is not None
+        if key not in self._jitted:
+            mesh, fn = self._mesh, self._fn
+            shardings = [node_sharding(mesh, nodes), pod_sharding(mesh, pods)]
+            if extra is not None:
+                shardings.append(constraint_sharding(mesh, extra))
+
+                def wrapped(nodes, pods, extra):
+                    return fn(nodes, pods, extra=extra)
+
+            else:
+
+                def wrapped(nodes, pods):
+                    return fn(nodes, pods)
+
+            self._jitted[key] = jax.jit(
+                wrapped,
+                in_shardings=tuple(shardings),
+                donate_argnums=(0,),
+            )
+        if extra is not None:
+            return self._jitted[key](nodes, pods, extra)
+        return self._jitted[key](nodes, pods)
+
+
+def sharded_repair_step(
+    mesh: Mesh,
+    filter_plugins,
+    pre_score_plugins,
+    score_plugins,
+    ctx,
+    max_rounds: int = 16,
+):
+    """The conflict-repair wave loop (ops/repair.repair_wave_step) jitted
+    with explicit shardings over ``mesh`` — same placement contract as
+    ``sharded_wave_step`` but never double-books a node.  The accept rule's
+    sort/segment scans run replicated per pod shard; the evaluate inside
+    each round keeps the (pods × nodes) tiles sharded on both axes."""
+    from functools import partial
+
+    from minisched_tpu.ops.repair import repair_wave_step
+
+    step = partial(
+        repair_wave_step,
+        filter_plugins=tuple(filter_plugins),
+        pre_score_plugins=tuple(pre_score_plugins),
+        score_plugins=tuple(score_plugins),
+        ctx=ctx,
+        max_rounds=max_rounds,
+    )
+    return _CompiledShardedStep(mesh, step)
+
+
 def sharded_wave_step(
     mesh: Mesh,
     filter_plugins,
@@ -153,26 +218,4 @@ def sharded_wave_step(
     def step(nodes, pods, extra=None):
         return wave_step(nodes, pods, *chains, ctx, extra=extra)
 
-    class _Compiled:
-        """One jitted executable per call signature (with/without the
-        constraint tables) — waves may alternate between the two."""
-
-        def __init__(self):
-            self._jitted = {}
-
-        def __call__(self, nodes, pods, extra=None):
-            key = extra is not None
-            if key not in self._jitted:
-                shardings = [node_sharding(mesh, nodes), pod_sharding(mesh, pods)]
-                if extra is not None:
-                    shardings.append(constraint_sharding(mesh, extra))
-                self._jitted[key] = jax.jit(
-                    step,
-                    in_shardings=tuple(shardings),
-                    donate_argnums=(0,),
-                )
-            if extra is not None:
-                return self._jitted[key](nodes, pods, extra)
-            return self._jitted[key](nodes, pods)
-
-    return _Compiled()
+    return _CompiledShardedStep(mesh, step)
