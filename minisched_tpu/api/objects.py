@@ -387,6 +387,12 @@ class PVSpec:
     #: node labels a consuming pod's node must carry (the PV nodeAffinity
     #: required terms, collapsed to match-labels form)
     required_node_labels: Dict[str, str] = field(default_factory=dict)
+    #: volume driver family — "ebs" / "gcepd" / "azuredisk" count against
+    #: their per-cloud attach limits (EBSLimits & friends, the volume-limit
+    #: members of the reference's default roster,
+    #: scheduler/scheduler_test.go:314-318); anything else is generic and
+    #: counts against NodeVolumeLimits
+    driver: str = ""
 
 
 @dataclass
@@ -403,6 +409,10 @@ class PersistentVolume:
 class PVCSpec:
     request: int = 0  # bytes
     volume_name: str = ""
+    #: the mount's access intent: read-only mounts of one volume may share
+    #: a node (VolumeRestrictions allows co-location only when every mount
+    #: of the volume is read-only)
+    read_only: bool = False
 
 
 @dataclass

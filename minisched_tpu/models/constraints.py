@@ -94,8 +94,19 @@ class ConstraintTables:
     #                  PV's node labels, or ∃ bindable free PV)
     pod_claims: Any  # i32[P, MAX_VOLUMES] indices into claim_mask
     vol_ok: Any  # bool[P] every referenced PVC exists
-    node_vol_count: Any  # i32[N] volumes mounted by assigned pods
     pod_n_vols: Any  # i32[P] volumes this pod mounts
+    # volume roster planes (VolumeZone / VolumeRestrictions / limit family)
+    claim_zone_ok: Any  # bool[C2, N] bound PV's zone labels match node
+    pod_vols_fam: Any  # i32[P, F] pod's volumes per driver family
+    node_vols_fam: Any  # i32[F, N] assigned volumes per driver family
+    # per-volume mount state (VolumeRestrictions): referenced claims bound
+    # to the same PV share a row; the repair loop carries these across
+    # rounds so intra-wave conflicts are enforced, not just assigned-pod
+    # ones.  Row Vd-1 is a dummy that unbound claims scatter into.
+    claim_vol: Any  # i32[C2] volume row of claim c; -1 when unbound
+    claim_ro: Any  # bool[C2] the claim mounts its volume read-only
+    vol_any: Any  # bool[Vd, N] some assigned pod on n mounts volume v
+    vol_rw: Any  # bool[Vd, N] ... with a writable mount
 
 
 def _selector_sig(sel: LabelSelector) -> Tuple:
@@ -174,6 +185,18 @@ def _topo_key_axis(combos, nodes) -> Tuple[Dict[str, int], Any, Any, Any]:
 
 def _matches(sel: LabelSelector, namespaces: Tuple[str, ...], pod: Any) -> bool:
     return pod.metadata.namespace in namespaces and sel.matches(pod.metadata.labels)
+
+
+def _claim_zone_row(pvc: Any, pv_by_name: Dict, nodes: Sequence[Any], zone_ok) -> List[bool]:
+    """VolumeZone's per-node verdict for one claim: unbound claims pass
+    everywhere (VolumeBinding owns them), a dangling volume_name passes
+    nowhere, bound claims defer to the plugin's pv_zone_ok."""
+    if not pvc.spec.volume_name:
+        return [True] * len(nodes)
+    pv = pv_by_name.get(pvc.spec.volume_name)
+    if pv is None:
+        return [False] * len(nodes)
+    return [zone_ok(pv, n) for n in nodes]
 
 
 def build_constraint_tables(
@@ -303,17 +326,40 @@ def build_constraint_tables(
             pod_matches_ex[i, t] = _matches(sel, nss, pod)
 
     # --- volume coupling ---------------------------------------------------
-    # feasibility semantics come from ONE place — the VolumeBinding plugin —
-    # so the host-side tables can never drift from the scalar filter
+    # feasibility semantics come from ONE place each — the VolumeBinding /
+    # VolumeZone / VolumeRestrictions / volume-limit plugins — so the
+    # host-side tables can never drift from the scalar filters
     from minisched_tpu.plugins.volumebinding import claim_node_mask
+    from minisched_tpu.plugins.volumelimits import FAMILIES, volume_family
+    from minisched_tpu.plugins.volumezone import pv_zone_ok
 
     pvc_by_key = {pvc.metadata.key: pvc for pvc in pvcs}
+    pv_by_name = {pv.metadata.name: pv for pv in pvs}
+    # claims mounted by assigned pods, grouped per node (restriction and
+    # family counting both walk these)
+    node_claims: List[List[Any]] = [[] for _ in range(len(nodes))]
+    for p in assigned:
+        for vol in p.spec.volumes:
+            opvc = pvc_by_key.get(f"{p.metadata.namespace}/{vol}")
+            node_claims[node_idx[p.spec.node_name]].append(opvc)
+
+    vol_ids: Dict[str, int] = {}  # volume_name → row of the vol planes
+
+    def vol_id(volume_name: str) -> int:
+        if volume_name not in vol_ids:
+            vol_ids[volume_name] = len(vol_ids)
+        return vol_ids[volume_name]
 
     claim_ids: Dict[str, int] = {}
     claim_rows: List[List[bool]] = []
+    zone_rows: List[List[bool]] = []
+    claim_vol_l: List[int] = []
+    claim_ro_l: List[bool] = []
     vol_ok = np.zeros(P, bool)
     pod_claims = np.zeros((P, MAX_VOLUMES), np.int32)
     pod_n_vols = np.zeros(P, np.int32)
+    F = len(FAMILIES)
+    pod_vols_fam = np.zeros((P, F), np.int32)
     for i, pod in enumerate(pending_pods):
         vols = pod.spec.volumes
         if len(vols) > MAX_VOLUMES:
@@ -324,19 +370,47 @@ def build_constraint_tables(
             key = f"{pod.metadata.namespace}/{vol}"
             if key not in pvc_by_key:
                 ok = False
+                pod_vols_fam[i, volume_family(None, pv_by_name)] += 1
                 continue
+            pvc = pvc_by_key[key]
+            pod_vols_fam[i, volume_family(pvc, pv_by_name)] += 1
             if key not in claim_ids:
                 claim_ids[key] = len(claim_rows)
-                claim_rows.append(claim_node_mask(pvc_by_key[key], pvs, nodes))
+                claim_rows.append(claim_node_mask(pvc, pvs, nodes))
+                zone_rows.append(_claim_zone_row(pvc, pv_by_name, nodes, pv_zone_ok))
+                claim_vol_l.append(
+                    vol_id(pvc.spec.volume_name) if pvc.spec.volume_name else -1
+                )
+                claim_ro_l.append(pvc.spec.read_only)
             pod_claims[i, j] = claim_ids[key]
         vol_ok[i] = ok
     C2 = pad_to(max(len(claim_rows), 1), 8)
     claim_mask = np.zeros((C2, N), bool)
+    claim_zone_ok = np.zeros((C2, N), bool)
+    claim_vol = np.full(C2, -1, np.int32)
+    claim_ro = np.zeros(C2, bool)
     for cid, row in enumerate(claim_rows):
         claim_mask[cid, : len(row)] = row
-    node_vol_count = np.zeros(N, np.int32)
-    for p in assigned:
-        node_vol_count[node_idx[p.spec.node_name]] += len(p.spec.volumes)
+        claim_zone_ok[cid, : len(row)] = zone_rows[cid]
+        claim_vol[cid] = claim_vol_l[cid]
+        claim_ro[cid] = claim_ro_l[cid]
+    # per-volume mount state from assigned pods: one pre-pass over node
+    # claims (O(assigned mounts)), rows only for volumes the wave's claims
+    # reference; last row stays a dummy scatter target for unbound claims
+    Vd = pad_to(len(vol_ids) + 1, 8)
+    vol_any = np.zeros((Vd, N), bool)
+    vol_rw = np.zeros((Vd, N), bool)
+    node_vols_fam = np.zeros((F, N), np.int32)
+    for n, claims in enumerate(node_claims):
+        for opvc in claims:
+            node_vols_fam[volume_family(opvc, pv_by_name), n] += 1
+            if opvc is None or not opvc.spec.volume_name:
+                continue
+            v = vol_ids.get(opvc.spec.volume_name)
+            if v is not None:
+                vol_any[v, n] = True
+                if not opvc.spec.read_only:
+                    vol_rw[v, n] = True
 
     # --- per-pod constraint arrays ----------------------------------------
     ts_combo = np.zeros((P, MAX_TSC), np.int32)
@@ -379,6 +453,10 @@ def build_constraint_tables(
             ppa_combo=ppa_combo, ppa_w=ppa_w, ppa_n=ppa_n,
             ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
             claim_mask=claim_mask, pod_claims=pod_claims, vol_ok=vol_ok,
-            node_vol_count=node_vol_count, pod_n_vols=pod_n_vols,
+            pod_n_vols=pod_n_vols,
+            claim_zone_ok=claim_zone_ok,
+            pod_vols_fam=pod_vols_fam, node_vols_fam=node_vols_fam,
+            claim_vol=claim_vol, claim_ro=claim_ro,
+            vol_any=vol_any, vol_rw=vol_rw,
         ))
     return ConstraintTables(**as_j)

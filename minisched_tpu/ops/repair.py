@@ -51,6 +51,7 @@ def accept_placements(
     check_resources: bool = True,
     check_ports: bool = True,
     vol_state=None,
+    restr_state=None,
 ):
     """bool[P]: which tentative placements commit this round.
 
@@ -66,16 +67,32 @@ def accept_placements(
     the first candidate per node always fits, which is what guarantees a
     commit per contested node per round (convergence).
 
-    ``vol_state``: (pod_n_vols i32[P], node_vol_count i32[N], max_volumes)
-    when NodeVolumeLimits is in the chain — volume counts then join the
-    cumulative-demand rule.  (Same-round double-booking of one FREE
-    PersistentVolume is out of acceptance's scope: the PV controller binds
-    a claim exactly once, so the loser fails at bind time and requeues —
-    the same race two racing schedulers have upstream.)
+    ``vol_state``: list of (pod_amt i32[P], node_count i32[N], max) triples,
+    one per volume-limit plugin in the chain (the EBS/GCEPD/Azure/generic
+    family split — plugins/volumelimits.py) — each family's counts then
+    join the cumulative-demand rule.  (Same-round double-booking of one
+    FREE PersistentVolume is out of acceptance's scope: the PV controller
+    binds a claim exactly once, so the loser fails at bind time and
+    requeues — the same race two racing schedulers have upstream.)
+
+    ``restr_state``: (pod_vol i32[P, V], pod_ro bool[P, V]) — per mount
+    slot, the volume row (−1 = unbound/none) and read-only flag — when
+    VolumeRestrictions is in the chain.  Same-round claims of one volume
+    on one node then follow the sequential-equivalent rule: the first pod
+    (index order) always survives; later pods survive only if both they
+    and the first are read-only.  (Exactly what a sequential bind order
+    yields: a writable first mount blocks everyone, a read-only first
+    mount admits read-only followers and rejects writable ones — a
+    rejected writable never blocks later read-only mounts.)
     """
     P = choice.shape[0]
     live = active & (choice >= 0)
-    if not check_resources and not check_ports and vol_state is None:
+    if (
+        not check_resources
+        and not check_ports
+        and vol_state is None
+        and restr_state is None
+    ):
         return live
     # sort by (node, pod index): key groups node segments, index-ordered
     key = jnp.where(live, choice, _INF32 // (P + 1)) * (P + 1) + jnp.arange(P)
@@ -110,7 +127,41 @@ def accept_placements(
     else:
         port_ok = jnp.ones(P, bool)
 
-    eligible = s_live & port_ok[order]
+    # same-round volume dedup (VolumeRestrictions): per (node, volume),
+    # sequential-equivalent rule — first pod in index order survives,
+    # later pods only when both they and the first mount read-only
+    if restr_state is not None:
+        pod_vol, pod_ro, n_vol_rows = restr_state
+        V = pod_vol.shape[1]
+        # a pod mounting one volume through two claims is a single mount —
+        # drop intra-pod duplicate slots so it can't lose to itself (the
+        # scalar filter only compares against OTHER pods)
+        dup_within = jnp.any(
+            (pod_vol[:, :, None] == pod_vol[:, None, :])
+            & (pod_vol[:, None, :] >= 0)
+            & (jnp.arange(V)[None, None, :] < jnp.arange(V)[None, :, None]),
+            axis=2,
+        )  # (P, V): an earlier slot already mounts this volume
+        slot_live = live[:, None] & (pod_vol >= 0) & ~dup_within
+        # key packs (node, volume); requires n_vol_rows * N < 2^31 (same
+        # discipline as the port key's node * 65536 above)
+        pair_key = choice[:, None] * jnp.int32(n_vol_rows) + pod_vol
+        flat_key = jnp.where(slot_live, pair_key, _INF32).reshape(-1)
+        # jnp.argsort is stable: pod-index order survives within equal keys
+        vorder = jnp.argsort(flat_key)
+        s_key = flat_key[vorder]
+        s_ro = pod_ro.reshape(-1)[vorder]
+        v_first = jnp.concatenate([jnp.array([True]), s_key[1:] != s_key[:-1]])
+        first_ro = s_ro[_segment_starts(s_key)]
+        ok_slot = v_first | (s_ro & first_ro)
+        v_loses = jnp.zeros(P * V, bool).at[vorder].set(
+            ~ok_slot & (s_key < _INF32)
+        )
+        restr_ok = ~jnp.any(v_loses.reshape(P, V), axis=1)  # (P,)
+    else:
+        restr_ok = jnp.ones(P, bool)
+
+    eligible = s_live & (port_ok & restr_ok)[order]
     if not check_resources and vol_state is None:
         return jnp.zeros(P, bool).at[order].set(eligible) & live
 
@@ -137,10 +188,10 @@ def accept_placements(
             & prefix_fits(ones, nodes.req_pods, nodes.alloc_pods)
         )
     if vol_state is not None:
-        pod_n_vols, node_vol_count, max_volumes = vol_state
-        fits = fits & prefix_fits(
-            pod_n_vols, node_vol_count, jnp.full_like(node_vol_count, max_volumes)
-        )
+        for pod_amt, node_count, max_volumes in vol_state:
+            fits = fits & prefix_fits(
+                pod_amt, node_count, jnp.full_like(node_count, max_volumes)
+            )
     # NOTE: the prefix rule is conservative only w.r.t. earlier *candidates*
     # that themselves fit — an earlier pod that does NOT fit still occupies
     # prefix demand this round; it is rejected and retried next round, so
@@ -170,31 +221,54 @@ def repair_wave_step(
     names = {pl.name() for pl in filter_plugins}
     check_resources = "NodeResourcesFit" in names
     check_ports = "NodePorts" in names
-    vol_limit = None
+    # volume-limit plugins in the chain, as (family index, max) pairs —
+    # EBS/GCEPD/Azure/generic all carry volume_family_index
+    # (plugins/volumelimits.py); detection is attribute-based so simulator
+    # wrappers (which forward attributes) are seen too
+    fam_limits: Tuple[Tuple[int, int], ...] = ()
+    check_restr = False
     if extra is not None:
-        for pl in filter_plugins:
-            if pl.name() == "NodeVolumeLimits":
-                vol_limit = pl.max_volumes
+        fam_limits = tuple(
+            (pl.volume_family_index, pl.max_volumes)
+            for pl in filter_plugins
+            if getattr(pl, "volume_family_index", None) is not None
+        )
+        check_restr = any(
+            getattr(pl, "enforces_volume_restrictions", False)
+            for pl in filter_plugins
+        )
+
+    if check_restr:
+        # per-mount-slot volume rows / read-only flags, fixed across rounds
+        V = extra.pod_claims.shape[1]
+        in_range = jnp.arange(V)[None, :] < extra.pod_n_vols[:, None]
+        slot_vol = jnp.where(
+            in_range, extra.claim_vol[extra.pod_claims], -1
+        )  # (P, V); −1 = unbound / no slot
+        slot_ro = extra.claim_ro[extra.pod_claims]  # (P, V)
+        n_vol_rows = extra.vol_any.shape[0]
+        dummy_row = n_vol_rows - 1  # never referenced by any claim_vol
 
     def cond(carry):
-        nodes_, committed, final, rnd, progress, vol_count = carry
+        nodes_, committed, final, rnd, progress, vols_fam, va, vr = carry
         return progress & (rnd < max_rounds)
 
     def body(carry):
-        nodes_, committed, final, rnd, _, vol_count = carry
+        nodes_, committed, final, rnd, _, vols_fam, va, vr = carry
         import dataclasses
 
         active_pods = dataclasses.replace(
             pods, valid=pods.valid & ~committed
         )
-        # feed committed volume counts back into the FILTER too — otherwise
-        # a node filled to its volume limit in an earlier round keeps
-        # winning the argmax and the contender never moves to its runner-up
-        extra_ = (
-            dataclasses.replace(extra, node_vol_count=vol_count)
-            if vol_limit is not None
-            else extra
-        )
+        # feed committed volume state back into the FILTER too — otherwise
+        # a node filled to its volume limit (or holding a conflicting
+        # mount) in an earlier round keeps winning the argmax and the
+        # contender never moves to its runner-up
+        extra_ = extra
+        if fam_limits:
+            extra_ = dataclasses.replace(extra_, node_vols_fam=vols_fam)
+        if check_restr:
+            extra_ = dataclasses.replace(extra_, vol_any=va, vol_rw=vr)
         result = evaluate(
             active_pods, nodes_, filter_plugins, pre_score_plugins,
             score_plugins, ctx, extra=extra_,
@@ -203,39 +277,59 @@ def repair_wave_step(
             nodes_, active_pods, result.choice, active_pods.valid,
             check_resources=check_resources, check_ports=check_ports,
             vol_state=(
-                (extra.pod_n_vols, vol_count, vol_limit)
-                if vol_limit is not None
+                [
+                    (extra.pod_vols_fam[:, f], vols_fam[f], mx)
+                    for f, mx in fam_limits
+                ]
+                if fam_limits
                 else None
+            ),
+            restr_state=(
+                (slot_vol, slot_ro, n_vol_rows) if check_restr else None
             ),
         )
         nodes_ = apply_placements(
             nodes_, active_pods, jnp.where(accept, result.choice, -1)
         )
-        if vol_limit is not None:
+        idx = jnp.where(accept, result.choice, 0)
+        if fam_limits:
             # carry the committed volume counts so later rounds (which see
             # the static extra tables) can't blow the per-node limit
-            idx = jnp.where(accept, result.choice, 0)
-            vol_count = vol_count.at[idx].add(
-                jnp.where(accept, extra.pod_n_vols, 0)
+            vols_fam = vols_fam.at[:, idx].add(
+                jnp.where(accept[None, :], extra.pod_vols_fam.T, 0)
             )
+        if check_restr:
+            # record the committed pods' mounts in the volume planes;
+            # non-accepted slots scatter into the dummy row
+            slot_acc = accept[:, None] & (slot_vol >= 0)
+            rows = jnp.where(slot_acc, slot_vol, dummy_row)
+            cols = jnp.broadcast_to(idx[:, None], rows.shape)
+            va = va.at[rows, cols].set(True)
+            rw_rows = jnp.where(slot_acc & ~slot_ro, slot_vol, dummy_row)
+            vr = vr.at[rw_rows, cols].set(True)
         final = jnp.where(accept, result.choice, final)
         committed = committed | accept
         # stop when nothing committed AND no uncommitted pod is feasible
         retryable = active_pods.valid & (result.choice >= 0) & ~accept
         progress = jnp.any(accept) & jnp.any(retryable)
-        return nodes_, committed, final, rnd + 1, progress, vol_count
+        return nodes_, committed, final, rnd + 1, progress, vols_fam, va, vr
 
     committed0 = ~pods.valid  # padding rows never schedule
     final0 = jnp.full((P,), -1, jnp.int32)
-    vol_count0 = (
-        extra.node_vol_count
-        if vol_limit is not None
-        else jnp.zeros((nodes.valid.shape[0],), jnp.int32)
+    vols_fam0 = (
+        extra.node_vols_fam
+        if fam_limits
+        else jnp.zeros((1, nodes.valid.shape[0]), jnp.int32)
     )
-    nodes, committed, final, rounds, _, _ = jax.lax.while_loop(
+    va0 = extra.vol_any if check_restr else jnp.zeros((1, 1), bool)
+    vr0 = extra.vol_rw if check_restr else jnp.zeros((1, 1), bool)
+    nodes, committed, final, rounds, _, _, _, _ = jax.lax.while_loop(
         cond,
         body,
-        (nodes, committed0, final0, jnp.int32(0), jnp.bool_(True), vol_count0),
+        (
+            nodes, committed0, final0, jnp.int32(0), jnp.bool_(True),
+            vols_fam0, va0, vr0,
+        ),
     )
     return nodes, final, rounds
 

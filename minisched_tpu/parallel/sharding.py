@@ -92,6 +92,14 @@ _CONSTRAINT_AXES = {
     "topo_unique": ("rep", None),
     "ex_domain": ("last", NODE_AXIS),
     "pod_matches_ex": ("first", POD_AXIS),
+    "claim_mask": ("last", NODE_AXIS),
+    "claim_zone_ok": ("last", NODE_AXIS),
+    "node_vols_fam": ("last", NODE_AXIS),
+    "pod_vols_fam": ("first", POD_AXIS),
+    "claim_vol": ("rep", None),
+    "claim_ro": ("rep", None),
+    "vol_any": ("last", NODE_AXIS),
+    "vol_rw": ("last", NODE_AXIS),
 }
 
 

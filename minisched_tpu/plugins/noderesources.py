@@ -49,14 +49,36 @@ def _nz_mem_mib(mib: int) -> int:
 
 class NodeResourcesFit(Plugin, BatchEvaluable):
     """Filter: pod's requests fit the node's remaining allocatable.
+    Also a scorer: the reference's default score roster enables
+    ``NodeResourcesFit`` at weight 1 with a ``ScoringStrategy`` of
+    ``LeastAllocated`` (scheduler/plugin/plugins_test.go:352,839-848), so
+    the Fit plugin delegates scoring to the strategy's scorer.
 
-    Upstream semantics: pod-count headroom always checked; per-resource
-    checks only for resources the pod actually requests (a zero request
-    fits even an overcommitted node).
+    Filter semantics (upstream): pod-count headroom always checked;
+    per-resource checks only for resources the pod actually requests (a
+    zero request fits even an overcommitted node).
     """
+
+    def __init__(self, scoring_strategy: str = "LeastAllocated"):
+        if scoring_strategy != "LeastAllocated":
+            raise ValueError(
+                f"unsupported ScoringStrategy {scoring_strategy!r} "
+                "(LeastAllocated only)"
+            )
+        self._scorer = NodeResourcesLeastAllocated()
 
     def name(self) -> str:
         return FIT_NAME
+
+    # -- score (strategy delegation) ---------------------------------------
+    def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
+        return self._scorer.score(state, pod, node_name)
+
+    def score_extensions(self):
+        return self._scorer.score_extensions()
+
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        return self._scorer.batch_score(ctx, pods, nodes, aux)
 
     # -- scalar ------------------------------------------------------------
     def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:

@@ -57,7 +57,12 @@ def _ensure_builtins() -> None:
 
     register("NodeUnschedulable", lambda args, ts: NodeUnschedulable())
     register("NodeNumber", lambda args, ts: NodeNumber(time_scale=ts))
-    register("NodeResourcesFit", lambda args, ts: NodeResourcesFit())
+    register(
+        "NodeResourcesFit",
+        lambda args, ts: NodeResourcesFit(
+            scoring_strategy=args.get("scoring_strategy", "LeastAllocated")
+        ),
+    )
     register(
         "NodeResourcesLeastAllocated",
         lambda args, ts: NodeResourcesLeastAllocated(),
@@ -73,15 +78,29 @@ def _ensure_builtins() -> None:
     register("ImageLocality", lambda args, ts: ImageLocality())
     register("InterPodAffinity", lambda args, ts: InterPodAffinity())
     register("PodTopologySpread", lambda args, ts: PodTopologySpread())
-    from minisched_tpu.plugins.volumebinding import DEFAULT_MAX_VOLUMES
+    from minisched_tpu.plugins.volumelimits import (
+        AzureDiskLimits,
+        EBSLimits,
+        GCEPDLimits,
+    )
+    from minisched_tpu.plugins.volumerestrictions import VolumeRestrictions
+    from minisched_tpu.plugins.volumezone import VolumeZone
 
     register("VolumeBinding", lambda args, ts: VolumeBinding())
-    register(
-        "NodeVolumeLimits",
-        lambda args, ts: NodeVolumeLimits(
-            max_volumes=args.get("max_volumes", DEFAULT_MAX_VOLUMES)
-        ),
-    )
+    register("VolumeRestrictions", lambda args, ts: VolumeRestrictions())
+    register("VolumeZone", lambda args, ts: VolumeZone())
+    for _name, _cls in (
+        ("NodeVolumeLimits", NodeVolumeLimits),
+        ("EBSLimits", EBSLimits),
+        ("GCEPDLimits", GCEPDLimits),
+        ("AzureDiskLimits", AzureDiskLimits),
+    ):
+        register(
+            _name,
+            lambda args, ts, _cls=_cls: _cls(
+                max_volumes=args.get("max_volumes")
+            ),
+        )
 
 
 @dataclass

@@ -31,6 +31,7 @@ from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
 from minisched_tpu.framework.nodeinfo import NodeInfo
 from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
 from minisched_tpu.framework.types import CycleState, Status
+from minisched_tpu.plugins.volumelimits import FAM_GENERIC, VolumeLimitsCore
 
 BINDING_NAME = "VolumeBinding"
 LIMITS_NAME = "NodeVolumeLimits"
@@ -38,9 +39,6 @@ LIMITS_NAME = "NodeVolumeLimits"
 REASON_UNBOUND = "pod has unbound immediate PersistentVolumeClaims"
 REASON_CONFLICT = "node(s) had volume node affinity conflict"
 REASON_NO_PV = "node(s) didn't find available persistent volumes to bind"
-REASON_LIMIT = "node(s) exceed max volume count"
-
-DEFAULT_MAX_VOLUMES = 16
 
 
 def _labels_ok(required: Dict[str, str], node: Any) -> bool:
@@ -148,35 +146,14 @@ class VolumeBinding(Plugin, BatchEvaluable):
         return extra.vol_ok[:, None] & claims_ok
 
 
-class NodeVolumeLimits(Plugin, BatchEvaluable):
-    needs_extra = True
+class NodeVolumeLimits(VolumeLimitsCore):
+    """The generic volume counter (upstream's CSI limits path): counts
+    every volume NOT bound to a named cloud family (EBS/GCEPD/AzureDisk
+    have their own roster entries — plugins/volumelimits.py).  With no
+    store client injected every volume is generic, which is the pre-split
+    behavior."""
 
-    def __init__(self, max_volumes: int = DEFAULT_MAX_VOLUMES):
-        self.max_volumes = max_volumes
+    volume_family_index = FAM_GENERIC
 
     def name(self) -> str:
         return LIMITS_NAME
-
-    # -- scalar ------------------------------------------------------------
-    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
-        n_pod = len(pod.spec.volumes)
-        if n_pod == 0:
-            return Status.success()
-        mounted = sum(len(p.spec.volumes) for p in node_info.pods)
-        if mounted + n_pod > self.max_volumes:
-            return Status.unschedulable(REASON_LIMIT).with_plugin(LIMITS_NAME)
-        return Status.success()
-
-    def events_to_register(self) -> List[ClusterEvent]:
-        return [ClusterEvent(GVK.POD, ActionType.DELETE)]
-
-    # -- batch -------------------------------------------------------------
-    def batch_filter(self, ctx: Any, pods: Any, nodes: Any, extra: Any):
-        if extra is None:
-            raise ValueError(
-                "NodeVolumeLimits batch kernel needs the wave's "
-                "ConstraintTables — pass `extra`"
-            )
-        n_pod = extra.pod_n_vols[:, None]  # (P, 1)
-        fits = extra.node_vol_count[None, :] + n_pod <= self.max_volumes
-        return (n_pod == 0) | fits

@@ -1,0 +1,158 @@
+"""Per-cloud volume attach limits: EBSLimits / GCEPDLimits / AzureDiskLimits.
+
+The reference's default filter roster enumerates four volume-limit plugins
+(scheduler/scheduler_test.go:315-318): EBSLimits, GCEPDLimits,
+NodeVolumeLimits and AzureDiskLimits — upstream, each counts only volumes
+of its own driver family against that family's per-node attach limit.
+This module provides the shared counting core and the three per-cloud
+plugins; the generic counter (``NodeVolumeLimits``, covering every volume
+not claimed by a named cloud family — upstream's CSI path) lives in
+plugins/volumebinding.py for import compatibility and subclasses the same
+core.
+
+A volume's family is the ``driver`` of the PV its claim is bound to
+(api/objects.PVSpec.driver); unbound or unresolvable claims count as
+generic.  Scalar forms resolve claims through the injected
+``store_client`` (like VolumeBinding); with no client injected every
+volume is generic — the pre-split behavior, kept so directly-constructed
+``NodeVolumeLimits`` works without a control plane.  Batch forms read the
+``pod_vols_fam`` / ``node_vols_fam`` planes of the wave's
+ConstraintTables (models/constraints.py), where the same family
+resolution ran host-side.
+
+Default limits follow upstream v1.22's non-CSI defaults: EBS 39 (AWS
+attach limit), GCE PD 16, Azure Disk 16, generic 16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+#: family axis of the pod_vols_fam/node_vols_fam constraint planes;
+#: index 0 is the generic (non-cloud / CSI / unbound) family
+FAMILIES = ("", "ebs", "gcepd", "azuredisk")
+FAM_GENERIC, FAM_EBS, FAM_GCEPD, FAM_AZURE = range(len(FAMILIES))
+
+REASON_LIMIT = "node(s) exceed max volume count"
+
+DEFAULT_MAX_VOLUMES = 16  # generic / GCE PD / Azure Disk
+DEFAULT_MAX_EBS = 39  # AWS attach limit
+
+
+def volume_family(pvc: Optional[Any], pv_by_name: Any) -> int:
+    """Family index of one claim: its bound PV's driver, else generic."""
+    if pvc is None or not pvc.spec.volume_name:
+        return FAM_GENERIC
+    pv = pv_by_name.get(pvc.spec.volume_name)
+    if pv is None:
+        return FAM_GENERIC
+    try:
+        return FAMILIES.index(pv.spec.driver)
+    except ValueError:
+        return FAM_GENERIC
+
+
+class VolumeLimitsCore(Plugin, BatchEvaluable):
+    """Shared counting core: pod's family-f volumes + node's mounted
+    family-f volumes must stay within ``max_volumes``."""
+
+    needs_extra = True
+    #: class-level family index; also the repair loop's marker for
+    #: volume-limit plugins (ops/repair.py reads it with max_volumes)
+    volume_family_index = FAM_GENERIC
+
+    def __init__(self, max_volumes: Optional[int] = None):
+        self.max_volumes = (
+            max_volumes if max_volumes is not None else self.default_max()
+        )
+        self.store_client = None  # injected by the service
+
+    @classmethod
+    def default_max(cls) -> int:
+        return DEFAULT_MAX_VOLUMES
+
+    # -- scalar ------------------------------------------------------------
+    def _pod_count(self, pod: Any, store: Any, pv_by_name: Any) -> int:
+        """Volumes of this plugin's family the pod mounts."""
+        if store is None:
+            # no control plane: every volume is generic (pre-split behavior)
+            n = len(pod.spec.volumes)
+            return n if self.volume_family_index == FAM_GENERIC else 0
+        count = 0
+        for vol in pod.spec.volumes:
+            try:
+                pvc = store.get(
+                    "PersistentVolumeClaim", pod.metadata.namespace, vol
+                )
+            except KeyError:
+                pvc = None
+            if volume_family(pvc, pv_by_name) == self.volume_family_index:
+                count += 1
+        return count
+
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        if not pod.spec.volumes:
+            return Status.success()
+        store = self.store_client.store if self.store_client is not None else None
+        # one PV map per filter call, shared across the pod + node's pods
+        pv_by_name = (
+            {pv.metadata.name: pv for pv in store.list("PersistentVolume")}
+            if store is not None
+            else {}
+        )
+        n_pod = self._pod_count(pod, store, pv_by_name)
+        if n_pod == 0:
+            return Status.success()
+        mounted = sum(
+            self._pod_count(p, store, pv_by_name)
+            for p in node_info.pods
+            if p.spec.volumes
+        )
+        if mounted + n_pod > self.max_volumes:
+            return Status.unschedulable(REASON_LIMIT).with_plugin(self.name())
+        return Status.success()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(GVK.POD, ActionType.DELETE)]
+
+    # -- batch -------------------------------------------------------------
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any, extra: Any):
+        if extra is None:
+            raise ValueError(
+                f"{self.name()} batch kernel needs the wave's "
+                "ConstraintTables — pass `extra`"
+            )
+        f = self.volume_family_index
+        n_pod = extra.pod_vols_fam[:, f][:, None]  # (P, 1)
+        fits = extra.node_vols_fam[f][None, :] + n_pod <= self.max_volumes
+        return (n_pod == 0) | fits
+
+
+class EBSLimits(VolumeLimitsCore):
+    volume_family_index = FAM_EBS
+
+    @classmethod
+    def default_max(cls) -> int:
+        return DEFAULT_MAX_EBS
+
+    def name(self) -> str:
+        return "EBSLimits"
+
+
+class GCEPDLimits(VolumeLimitsCore):
+    volume_family_index = FAM_GCEPD
+
+    def name(self) -> str:
+        return "GCEPDLimits"
+
+
+class AzureDiskLimits(VolumeLimitsCore):
+    volume_family_index = FAM_AZURE
+
+    def name(self) -> str:
+        return "AzureDiskLimits"

@@ -1,0 +1,122 @@
+"""VolumeRestrictions filter: single-attach volumes can't share a node.
+
+Member of the reference's default filter roster
+(scheduler/scheduler_test.go:314).  Upstream semantics (v1.22
+``volumerestrictions``): a pod conflicts with a node when another pod
+already on that node mounts the same underlying disk, unless every mount
+involved is read-only (the GCE-PD rule; EBS/AzureDisk forbid any
+sharing — this framework applies the one permissive rule uniformly and
+documents it so the scalar oracle and the kernel agree on ONE semantic).
+
+In this framework's volume model the "same underlying disk" is two claims
+bound to the same PersistentVolume, and the mount's access intent is the
+claim's ``read_only`` flag (api/objects.PVCSpec.read_only).
+
+Scalar form resolves claims through the injected ``store_client``; the
+batch form derives per-claim conflicts from the ``vol_any``/``vol_rw``
+per-volume mount planes of the wave's ConstraintTables: claim c conflicts
+on node n iff some mount of its volume there is writable, or any mount
+exists and c itself is writable.  The repair loop (ops/repair.py) carries
+those planes across rounds, so conflicts with pods committed EARLIER IN
+THE SAME WAVE are enforced too, not just assigned-pod ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+NAME = "VolumeRestrictions"
+
+REASON_CONFLICT = "node(s) had volume restrictions conflict"
+REASON_UNBOUND = "pod has unbound immediate PersistentVolumeClaims"
+
+
+def mounts_conflict(pvc: Any, other_pvc: Any) -> bool:
+    """Two bound claims conflict iff they share a PV and either mount is
+    writable — the ONE conflict rule, shared by the scalar filter and the
+    host-side constraint-table build."""
+    return (
+        bool(pvc.spec.volume_name)
+        and pvc.spec.volume_name == other_pvc.spec.volume_name
+        and not (pvc.spec.read_only and other_pvc.spec.read_only)
+    )
+
+
+class VolumeRestrictions(Plugin, BatchEvaluable):
+    needs_extra = True
+    #: the repair loop's marker (ops/repair.py): carry per-volume mount
+    #: state across rounds and dedup same-round mounts
+    enforces_volume_restrictions = True
+
+    def __init__(self):
+        self.store_client = None  # injected by the service
+
+    def name(self) -> str:
+        return NAME
+
+    # -- scalar ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        if not pod.spec.volumes:
+            return Status.success()
+        if self.store_client is None:
+            return Status.error(f"{NAME}: no store client injected")
+        store = self.store_client.store
+
+        def resolve(ns: str, vol: str):
+            return store.get("PersistentVolumeClaim", ns, vol)
+
+        for vol in pod.spec.volumes:
+            try:
+                pvc = resolve(pod.metadata.namespace, vol)
+            except KeyError:
+                return Status.unresolvable(REASON_UNBOUND).with_plugin(NAME)
+            if not pvc.spec.volume_name:
+                continue  # unbound: no disk identity yet
+            for other in node_info.pods:
+                for ovol in other.spec.volumes:
+                    try:
+                        opvc = resolve(other.metadata.namespace, ovol)
+                    except KeyError:
+                        continue
+                    if mounts_conflict(pvc, opvc):
+                        return Status.unschedulable(REASON_CONFLICT).with_plugin(
+                            NAME
+                        )
+        return Status.success()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(GVK.POD, ActionType.DELETE),
+            ClusterEvent(
+                GVK.PERSISTENT_VOLUME_CLAIM, ActionType.ADD | ActionType.UPDATE
+            ),
+        ]
+
+    # -- batch -------------------------------------------------------------
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any, extra: Any):
+        if extra is None:
+            raise ValueError(
+                "VolumeRestrictions batch kernel needs the wave's "
+                "ConstraintTables — pass `extra`"
+            )
+        in_range = (
+            jnp.arange(extra.pod_claims.shape[1])[None, :]
+            < extra.pod_n_vols[:, None]
+        )  # (P, V)
+        # conflict of each referenced claim per node, from the volume planes
+        cv = jnp.maximum(extra.claim_vol, 0)
+        bound = extra.claim_vol >= 0
+        conflict = bound[:, None] & (
+            extra.vol_rw[cv]
+            | (extra.vol_any[cv] & ~extra.claim_ro[:, None])
+        )  # (C2, N)
+        per_claim = conflict[extra.pod_claims]  # (P, V, N)
+        ok = jnp.all(~per_claim | ~in_range[:, :, None], axis=1)  # (P, N)
+        return extra.vol_ok[:, None] & ok

@@ -1,0 +1,110 @@
+"""VolumeZone filter: a bound PV's zone/region labels must match the node.
+
+Member of the reference's default filter roster
+(scheduler/scheduler_test.go:320).  Upstream semantics (v1.22
+``volumezone``): for every claim the pod mounts that is BOUND to a PV, any
+zone/region topology label carried by the PV (set by the cloud provider)
+must be matched exactly by the candidate node's labels; unbound claims are
+skipped (VolumeBinding owns them), and a missing claim is unresolvable.
+
+Scalar form resolves claims through the injected ``store_client``; the
+batch form gathers the host-precomputed ``claim_zone_ok[C2, N]`` plane of
+the wave's ConstraintTables (models/constraints.py) — the per-claim check
+runs once per claim host-side, and the kernel is a gather + all-reduce
+like VolumeBinding's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.nodeinfo import NodeInfo
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+NAME = "VolumeZone"
+
+REASON_ZONE = "node(s) had no available volume zone"
+REASON_UNBOUND = "pod has unbound immediate PersistentVolumeClaims"
+
+#: the topology labels upstream treats as zonal (volume_zone.go's
+#: topologyLabels): both the GA and the deprecated beta spellings
+ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+def pv_zone_ok(pv: Any, node: Any) -> bool:
+    """The ONE definition of PV↔node zone compatibility, shared by the
+    scalar filter and the host-side constraint-table build."""
+    labels = node.metadata.labels
+    for key in ZONE_LABELS:
+        want = pv.metadata.labels.get(key)
+        if want is not None and labels.get(key) != want:
+            return False
+    return True
+
+
+class VolumeZone(Plugin, BatchEvaluable):
+    needs_extra = True
+
+    def __init__(self):
+        self.store_client = None  # injected by the service
+
+    def name(self) -> str:
+        return NAME
+
+    # -- scalar ------------------------------------------------------------
+    def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
+        if not pod.spec.volumes:
+            return Status.success()
+        if self.store_client is None:
+            return Status.error(f"{NAME}: no store client injected")
+        store = self.store_client.store
+        node = node_info.node
+        for vol in pod.spec.volumes:
+            try:
+                pvc = store.get(
+                    "PersistentVolumeClaim", pod.metadata.namespace, vol
+                )
+            except KeyError:
+                return Status.unresolvable(REASON_UNBOUND).with_plugin(NAME)
+            if not pvc.spec.volume_name:
+                continue  # unbound: VolumeBinding's problem
+            try:
+                pv = store.get("PersistentVolume", "", pvc.spec.volume_name)
+            except KeyError:
+                return Status.unresolvable(REASON_UNBOUND).with_plugin(NAME)
+            if not pv_zone_ok(pv, node):
+                return Status.unschedulable(REASON_ZONE).with_plugin(NAME)
+        return Status.success()
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(GVK.PERSISTENT_VOLUME, ActionType.ADD | ActionType.UPDATE),
+            ClusterEvent(
+                GVK.PERSISTENT_VOLUME_CLAIM, ActionType.ADD | ActionType.UPDATE
+            ),
+            ClusterEvent(GVK.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+    # -- batch -------------------------------------------------------------
+    def batch_filter(self, ctx: Any, pods: Any, nodes: Any, extra: Any):
+        if extra is None:
+            raise ValueError(
+                "VolumeZone batch kernel needs the wave's ConstraintTables "
+                "— pass `extra`"
+            )
+        in_range = (
+            jnp.arange(extra.pod_claims.shape[1])[None, :]
+            < extra.pod_n_vols[:, None]
+        )  # (P, V)
+        per_claim = extra.claim_zone_ok[extra.pod_claims]  # (P, V, N)
+        ok = jnp.all(per_claim | ~in_range[:, :, None], axis=1)  # (P, N)
+        return extra.vol_ok[:, None] & ok

@@ -106,11 +106,12 @@ def default_scheduler_config(time_scale: float = 1.0) -> SchedulerConfig:
 
 
 def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
-    """The upstream default plugin roster, as far as this framework
-    implements it — the rosters the reference's defaultconfig produces
-    (scheduler/defaultconfig/defaultconfig.go:17-33, enumerated with their
-    weights in scheduler/scheduler_test.go:307-332).  Weights follow
-    upstream defaults (TaintToleration 3, PodTopologySpread 2, rest 1).
+    """The upstream default plugin roster: the same 15-filter / 7-score
+    enumeration (same order, same weights) the reference's defaultconfig
+    produces (scheduler/defaultconfig/defaultconfig.go:17-33, enumerated
+    in scheduler/scheduler_test.go:307-332 — filter :307-323, score with
+    weights :324-332; NodeResourcesFit scores via its LeastAllocated
+    ScoringStrategy, plugins_test.go:839-848).
     """
     return SchedulerConfig(
         filter=PluginSet(
@@ -121,10 +122,15 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
                 PluginEnabled("NodeAffinity"),
                 PluginEnabled("NodePorts"),
                 PluginEnabled("NodeResourcesFit"),
-                PluginEnabled("VolumeBinding"),
+                PluginEnabled("VolumeRestrictions"),
+                PluginEnabled("EBSLimits"),
+                PluginEnabled("GCEPDLimits"),
                 PluginEnabled("NodeVolumeLimits"),
-                PluginEnabled("InterPodAffinity"),
+                PluginEnabled("AzureDiskLimits"),
+                PluginEnabled("VolumeBinding"),
+                PluginEnabled("VolumeZone"),
                 PluginEnabled("PodTopologySpread"),
+                PluginEnabled("InterPodAffinity"),
             ]
         ),
         pre_score=PluginSet(
@@ -139,10 +145,10 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
                 PluginEnabled("NodeResourcesBalancedAllocation", weight=1),
                 PluginEnabled("ImageLocality", weight=1),
                 PluginEnabled("InterPodAffinity", weight=1),
-                PluginEnabled("NodeResourcesLeastAllocated", weight=1),
+                PluginEnabled("NodeResourcesFit", weight=1),
                 PluginEnabled("NodeAffinity", weight=1),
                 PluginEnabled("PodTopologySpread", weight=2),
-                PluginEnabled("TaintToleration", weight=3),
+                PluginEnabled("TaintToleration", weight=1),
             ]
         ),
         time_scale=time_scale,

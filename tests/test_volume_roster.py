@@ -1,0 +1,447 @@
+"""The volume members of the reference's default filter roster
+(scheduler/scheduler_test.go:307-323): VolumeZone, VolumeRestrictions, and
+the per-cloud volume-limit family (EBS/GCEPD/Azure + generic
+NodeVolumeLimits) — scalar behavior, batch parity, repair safety, and the
+1:1 roster enumeration."""
+
+from __future__ import annotations
+
+from minisched_tpu.api.objects import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PVCSpec,
+    PVSpec,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.controlplane.client import KIND_PV, KIND_PVC, Client
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import CycleState, FitError
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.ops.fused import FusedEvaluator
+from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+from minisched_tpu.plugins.volumebinding import NodeVolumeLimits, VolumeBinding
+from minisched_tpu.plugins.volumelimits import (
+    AzureDiskLimits,
+    EBSLimits,
+    GCEPDLimits,
+)
+from minisched_tpu.plugins.volumerestrictions import VolumeRestrictions
+from minisched_tpu.plugins.volumezone import ZONE_LABELS, VolumeZone
+
+GI = 1024**3
+ZONE = ZONE_LABELS[0]
+
+
+def _pv(name, capacity=GI, claim="", labels=None, node_labels=None, driver=""):
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
+        spec=PVSpec(
+            capacity=capacity, claim_ref=claim, driver=driver,
+            required_node_labels=dict(node_labels or {}),
+        ),
+    )
+
+
+def _pvc(name, request=GI, volume="", read_only=False):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name),
+        spec=PVCSpec(request=request, volume_name=volume, read_only=read_only),
+    )
+
+
+def _client_with(nodes=(), pvs=(), pvcs=()):
+    client = Client()
+    for n in nodes:
+        client.nodes().create(n)
+    for pv in pvs:
+        client.store.create(KIND_PV, pv)
+    for pvc in pvcs:
+        client.store.create(KIND_PVC, pvc)
+    return client
+
+
+def _with_client(plugin, client):
+    plugin.store_client = client
+    return plugin
+
+
+def _assigned(name, node, volumes=()):
+    p = make_pod(name, volumes=list(volumes))
+    p.metadata.uid = name
+    p.spec.node_name = node
+    return p
+
+
+# --------------------------------------------------------------------------
+# VolumeZone
+# --------------------------------------------------------------------------
+
+
+def test_volume_zone_scalar():
+    node_a = make_node("a", labels={ZONE: "zone-a"})
+    node_b = make_node("b", labels={ZONE: "zone-b"})
+    node_bare = make_node("c")  # no zone label at all → mismatch
+    client = _client_with(
+        nodes=[node_a, node_b, node_bare],
+        pvs=[_pv("pv1", claim="default/data", labels={ZONE: "zone-a"})],
+        pvcs=[_pvc("data", volume="pv1")],
+    )
+    infos = build_node_infos([node_a, node_b, node_bare], [])
+    pod = make_pod("p", volumes=["data"])
+    vz = _with_client(VolumeZone(), client)
+    assert vz.filter(CycleState(), pod, infos[0]).is_success()
+    assert not vz.filter(CycleState(), pod, infos[1]).is_success()
+    assert not vz.filter(CycleState(), pod, infos[2]).is_success()
+
+
+def test_volume_zone_skips_unbound_and_unlabeled():
+    node = make_node("n", labels={ZONE: "zone-a"})
+    client = _client_with(
+        nodes=[node],
+        pvs=[_pv("plain", claim="default/plain-c")],  # PV without zone labels
+        pvcs=[_pvc("loose"), _pvc("plain-c", volume="plain")],
+    )
+    [ni] = build_node_infos([node], [])
+    vz = _with_client(VolumeZone(), client)
+    # unbound claim: VolumeBinding's problem, zone passes
+    assert vz.filter(CycleState(), make_pod("p1", volumes=["loose"]), ni).is_success()
+    # bound PV carrying no zone labels: passes anywhere
+    assert vz.filter(CycleState(), make_pod("p2", volumes=["plain-c"]), ni).is_success()
+    # missing claim: unresolvable
+    st = vz.filter(CycleState(), make_pod("p3", volumes=["ghost"]), ni)
+    assert st.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE"
+
+
+# --------------------------------------------------------------------------
+# VolumeRestrictions
+# --------------------------------------------------------------------------
+
+
+def test_volume_restrictions_scalar_conflict():
+    node = make_node("n1")
+    holder = _assigned("holder", "n1", volumes=["mine"])
+    client = _client_with(
+        nodes=[node],
+        pvs=[_pv("disk", claim="default/mine")],
+        pvcs=[_pvc("mine", volume="disk"), _pvc("other", volume="disk")],
+    )
+    [ni] = build_node_infos([node], [holder])
+    vr = _with_client(VolumeRestrictions(), client)
+    # same underlying PV, writable → conflict
+    st = vr.filter(CycleState(), make_pod("p", volumes=["other"]), ni)
+    assert not st.is_success()
+    # empty node → fine
+    [ni_empty] = build_node_infos([node], [])
+    assert vr.filter(
+        CycleState(), make_pod("p", volumes=["other"]), ni_empty
+    ).is_success()
+
+
+def test_volume_restrictions_read_only_sharing_allowed():
+    node = make_node("n1")
+    holder = _assigned("holder", "n1", volumes=["ro1"])
+    client = _client_with(
+        nodes=[node],
+        pvs=[_pv("disk", claim="default/ro1")],
+        pvcs=[
+            _pvc("ro1", volume="disk", read_only=True),
+            _pvc("ro2", volume="disk", read_only=True),
+            _pvc("rw", volume="disk"),
+        ],
+    )
+    [ni] = build_node_infos([node], [holder])
+    vr = _with_client(VolumeRestrictions(), client)
+    assert vr.filter(CycleState(), make_pod("p", volumes=["ro2"]), ni).is_success()
+    assert not vr.filter(CycleState(), make_pod("q", volumes=["rw"]), ni).is_success()
+
+
+# --------------------------------------------------------------------------
+# Volume-limit family split
+# --------------------------------------------------------------------------
+
+
+def test_family_limits_count_only_their_driver():
+    node = make_node("n1")
+    # holder mounts 2 EBS volumes and 1 generic
+    holder = _assigned("holder", "n1", volumes=["e1", "e2", "g1"])
+    pvs = [
+        _pv("pve1", claim="default/e1", driver="ebs"),
+        _pv("pve2", claim="default/e2", driver="ebs"),
+        _pv("pve3", claim="default/e3", driver="ebs"),
+        _pv("pvg1", claim="default/g1"),
+        _pv("pvg2", claim="default/g2"),
+    ]
+    pvcs = [
+        _pvc("e1", volume="pve1"), _pvc("e2", volume="pve2"),
+        _pvc("e3", volume="pve3"), _pvc("g1", volume="pvg1"),
+        _pvc("g2", volume="pvg2"),
+    ]
+    client = _client_with(nodes=[node], pvs=pvs, pvcs=pvcs)
+    [ni] = build_node_infos([node], [holder])
+    ebs = _with_client(EBSLimits(max_volumes=2), client)
+    generic = _with_client(NodeVolumeLimits(max_volumes=2), client)
+    ebs_pod = make_pod("p-ebs", volumes=["e3"])
+    gen_pod = make_pod("p-gen", volumes=["g2"])
+    # node holds 2 EBS volumes: a third EBS volume exceeds the EBS cap
+    assert not ebs.filter(CycleState(), ebs_pod, ni).is_success()
+    # ...but a generic volume doesn't touch the EBS counter
+    assert ebs.filter(CycleState(), gen_pod, ni).is_success()
+    # generic counter sees 1 generic volume: one more fits at cap 2
+    assert generic.filter(CycleState(), gen_pod, ni).is_success()
+    # and the EBS pod doesn't touch the generic counter
+    assert generic.filter(CycleState(), ebs_pod, ni).is_success()
+
+
+def test_family_limit_defaults():
+    assert EBSLimits().max_volumes == 39
+    assert GCEPDLimits().max_volumes == 16
+    assert AzureDiskLimits().max_volumes == 16
+    assert NodeVolumeLimits().max_volumes == 16
+
+
+def test_no_client_back_compat_counts_everything_generic():
+    """Directly-constructed NodeVolumeLimits (no control plane) keeps the
+    pre-split behavior: every volume is generic."""
+    node = make_node("n1")
+    holder = _assigned("holder", "n1", volumes=["v1", "v2"])
+    [ni] = build_node_infos([node], [holder])
+    nvl = NodeVolumeLimits(max_volumes=3)
+    assert nvl.filter(CycleState(), make_pod("p", volumes=["v3"]), ni).is_success()
+    assert not nvl.filter(
+        CycleState(), make_pod("q", volumes=["v3", "v4"]), ni
+    ).is_success()
+    # cloud family plugins see nothing without a client
+    assert EBSLimits(max_volumes=1).filter(
+        CycleState(), make_pod("r", volumes=["v3", "v4"]), ni
+    ).is_success()
+
+
+# --------------------------------------------------------------------------
+# Batch parity: scalar oracle vs fused kernel across the new plugins
+# --------------------------------------------------------------------------
+
+
+def test_batch_parity_volume_roster_chain():
+    from minisched_tpu.engine.scheduler import schedule_pod_once
+
+    nodes = [
+        make_node("a", labels={ZONE: "zone-a"}),
+        make_node("b", labels={ZONE: "zone-b"}),
+        make_node("c", labels={ZONE: "zone-a"}),
+    ]
+    assigned = [
+        _assigned("holder-disk", "a", volumes=["shared"]),
+        _assigned("holder-ebs", "c", volumes=["ebs-held"]),
+    ]
+    pvs = [
+        _pv("disk", claim="default/shared", labels={ZONE: "zone-a"}),
+        _pv("zoned-b", claim="default/in-b", labels={ZONE: "zone-b"}),
+        _pv("ebs1", claim="default/ebs-held", driver="ebs"),
+        _pv("ebs2", claim="default/ebs-new", driver="ebs"),
+        _pv("shared2", claim="default/shared-again", labels={ZONE: "zone-a"}),
+    ]
+    pvcs = [
+        _pvc("shared", volume="disk"),
+        _pvc("shared-again", volume="disk"),
+        _pvc("in-b", volume="zoned-b"),
+        _pvc("ebs-held", volume="ebs1"),
+        _pvc("ebs-new", volume="ebs2"),
+    ]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [
+        # same PV as holder-disk (writable) → conflict on a; zone pins to
+        # zone-a → only c... but claim's PV pins node labels? (none) → c
+        make_pod("p-conflict", volumes=["shared-again"]),
+        # zone-b PV → b only
+        make_pod("p-zoneb", volumes=["in-b"]),
+        # EBS volume, EBS cap 1, holder on c → a or b fine
+        make_pod("p-ebs", volumes=["ebs-new"]),
+        # no volumes → anywhere
+        make_pod("p-free"),
+    ]
+    chain = [
+        NodeUnschedulable(),
+        _with_client(VolumeRestrictions(), client),
+        _with_client(EBSLimits(max_volumes=1), client),
+        _with_client(NodeVolumeLimits(), client),
+        _with_client(VolumeBinding(), client),
+        _with_client(VolumeZone(), client),
+    ]
+    infos = build_node_infos(nodes, assigned)
+    oracle = []
+    for pod in pods:
+        try:
+            oracle.append(schedule_pod_once(chain, [], [], {}, pod, infos))
+        except FitError:
+            oracle.append("")
+    node_table, node_names = build_node_table(nodes, _group_by_node(assigned))
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned, pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    res = FusedEvaluator(chain, [], [])(pod_table, node_table, extra)
+    batch = [
+        node_names[c] if c >= 0 else "" for c in res.choice.tolist()[: len(pods)]
+    ]
+    assert oracle == batch
+    # spot semantic checks, not just parity
+    assert batch[0] == "c"  # conflict on a, zone-a only → c
+    assert batch[1] == "b"
+    assert batch[2] in ("a", "b")
+
+
+def _group_by_node(assigned):
+    by_node = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    return by_node
+
+
+def test_repair_respects_family_limits():
+    """Repair rounds must enforce each family's cap separately."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes = [make_node("n1"), make_node("n2")]
+    pvs = [_pv(f"pve{i}", claim=f"default/e{i}", driver="ebs") for i in range(4)]
+    pvcs = [_pvc(f"e{i}", volume=f"pve{i}") for i in range(4)]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [make_pod(f"p{i}", volumes=[f"e{i}"]) for i in range(4)]
+    chain = [
+        NodeUnschedulable(),
+        _with_client(VolumeBinding(), client),
+        _with_client(EBSLimits(max_volumes=2), client),
+    ]
+    node_table, _ = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    ev = RepairingEvaluator(chain, [], [])
+    _, choice, _ = ev(pod_table, node_table, extra)
+    placements = [c for c in choice.tolist()[: len(pods)] if c >= 0]
+    assert len(placements) == 4  # 2 per node
+    assert max(placements.count(i) for i in set(placements)) == 2
+
+
+def test_repair_enforces_intra_wave_restriction_conflicts():
+    """Two pending pods mounting the same writable bound PV must not land
+    on one node in a single repair wave (regression: the static conflict
+    table only saw assigned pods, so both committed)."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes = [make_node("n1")]
+    pvs = [_pv("disk", claim="default/c1")]
+    pvcs = [_pvc("c1", volume="disk"), _pvc("c2", volume="disk")]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [make_pod("p1", volumes=["c1"]), make_pod("p2", volumes=["c2"])]
+    chain = [NodeUnschedulable(), _with_client(VolumeRestrictions(), client)]
+    node_table, _ = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    _, choice, _ = RepairingEvaluator(chain, [], [])(pod_table, node_table, extra)
+    placed = [c for c in choice.tolist()[: len(pods)] if c >= 0]
+    # sequential semantics: p1 takes the node, p2 conflicts everywhere
+    assert placed == [0] and int(choice[1]) == -1
+
+
+def test_repair_intra_wave_read_only_mounts_share():
+    """All-read-only mounts of one PV may share the node; with a second
+    node available, a writable contender must be re-routed there."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes = [make_node("n1"), make_node("n2")]
+    pvs = [_pv("disk", claim="default/ro1")]
+    pvcs = [
+        _pvc("ro1", volume="disk", read_only=True),
+        _pvc("ro2", volume="disk", read_only=True),
+        _pvc("rw", volume="disk"),
+    ]
+    client = _client_with(nodes=nodes, pvs=pvs, pvcs=pvcs)
+    pods = [
+        make_pod("a-ro1", volumes=["ro1"]),
+        make_pod("b-ro2", volumes=["ro2"]),
+        make_pod("c-rw", volumes=["rw"]),
+    ]
+    chain = [NodeUnschedulable(), _with_client(VolumeRestrictions(), client)]
+    node_table, node_names = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    _, choice, _ = RepairingEvaluator(chain, [], [])(pod_table, node_table, extra)
+    placements = [
+        node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]
+    ]
+    assert "" not in placements
+    # the two read-only mounts share one node; the writable lands alone
+    assert placements[0] == placements[1]
+    assert placements[2] != placements[0]
+
+
+# --------------------------------------------------------------------------
+# Roster enumeration 1:1 with the reference
+# --------------------------------------------------------------------------
+
+
+def test_full_roster_matches_reference_enumeration():
+    """default_full_roster_config must enumerate the same 15-filter /
+    7-score set (same order, same weights) as the reference
+    (scheduler/scheduler_test.go:307-332)."""
+    from minisched_tpu.service.config import default_full_roster_config
+
+    cfg = default_full_roster_config()
+    assert [p.name for p in cfg.filter.enabled] == [
+        "NodeUnschedulable",
+        "NodeName",
+        "TaintToleration",
+        "NodeAffinity",
+        "NodePorts",
+        "NodeResourcesFit",
+        "VolumeRestrictions",
+        "EBSLimits",
+        "GCEPDLimits",
+        "NodeVolumeLimits",
+        "AzureDiskLimits",
+        "VolumeBinding",
+        "VolumeZone",
+        "PodTopologySpread",
+        "InterPodAffinity",
+    ]
+    assert [(p.name, p.weight) for p in cfg.score.enabled] == [
+        ("NodeResourcesBalancedAllocation", 1),
+        ("ImageLocality", 1),
+        ("InterPodAffinity", 1),
+        ("NodeResourcesFit", 1),
+        ("NodeAffinity", 1),
+        ("PodTopologySpread", 2),
+        ("TaintToleration", 1),
+    ]
+
+
+def test_full_roster_builds_and_simulator_converts():
+    from minisched_tpu.plugins.registry import build_plugins
+    from minisched_tpu.plugins.simulator import convert_configuration_for_simulator
+    from minisched_tpu.service.config import default_full_roster_config
+
+    cfg = default_full_roster_config()
+    chains = build_plugins(cfg)
+    assert len(chains.filter) == 15
+    assert len(chains.score) == 7
+    # NodeResourcesFit appears in both rosters as ONE instance (the
+    # reference shares plugin singletons the same way, initialize.go:188-213)
+    fit_f = next(p for p in chains.filter if p.name() == "NodeResourcesFit")
+    fit_s = next(p for p in chains.score if p.name() == "NodeResourcesFit")
+    assert fit_f is fit_s
+    conv = convert_configuration_for_simulator(cfg)
+    assert [p.name for p in conv.filter.enabled] == [
+        p.name + "ForSimulator" for p in cfg.filter.enabled
+    ]
