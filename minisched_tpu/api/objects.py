@@ -353,6 +353,10 @@ POD_FAILED = "Failed"
 class PodStatus:
     phase: str = POD_PENDING
     conditions: List[Dict[str, str]] = field(default_factory=list)
+    #: set by a successful PostFilter (preemption): the node the pod is
+    #: expected to land on once its victims terminate (upstream
+    #: status.nominatedNodeName)
+    nominated_node_name: str = ""
 
 
 @dataclass

@@ -26,6 +26,7 @@ from typing import Any, List, Optional
 from minisched_tpu.api.objects import Pod
 from minisched_tpu.engine.scheduler import Scheduler
 from minisched_tpu.framework.types import (
+    CycleState,
     Diagnosis,
     FitError,
     QueuedPodInfo,
@@ -172,21 +173,76 @@ class DeviceScheduler(Scheduler):
             return
         pods = [qpi.pod for qpi in qpis]
 
+        losers: List[Any] = []
         for qpi, pod, c in zip(qpis, pods, placements):
             if c < 0:
-                diagnosis = Diagnosis()
-                diagnosis.unschedulable_plugins = {
-                    p.name() for p in self.filter_plugins
-                }
-                self.error_func(qpi, FitError(pod, len(nodes), diagnosis))
-                if self.on_decision:
-                    self.on_decision(
-                        pod, None, Status.unschedulable("no feasible node")
-                    )
+                losers.append((qpi, pod))
                 continue
             self._assume(pod, node_names[c])
             self._permit_and_bind(qpi, pod, node_names[c])
+        if losers:
+            self._handle_wave_losers(losers, node_infos, len(nodes))
         self.metrics.observe("wave", time.monotonic() - t_wave)
+
+    def _handle_wave_losers(
+        self, losers: List[Any], node_infos: List[Any], n_nodes: int
+    ) -> None:
+        """Park every wave loser, then run the host-side PostFilter chain
+        (preemption) for each — like the scalar engine's failure path.
+
+        Parking happens FIRST so victims' Pod/DELETE requeue events find
+        the losers in the unschedulableQ.  Each loser preempts against a
+        snapshot adjusted for the wave: this wave's assumed winners, the
+        victims earlier losers already evicted, and earlier losers'
+        nominated pods (which will consume the capacity they freed) —
+        otherwise several losers select the same victims and over-evict.
+        """
+        diagnoses = {}
+        for qpi, pod in losers:
+            diagnosis = Diagnosis()
+            diagnosis.unschedulable_plugins = {
+                p.name() for p in self.filter_plugins
+            }
+            diagnoses[pod.metadata.uid] = diagnosis
+            self.error_func(qpi, FitError(pod, n_nodes, diagnosis))
+            if self.on_decision:
+                self.on_decision(
+                    pod, None, Status.unschedulable("no feasible node")
+                )
+        if not self.post_filter_plugins:
+            return
+        evicted: set = set()
+        phantoms: List[Pod] = []  # nominated pods: freed capacity is spoken for
+        for qpi, pod in losers:
+            infos = self._adjusted_infos(node_infos, evicted, phantoms)
+            before = {p.metadata.uid for p in self.client.store.list("Pod")}
+            nominated = self.run_post_filter(
+                CycleState(), pod, infos, diagnoses[pod.metadata.uid]
+            )
+            after = {p.metadata.uid for p in self.client.store.list("Pod")}
+            evicted |= before - after
+            if nominated:
+                ph = pod.clone()
+                ph.spec.node_name = nominated
+                phantoms.append(ph)
+
+    def _adjusted_infos(
+        self, node_infos: List[Any], evicted: set, phantoms: List[Pod]
+    ) -> List[Any]:
+        from minisched_tpu.framework.nodeinfo import build_node_infos
+
+        pods = [
+            p
+            for ni in node_infos
+            for p in ni.pods
+            if p.metadata.uid not in evicted
+        ] + list(phantoms)
+        known = {p.metadata.uid for p in pods}
+        with self._assumed_lock:
+            assumed = [
+                a for a in self._assumed.values() if a.metadata.uid not in known
+            ]
+        return build_node_infos([ni.node for ni in node_infos], pods + assumed)
 
     def _drop_unencodable(self, qpis: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
         """Park pods whose specs exceed the static table capacities (they
@@ -233,6 +289,7 @@ def new_device_scheduler(
         client,
         informer_factory,
         filter_plugins=chains.filter,
+        post_filter_plugins=chains.post_filter,
         pre_score_plugins=chains.pre_score,
         score_plugins=chains.score,
         permit_plugins=chains.permit,

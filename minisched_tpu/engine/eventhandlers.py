@@ -57,11 +57,16 @@ def add_all_event_handlers(
             filter=lambda pod: not assigned(pod),
         )
     )
-    # assigned pods may unblock pods waiting on inter-pod constraints
+    # assigned pods may unblock pods waiting on inter-pod constraints;
+    # their DELETION frees capacity (it is how preemption victims make
+    # room), so it replays pods whose failed plugins registered Pod/DELETE
     pod_informer.add_event_handlers(
         ResourceEventHandlers(
             on_add=lambda pod: sched.queue.assigned_pod_added(pod),
             on_update=lambda old, new: sched.queue.assigned_pod_updated(new),
+            on_delete=lambda pod: sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.DELETE)
+            ),
             filter=assigned,
         )
     )

@@ -95,6 +95,25 @@ def run_filter_plugins(
     return feasible, diagnosis
 
 
+def run_post_filter_plugins(
+    post_filter_plugins: List[Any],
+    state: CycleState,
+    pod: Pod,
+    node_infos: List[NodeInfo],
+    diagnosis: Diagnosis,
+) -> Tuple[Optional[str], Status]:
+    """Upstream RunPostFilterPlugins: runs after filtering leaves no
+    feasible node; the first plugin returning Success wins (its nominated
+    node is the result), an Error aborts, otherwise Unschedulable."""
+    for pl in post_filter_plugins:
+        nominated, status = pl.post_filter(state, pod, node_infos, diagnosis)
+        if status.is_success():
+            return nominated, status
+        if status.code.name == "ERROR":
+            return None, status.with_plugin(status.plugin or pl.name())
+    return None, Status.unschedulable("no postFilter plugin made the pod schedulable")
+
+
 def run_pre_score_plugins(
     pre_score_plugins: List[Any], state: CycleState, pod: Pod, nodes: List[Any]
 ) -> Status:
@@ -249,10 +268,12 @@ class Scheduler:
         score_weights: Optional[Dict[str, int]] = None,
         queue_opts: Optional[dict] = None,
         reserve_plugins: Optional[List[Any]] = None,
+        post_filter_plugins: Optional[List[Any]] = None,
     ):
         self.client = client
         self.informer_factory = informer_factory
         self.filter_plugins = filter_plugins
+        self.post_filter_plugins = post_filter_plugins or []
         self.pre_score_plugins = pre_score_plugins
         self.score_plugins = score_plugins
         self.permit_plugins = permit_plugins
@@ -353,7 +374,17 @@ class Scheduler:
             with self.metrics.timed("schedule"):
                 node_name = self._schedule_pod(state, pod, node_infos, qpi)
         except Exception as err:
+            # park the pod BEFORE preempting: the victims' Pod/DELETE
+            # requeue events must find it in the unschedulableQ — deleting
+            # first opens a window where the only wake-up event fires while
+            # the pod is in neither queue (upstream closes the same window
+            # with moveRequestCycle)
             self.error_func(qpi, err)
+            if isinstance(err, FitError):
+                # PostFilter runs when filtering fails (upstream
+                # RunPostFilterPlugins) — preemption may free a node; the
+                # parked pod lands once the victims' DELETE events replay it
+                self.run_post_filter(state, pod, node_infos, err.diagnosis)
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))
             self.metrics.observe("cycle_failed", time.monotonic() - t_cycle)
@@ -416,6 +447,47 @@ class Scheduler:
             node_infos,
             state=state,
         )
+
+    def run_post_filter(
+        self,
+        state: CycleState,
+        pod: Pod,
+        node_infos: List[NodeInfo],
+        diagnosis: Diagnosis,
+    ) -> Optional[str]:
+        """Run the PostFilter chain on a scheduling failure; on success the
+        nominated node lands in pod.status.nominated_node_name (upstream's
+        nominatedNodeName).  Never raises — a preemption failure must not
+        mask the original FitError path."""
+        if not self.post_filter_plugins:
+            return None
+        try:
+            nominated, status = run_post_filter_plugins(
+                self.post_filter_plugins, state, pod, node_infos, diagnosis
+            )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return None
+        if status.is_success() and nominated:
+            pod.status.nominated_node_name = nominated
+
+            # surface it through the API too (upstream patches
+            # status.nominatedNodeName); binding later resets the status,
+            # clearing the nomination exactly like upstream
+            def set_nominated(p):
+                p.status.nominated_node_name = nominated
+                return p
+
+            try:
+                self.client.pods(pod.metadata.namespace).mutate(
+                    pod.metadata.name, set_nominated
+                )
+            except KeyError:
+                pass  # pod deleted meanwhile
+            return nominated
+        return None
 
     # -- extension-point runners (thin wrappers over the module fns) ----
     def run_filter_plugins(

@@ -67,6 +67,26 @@ class FilterPlugin(Protocol):
 
 
 @runtime_checkable
+class PostFilterPlugin(Protocol):
+    """Runs when filtering leaves no feasible node (the upstream
+    framework.PostFilterPlugin — DefaultPreemption is the in-tree member;
+    the reference's config machinery carries its args through conversion,
+    scheduler/scheduler_test.go:164,205, plugin/plugins.go:77-141)."""
+
+    def name(self) -> str: ...
+
+    def post_filter(
+        self, state: CycleState, pod: Any, node_infos: List[NodeInfo],
+        diagnosis: Any,
+    ) -> Tuple[Optional[str], Status]:
+        """Attempt to make the pod schedulable (e.g. by evicting victims).
+        Returns (nominated node name or None, status); a Success status
+        means the pod should become schedulable there once the cluster
+        reacts (victims terminate)."""
+        ...
+
+
+@runtime_checkable
 class PreScorePlugin(Protocol):
     def name(self) -> str: ...
 
@@ -184,6 +204,10 @@ def implements_pre_filter(p: Any) -> bool:
 
 def implements_filter(p: Any) -> bool:
     return callable(getattr(p, "filter", None))
+
+
+def implements_post_filter(p: Any) -> bool:
+    return callable(getattr(p, "post_filter", None))
 
 
 def implements_pre_score(p: Any) -> bool:

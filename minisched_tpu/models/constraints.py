@@ -97,14 +97,22 @@ class ConstraintTables:
     pod_n_vols: Any  # i32[P] volumes this pod mounts
     # volume roster planes (VolumeZone / VolumeRestrictions / limit family)
     claim_zone_ok: Any  # bool[C2, N] bound PV's zone labels match node
-    pod_vols_fam: Any  # i32[P, F] pod's volumes per driver family
-    node_vols_fam: Any  # i32[F, N] assigned volumes per driver family
-    # per-volume mount state (VolumeRestrictions): referenced claims bound
-    # to the same PV share a row; the repair loop carries these across
-    # rounds so intra-wave conflicts are enforced, not just assigned-pod
-    # ones.  Row Vd-1 is a dummy that unbound claims scatter into.
+    pod_vols_fam: Any  # i32[P, F] pod's DISTINCT volumes per driver family
+    #                    (+ unresolvable mounts, counted generic per-mount)
+    node_vols_fam: Any  # i32[F, N] distinct assigned volumes per family
+    # per-volume mount state, one row per counting key — a bound claim's
+    # PersistentVolume, or an unbound claim itself (claims bound to one PV
+    # share a row; upstream's attach limits count unique volumes, not
+    # mounts).  The repair loop carries vol_any/vol_rw across rounds so
+    # intra-wave conflicts are enforced, not just assigned-pod ones.
+    # Row Vd-1 is a dummy scatter target.
     claim_vol: Any  # i32[C2] volume row of claim c; -1 when unbound
+    #                 (VolumeRestrictions: conflicts need a PV identity)
+    claim_cnt: Any  # i32[C2] counting row of claim c (always >= 0)
+    claim_family: Any  # i32[C2] driver family of claim c
     claim_ro: Any  # bool[C2] the claim mounts its volume read-only
+    pod_claim_valid: Any  # bool[P, MAX_VOLUMES] slot holds a real claim
+    pod_missing: Any  # i32[P] mounts whose PVC doesn't exist (generic)
     vol_any: Any  # bool[Vd, N] some assigned pod on n mounts volume v
     vol_rw: Any  # bool[Vd, N] ... with a writable mount
 
@@ -343,20 +351,32 @@ def build_constraint_tables(
             opvc = pvc_by_key.get(f"{p.metadata.namespace}/{vol}")
             node_claims[node_idx[p.spec.node_name]].append(opvc)
 
-    vol_ids: Dict[str, int] = {}  # volume_name → row of the vol planes
+    # counting key of a claim: its bound PV, else the claim itself —
+    # upstream's attach limits count unique VOLUMES, so claims sharing a
+    # PV share a row (tuple-keyed to keep the two namespaces apart)
+    def count_key(pvc: Any) -> Tuple[str, str]:
+        if pvc.spec.volume_name:
+            return ("pv", pvc.spec.volume_name)
+        return ("pvc", pvc.metadata.key)
 
-    def vol_id(volume_name: str) -> int:
-        if volume_name not in vol_ids:
-            vol_ids[volume_name] = len(vol_ids)
-        return vol_ids[volume_name]
+    vol_ids: Dict[Tuple[str, str], int] = {}  # counting key → vol-plane row
+
+    def vol_id(key: Tuple[str, str]) -> int:
+        if key not in vol_ids:
+            vol_ids[key] = len(vol_ids)
+        return vol_ids[key]
 
     claim_ids: Dict[str, int] = {}
     claim_rows: List[List[bool]] = []
     zone_rows: List[List[bool]] = []
     claim_vol_l: List[int] = []
+    claim_cnt_l: List[int] = []
+    claim_fam_l: List[int] = []
     claim_ro_l: List[bool] = []
     vol_ok = np.zeros(P, bool)
     pod_claims = np.zeros((P, MAX_VOLUMES), np.int32)
+    pod_claim_valid = np.zeros((P, MAX_VOLUMES), bool)
+    pod_missing = np.zeros(P, np.int32)
     pod_n_vols = np.zeros(P, np.int32)
     F = len(FAMILIES)
     pod_vols_fam = np.zeros((P, F), np.int32)
@@ -366,50 +386,67 @@ def build_constraint_tables(
             raise ValueError(f"pod {pod.metadata.name}: >{MAX_VOLUMES} volumes")
         pod_n_vols[i] = len(vols)
         ok = True
+        seen_keys: set = set()
         for j, vol in enumerate(vols):
             key = f"{pod.metadata.namespace}/{vol}"
             if key not in pvc_by_key:
                 ok = False
+                pod_missing[i] += 1
                 pod_vols_fam[i, volume_family(None, pv_by_name)] += 1
                 continue
             pvc = pvc_by_key[key]
-            pod_vols_fam[i, volume_family(pvc, pv_by_name)] += 1
+            ck = count_key(pvc)
+            if ck not in seen_keys:  # distinct volumes, not mounts
+                seen_keys.add(ck)
+                pod_vols_fam[i, volume_family(pvc, pv_by_name)] += 1
             if key not in claim_ids:
                 claim_ids[key] = len(claim_rows)
                 claim_rows.append(claim_node_mask(pvc, pvs, nodes))
                 zone_rows.append(_claim_zone_row(pvc, pv_by_name, nodes, pv_zone_ok))
-                claim_vol_l.append(
-                    vol_id(pvc.spec.volume_name) if pvc.spec.volume_name else -1
-                )
+                row = vol_id(ck)
+                claim_cnt_l.append(row)
+                claim_vol_l.append(row if pvc.spec.volume_name else -1)
+                claim_fam_l.append(volume_family(pvc, pv_by_name))
                 claim_ro_l.append(pvc.spec.read_only)
             pod_claims[i, j] = claim_ids[key]
+            pod_claim_valid[i, j] = True
         vol_ok[i] = ok
     C2 = pad_to(max(len(claim_rows), 1), 8)
     claim_mask = np.zeros((C2, N), bool)
     claim_zone_ok = np.zeros((C2, N), bool)
     claim_vol = np.full(C2, -1, np.int32)
+    claim_cnt = np.zeros(C2, np.int32)
+    claim_family = np.zeros(C2, np.int32)
     claim_ro = np.zeros(C2, bool)
     for cid, row in enumerate(claim_rows):
         claim_mask[cid, : len(row)] = row
         claim_zone_ok[cid, : len(row)] = zone_rows[cid]
         claim_vol[cid] = claim_vol_l[cid]
+        claim_cnt[cid] = claim_cnt_l[cid]
+        claim_family[cid] = claim_fam_l[cid]
         claim_ro[cid] = claim_ro_l[cid]
     # per-volume mount state from assigned pods: one pre-pass over node
     # claims (O(assigned mounts)), rows only for volumes the wave's claims
-    # reference; last row stays a dummy scatter target for unbound claims
+    # reference; last row stays a dummy scatter target
     Vd = pad_to(len(vol_ids) + 1, 8)
     vol_any = np.zeros((Vd, N), bool)
     vol_rw = np.zeros((Vd, N), bool)
     node_vols_fam = np.zeros((F, N), np.int32)
     for n, claims in enumerate(node_claims):
+        seen_node: set = set()
         for opvc in claims:
-            node_vols_fam[volume_family(opvc, pv_by_name), n] += 1
-            if opvc is None or not opvc.spec.volume_name:
+            if opvc is None:
+                # no identity: each unresolvable mount counts by itself
+                node_vols_fam[0, n] += 1
                 continue
-            v = vol_ids.get(opvc.spec.volume_name)
+            ck = count_key(opvc)
+            if ck not in seen_node:  # distinct volumes per node
+                seen_node.add(ck)
+                node_vols_fam[volume_family(opvc, pv_by_name), n] += 1
+            v = vol_ids.get(ck)
             if v is not None:
                 vol_any[v, n] = True
-                if not opvc.spec.read_only:
+                if opvc.spec.volume_name and not opvc.spec.read_only:
                     vol_rw[v, n] = True
 
     # --- per-pod constraint arrays ----------------------------------------
@@ -456,7 +493,9 @@ def build_constraint_tables(
             pod_n_vols=pod_n_vols,
             claim_zone_ok=claim_zone_ok,
             pod_vols_fam=pod_vols_fam, node_vols_fam=node_vols_fam,
-            claim_vol=claim_vol, claim_ro=claim_ro,
+            claim_vol=claim_vol, claim_cnt=claim_cnt,
+            claim_family=claim_family, claim_ro=claim_ro,
+            pod_claim_valid=pod_claim_valid, pod_missing=pod_missing,
             vol_any=vol_any, vol_rw=vol_rw,
         ))
     return ConstraintTables(**as_j)

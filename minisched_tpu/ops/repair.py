@@ -238,16 +238,32 @@ def repair_wave_step(
             for pl in filter_plugins
         )
 
-    if check_restr:
+    # the volume planes are carried whenever something reads them across
+    # rounds: VolumeRestrictions (conflicts) or any limit plugin (its
+    # unique-attach dedup reads vol_any)
+    track_vols = check_restr or bool(fam_limits)
+    if track_vols:
         # per-mount-slot volume rows / read-only flags, fixed across rounds
         V = extra.pod_claims.shape[1]
         in_range = jnp.arange(V)[None, :] < extra.pod_n_vols[:, None]
+        slot_valid = in_range & extra.pod_claim_valid
+        slot_cnt = jnp.where(
+            slot_valid, extra.claim_cnt[extra.pod_claims], -1
+        )  # (P, V) counting rows; −1 = no claim in slot
         slot_vol = jnp.where(
-            in_range, extra.claim_vol[extra.pod_claims], -1
-        )  # (P, V); −1 = unbound / no slot
+            slot_valid, extra.claim_vol[extra.pod_claims], -1
+        )  # (P, V) bound-volume rows; −1 = unbound / no slot
         slot_ro = extra.claim_ro[extra.pod_claims]  # (P, V)
+        slot_fam = extra.claim_family[extra.pod_claims]  # (P, V)
+        # mounts sharing one volume within a pod count once
+        slot_dup = jnp.any(
+            (slot_cnt[:, :, None] == slot_cnt[:, None, :])
+            & (slot_cnt[:, None, :] >= 0)
+            & (jnp.arange(V)[None, None, :] < jnp.arange(V)[None, :, None]),
+            axis=2,
+        )
         n_vol_rows = extra.vol_any.shape[0]
-        dummy_row = n_vol_rows - 1  # never referenced by any claim_vol
+        dummy_row = n_vol_rows - 1  # never referenced by any claim row
 
     def cond(carry):
         nodes_, committed, final, rnd, progress, vols_fam, va, vr = carry
@@ -267,7 +283,7 @@ def repair_wave_step(
         extra_ = extra
         if fam_limits:
             extra_ = dataclasses.replace(extra_, node_vols_fam=vols_fam)
-        if check_restr:
+        if track_vols:
             extra_ = dataclasses.replace(extra_, vol_any=va, vol_rw=vr)
         result = evaluate(
             active_pods, nodes_, filter_plugins, pre_score_plugins,
@@ -293,19 +309,33 @@ def repair_wave_step(
         )
         idx = jnp.where(accept, result.choice, 0)
         if fam_limits:
-            # carry the committed volume counts so later rounds (which see
-            # the static extra tables) can't blow the per-node limit
-            vols_fam = vols_fam.at[:, idx].add(
-                jnp.where(accept[None, :], extra.pod_vols_fam.T, 0)
+            # carry the committed attach counts so later rounds (which see
+            # the static extra tables) can't blow the per-node limit —
+            # counting only NEW attachments (a volume already on the node,
+            # per pre-update vol_any, is not a new attach)
+            attached = va[jnp.maximum(slot_cnt, 0), idx[:, None]]  # (P, V)
+            new_slot = accept[:, None] & (slot_cnt >= 0) & ~slot_dup & ~attached
+            for f in range(vols_fam.shape[0]):
+                counts_f = jnp.sum(
+                    new_slot & (slot_fam == f), axis=1, dtype=jnp.int32
+                )
+                vols_fam = vols_fam.at[f, idx].add(counts_f)
+            vols_fam = vols_fam.at[0, idx].add(
+                jnp.where(accept, extra.pod_missing, 0)
             )
-        if check_restr:
+        if track_vols:
             # record the committed pods' mounts in the volume planes;
-            # non-accepted slots scatter into the dummy row
-            slot_acc = accept[:, None] & (slot_vol >= 0)
-            rows = jnp.where(slot_acc, slot_vol, dummy_row)
+            # non-accepted slots scatter into the dummy row.  vol_any rows
+            # are counting keys (bound PV or unbound claim — the limit
+            # plugins' dedup); vol_rw only tracks bound, writable mounts
+            # (the restriction conflicts)
+            slot_acc = accept[:, None] & (slot_cnt >= 0)
+            rows = jnp.where(slot_acc, slot_cnt, dummy_row)
             cols = jnp.broadcast_to(idx[:, None], rows.shape)
             va = va.at[rows, cols].set(True)
-            rw_rows = jnp.where(slot_acc & ~slot_ro, slot_vol, dummy_row)
+            rw_rows = jnp.where(
+                accept[:, None] & (slot_vol >= 0) & ~slot_ro, slot_vol, dummy_row
+            )
             vr = vr.at[rw_rows, cols].set(True)
         final = jnp.where(accept, result.choice, final)
         committed = committed | accept
@@ -321,8 +351,8 @@ def repair_wave_step(
         if fam_limits
         else jnp.zeros((1, nodes.valid.shape[0]), jnp.int32)
     )
-    va0 = extra.vol_any if check_restr else jnp.zeros((1, 1), bool)
-    vr0 = extra.vol_rw if check_restr else jnp.zeros((1, 1), bool)
+    va0 = extra.vol_any if track_vols else jnp.zeros((1, 1), bool)
+    vr0 = extra.vol_rw if track_vols else jnp.zeros((1, 1), bool)
     nodes, committed, final, rounds, _, _, _, _ = jax.lax.while_loop(
         cond,
         body,

@@ -97,7 +97,11 @@ _CONSTRAINT_AXES = {
     "node_vols_fam": ("last", NODE_AXIS),
     "pod_vols_fam": ("first", POD_AXIS),
     "claim_vol": ("rep", None),
+    "claim_cnt": ("rep", None),
+    "claim_family": ("rep", None),
     "claim_ro": ("rep", None),
+    "pod_claim_valid": ("first", POD_AXIS),
+    "pod_missing": ("first", POD_AXIS),
     "vol_any": ("last", NODE_AXIS),
     "vol_rw": ("last", NODE_AXIS),
 }

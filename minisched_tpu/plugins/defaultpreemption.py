@@ -1,0 +1,165 @@
+"""DefaultPreemption: the in-tree PostFilter plugin.
+
+Closes the reference's PostFilter extension-point surface: the reference's
+config machinery carries ``DefaultPreemption`` plugin config through
+conversion (scheduler/scheduler_test.go:164,205; plugin/plugins.go:77-141
+decodes its args — MinCandidateNodesPercentage / MinCandidateNodesAbsolute),
+and upstream's default PostFilter roster is exactly ``[DefaultPreemption]``.
+
+Semantics (upstream v1.22 ``defaultpreemption``, simplified where noted):
+
+* Runs when filtering leaves no feasible node.  Candidate nodes are those
+  whose filter verdict was plain Unschedulable (UnschedulableAndUnresolvable
+  nodes are skipped — no eviction can fix those), capped at
+  ``max(min_candidate_nodes_absolute, pct% of nodes)`` dry-run candidates.
+* Victims on a candidate node are assigned pods with LOWER priority than
+  the incoming pod, evicted lowest-priority-first (ties broken by name)
+  until the pod passes the full filter chain against the trimmed node.
+  (Upstream removes all lower-priority pods then "reprieves" back; the
+  greedy form picks the same victims for resource-monotone filters and is
+  deterministic.)
+* The best candidate minimizes (victim count, highest victim priority,
+  node name).  Its victims are deleted through the API and the pod gets
+  the node as ``status.nominated_node_name``; the pod itself requeues and
+  schedules once the informer sees the deletions (the Pod/DELETE cluster
+  event gates its requeue, queue.go:167-190 semantics).
+
+The plugin needs the engine handle ``h`` (filter chain + client), injected
+by the service like the waiting-pod Handle (initialize.go:188-213's
+singleton wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from minisched_tpu.framework.nodeinfo import NodeInfo, build_node_infos
+from minisched_tpu.framework.plugin import Plugin
+from minisched_tpu.framework.types import CycleState, Status
+
+NAME = "DefaultPreemption"
+
+DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE = 10
+DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE = 100
+
+REASON_NO_CANDIDATES = "preemption: no candidate node frees enough resources"
+
+
+class DefaultPreemption(Plugin):
+    def __init__(
+        self,
+        min_candidate_nodes_percentage: int = DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE,
+        min_candidate_nodes_absolute: int = DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE,
+    ):
+        self.min_candidate_nodes_percentage = min_candidate_nodes_percentage
+        self.min_candidate_nodes_absolute = min_candidate_nodes_absolute
+        self.h = None  # engine handle, injected by the service
+
+    def name(self) -> str:
+        return NAME
+
+    # ------------------------------------------------------------------
+    def _max_candidates(self, n_nodes: int) -> int:
+        by_pct = n_nodes * self.min_candidate_nodes_percentage // 100
+        return max(min(max(by_pct, self.min_candidate_nodes_absolute), n_nodes), 1)
+
+    def _feasible_after(
+        self,
+        pod: Any,
+        target: NodeInfo,
+        remaining: List[Any],
+        node_infos: List[NodeInfo],
+    ) -> bool:
+        """Would the pod pass the full filter chain on ``target`` with only
+        ``remaining`` pods assigned there?  When some filter implements
+        pre-filter, it runs against the whole (substituted) snapshot so
+        cross-pod aggregates see the evictions; chains without pre-filter
+        skip the full-snapshot rebuild entirely (the common fast path —
+        this probe runs once per victim prefix)."""
+        from minisched_tpu.engine.scheduler import (
+            run_filter_plugins,
+            run_pre_filter_plugins,
+        )
+        from minisched_tpu.framework.plugin import implements_pre_filter
+        from minisched_tpu.framework.types import is_success
+
+        filters = self.h.filter_plugins
+        [trimmed] = build_node_infos([target.node], remaining)
+        state = CycleState()
+        if any(implements_pre_filter(pl) for pl in filters):
+            infos = [
+                trimmed if ni.name == target.name else ni for ni in node_infos
+            ]
+            for ni in infos:
+                state.write("nodeinfo/" + ni.name, ni)
+            state.write("nodeinfos", infos)
+            status, _ = run_pre_filter_plugins(filters, state, pod, infos)
+            if not is_success(status):
+                return False
+        else:
+            state.write("nodeinfo/" + trimmed.name, trimmed)
+            state.write("nodeinfos", [trimmed])
+        try:
+            feasible, _ = run_filter_plugins(filters, state, pod, [trimmed])
+        except Exception:
+            return False
+        return bool(feasible)
+
+    def _select_victims(
+        self, pod: Any, ni: NodeInfo, node_infos: List[NodeInfo]
+    ) -> Optional[List[Any]]:
+        lower = sorted(
+            (p for p in ni.pods if p.spec.priority < pod.spec.priority),
+            key=lambda p: (p.spec.priority, p.metadata.name),
+        )
+        if not lower:
+            return None
+        remaining = list(ni.pods)
+        victims: List[Any] = []
+        for v in lower:
+            remaining.remove(v)
+            victims.append(v)
+            if self._feasible_after(pod, ni, remaining, node_infos):
+                return victims
+        return None
+
+    # ------------------------------------------------------------------
+    def post_filter(
+        self,
+        state: CycleState,
+        pod: Any,
+        node_infos: List[NodeInfo],
+        diagnosis: Any,
+    ) -> Tuple[Optional[str], Status]:
+        if self.h is None:
+            return None, Status.error(f"{NAME}: no engine handle injected")
+        cap = self._max_candidates(len(node_infos))
+        candidates: List[Tuple[NodeInfo, List[Any]]] = []
+        statuses = getattr(diagnosis, "node_to_status", {}) or {}
+        for ni in node_infos:  # name-sorted snapshot → deterministic order
+            st = statuses.get(ni.name)
+            if st is not None and st.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE":
+                continue  # eviction can't fix these (upstream skips them)
+            victims = self._select_victims(pod, ni, node_infos)
+            if victims is not None:
+                candidates.append((ni, victims))
+                if len(candidates) >= cap:
+                    break
+        if not candidates:
+            return None, Status.unschedulable(REASON_NO_CANDIDATES).with_plugin(
+                NAME
+            )
+        best_ni, best_victims = min(
+            candidates,
+            key=lambda c: (
+                len(c[1]),
+                max(v.spec.priority for v in c[1]),
+                c[0].name,
+            ),
+        )
+        for v in best_victims:
+            try:
+                self.h.client.pods(v.metadata.namespace).delete(v.metadata.name)
+            except KeyError:
+                pass  # already gone (stale snapshot) — capacity is freed
+        return best_ni.name, Status.success()

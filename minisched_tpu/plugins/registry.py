@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List
 from minisched_tpu.framework.plugin import (
     implements_filter,
     implements_permit,
+    implements_post_filter,
     implements_pre_score,
     implements_reserve,
     implements_score,
@@ -86,6 +87,25 @@ def _ensure_builtins() -> None:
     from minisched_tpu.plugins.volumerestrictions import VolumeRestrictions
     from minisched_tpu.plugins.volumezone import VolumeZone
 
+    from minisched_tpu.plugins.defaultpreemption import (
+        DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE,
+        DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE,
+        DefaultPreemption,
+    )
+
+    register(
+        "DefaultPreemption",
+        lambda args, ts: DefaultPreemption(
+            min_candidate_nodes_percentage=args.get(
+                "min_candidate_nodes_percentage",
+                DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE,
+            ),
+            min_candidate_nodes_absolute=args.get(
+                "min_candidate_nodes_absolute",
+                DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE,
+            ),
+        ),
+    )
     register("VolumeBinding", lambda args, ts: VolumeBinding())
     register("VolumeRestrictions", lambda args, ts: VolumeRestrictions())
     register("VolumeZone", lambda args, ts: VolumeZone())
@@ -106,6 +126,7 @@ def _ensure_builtins() -> None:
 @dataclass
 class PluginChains:
     filter: List[Any] = field(default_factory=list)
+    post_filter: List[Any] = field(default_factory=list)
     pre_score: List[Any] = field(default_factory=list)
     score: List[Any] = field(default_factory=list)
     reserve: List[Any] = field(default_factory=list)
@@ -118,8 +139,8 @@ class PluginChains:
 
     def all_instances(self) -> List[Any]:
         seen: Dict[int, Any] = {}
-        for chain in (self.filter, self.pre_score, self.score, self.reserve,
-                      self.permit):
+        for chain in (self.filter, self.post_filter, self.pre_score,
+                      self.score, self.reserve, self.permit):
             for p in chain:
                 seen[id(p)] = p
         return list(seen.values())
@@ -127,6 +148,7 @@ class PluginChains:
 
 _CAPABILITY_CHECKS = {
     "filter": implements_filter,
+    "post_filter": implements_post_filter,
     "pre_score": implements_pre_score,
     "score": implements_score,
     "reserve": implements_reserve,

@@ -77,23 +77,37 @@ class VolumeLimitsCore(Plugin, BatchEvaluable):
         return DEFAULT_MAX_VOLUMES
 
     # -- scalar ------------------------------------------------------------
-    def _pod_count(self, pod: Any, store: Any, pv_by_name: Any) -> int:
-        """Volumes of this plugin's family the pod mounts."""
+    def _family_keys(self, pod: Any, store: Any, pv_by_name: Any):
+        """(set of counting keys of this family the pod mounts, number of
+        unresolvable mounts).  A counting key identifies a VOLUME — the
+        bound PV, or the claim itself when unbound — so mounts sharing one
+        volume count once (upstream counts unique volumes, not mounts);
+        unresolvable mounts have no identity and count one each (generic
+        family)."""
+        f = self.volume_family_index
         if store is None:
-            # no control plane: every volume is generic (pre-split behavior)
-            n = len(pod.spec.volumes)
-            return n if self.volume_family_index == FAM_GENERIC else 0
-        count = 0
+            # no control plane: every volume is generic, keyed by claim name
+            if f != FAM_GENERIC:
+                return set(), 0
+            return {(pod.metadata.namespace, v) for v in pod.spec.volumes}, 0
+        keys = set()
+        missing = 0
         for vol in pod.spec.volumes:
             try:
                 pvc = store.get(
                     "PersistentVolumeClaim", pod.metadata.namespace, vol
                 )
             except KeyError:
-                pvc = None
-            if volume_family(pvc, pv_by_name) == self.volume_family_index:
-                count += 1
-        return count
+                missing += 1
+                continue
+            if volume_family(pvc, pv_by_name) != f:
+                continue
+            keys.add(
+                ("pv", pvc.spec.volume_name)
+                if pvc.spec.volume_name
+                else ("pvc", f"{pod.metadata.namespace}/{vol}")
+            )
+        return keys, (missing if f == FAM_GENERIC else 0)
 
     def filter(self, state: CycleState, pod: Any, node_info: NodeInfo) -> Status:
         if not pod.spec.volumes:
@@ -105,15 +119,20 @@ class VolumeLimitsCore(Plugin, BatchEvaluable):
             if store is not None
             else {}
         )
-        n_pod = self._pod_count(pod, store, pv_by_name)
-        if n_pod == 0:
+        pod_keys, pod_missing = self._family_keys(pod, store, pv_by_name)
+        node_keys: set = set()
+        node_missing = 0
+        for p in node_info.pods:
+            if not p.spec.volumes:
+                continue
+            k, m = self._family_keys(p, store, pv_by_name)
+            node_keys |= k
+            node_missing += m
+        # only volumes NOT already attached to the node are new attachments
+        new = len(pod_keys - node_keys) + pod_missing
+        if new == 0:
             return Status.success()
-        mounted = sum(
-            self._pod_count(p, store, pv_by_name)
-            for p in node_info.pods
-            if p.spec.volumes
-        )
-        if mounted + n_pod > self.max_volumes:
+        if len(node_keys) + node_missing + new > self.max_volumes:
             return Status.unschedulable(REASON_LIMIT).with_plugin(self.name())
         return Status.success()
 
@@ -127,10 +146,32 @@ class VolumeLimitsCore(Plugin, BatchEvaluable):
                 f"{self.name()} batch kernel needs the wave's "
                 "ConstraintTables — pass `extra`"
             )
+        import jax.numpy as jnp
+
         f = self.volume_family_index
-        n_pod = extra.pod_vols_fam[:, f][:, None]  # (P, 1)
-        fits = extra.node_vols_fam[f][None, :] + n_pod <= self.max_volumes
-        return (n_pod == 0) | fits
+        V = extra.pod_claims.shape[1]
+        in_range = jnp.arange(V)[None, :] < extra.pod_n_vols[:, None]
+        valid = in_range & extra.pod_claim_valid  # (P, V)
+        cnt = extra.claim_cnt[extra.pod_claims]  # (P, V) counting rows
+        fam = extra.claim_family[extra.pod_claims]  # (P, V)
+        use = valid & (fam == f)
+        # mounts sharing one volume within the pod count once
+        dup = jnp.any(
+            (cnt[:, :, None] == cnt[:, None, :])
+            & use[:, None, :]
+            & (jnp.arange(V)[None, None, :] < jnp.arange(V)[None, :, None]),
+            axis=2,
+        )
+        use = use & ~dup
+        # a volume already attached to the node is not a NEW attachment
+        attached = extra.vol_any[cnt]  # (P, V, N)
+        new = jnp.sum(
+            use[:, :, None] & ~attached, axis=1, dtype=jnp.int32
+        )  # (P, N)
+        if f == FAM_GENERIC:
+            new = new + extra.pod_missing[:, None]
+        fits = extra.node_vols_fam[f][None, :] + new <= self.max_volumes
+        return (new == 0) | fits
 
 
 class EBSLimits(VolumeLimitsCore):

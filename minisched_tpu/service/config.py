@@ -69,6 +69,7 @@ class PluginSet:
 @dataclass
 class SchedulerConfig:
     filter: PluginSet = field(default_factory=PluginSet)
+    post_filter: PluginSet = field(default_factory=PluginSet)
     pre_score: PluginSet = field(default_factory=PluginSet)
     score: PluginSet = field(default_factory=PluginSet)
     reserve: PluginSet = field(default_factory=PluginSet)
@@ -86,6 +87,7 @@ class SchedulerConfig:
     def extension_points(self) -> Dict[str, PluginSet]:
         return {
             "filter": self.filter,
+            "post_filter": self.post_filter,
             "pre_score": self.pre_score,
             "score": self.score,
             "reserve": self.reserve,
@@ -133,6 +135,7 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
                 PluginEnabled("InterPodAffinity"),
             ]
         ),
+        post_filter=PluginSet(enabled=[PluginEnabled("DefaultPreemption")]),
         pre_score=PluginSet(
             enabled=[
                 PluginEnabled("ImageLocality"),

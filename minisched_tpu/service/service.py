@@ -151,6 +151,7 @@ def build_scheduler_from_config(
         client,
         factory,
         filter_plugins=chains.filter,
+        post_filter_plugins=chains.post_filter,
         pre_score_plugins=chains.pre_score,
         score_plugins=chains.score,
         permit_plugins=chains.permit,

@@ -1,0 +1,213 @@
+"""PostFilter extension point + DefaultPreemption (the reference's config
+machinery carries DefaultPreemption args through conversion,
+scheduler/scheduler_test.go:164,205; plugin/plugins.go:77-141)."""
+
+from __future__ import annotations
+
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.framework.nodeinfo import build_node_infos
+from minisched_tpu.framework.types import CycleState, Diagnosis, Status
+from minisched_tpu.plugins.defaultpreemption import DefaultPreemption
+from minisched_tpu.plugins.noderesources import NodeResourcesFit
+
+
+class _Handle:
+    """Minimal engine handle: filter chain + client."""
+
+    def __init__(self, client, filter_plugins):
+        self.client = client
+        self.filter_plugins = filter_plugins
+
+
+def _assigned(name, node, cpu, priority=0):
+    p = make_pod(name, requests={"cpu": cpu}, priority=priority)
+    p.metadata.uid = name
+    p.spec.node_name = node
+    return p
+
+
+def _cluster(client, assigned):
+    nodes = [
+        make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10}),
+        make_node("n2", capacity={"cpu": "2", "memory": "8Gi", "pods": 10}),
+    ]
+    for n in nodes:
+        client.nodes().create(n)
+    for p in assigned:
+        client.pods().create(p)
+    return build_node_infos(nodes, assigned)
+
+
+def test_preemption_picks_fewest_victims():
+    client = Client()
+    assigned = [
+        _assigned("small-a", "n1", "1"),
+        _assigned("small-b", "n1", "1"),
+        _assigned("big", "n2", "2"),
+    ]
+    infos = _cluster(client, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("wants-2cpu", requests={"cpu": "2"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert status.is_success()
+    # evicting 1 pod (big on n2) beats evicting 2 (n1's smalls)
+    assert nominated == "n2"
+    names = {p.metadata.name for p in client.pods().list()}
+    assert "big" not in names
+    assert {"small-a", "small-b"} <= names
+
+
+def test_preemption_requires_lower_priority_victims():
+    client = Client()
+    assigned = [
+        _assigned("peer-a", "n1", "2", priority=10),
+        _assigned("peer-b", "n2", "2", priority=10),
+    ]
+    infos = _cluster(client, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("same-prio", requests={"cpu": "2"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert nominated is None and not status.is_success()
+    assert len(client.pods().list()) == 2  # nothing evicted
+
+
+def test_preemption_evicts_lowest_priority_first():
+    client = Client()
+    assigned = [
+        _assigned("low", "n1", "1", priority=1),
+        _assigned("mid", "n1", "1", priority=5),
+        _assigned("blocker", "n2", "2", priority=9),
+    ]
+    infos = _cluster(client, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    # needs 1 cpu: evicting just "low" on n1 suffices; n2 would also work
+    # with one victim ("blocker", prio 9) — the lower max-victim-priority
+    # candidate (n1, prio 1) must win the tie on victim count
+    pod = make_pod("wants-1cpu", requests={"cpu": "1"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert status.is_success() and nominated == "n1"
+    names = {p.metadata.name for p in client.pods().list()}
+    assert "low" not in names and "mid" in names and "blocker" in names
+
+
+def test_preemption_skips_unresolvable_nodes():
+    client = Client()
+    assigned = [_assigned("small", "n1", "2", priority=0)]
+    infos = _cluster(client, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    diagnosis = Diagnosis()
+    diagnosis.node_to_status["n1"] = Status.unresolvable("volume gone")
+    pod = make_pod("p", requests={"cpu": "1"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, diagnosis)
+    # n1 is unresolvable; n2 is empty (no victims) → no candidates
+    assert nominated is None and not status.is_success()
+    assert len(client.pods().list()) == 1
+
+
+def test_candidate_cap_math():
+    dp = DefaultPreemption(
+        min_candidate_nodes_percentage=10, min_candidate_nodes_absolute=2
+    )
+    assert dp._max_candidates(1000) == 100  # pct wins
+    assert dp._max_candidates(10) == 2  # absolute floor wins
+    assert dp._max_candidates(1) == 1  # capped at n
+
+
+def test_default_preemption_args_flow_through_config():
+    """The reference's conversion carries DefaultPreemption plugin args
+    (scheduler_test.go:164,205); ours must too — through customization,
+    build, AND simulator conversion."""
+    from minisched_tpu.plugins.registry import build_plugins
+    from minisched_tpu.plugins.simulator import convert_configuration_for_simulator
+    from minisched_tpu.service.config import (
+        SchedulerConfig,
+        apply_plugin_customization,
+        default_full_roster_config,
+    )
+
+    custom = SchedulerConfig(
+        plugin_args={"DefaultPreemption": {"min_candidate_nodes_absolute": 7}}
+    )
+    cfg = apply_plugin_customization(default_full_roster_config(), custom)
+    assert [p.name for p in cfg.post_filter.enabled] == ["DefaultPreemption"]
+    chains = build_plugins(cfg)
+    [dp] = chains.post_filter
+    assert dp.min_candidate_nodes_absolute == 7
+    # simulator conversion wraps filter/score only; PostFilter passes through
+    conv = convert_configuration_for_simulator(cfg)
+    assert [p.name for p in conv.post_filter.enabled] == ["DefaultPreemption"]
+    assert conv.plugin_args["DefaultPreemption"] == {
+        "min_candidate_nodes_absolute": 7
+    }
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_live_preemption_scalar_engine():
+    """Full loop: cluster full of low-priority pods; a high-priority pod
+    arrives, preemption evicts a victim, the DELETE event requeues the
+    pod, and it binds to the nominated node."""
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    cfg = default_full_roster_config(time_scale=0.01)
+    cfg.queue_opts = {"initial_backoff_s": 0.05, "max_backoff_s": 0.2}
+    svc.start_scheduler(cfg)
+    try:
+        client.nodes().create(
+            make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10})
+        )
+        client.pods().create(make_pod("low", requests={"cpu": "2"}, priority=1))
+        assert _wait(lambda: client.pods().get("low").spec.node_name == "n1")
+        client.pods().create(make_pod("high", requests={"cpu": "2"}, priority=100))
+        # nomination surfaces on the API while the pod waits for its victim
+        assert _wait(
+            lambda: client.pods().get("high").status.nominated_node_name == "n1"
+            or client.pods().get("high").spec.node_name == "n1"
+        )
+        assert _wait(lambda: client.pods().get("high").spec.node_name == "n1")
+        assert "low" not in {p.metadata.name for p in client.pods().list()}
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_live_preemption_device_engine():
+    """Same loop through the device wave engine: wave losers run the
+    host-side PostFilter chain."""
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    cfg = default_full_roster_config(time_scale=0.01)
+    cfg.queue_opts = {"initial_backoff_s": 0.05, "max_backoff_s": 0.2}
+    svc.start_scheduler(cfg, device_mode=True, max_wave=16)
+    try:
+        client.nodes().create(
+            make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10})
+        )
+        client.pods().create(make_pod("low", requests={"cpu": "2"}, priority=1))
+        assert _wait(lambda: client.pods().get("low").spec.node_name == "n1", 60)
+        client.pods().create(make_pod("high", requests={"cpu": "2"}, priority=100))
+        assert _wait(
+            lambda: client.pods().get("high").spec.node_name == "n1", 60
+        )
+        assert "low" not in {p.metadata.name for p in client.pods().list()}
+    finally:
+        svc.shutdown_scheduler()

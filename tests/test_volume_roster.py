@@ -328,6 +328,48 @@ def test_repair_respects_family_limits():
     assert max(placements.count(i) for i in set(placements)) == 2
 
 
+def test_shared_volume_counts_once_scalar_and_batch():
+    """Attach limits count unique VOLUMES, not mounts (upstream v1.22): a
+    pod mounting a PV already attached to the node adds no new attachment
+    and passes even at the cap — in both the scalar and batch paths."""
+    node = make_node("n1")
+    holder = _assigned("holder", "n1", volumes=["c-held"])
+    pvs = [_pv("shared-pv", claim="default/c-held")]
+    pvcs = [
+        _pvc("c-held", volume="shared-pv", read_only=True),
+        _pvc("c-same", volume="shared-pv", read_only=True),
+    ]
+    client = _client_with(nodes=[node], pvs=pvs, pvcs=pvcs)
+    [ni] = build_node_infos([node], [holder])
+    nvl = _with_client(NodeVolumeLimits(max_volumes=1), client)
+    pod = make_pod("p", volumes=["c-same"])  # same PV via a second claim
+    assert nvl.filter(CycleState(), pod, ni).is_success()
+    # batch path agrees
+    node_table, _ = build_node_table([node], {"n1": [holder]})
+    pod_table, _ = build_pod_table([pod])
+    extra = build_constraint_tables(
+        [pod], [node], [holder], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs, pvs=pvs,
+    )
+    res = FusedEvaluator([nvl], [], [])(pod_table, node_table, extra)
+    assert int(res.choice[0]) == 0
+    # ...while a genuinely new volume at the cap is rejected in both
+    client.store.create(KIND_PV, _pv("other-pv", claim="default/c-new"))
+    pvc_new = _pvc("c-new", volume="other-pv")
+    client.store.create(KIND_PVC, pvc_new)
+    pod2 = make_pod("q", volumes=["c-new"])
+    assert not nvl.filter(CycleState(), pod2, ni).is_success()
+    pvs2 = pvs + [_pv("other-pv", claim="default/c-new")]
+    pvcs2 = pvcs + [pvc_new]
+    pod_table2, _ = build_pod_table([pod2])
+    extra2 = build_constraint_tables(
+        [pod2], [node], [holder], pod_capacity=pod_table2.capacity,
+        node_capacity=node_table.capacity, pvcs=pvcs2, pvs=pvs2,
+    )
+    res2 = FusedEvaluator([nvl], [], [])(pod_table2, node_table, extra2)
+    assert int(res2.choice[0]) == -1
+
+
 def test_repair_enforces_intra_wave_restriction_conflicts():
     """Two pending pods mounting the same writable bound PV must not land
     on one node in a single repair wave (regression: the static conflict
