@@ -147,6 +147,7 @@ class DeviceScheduler(Scheduler):
                     node_capacity=node_table.capacity,
                     pvcs=self.client.store.list("PersistentVolumeClaim"),
                     pvs=self.client.store.list("PersistentVolume"),
+                    scan_planes=False,  # wave mode never runs the scan
                 )
             _, choice, _ = self._get_evaluator()(pod_table, node_table, extra)
             return node_names, choice.tolist()[: len(pods_)]
@@ -255,7 +256,8 @@ class DeviceScheduler(Scheduler):
                 build_pod_table([qpi.pod], capacity=128)
                 if self._needs_extra:  # only caps the wave actually encodes
                     build_constraint_tables([qpi.pod], [], [], pod_capacity=128,
-                                            node_capacity=128)
+                                            node_capacity=128,
+                                            scan_planes=False)
             except ValueError as err:
                 self.error_func(qpi, err)
                 if self.on_decision:

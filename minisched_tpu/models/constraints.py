@@ -89,6 +89,13 @@ class ConstraintTables:
     # reverse direction: assigned pods' required anti-affinity terms
     ex_domain: Any  # bool[T, N] nodes in the owning pod's topo domain
     pod_matches_ex: Any  # bool[P, T] pending pod matches term selector
+    # sequential-scan support (ops/sequential.py): which pending pods match
+    # each combo's selector — commits update the combo aggregates with it —
+    # and the exclusion plane accumulated from committed pods' required
+    # anti-affinity terms (all-False outside the scan)
+    pod_matches_combo: Any  # bool[P, C]
+    combo_excl: Any  # bool[C, N] matching pods banned (committed pod's
+    #                  anti-affinity domain)
     # volume coupling (VolumeBinding / NodeVolumeLimits)
     claim_mask: Any  # bool[C2, N] nodes OK for referenced claim c (bound
     #                  PV's node labels, or ∃ bindable free PV)
@@ -115,6 +122,65 @@ class ConstraintTables:
     pod_missing: Any  # i32[P] mounts whose PVC doesn't exist (generic)
     vol_any: Any  # bool[Vd, N] some assigned pod on n mounts volume v
     vol_rw: Any  # bool[Vd, N] ... with a writable mount
+
+
+#: field → (kind, axis-role) — the ONE authority on how each plane is laid
+#: out: "first"/pods = leading pod dim, "last"/nodes = trailing node dim,
+#: "rep" = small metadata.  parallel/sharding.py turns this into mesh
+#: shardings; ops/sequential.py uses the pod-axis set to slice per-pod rows.
+CONSTRAINT_AXES = {
+    "combo_dsum": ("last", "nodes"),
+    "combo_haskey": ("last", "nodes"),
+    "combo_here": ("last", "nodes"),
+    "combo_global": ("rep", None),
+    "combo_key": ("rep", None),
+    "topo_domain": ("last", "nodes"),
+    "topo_onehot": ("last", "nodes"),
+    "topo_unique": ("rep", None),
+    "ex_domain": ("last", "nodes"),
+    "pod_matches_ex": ("first", "pods"),
+    "pod_matches_combo": ("first", "pods"),
+    "combo_excl": ("last", "nodes"),
+    "claim_mask": ("last", "nodes"),
+    "claim_zone_ok": ("last", "nodes"),
+    "node_vols_fam": ("last", "nodes"),
+    "pod_vols_fam": ("first", "pods"),
+    "claim_vol": ("rep", None),
+    "claim_cnt": ("rep", None),
+    "claim_family": ("rep", None),
+    "claim_ro": ("rep", None),
+    "pod_claim_valid": ("first", "pods"),
+    "pod_missing": ("first", "pods"),
+    "vol_any": ("last", "nodes"),
+    "vol_rw": ("last", "nodes"),
+    # per-pod constraint rows (default shape: leading pod dim)
+    "ts_combo": ("first", "pods"),
+    "ts_skew": ("first", "pods"),
+    "ts_mode": ("first", "pods"),
+    "ts_n": ("first", "pods"),
+    "pa_combo": ("first", "pods"),
+    "pa_self": ("first", "pods"),
+    "pa_n": ("first", "pods"),
+    "pan_combo": ("first", "pods"),
+    "pan_n": ("first", "pods"),
+    "ppa_combo": ("first", "pods"),
+    "ppa_w": ("first", "pods"),
+    "ppa_n": ("first", "pods"),
+    "pod_claims": ("first", "pods"),
+    "vol_ok": ("first", "pods"),
+    "pod_n_vols": ("first", "pods"),
+}
+
+#: fields with a leading pod dimension (sliced per step by the scan)
+POD_AXIS_FIELDS = tuple(
+    name for name, (kind, _) in CONSTRAINT_AXES.items() if kind == "first"
+)
+
+#: fields the sequential scan carries and updates as pods commit
+SCAN_CARRIED_FIELDS = (
+    "combo_dsum", "combo_here", "combo_global", "combo_excl",
+    "vol_any", "vol_rw", "node_vols_fam",
+)
 
 
 def _selector_sig(sel: LabelSelector) -> Tuple:
@@ -215,6 +281,7 @@ def build_constraint_tables(
     node_capacity: Optional[int] = None,
     pvcs: Sequence[Any] = (),
     pvs: Sequence[Any] = (),
+    scan_planes: bool = True,
 ) -> ConstraintTables:
     """Build the wave's coupling tables.
 
@@ -222,6 +289,12 @@ def build_constraint_tables(
     so node indices line up.  ``assigned_pods`` are pods with
     ``spec.node_name`` set; others are ignored.  ``pvcs``/``pvs`` feed the
     volume coupling planes (VolumeBinding / NodeVolumeLimits).
+
+    ``scan_planes``: build ``pod_matches_combo`` (the O(P × selector-groups)
+    pending-pod matching the sequential scan's commit updates need).  On by
+    default — all-False would silently break scan parity — wave-only
+    callers (DeviceScheduler, bench wave paths) pass False to skip the
+    host-side matching cost.
     """
     P = pod_capacity or pad_to(len(pending_pods))
     N = node_capacity or pad_to(len(nodes))
@@ -284,6 +357,21 @@ def build_constraint_tables(
     topo_domain[:, : topo_domain_.shape[1]] = topo_domain_
     topo_onehot = np.zeros((K, D, N), bool)
     topo_onehot[:, :, : topo_onehot_.shape[2]] = topo_onehot_
+    pod_matches_combo = np.zeros((P, C), bool)
+    combo_excl = np.zeros((C, N), bool)
+    if scan_planes:
+        # combos sharing (namespaces, selector) across topology keys match
+        # identically — compute each distinct group once
+        match_cache: Dict[Tuple, Any] = {}
+        for cid, (nss, sel, _topo) in enumerate(reg.combos):
+            mkey = (nss, _selector_sig(sel))
+            if mkey not in match_cache:
+                match_cache[mkey] = np.fromiter(
+                    (_matches(sel, nss, pod) for pod in pending_pods),
+                    dtype=bool,
+                    count=len(pending_pods),
+                )
+            pod_matches_combo[: len(pending_pods), cid] = match_cache[mkey]
     for cid, (nss, sel, topo) in enumerate(reg.combos):
         combo_key[cid] = key_ids[topo]
         matching = [p for p in assigned if _matches(sel, nss, p)]
@@ -489,6 +577,7 @@ def build_constraint_tables(
             pan_combo=pan_combo, pan_n=pan_n,
             ppa_combo=ppa_combo, ppa_w=ppa_w, ppa_n=ppa_n,
             ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
+            pod_matches_combo=pod_matches_combo, combo_excl=combo_excl,
             claim_mask=claim_mask, pod_claims=pod_claims, vol_ok=vol_ok,
             pod_n_vols=pod_n_vols,
             claim_zone_ok=claim_zone_ok,

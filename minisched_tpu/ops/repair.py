@@ -244,23 +244,10 @@ def repair_wave_step(
     track_vols = check_restr or bool(fam_limits)
     if track_vols:
         # per-mount-slot volume rows / read-only flags, fixed across rounds
-        V = extra.pod_claims.shape[1]
-        in_range = jnp.arange(V)[None, :] < extra.pod_n_vols[:, None]
-        slot_valid = in_range & extra.pod_claim_valid
-        slot_cnt = jnp.where(
-            slot_valid, extra.claim_cnt[extra.pod_claims], -1
-        )  # (P, V) counting rows; −1 = no claim in slot
-        slot_vol = jnp.where(
-            slot_valid, extra.claim_vol[extra.pod_claims], -1
-        )  # (P, V) bound-volume rows; −1 = unbound / no slot
-        slot_ro = extra.claim_ro[extra.pod_claims]  # (P, V)
-        slot_fam = extra.claim_family[extra.pod_claims]  # (P, V)
-        # mounts sharing one volume within a pod count once
-        slot_dup = jnp.any(
-            (slot_cnt[:, :, None] == slot_cnt[:, None, :])
-            & (slot_cnt[:, None, :] >= 0)
-            & (jnp.arange(V)[None, None, :] < jnp.arange(V)[None, :, None]),
-            axis=2,
+        from minisched_tpu.ops.state import mount_slot_planes
+
+        slot_cnt, slot_vol, slot_ro, slot_fam, slot_dup = mount_slot_planes(
+            extra
         )
         n_vol_rows = extra.vol_any.shape[0]
         dummy_row = n_vol_rows - 1  # never referenced by any claim row

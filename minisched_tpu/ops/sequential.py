@@ -5,11 +5,25 @@ binds of all pods before it (minisched/minisched.go:32-113).  The wave
 evaluator (ops/fused.py + ops/state.py) is the throughput mode — all pods
 against the pre-wave state — which is bit-exact only for plugin chains
 whose decisions don't depend on earlier binds (e.g. NodeUnschedulable +
-NodeNumber).  For bind-dependent chains (NodeResourcesFit/LeastAllocated,
-NodePorts, …) THIS module is the parity mode: a ``lax.scan`` over the pod
-axis where each step evaluates one pod row (still fully vectorized over
-nodes — the per-step kernel is a (1, N) slice of the same fused chain) and
-commits the placement into the carried NodeTable before the next step.
+NodeNumber).  For bind-dependent chains THIS module is the parity mode: a
+``lax.scan`` over the pod axis where each step evaluates one pod row
+(still fully vectorized over nodes — the per-step kernel is a (1, N)
+slice of the same fused chain) and commits the placement before the next
+step.
+
+Cross-pod plugins are supported by carrying their coupling state through
+the scan:
+
+* **combo aggregates** (InterPodAffinity / PodTopologySpread): a
+  committed pod joins ``combo_global`` / ``combo_here`` / ``combo_dsum``
+  for every combo whose selector it matches (``pod_matches_combo``,
+  host-precomputed), with the domain mask derived on device from the
+  topo-key planes.  Its required anti-affinity terms accumulate into
+  ``combo_excl``, which the affinity filter applies to later pods — the
+  in-scan version of the reverse-direction check.
+* **volume planes** (VolumeRestrictions / limit family / VolumeBinding):
+  the committed pod's mounts update ``vol_any`` / ``vol_rw`` /
+  ``node_vols_fam`` exactly like the repair loop's commit step.
 
 One compiled program schedules the whole table: 100k pods = one scan of
 100k fused steps, no host round-trips (SURVEY.md §7 hard part 2 — the
@@ -19,15 +33,17 @@ sequential rather than approximating with repair passes).
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from minisched_tpu.models.constraints import POD_AXIS_FIELDS
 from minisched_tpu.models.tables import NodeTable, PodTable
 from minisched_tpu.ops.fused import BatchContext, evaluate
-from minisched_tpu.ops.state import apply_placements
+from minisched_tpu.ops.state import apply_placements, mount_slot_planes
 
 
 def _slice_pod(pods: PodTable, i) -> PodTable:
@@ -37,6 +53,30 @@ def _slice_pod(pods: PodTable, i) -> PodTable:
     )
 
 
+def _slice_extra_row(extra: Any, i) -> Any:
+    """ConstraintTables with every pod-axis plane narrowed to row i."""
+    reps = {
+        f: jax.lax.dynamic_slice_in_dim(getattr(extra, f), i, 1, axis=0)
+        for f in POD_AXIS_FIELDS
+    }
+    return dataclasses.replace(extra, **reps)
+
+
+def _combo_domain_masks(extra: Any, n) -> Any:
+    """bool[C, N]: for each combo, the nodes sharing node ``n``'s value of
+    the combo's topology key (all-False when n lacks the key).  Unique
+    (hostname-like) keys collapse to {n} itself."""
+    keys = extra.combo_key  # (C,)
+    D = extra.topo_onehot.shape[1]
+    d = extra.topo_domain[keys, n]  # (C,) domain id or D sentinel
+    has_key = d != D
+    dom = extra.topo_onehot[keys, jnp.minimum(d, D - 1), :]  # (C, N)
+    N = dom.shape[1]
+    onehot_n = jnp.arange(N) == n
+    unique = extra.topo_unique[keys]  # (C,)
+    return jnp.where(unique[:, None], onehot_n[None, :], dom) & has_key[:, None]
+
+
 def scan_schedule(
     nodes: NodeTable,
     pods: PodTable,
@@ -44,37 +84,134 @@ def scan_schedule(
     pre_score_plugins: Sequence[Any],
     score_plugins: Sequence[Any],
     ctx: BatchContext,
+    extra: Any = None,
 ) -> Tuple[NodeTable, Any, Any]:
     """Schedule every pod in order with sequential-bind semantics.
 
     Returns (final NodeTable, choice i32[P], best_score i32[P]) — the
     placements the reference's one-pod-at-a-time loop would produce,
-    computed in one jitted scan.  Cross-pod (``needs_extra``) plugins are
-    not supported here yet — their coupling state would need per-step
-    updates; use the wave path with per-wave table rebuilds for those.
+    computed in one jitted scan.  ``extra`` (the wave's ConstraintTables)
+    is required when the chain contains cross-pod plugins; its coupling
+    planes are carried and updated per committed pod.
     """
-    for pl in (*filter_plugins, *score_plugins):
-        if getattr(pl, "needs_extra", False):
-            raise NotImplementedError(
-                f"sequential scan does not support cross-pod plugin "
-                f"{pl.name()} yet"
-            )
-
-    def step(carry_nodes, i):
-        pod_row = _slice_pod(pods, i)
-        result = evaluate(
-            pod_row,
-            carry_nodes,
-            filter_plugins,
-            pre_score_plugins,
-            score_plugins,
-            ctx,
+    needs_extra = any(
+        getattr(pl, "needs_extra", False)
+        for pl in (*filter_plugins, *score_plugins)
+    )
+    if needs_extra and extra is None:
+        names = [
+            pl.name()
+            for pl in (*filter_plugins, *score_plugins)
+            if getattr(pl, "needs_extra", False)
+        ]
+        raise ValueError(
+            f"sequential scan with cross-pod plugins {names} needs the "
+            "ConstraintTables — pass `extra`"
         )
-        carry_nodes = apply_placements(carry_nodes, pod_row, result.choice)
-        return carry_nodes, (result.choice[0], result.best_score[0])
 
-    nodes, (choice, best) = jax.lax.scan(
-        step, nodes, jnp.arange(pods.valid.shape[0])
+    if extra is None:
+
+        def step(carry_nodes, i):
+            pod_row = _slice_pod(pods, i)
+            result = evaluate(
+                pod_row, carry_nodes, filter_plugins, pre_score_plugins,
+                score_plugins, ctx,
+            )
+            carry_nodes = apply_placements(carry_nodes, pod_row, result.choice)
+            return carry_nodes, (result.choice[0], result.best_score[0])
+
+        nodes, (choice, best) = jax.lax.scan(
+            step, nodes, jnp.arange(pods.valid.shape[0])
+        )
+        return nodes, choice, best
+
+    # which coupling planes this chain actually needs carried — plugins
+    # declare it (scan_carried_planes); an unknown cross-pod plugin without
+    # the attribute gets everything (the safe default)
+    tracked: set = set()
+    for pl in (*filter_plugins, *pre_score_plugins, *score_plugins):
+        if getattr(pl, "needs_extra", False):
+            tracked |= set(
+                getattr(pl, "scan_carried_planes", ("combos", "volumes"))
+            )
+    track_combos = "combos" in tracked
+    track_vols = "volumes" in tracked
+
+    if track_vols:
+        slot_cnt, slot_vol, slot_ro, slot_fam, slot_dup = mount_slot_planes(
+            extra
+        )
+        dummy_row = extra.vol_any.shape[0] - 1
+        F = extra.node_vols_fam.shape[0]
+    A = extra.pan_combo.shape[1]
+    _z = jnp.zeros((1, 1), jnp.int32)  # placeholder for untracked carries
+
+    def step(carry, i):
+        carry_nodes, dsum, here, glob, excl, va, vr, nvf = carry
+        pod_row = _slice_pod(pods, i)
+        reps = {}
+        if track_combos:
+            reps.update(
+                combo_dsum=dsum, combo_here=here, combo_global=glob,
+                combo_excl=excl,
+            )
+        if track_vols:
+            reps.update(vol_any=va, vol_rw=vr, node_vols_fam=nvf)
+        extra_i = dataclasses.replace(_slice_extra_row(extra, i), **reps)
+        result = evaluate(
+            pod_row, carry_nodes, filter_plugins, pre_score_plugins,
+            score_plugins, ctx, extra=extra_i,
+        )
+        choice = result.choice[0]
+        committed = choice >= 0
+        n = jnp.maximum(choice, 0)
+        carry_nodes = apply_placements(carry_nodes, pod_row, result.choice)
+
+        if track_combos:
+            # -- combo aggregates: the committed pod becomes assigned -----
+            dom = _combo_domain_masks(extra, n)  # (C, N)
+            pmc = extra.pod_matches_combo[i] & committed  # (C,)
+            dsum = dsum + (pmc[:, None] & dom).astype(dsum.dtype)
+            here = here.at[:, n].add(pmc.astype(here.dtype))
+            glob = glob + pmc.astype(glob.dtype)
+            # its required anti-affinity terms ban matchers from the domain
+            pan_c = extra.pan_combo[i]  # (A,)
+            pan_in = (jnp.arange(A) < extra.pan_n[i]) & committed
+            excl = excl.at[pan_c].max(pan_in[:, None] & dom[pan_c])
+
+        if track_vols:
+            # -- volume planes: same commit update as the repair loop -----
+            sc, sv = slot_cnt[i], slot_vol[i]
+            sro, sfam = slot_ro[i], slot_fam[i]
+            attached = va[jnp.maximum(sc, 0), n]  # (V,)
+            new_slot = committed & (sc >= 0) & ~slot_dup[i] & ~attached
+            for f in range(F):
+                nvf = nvf.at[f, n].add(
+                    jnp.sum(new_slot & (sfam == f), dtype=nvf.dtype)
+                )
+            nvf = nvf.at[0, n].add(
+                jnp.where(committed, extra.pod_missing[i], 0)
+            )
+            rows = jnp.where(committed & (sc >= 0), sc, dummy_row)
+            va = va.at[rows, n].set(True)
+            rw_rows = jnp.where(committed & (sv >= 0) & ~sro, sv, dummy_row)
+            vr = vr.at[rw_rows, n].set(True)
+
+        carry = (carry_nodes, dsum, here, glob, excl, va, vr, nvf)
+        return carry, (choice, result.best_score[0])
+
+    carry0 = (
+        nodes,
+        extra.combo_dsum if track_combos else _z,
+        extra.combo_here if track_combos else _z,
+        extra.combo_global if track_combos else _z,
+        extra.combo_excl if track_combos else _z,
+        extra.vol_any if track_vols else _z,
+        extra.vol_rw if track_vols else _z,
+        extra.node_vols_fam if track_vols else _z,
+    )
+    (nodes, *_), (choice, best) = jax.lax.scan(
+        step, carry0, jnp.arange(pods.valid.shape[0])
     )
     return nodes, choice, best
 
@@ -92,7 +229,9 @@ class SequentialScheduler:
         from minisched_tpu.ops.fused import validate_batch_chains
 
         validate_batch_chains(filter_plugins, pre_score_plugins, score_plugins)
-        ctx = BatchContext(weights=tuple(sorted((weights or {}).items())))
+        ctx = BatchContext(
+            weights=tuple(sorted((weights or {}).items())), in_scan=True
+        )
         self._fn = jax.jit(
             partial(
                 scan_schedule,
@@ -103,7 +242,9 @@ class SequentialScheduler:
             )
         )
 
-    def __call__(self, pods: PodTable, nodes: NodeTable):
+    def __call__(self, pods: PodTable, nodes: NodeTable, extra: Any = None):
         """Argument order matches FusedEvaluator (pods first); the inner
         scan keeps state-first like wave_step."""
+        if extra is not None:
+            return self._fn(nodes, pods, extra=extra)
         return self._fn(nodes, pods)

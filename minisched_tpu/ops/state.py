@@ -55,6 +55,28 @@ def _commit_ports(nodes: NodeTable, pods: PodTable, placed, choice):
     return used_port, num_used
 
 
+def mount_slot_planes(extra) -> Tuple[Any, Any, Any, Any, Any]:
+    """Per-mount-slot volume planes shared by the repair and sequential
+    commit updates: (slot_cnt, slot_vol, slot_ro, slot_fam, slot_dup), all
+    (P, V).  slot_cnt is the counting row (−1 = empty slot), slot_vol the
+    bound-volume row (−1 = unbound/empty), slot_dup marks later mounts of
+    a volume the pod already mounts (they count once)."""
+    V = extra.pod_claims.shape[1]
+    in_range = jnp.arange(V)[None, :] < extra.pod_n_vols[:, None]
+    slot_valid = in_range & extra.pod_claim_valid
+    slot_cnt = jnp.where(slot_valid, extra.claim_cnt[extra.pod_claims], -1)
+    slot_vol = jnp.where(slot_valid, extra.claim_vol[extra.pod_claims], -1)
+    slot_ro = extra.claim_ro[extra.pod_claims]
+    slot_fam = extra.claim_family[extra.pod_claims]
+    slot_dup = jnp.any(
+        (slot_cnt[:, :, None] == slot_cnt[:, None, :])
+        & (slot_cnt[:, None, :] >= 0)
+        & (jnp.arange(V)[None, None, :] < jnp.arange(V)[None, :, None]),
+        axis=2,
+    )
+    return slot_cnt, slot_vol, slot_ro, slot_fam, slot_dup
+
+
 def apply_placements(nodes: NodeTable, pods: PodTable, choice) -> NodeTable:
     """Commit chosen placements: add each placed pod's resource requests to
     its node's ``req_*`` accounting and its host ports to the node's

@@ -78,32 +78,15 @@ def node_sharding(mesh: Mesh, table: NodeTable):
     return _table_sharding(mesh, table, NODE_AXIS)
 
 
-#: ConstraintTables field → which axis carries the mesh dimension.  Fields
-#: with a leading pod dim split on "pods"; fields whose LAST dim is the
-#: node axis split there; small per-combo/key vectors replicate.
+#: ConstraintTables field → mesh placement, derived from the single
+#: authoritative layout map (models/constraints.CONSTRAINT_AXES): leading
+#: pod dims split on "pods", trailing node dims on "nodes", small
+#: per-combo/key metadata replicates.
+from minisched_tpu.models.constraints import CONSTRAINT_AXES as _LAYOUT
+
+_AXIS_NAME = {"pods": POD_AXIS, "nodes": NODE_AXIS, None: None}
 _CONSTRAINT_AXES = {
-    "combo_dsum": ("last", NODE_AXIS),
-    "combo_haskey": ("last", NODE_AXIS),
-    "combo_here": ("last", NODE_AXIS),
-    "combo_global": ("rep", None),
-    "combo_key": ("rep", None),
-    "topo_domain": ("last", NODE_AXIS),
-    "topo_onehot": ("last", NODE_AXIS),
-    "topo_unique": ("rep", None),
-    "ex_domain": ("last", NODE_AXIS),
-    "pod_matches_ex": ("first", POD_AXIS),
-    "claim_mask": ("last", NODE_AXIS),
-    "claim_zone_ok": ("last", NODE_AXIS),
-    "node_vols_fam": ("last", NODE_AXIS),
-    "pod_vols_fam": ("first", POD_AXIS),
-    "claim_vol": ("rep", None),
-    "claim_cnt": ("rep", None),
-    "claim_family": ("rep", None),
-    "claim_ro": ("rep", None),
-    "pod_claim_valid": ("first", POD_AXIS),
-    "pod_missing": ("first", POD_AXIS),
-    "vol_any": ("last", NODE_AXIS),
-    "vol_rw": ("last", NODE_AXIS),
+    name: (kind, _AXIS_NAME[role]) for name, (kind, role) in _LAYOUT.items()
 }
 
 

@@ -87,6 +87,9 @@ class _Normalize:
 
 class InterPodAffinity(Plugin, BatchEvaluable):
     needs_extra = True
+    #: which coupling planes the sequential scan must carry for this
+    #: plugin (ops/sequential.py): the combo aggregates
+    scan_carried_planes = ("combos",)
 
     def name(self) -> str:
         return NAME
@@ -201,6 +204,19 @@ class InterPodAffinity(Plugin, BatchEvaluable):
             )
             > 0
         )  # (P, N)
+        # same check against pods committed EARLIER IN THIS SCAN: the
+        # sequential engine accumulates their anti-affinity domains into
+        # combo_excl.  Statically all-False outside the scan — the matmul
+        # only compiles when the scan context sets in_scan
+        if getattr(ctx, "in_scan", False):
+            rev = rev | (
+                jnp.einsum(
+                    "pc,cn->pn",
+                    extra.pod_matches_combo.astype(jnp.int32),
+                    extra.combo_excl.astype(jnp.int32),
+                )
+                > 0
+            )
 
         # incoming required anti-affinity
         pan_in = (

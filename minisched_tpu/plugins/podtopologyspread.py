@@ -94,6 +94,8 @@ class _Normalize:
 
 class PodTopologySpread(Plugin, BatchEvaluable):
     needs_extra = True
+    #: the sequential scan carries the combo aggregates for this plugin
+    scan_carried_planes = ("combos",)
 
     def name(self) -> str:
         return NAME

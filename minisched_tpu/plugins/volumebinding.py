@@ -72,6 +72,9 @@ def claim_node_mask(pvc: Any, pvs: Any, nodes: Any):
 
 class VolumeBinding(Plugin, BatchEvaluable):
     needs_extra = True
+    #: reads only bind-static planes (claim_mask/vol_ok) — the sequential
+    #: scan carries nothing for it
+    scan_carried_planes = ()
 
     def __init__(self):
         self.store_client = None  # injected by the service (like permit's h)

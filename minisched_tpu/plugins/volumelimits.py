@@ -65,6 +65,8 @@ class VolumeLimitsCore(Plugin, BatchEvaluable):
     #: class-level family index; also the repair loop's marker for
     #: volume-limit plugins (ops/repair.py reads it with max_volumes)
     volume_family_index = FAM_GENERIC
+    #: the sequential scan carries the volume planes for this plugin
+    scan_carried_planes = ("volumes",)
 
     def __init__(self, max_volumes: Optional[int] = None):
         self.max_volumes = (

@@ -54,6 +54,8 @@ class VolumeRestrictions(Plugin, BatchEvaluable):
     #: the repair loop's marker (ops/repair.py): carry per-volume mount
     #: state across rounds and dedup same-round mounts
     enforces_volume_restrictions = True
+    #: the sequential scan carries the volume planes for this plugin
+    scan_carried_planes = ("volumes",)
 
     def __init__(self):
         self.store_client = None  # injected by the service

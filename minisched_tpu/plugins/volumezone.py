@@ -53,6 +53,9 @@ def pv_zone_ok(pv: Any, node: Any) -> bool:
 
 class VolumeZone(Plugin, BatchEvaluable):
     needs_extra = True
+    #: reads only bind-static planes (claim_zone_ok) — the sequential scan
+    #: carries nothing for it
+    scan_carried_planes = ()
 
     def __init__(self):
         self.store_client = None  # injected by the service
