@@ -110,6 +110,10 @@ class DeviceScheduler(Scheduler):
                 self.pre_score_plugins,
                 self.score_plugins,
                 weights=self.score_weights,
+                # per-pod first-failing-plugin masks for the losers, so
+                # event-gated requeue sees the ACTUAL failing plugins, not
+                # the whole chain
+                with_diagnostics=True,
             )
         return self._evaluator
 
@@ -149,12 +153,25 @@ class DeviceScheduler(Scheduler):
                     pvs=self.client.store.list("PersistentVolume"),
                     scan_planes=False,  # wave mode never runs the scan
                 )
-            _, choice, _ = self._get_evaluator()(pod_table, node_table, extra)
-            return node_names, choice.tolist()[: len(pods_)]
+            _, choice, _, unsched = self._get_evaluator()(
+                pod_table, node_table, extra
+            )
+            # bool[K, P] → per-pod failing-plugin name sets
+            unsched = unsched.tolist()
+            plugin_names = [p.name() for p in self.filter_plugins]
+            fail_sets = [
+                {
+                    name
+                    for k, name in enumerate(plugin_names)
+                    if unsched[k][i]
+                }
+                for i in range(len(pods_))
+            ]
+            return node_names, choice.tolist()[: len(pods_)], fail_sets
 
         try:
             with self.metrics.timed("wave_evaluate"):
-                node_names, placements = build_and_evaluate(qpis)
+                node_names, placements, fail_sets = build_and_evaluate(qpis)
         except ValueError:
             # a pod exceeding a static table capacity (MAX_* in
             # models/tables.py, MAX_VOLUMES in constraints.py) must be
@@ -163,7 +180,7 @@ class DeviceScheduler(Scheduler):
             if not qpis:
                 return
             try:
-                node_names, placements = build_and_evaluate(qpis)
+                node_names, placements, fail_sets = build_and_evaluate(qpis)
             except Exception as err:
                 for qpi in qpis:  # never lose a popped wave: requeue all
                     self.error_func(qpi, err)
@@ -175,9 +192,9 @@ class DeviceScheduler(Scheduler):
         pods = [qpi.pod for qpi in qpis]
 
         losers: List[Any] = []
-        for qpi, pod, c in zip(qpis, pods, placements):
+        for qpi, pod, c, fails in zip(qpis, pods, placements, fail_sets):
             if c < 0:
-                losers.append((qpi, pod))
+                losers.append((qpi, pod, fails))
                 continue
             self._assume(pod, node_names[c])
             self._permit_and_bind(qpi, pod, node_names[c])
@@ -199,9 +216,13 @@ class DeviceScheduler(Scheduler):
         otherwise several losers select the same victims and over-evict.
         """
         diagnoses = {}
-        for qpi, pod in losers:
+        for qpi, pod, fails in losers:
             diagnosis = Diagnosis()
-            diagnosis.unschedulable_plugins = {
+            # the fused evaluator's per-plugin masks name the actual
+            # first-failing plugin(s) per pod (minisched.go:118-121,134
+            # semantics); an empty set (e.g. empty-chain configs) falls
+            # back to the whole chain so event-gated requeue can't strand
+            diagnosis.unschedulable_plugins = set(fails) or {
                 p.name() for p in self.filter_plugins
             }
             diagnoses[pod.metadata.uid] = diagnosis
@@ -214,7 +235,7 @@ class DeviceScheduler(Scheduler):
             return
         evicted: set = set()
         phantoms: List[Pod] = []  # nominated pods: freed capacity is spoken for
-        for qpi, pod in losers:
+        for qpi, pod, _fails in losers:
             infos = self._adjusted_infos(node_infos, evicted, phantoms)
             before = {p.metadata.uid for p in self.client.store.list("Pod")}
             nominated = self.run_post_filter(

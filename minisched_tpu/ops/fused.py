@@ -230,6 +230,25 @@ def evaluate(
     )
 
 
+def unschedulable_plugin_masks(filter_masks, valid):
+    """bool[K, P]: is filter plugin k a FIRST-failing plugin for pod p on
+    some node — the batch analog of the scalar Diagnosis collection
+    (minisched.go:118-121,134): per node, only the first plugin in chain
+    order that rejects is recorded (short-circuit), and a pod's
+    ``unschedulable_plugins`` is the union over nodes.
+
+    filter_masks: bool[K, P, N] per-plugin pass masks (PlacementResult
+    diagnostics); valid: bool[P, N] the pod×node validity mask.
+    """
+    prefix = valid
+    out = []
+    for k in range(filter_masks.shape[0]):
+        m = filter_masks[k]
+        out.append(jnp.any(prefix & ~m, axis=1))
+        prefix = prefix & m
+    return jnp.stack(out)
+
+
 def validate_batch_chains(*chains: Sequence[Any]) -> None:
     """Every plugin in a device chain must implement the batch protocol —
     fail at construction with a clear error, not at trace time."""

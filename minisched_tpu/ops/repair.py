@@ -210,12 +210,17 @@ def repair_wave_step(
     ctx: BatchContext,
     extra: Any = None,
     max_rounds: int = 16,
+    with_diagnostics: bool = False,
 ) -> Tuple[NodeTable, Any, Any]:
     """Evaluate-accept-commit rounds until every pod is placed or
     infeasible (bounded by ``max_rounds``).  Traceable; call under jit.
 
     Returns (updated NodeTable, choice i32[P] with −1 = unplaced,
-    rounds_used i32).
+    rounds_used i32); with ``with_diagnostics`` a fourth element — bool
+    [K, P] per-filter-plugin first-failure masks for the UNPLACED pods
+    against the final table (ops/fused.unschedulable_plugin_masks) — so
+    the engine's FitError names the actually-failing plugin(s), like the
+    scalar Diagnosis (minisched.go:118-121,134).
     """
     P = pods.valid.shape[0]
     names = {pl.name() for pl in filter_plugins}
@@ -340,7 +345,7 @@ def repair_wave_step(
     )
     va0 = extra.vol_any if track_vols else jnp.zeros((1, 1), bool)
     vr0 = extra.vol_rw if track_vols else jnp.zeros((1, 1), bool)
-    nodes, committed, final, rounds, _, _, _, _ = jax.lax.while_loop(
+    nodes, committed, final, rounds, _, vols_fam, va, vr = jax.lax.while_loop(
         cond,
         body,
         (
@@ -348,7 +353,42 @@ def repair_wave_step(
             vols_fam0, va0, vr0,
         ),
     )
-    return nodes, final, rounds
+    if not with_diagnostics:
+        return nodes, final, rounds
+
+    # one diagnostic evaluation of the unplaced remainder against the
+    # FINAL state (committed volume/limit planes included) — filters only
+    # (the score chain can't affect unschedulable_plugins), and skipped
+    # outright when every pod placed
+    import dataclasses
+
+    from minisched_tpu.ops.fused import unschedulable_plugin_masks
+
+    K = len(filter_plugins)
+    if K == 0:
+        return nodes, final, rounds, jnp.zeros((0, P), bool)
+    losers = dataclasses.replace(pods, valid=pods.valid & ~committed)
+    extra_f = extra
+    if extra is not None and fam_limits:
+        extra_f = dataclasses.replace(extra_f, node_vols_fam=vols_fam)
+    if extra is not None and track_vols:
+        extra_f = dataclasses.replace(extra_f, vol_any=va, vol_rw=vr)
+
+    def diag(_):
+        result = evaluate(
+            losers, nodes, filter_plugins, (), (), ctx,
+            with_diagnostics=True, extra=extra_f,
+        )
+        valid = losers.valid[:, None] & nodes.valid[None, :]
+        return unschedulable_plugin_masks(result.filter_masks, valid)
+
+    unsched = jax.lax.cond(
+        jnp.any(losers.valid),
+        diag,
+        lambda _: jnp.zeros((K, P), bool),
+        None,
+    )
+    return nodes, final, rounds, unsched
 
 
 class RepairingEvaluator:
@@ -361,6 +401,7 @@ class RepairingEvaluator:
         score_plugins: Sequence[Any],
         weights: Optional[dict] = None,
         max_rounds: int = 16,
+        with_diagnostics: bool = False,
     ):
         from minisched_tpu.ops.fused import validate_batch_chains
 
@@ -374,6 +415,7 @@ class RepairingEvaluator:
                 score_plugins=tuple(score_plugins),
                 ctx=ctx,
                 max_rounds=max_rounds,
+                with_diagnostics=with_diagnostics,
             ),
         )
 
