@@ -417,6 +417,12 @@ class PVCSpec:
     #: a node (VolumeRestrictions allows co-location only when every mount
     #: of the volume is read-only)
     read_only: bool = False
+    #: non-empty → the PV controller may DYNAMICALLY PROVISION a volume
+    #: when no existing PV fits (upstream semantics: provisioning runs
+    #: through a StorageClass; the reference enables it with
+    #: hostpath/local plugins, pvcontroller.go:24-32).  A name matching a
+    #: driver family ("ebs"/"gcepd"/"azuredisk") provisions that family.
+    storage_class_name: str = ""
 
 
 @dataclass

@@ -1,11 +1,18 @@
-"""PersistentVolume controller hook.
+"""PersistentVolume controller: static binding + dynamic provisioning.
 
-The reference runs the real upstream PV controller so PVC-binding scenarios
-work (pvcontroller/pvcontroller.go:16-44).  Our control plane keeps the same
-shaped hook (SURVEY.md §7 stage 2: "keep a PV-controller-shaped hook but
-stub it"): a minimal binder that matches pending PVCs to available PVs by
-capacity and access, enough for volume-flavored scenarios; dynamic
-provisioning is a TODO gate.
+The reference runs the real upstream PV controller with dynamic
+provisioning ENABLED (pvcontroller/pvcontroller.go:24-32 —
+``EnableDynamicProvisioning: true`` with hostpath/local volume plugins) so
+PVC-binding scenarios work.  This controller does both halves:
+
+* **static binding** — a pending claim binds to the first free PV of
+  sufficient capacity;
+* **dynamic provisioning** — a claim carrying a ``storage_class_name``
+  for which no existing PV fits gets a fresh hostpath-style PV created
+  and bound (upstream: the StorageClass names the provisioner; here a
+  class naming a driver family provisions that family's volumes).
+  Claims WITHOUT a storage class never provision — the upstream "static
+  binding only" semantic the reference scenario relies on.
 """
 
 from __future__ import annotations
@@ -21,8 +28,9 @@ from minisched_tpu.controlplane.informer import (
 
 
 class PVController:
-    def __init__(self, client: Client):
+    def __init__(self, client: Client, provisioning_enabled: bool = True):
         self._client = client
+        self._provisioning_enabled = provisioning_enabled
         self._factory = SharedInformerFactory(client.store)
         self._lock = threading.Lock()
         self._factory.informer_for(KIND_PVC).add_event_handlers(
@@ -54,14 +62,58 @@ class PVController:
                     pv.spec, "capacity", 0
                 ) < getattr(pvc.spec, "request", 0):
                     continue
-                pv.spec.claim_ref = pvc.metadata.key
-                self._client.store.update(KIND_PV, pv)
-                pvc.spec.volume_name = pv.metadata.name
-                pvc.status.phase = "Bound"
-                self._client.store.update(KIND_PVC, pvc)
+                self._bind(pvc, pv)
                 return
+            if self._provisioning_enabled and getattr(
+                pvc.spec, "storage_class_name", ""
+            ):
+                self._bind(pvc, self._provision(pvc))
+
+    def _bind(self, pvc: Any, pv: Any) -> None:
+        pv.spec.claim_ref = pvc.metadata.key
+        self._client.store.update(KIND_PV, pv)
+        pvc.spec.volume_name = pv.metadata.name
+        pvc.status.phase = "Bound"
+        self._client.store.update(KIND_PVC, pvc)
+
+    def _provision(self, pvc: Any) -> Any:
+        """Create a fresh PV for the claim (upstream's provisioner path);
+        the class name doubles as the driver family when it names one."""
+        from minisched_tpu.api.objects import (
+            ObjectMeta,
+            PersistentVolume,
+            PVSpec,
+        )
+        from minisched_tpu.plugins.volumelimits import FAMILIES
+
+        import uuid
+
+        sc = pvc.spec.storage_class_name
+        # upstream names provisioned PVs pvc-<uid> — unique even across
+        # delete/recreate of the same claim (the old PV lingers bound)
+        uid = pvc.metadata.uid or uuid.uuid4().hex[:12]
+        name = f"pvc-{uid}"
+        if any(
+            pv.metadata.name == name for pv in self._client.store.list(KIND_PV)
+        ):
+            name = f"pvc-{uuid.uuid4().hex[:12]}"
+        pv = PersistentVolume(
+            metadata=ObjectMeta(
+                name=name,
+                namespace="",
+                labels={"pv.kubernetes.io/provisioned-by": sc},
+            ),
+            spec=PVSpec(
+                capacity=max(getattr(pvc.spec, "request", 0), 1),
+                driver=sc if sc in FAMILIES else "",
+            ),
+        )
+        return self._client.store.create(KIND_PV, pv)
 
 
-def start_pv_controller(client: Client) -> PVController:
-    """pvcontroller.go:16-44's StartPersistentVolumeController."""
-    return PVController(client).start()
+def start_pv_controller(
+    client: Client, provisioning_enabled: bool = True
+) -> PVController:
+    """pvcontroller.go:16-44's StartPersistentVolumeController (dynamic
+    provisioning on by default, matching pvcontroller.go:24-32)."""
+    return PVController(client, provisioning_enabled=provisioning_enabled).start()

@@ -211,7 +211,7 @@ def repair_wave_step(
     extra: Any = None,
     max_rounds: int = 16,
     with_diagnostics: bool = False,
-) -> Tuple[NodeTable, Any, Any]:
+) -> Tuple[Any, ...]:
     """Evaluate-accept-commit rounds until every pod is placed or
     infeasible (bounded by ``max_rounds``).  Traceable; call under jit.
 

@@ -99,3 +99,109 @@ def test_bound_pv_not_double_claimed():
         )
     finally:
         ctrl.stop()
+
+
+def test_dynamic_provisioning_for_storage_class_claim():
+    """A claim naming a storage class with no fitting PV gets a fresh
+    volume provisioned and bound (pvcontroller.go:24-32's enabled
+    provisioning); a classless claim stays Pending."""
+    client = Client()
+    ctrl = start_pv_controller(client)
+    try:
+        client.store.create(
+            KIND_PVC,
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="dyn"),
+                spec=PVCSpec(request=5 * GI, storage_class_name="standard"),
+            ),
+        )
+        client.store.create(KIND_PVC, _pvc("static", 5 * GI))
+        assert _wait(
+            lambda: client.store.get(KIND_PVC, "default", "dyn").status.phase
+            == "Bound"
+        )
+        pvc = client.store.get(KIND_PVC, "default", "dyn")
+        assert pvc.spec.volume_name.startswith("pvc-")
+        pv = client.store.get(KIND_PV, "", pvc.spec.volume_name)
+        assert pv.spec.claim_ref == "default/dyn"
+        assert pv.spec.capacity >= 5 * GI
+        # no storage class → static binding only, stays pending
+        assert client.store.get(KIND_PVC, "default", "static").status.phase != "Bound"
+    finally:
+        ctrl.stop()
+
+
+def test_provisioned_class_maps_to_driver_family():
+    client = Client()
+    ctrl = start_pv_controller(client)
+    try:
+        client.store.create(
+            KIND_PVC,
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="disk"),
+                spec=PVCSpec(request=GI, storage_class_name="ebs"),
+            ),
+        )
+        assert _wait(
+            lambda: client.store.get(KIND_PVC, "default", "disk").status.phase
+            == "Bound"
+        )
+        vol = client.store.get(KIND_PVC, "default", "disk").spec.volume_name
+        assert client.store.get(KIND_PV, "", vol).spec.driver == "ebs"
+    finally:
+        ctrl.stop()
+
+
+def test_provisioning_disabled_leaves_claim_pending():
+    client = Client()
+    ctrl = start_pv_controller(client, provisioning_enabled=False)
+    try:
+        client.store.create(
+            KIND_PVC,
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="dyn"),
+                spec=PVCSpec(request=GI, storage_class_name="standard"),
+            ),
+        )
+        time.sleep(0.3)
+        assert client.store.get(KIND_PVC, "default", "dyn").status.phase != "Bound"
+    finally:
+        ctrl.stop()
+
+
+def test_pod_schedules_only_after_provisioning():
+    """Scenario: a pod mounting a storage-class claim parks while no PV
+    exists (controller down), then the controller starts, provisions, the
+    PVC event requeues the pod, and it binds — the volume scenario shape
+    the reference's enabled provisioning supports."""
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    cfg = default_full_roster_config(time_scale=0.01)
+    cfg.queue_opts = {"initial_backoff_s": 0.05, "max_backoff_s": 0.2}
+    svc.start_scheduler(cfg)
+    ctrl = None
+    try:
+        client.nodes().create(make_node("node1"))
+        client.store.create(
+            KIND_PVC,
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="data"),
+                spec=PVCSpec(request=GI, storage_class_name="standard"),
+            ),
+        )
+        client.pods().create(make_pod("pod1", volumes=["data"]))
+        assert _wait(
+            lambda: svc.scheduler.queue.stats()["unschedulable"] == 1, 10
+        )
+        assert client.pods().get("pod1").spec.node_name == ""
+        ctrl = start_pv_controller(client)
+        assert _wait(
+            lambda: client.pods().get("pod1").spec.node_name == "node1", 15
+        )
+    finally:
+        svc.shutdown_scheduler()
+        if ctrl is not None:
+            ctrl.stop()
