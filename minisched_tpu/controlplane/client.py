@@ -3,15 +3,84 @@
 Mirrors exactly the clientset calls the reference makes: ``Nodes().Create /
 List`` (sched.go:84,121; minisched/minisched.go:40), ``Pods().Create / Get /
 Update`` (sched.go:91,111; resultstore store.go:120-128) and the binding
-subresource ``Pods().Bind`` (minisched/minisched.go:267-273).
+subresource ``Pods().Bind`` (minisched/minisched.go:267-273), plus the
+client-side QPS/Burst rate limiter the reference configures at 5000/5000
+(k8sapiserver.go:57-62) — off by default, enabled per client.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, List, Optional
 
 from minisched_tpu.api.objects import Binding, Node, Pod, PodStatus
 from minisched_tpu.controlplane.store import ObjectStore
+
+#: the reference's client limits (k8sapiserver.go:60-61)
+DEFAULT_QPS = 5000.0
+DEFAULT_BURST = 5000
+
+
+class TokenBucket:
+    """client-go flowcontrol-style token bucket: ``burst`` capacity
+    refilled at ``qps`` tokens/sec; ``acquire`` blocks until a token is
+    available."""
+
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0:
+            raise ValueError(f"qps must be positive, got {qps}")
+        self._qps = float(qps)
+        # a bucket that can never hold one whole token would block every
+        # acquire forever — clamp like client-go's flowcontrol does
+        self._burst = float(max(burst, 1))
+        self._tokens = self._burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self._burst, self._tokens + (now - self._last) * self._qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self._qps
+            time.sleep(wait)
+
+
+class _ThrottledStore:
+    """Store proxy acquiring one rate-limit token per API operation (the
+    client-go rate limiter gates every request; watch STREAMS pay one
+    token at subscription, not per event — matching client-go, where the
+    limiter covers requests, not watch deliveries)."""
+
+    _THROTTLED = frozenset(
+        ("create", "get", "list", "update", "delete", "mutate", "watch")
+    )
+
+    def __init__(self, store: ObjectStore, limiter: TokenBucket):
+        object.__setattr__(self, "_store", store)
+        object.__setattr__(self, "_limiter", limiter)
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._store, name)
+        if name in self._THROTTLED:
+            limiter = self._limiter
+
+            def gated(*args: Any, **kwargs: Any) -> Any:
+                limiter.acquire()
+                return attr(*args, **kwargs)
+
+            return gated
+        return attr
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        setattr(self._store, name, value)
 
 KIND_POD = "Pod"
 KIND_NODE = "Node"
@@ -97,10 +166,28 @@ class _PodAPI:
 
 
 class Client:
-    """clientset.Interface equivalent."""
+    """clientset.Interface equivalent.
 
-    def __init__(self, store: Optional[ObjectStore] = None):
-        self.store = store or ObjectStore()
+    ``qps``/``burst`` enable the client-side rate limiter (the reference
+    sets QPS/Burst 5000, k8sapiserver.go:57-62 — use DEFAULT_QPS /
+    DEFAULT_BURST for that); None (default) = unlimited.
+    """
+
+    def __init__(
+        self,
+        store: Optional[ObjectStore] = None,
+        qps: Optional[float] = None,
+        burst: Optional[int] = None,
+    ):
+        raw = store or ObjectStore()
+        if qps:
+            self.rate_limiter: Optional[TokenBucket] = TokenBucket(
+                qps, burst if burst is not None else int(qps)
+            )
+            self.store = _ThrottledStore(raw, self.rate_limiter)
+        else:
+            self.rate_limiter = None
+            self.store = raw
 
     def nodes(self) -> _NodeAPI:
         return _NodeAPI(self.store)

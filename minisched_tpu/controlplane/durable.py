@@ -1,0 +1,184 @@
+"""Durable store backend: a file write-ahead log behind the storage boundary.
+
+The reference's L0 is a real etcd process (hack/etcd.sh:26-44;
+k8sapiserver.go:93-105 wires the apiserver's storage to it) — every write
+is durable before the API call returns, and restarting the process
+recovers the cluster state.  This backend closes that layer for the
+in-process control plane (SURVEY.md §7 stage 9's optional store): a
+``DurableObjectStore`` appends one JSON line per mutation to a WAL before
+the call returns, and re-opening the same path replays the log.
+``compact()`` collapses the log to the current state with an atomic
+replace — etcd's snapshot+compaction cycle in miniature.
+
+The record encoding reuses the checkpoint codec (controlplane/checkpoint)
+so WAL, checkpoint files, and the HTTP façade all speak the same
+language-neutral JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
+from minisched_tpu.controlplane.store import ObjectStore
+
+
+class DurableObjectStore(ObjectStore):
+    """ObjectStore whose mutations are logged to ``path`` before returning.
+
+    ``fsync=True`` makes every append an fsync (etcd-grade durability at
+    file-IO cost); the default flushes to the OS, surviving process death
+    but not host power loss — the right trade for the simulator.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
+        super().__init__()
+        self._path = path
+        self._fsync = fsync
+        self._log = None  # replay must not re-log
+        self._replay()
+        self._log = open(self._path, "a", encoding="utf-8")
+
+    # -- logging -----------------------------------------------------------
+    @staticmethod
+    def _loggable(kind: str) -> bool:
+        # only kinds the checkpoint codec can decode are durable; volatile
+        # kinds (Events, and any future unregistered kind) stay in-memory —
+        # logging them would make the WAL unopenable at replay
+        return kind in KIND_TYPES
+
+    def _append(self, rec: dict) -> None:
+        if self._log is None:
+            return
+        self._log.write(json.dumps(rec) + "\n")
+        self._log.flush()
+        if self._fsync:
+            os.fsync(self._log.fileno())
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            out = super().create(kind, obj)
+            if self._loggable(kind):
+                self._append({"op": "put", "kind": kind, "obj": _encode(out)})
+            return out
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            out = super().update(kind, obj)
+            if self._loggable(kind):
+                self._append({"op": "put", "kind": kind, "obj": _encode(out)})
+            return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            super().delete(kind, namespace, name)
+            if self._loggable(kind):
+                self._append(
+                    {
+                        "op": "del",
+                        "kind": kind,
+                        "key": f"{namespace}/{name}",
+                        "rv": self.resource_version,
+                    }
+                )
+
+    def restore_object(self, kind: str, obj: Any) -> None:
+        with self._lock:
+            super().restore_object(kind, obj)
+            if self._loggable(kind):
+                self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
+
+    def set_resource_version(self, rv: int) -> None:
+        with self._lock:
+            super().set_resource_version(rv)
+            # checkpoint restores fast-forward past the max object rv (e.g.
+            # trailing deletes before the snapshot) — persist the watermark
+            # or reopened stores would re-issue observed versions
+            self._append({"op": "rv", "rv": self.resource_version})
+
+    # -- recovery ----------------------------------------------------------
+    def _replay(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        good_end = 0  # byte offset past the last decodable record
+        with open(self._path, "rb") as f:
+            data = f.read()
+        lines = data.splitlines(keepends=True)
+        for idx, raw in enumerate(lines):
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                good_end += len(raw)
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if idx == len(lines) - 1:
+                    break  # torn tail from a crash mid-append: drop it
+                raise
+            self._apply(rec)
+            good_end += len(raw)
+        if good_end < len(data):
+            # physically truncate the torn tail — appending after it would
+            # concatenate the next record onto garbage, losing it on the
+            # following reopen (and poisoning every later replay)
+            with open(self._path, "rb+") as f:
+                f.truncate(good_end)
+
+    def _apply(self, rec: dict) -> None:
+        op = rec["op"]
+        if op == "rv":
+            self._rv = max(self._rv, rec["rv"])
+            return
+        kind = rec["kind"]
+        if kind not in KIND_TYPES:
+            return  # written by a newer schema; skip rather than fail open
+        if op == "put":
+            obj = _decode(KIND_TYPES[kind], rec["obj"])
+            self._objects.setdefault(kind, {})[obj.metadata.key] = obj
+            self._rv = max(self._rv, obj.metadata.resource_version)
+        elif op == "del":
+            self._objects.get(kind, {}).pop(rec["key"], None)
+            self._rv = max(self._rv, rec.get("rv", 0))
+
+    # -- compaction --------------------------------------------------------
+    def compact(self) -> None:
+        """Collapse the log to one put per live object (atomic replace);
+        the previous log stays intact until the rename lands."""
+        with self._lock:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for kind in KIND_TYPES:
+                    for obj in self._objects.get(kind, {}).values():
+                        f.write(
+                            json.dumps(
+                                {"op": "put", "kind": kind, "obj": _encode(obj)}
+                            )
+                            + "\n"
+                        )
+                f.write(json.dumps({"op": "rv", "rv": self._rv}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            if self._log is not None:
+                self._log.close()
+            os.replace(tmp, self._path)
+            self._log = open(self._path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+
+
+def store_from_url(url: str) -> Optional[ObjectStore]:
+    """Resolve ProcessConfig's external-store URL (the reference's
+    KUBE_SCHEDULER_SIMULATOR_ETCD_URL analog, config/config.go:59-66):
+    ``file://<path>`` → a WAL-backed DurableObjectStore; empty → None
+    (caller uses the in-memory store)."""
+    if not url:
+        return None
+    if url.startswith("file://"):
+        return DurableObjectStore(url[len("file://"):])
+    raise ValueError(f"unsupported store url {url!r} (file://<path> only)")

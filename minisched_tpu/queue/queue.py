@@ -101,6 +101,9 @@ class SchedulingQueue:
     def _push_backoff(self, qpi: QueuedPodInfo) -> None:
         self._seq += 1
         heapq.heappush(self._backoff, (self._backoff_ready_time(qpi), self._seq, qpi))
+        # wake blocked consumers: their wait deadline is computed from the
+        # earliest backoff expiry, which this push may have just moved up
+        self._cond.notify_all()
 
     # -- producer side -----------------------------------------------------
     def add(self, pod) -> None:
@@ -221,12 +224,21 @@ class SchedulingQueue:
         with self._cond:
             while not self._active and not self._closed:
                 self.flush_backoff_completed_locked()
-                wait = 0.05
+                if self._active:
+                    break  # the flush's own notify predates our wait
+                # sleep until the next backoff expiry (event-driven: adds
+                # and earlier backoff pushes notify) — no fixed-rate poll
+                wait = None
+                if self._backoff:
+                    wait = max(self._backoff[0][0] - self._clock(), 0.0)
+                    if self._clock is not time.monotonic:
+                        # fake clocks advance out-of-band; stay responsive
+                        wait = min(wait, 0.05)
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
-                    wait = min(wait, remaining)
+                    wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
             if not self._active:
                 return None

@@ -204,3 +204,27 @@ class TestImplementedPanics:
         q.flush_unschedulable_leftover()
         assert q.stats()["unschedulable"] == 0
         assert q.stats()["active"] == 1
+
+
+def test_pop_wakes_at_backoff_expiry_not_poll_interval():
+    """pop computes its wait from the next backoff expiry (no fixed-rate
+    poll): a pod backing off 0.3s is delivered promptly at expiry, well
+    before a generous pop timeout."""
+    import time as _time
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+
+    q = SchedulingQueue(initial_backoff_s=0.3, max_backoff_s=0.3)
+    qpi = QueuedPodInfo(PodInfo(make_pod("late")))
+    qpi.attempts = 1
+    q.add_unschedulable(qpi)
+    # an event moves it to the backoff heap (still 0.3s from ready)
+    from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+
+    q.move_all_to_active_or_backoff(ClusterEvent(GVK.WILDCARD, ActionType.ALL))
+    t0 = _time.monotonic()
+    out = q.pop(timeout=5.0)
+    elapsed = _time.monotonic() - t0
+    assert out is not None and out.pod.metadata.name == "late"
+    assert 0.1 <= elapsed < 2.0, elapsed
