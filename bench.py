@@ -360,9 +360,12 @@ def bench_headline() -> dict:
 
 
 def main() -> None:
+    from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+    cache_dir = enable_persistent_cache()
     import jax
 
-    log(f"devices: {jax.devices()}")
+    log(f"devices: {jax.devices()} (compile cache: {cache_dir})")
     # the headline runs FIRST on a clean device: on the tunneled runtime,
     # earlier evaluator executions leave the backend in a state where every
     # later dispatch pays ~16ms (observed; survives clear_caches + gc), two

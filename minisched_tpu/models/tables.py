@@ -114,9 +114,15 @@ _SCHEMA_SEEN: Dict[Tuple, int] = {}
 
 
 @functools.lru_cache(maxsize=None)
-def _flat_splitter(metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...]):
+def _flat_splitter(
+    metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...],
+    zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
+):
     """Jitted device-side splitter for one packed-table schema: slices the
-    flat int32 buffer back into named columns with their dtypes."""
+    flat int32 buffer back into named columns with their dtypes, plus any
+    all-zero columns materialized directly on device (no wire bytes)."""
+
+    _DT = {"bool": jnp.bool_, "uint32": jnp.uint32, "int32": jnp.int32}
 
     def split(flat):
         out = {}
@@ -133,29 +139,21 @@ def _flat_splitter(metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...]):
                 out[name] = jax.lax.bitcast_convert_type(seg, jnp.uint32)
             else:
                 out[name] = seg
+        for name, kind, shape in zero_metas:
+            out[name] = jnp.zeros(shape, _DT[kind])
         return out
 
     return jax.jit(split)
 
 
-def batched_device_put(t: Dict[str, Any]) -> Dict[str, Any]:
-    """Move a dict of host numpy columns to device in ONE transfer.
-
-    Per-array device_put pays a full dispatch round-trip per LEAF (~33ms
-    on the tunneled runtime — a 37-column table cost >1s in pure latency).
-    Packing every column into one flat int32 buffer makes it one
-    round-trip + bandwidth; a cached jitted splitter rebuilds the columns
-    on device.  bools widen to int32 on the wire; uint32 rides as a
-    bitcast.
-    """
-    arrays = {k: np.asarray(v) for k, v in t.items()}
+def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
     for k, v in arrays.items():
         if v.dtype not in (np.bool_, np.uint32, np.int32):
             raise TypeError(
                 f"batched_device_put: column {k!r} has dtype {v.dtype}; only "
                 "bool/uint32/int32 ride the packed wire format"
             )
-    metas = tuple(
+    return tuple(
         (
             k,
             "bool"
@@ -165,13 +163,35 @@ def batched_device_put(t: Dict[str, Any]) -> Dict[str, Any]:
         )
         for k, v in arrays.items()
     )
+
+
+def batched_device_put(
+    t: Dict[str, Any],
+    zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
+) -> Dict[str, Any]:
+    """Move a dict of host numpy columns to device in ONE transfer.
+
+    Per-array device_put pays a full dispatch round-trip per LEAF (~33ms
+    on the tunneled runtime — a 37-column table cost >1s in pure latency).
+    Packing every column into one flat int32 buffer makes it one
+    round-trip + bandwidth; a cached jitted splitter rebuilds the columns
+    on device.  bools widen to int32 on the wire; uint32 rides as a
+    bitcast.
+
+    ``zero_metas``: extra (name, kind, shape) columns known to be all-zero
+    — created inside the SAME compiled splitter (zero wire bytes, and no
+    second executable to load; one tunnel program-load costs ~0.4s).
+    """
+    arrays = {k: np.asarray(v) for k, v in t.items()}
+    metas = _col_metas(arrays)
     total = sum(v.size for v in arrays.values())
     _SCHEMA_SEEN[metas] = _SCHEMA_SEEN.get(metas, 0) + 1
-    if total < 50_000 or _SCHEMA_SEEN[metas] < 2:
-        # small tables, or a schema seen for the first time (one-shot
-        # builds, tests): the splitter's one-time compile would dwarf the
-        # per-leaf round-trips it saves.  Wave pipelines hit the same
-        # schema every wave and take the packed path from the second build.
+    if not zero_metas and total < 50_000 and _SCHEMA_SEEN[metas] < 2:
+        # small one-shot tables (tests, tiny scenarios): per-leaf puts are
+        # fine.  Anything big OR repeated takes the packed path — the
+        # splitter's compile is served by the persistent compilation cache
+        # (utils/compilecache.py) after the first-ever build, so even a
+        # one-shot 39-column constraint table beats 39 tunnel round-trips.
         return {k: jnp.asarray(v) for k, v in arrays.items()}
     parts = []
     for (k, kind, _shape), v in zip(metas, arrays.values()):
@@ -182,7 +202,7 @@ def batched_device_put(t: Dict[str, Any]) -> Dict[str, Any]:
         else:
             parts.append(np.ascontiguousarray(v.ravel(), dtype=np.int32))
     flat = np.concatenate(parts) if parts else np.zeros(0, np.int32)
-    return _flat_splitter(metas)(flat)
+    return _flat_splitter(metas, zero_metas)(flat)
 
 
 def _register_table(cls):
@@ -498,48 +518,52 @@ def _build_pod_table_fast(pods: Sequence[Any], cap: int) -> Tuple[PodTable, List
         for pod in pods
     ]
     host["image_key"] = img
-    host = batched_device_put(host)  # one packed transfer
-    # every constraint column is all-zero for simple pods: materialize them
-    # ON DEVICE (no host→device transfer) — the table is ~50× wider than
-    # its live fast-path columns and PCIe/tunnel bandwidth on the host
-    # build was the wave pipeline's bottleneck.  One jitted builder per
-    # capacity produces the whole zero-pytree in a single compilation.
-    return PodTable(**host, **_device_zero_pod_columns(cap)), names
+    # every constraint column is all-zero for simple pods: materialized ON
+    # DEVICE inside the same compiled splitter as the packed transfer (no
+    # wire bytes, no second executable) — the table is ~50× wider than its
+    # live fast-path columns and PCIe/tunnel bandwidth on the host build
+    # was the wave pipeline's bottleneck.
+    cols = batched_device_put(host, zero_metas=_zero_pod_metas(cap))
+    return PodTable(**cols), names
 
 
-@jax.jit
-def _zero_pod_constraint_columns(cap_token):
-    """All always-zero-for-simple-pods PodTable columns as one compiled
-    computation.  ``cap_token`` is a shape-(cap,) dummy carrying the
-    capacity into the trace."""
-    cap = cap_token.shape[0]
+@functools.lru_cache(maxsize=None)
+def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
+    """(name, kind, shape) of every PodTable column that is all-zero for
+    simple pods, for ``batched_device_put``'s on-device zero fill."""
     TR = (cap, MAX_AFF_TERMS, MAX_AFF_REQS)
     PR = (cap, MAX_PREF_TERMS, MAX_AFF_REQS)
-
-    def z(shape, dtype=jnp.int32):
-        return jnp.zeros(shape, dtype)
-
-    return dict(
-        spec_node_name=z(cap),
-        tol_key=z((cap, MAX_TOLERATIONS)), tol_value=z((cap, MAX_TOLERATIONS)),
-        tol_effect=z((cap, MAX_TOLERATIONS)), tol_op=z((cap, MAX_TOLERATIONS)),
-        tol_empty_key=z((cap, MAX_TOLERATIONS), bool), num_tols=z(cap),
-        sel_key=z((cap, MAX_LABELS)), sel_value=z((cap, MAX_LABELS)),
-        num_sel=z(cap),
-        aff_required=z(cap, bool),
-        aff_key=z(TR), aff_op=z(TR), aff_vals=z(TR + (MAX_AFF_VALS,)),
-        aff_nvals=z(TR), aff_numval=z(TR),
-        aff_nreqs=z(TR[:2]), aff_nterms=z(cap),
-        pref_weight=z((cap, MAX_PREF_TERMS)),
-        pref_key=z(PR), pref_op=z(PR), pref_vals=z(PR + (MAX_AFF_VALS,)),
-        pref_nvals=z(PR), pref_numval=z(PR),
-        pref_nreqs=z(PR[:2]), pref_nterms=z(cap),
-        port=z((cap, MAX_PORTS)), num_ports=z(cap),
+    i32, b = "int32", "bool"
+    return (
+        ("spec_node_name", i32, (cap,)),
+        ("tol_key", i32, (cap, MAX_TOLERATIONS)),
+        ("tol_value", i32, (cap, MAX_TOLERATIONS)),
+        ("tol_effect", i32, (cap, MAX_TOLERATIONS)),
+        ("tol_op", i32, (cap, MAX_TOLERATIONS)),
+        ("tol_empty_key", b, (cap, MAX_TOLERATIONS)),
+        ("num_tols", i32, (cap,)),
+        ("sel_key", i32, (cap, MAX_LABELS)),
+        ("sel_value", i32, (cap, MAX_LABELS)),
+        ("num_sel", i32, (cap,)),
+        ("aff_required", b, (cap,)),
+        ("aff_key", i32, TR),
+        ("aff_op", i32, TR),
+        ("aff_vals", i32, TR + (MAX_AFF_VALS,)),
+        ("aff_nvals", i32, TR),
+        ("aff_numval", i32, TR),
+        ("aff_nreqs", i32, TR[:2]),
+        ("aff_nterms", i32, (cap,)),
+        ("pref_weight", i32, (cap, MAX_PREF_TERMS)),
+        ("pref_key", i32, PR),
+        ("pref_op", i32, PR),
+        ("pref_vals", i32, PR + (MAX_AFF_VALS,)),
+        ("pref_nvals", i32, PR),
+        ("pref_numval", i32, PR),
+        ("pref_nreqs", i32, PR[:2]),
+        ("pref_nterms", i32, (cap,)),
+        ("port", i32, (cap, MAX_PORTS)),
+        ("num_ports", i32, (cap,)),
     )
-
-
-def _device_zero_pod_columns(cap: int) -> Dict[str, Any]:
-    return _zero_pod_constraint_columns(jnp.empty((cap,), jnp.int8))
 
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable, List[str]]:

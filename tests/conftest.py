@@ -28,3 +28,7 @@ assert jax.devices()[0].platform == "cpu", (
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
