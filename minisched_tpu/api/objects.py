@@ -15,7 +15,6 @@ never touching floats for resources.
 
 from __future__ import annotations
 
-import copy
 import itertools
 import threading
 from dataclasses import dataclass, field
@@ -115,7 +114,17 @@ class ResourceList:
             self.scalar[k] = self.scalar.get(k, 0) - v
 
     def clone(self) -> "ResourceList":
-        return copy.deepcopy(self)
+        # structural copy: clone() sits on every store read/write and every
+        # snapshot — deepcopy's reflective walk measured ~450 frames per
+        # Pod and dominated the bind path (1.5ms/bind), so every clone in
+        # this module is hand-rolled over the known dataclass shape
+        return ResourceList(
+            self.milli_cpu,
+            self.memory,
+            self.pods,
+            self.ephemeral_storage,
+            dict(self.scalar),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -136,6 +145,17 @@ class ObjectMeta:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "ObjectMeta":
+        return ObjectMeta(
+            self.name,
+            self.namespace,
+            self.uid,
+            dict(self.labels),
+            dict(self.annotations),
+            self.resource_version,
+            self.creation_timestamp,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -203,7 +223,18 @@ class Node:
         return self.metadata.name
 
     def clone(self) -> "Node":
-        return copy.deepcopy(self)
+        return Node(
+            metadata=self.metadata.clone(),
+            spec=NodeSpec(
+                unschedulable=self.spec.unschedulable,
+                taints=[Taint(t.key, t.value, t.effect) for t in self.spec.taints],
+            ),
+            status=NodeStatus(
+                capacity=self.status.capacity.clone(),
+                allocatable=self.status.allocatable.clone(),
+                images=dict(self.status.images),
+            ),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -231,6 +262,15 @@ class LabelSelectorRequirement:
 class LabelSelector:
     match_labels: Dict[str, str] = field(default_factory=dict)
     match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    def clone(self) -> "LabelSelector":
+        return LabelSelector(
+            dict(self.match_labels),
+            [
+                LabelSelectorRequirement(r.key, r.operator, list(r.values))
+                for r in self.match_expressions
+            ],
+        )
 
     def matches(self, labels: Dict[str, str]) -> bool:
         for k, v in self.match_labels.items():
@@ -343,6 +383,90 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
 
 
+def _clone_term(t: NodeSelectorTerm) -> NodeSelectorTerm:
+    return NodeSelectorTerm(
+        [
+            LabelSelectorRequirement(r.key, r.operator, list(r.values))
+            for r in t.match_expressions
+        ]
+    )
+
+
+def _clone_pod_term(t: PodAffinityTerm) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        t.label_selector.clone(), t.topology_key, list(t.namespaces)
+    )
+
+
+def _clone_affinity(aff: Optional[Affinity]) -> Optional[Affinity]:
+    if aff is None:
+        return None
+    na = aff.node_affinity
+    pa = aff.pod_affinity
+    paa = aff.pod_anti_affinity
+    return Affinity(
+        node_affinity=None
+        if na is None
+        else NodeAffinity(
+            required_terms=None
+            if na.required_terms is None
+            else [_clone_term(t) for t in na.required_terms],
+            preferred=[
+                PreferredSchedulingTerm(p.weight, _clone_term(p.preference))
+                for p in na.preferred
+            ],
+        ),
+        pod_affinity=None
+        if pa is None
+        else PodAffinity(
+            required=[_clone_pod_term(t) for t in pa.required],
+            preferred=[
+                WeightedPodAffinityTerm(w.weight, _clone_pod_term(w.term))
+                for w in pa.preferred
+            ],
+        ),
+        pod_anti_affinity=None
+        if paa is None
+        else PodAntiAffinity(
+            required=[_clone_pod_term(t) for t in paa.required],
+            preferred=[
+                WeightedPodAffinityTerm(w.weight, _clone_pod_term(w.term))
+                for w in paa.preferred
+            ],
+        ),
+    )
+
+
+def _clone_pod_spec(spec: "PodSpec") -> "PodSpec":
+    return PodSpec(
+        node_name=spec.node_name,
+        containers=[
+            Container(
+                c.name, c.image, c.requests.clone(), c.limits.clone(), list(c.ports)
+            )
+            for c in spec.containers
+        ],
+        node_selector=dict(spec.node_selector),
+        tolerations=[
+            Toleration(t.key, t.operator, t.value, t.effect)
+            for t in spec.tolerations
+        ],
+        affinity=_clone_affinity(spec.affinity),
+        topology_spread_constraints=[
+            TopologySpreadConstraint(
+                c.max_skew,
+                c.topology_key,
+                c.when_unsatisfiable,
+                c.label_selector.clone(),
+            )
+            for c in spec.topology_spread_constraints
+        ],
+        volumes=list(spec.volumes),
+        priority=spec.priority,
+        scheduler_name=spec.scheduler_name,
+    )
+
+
 POD_PENDING = "Pending"
 POD_RUNNING = "Running"
 POD_SUCCEEDED = "Succeeded"
@@ -372,7 +496,15 @@ class Pod:
         return self.metadata.name
 
     def clone(self) -> "Pod":
-        return copy.deepcopy(self)
+        return Pod(
+            metadata=self.metadata.clone(),
+            spec=_clone_pod_spec(self.spec),
+            status=PodStatus(
+                phase=self.status.phase,
+                conditions=[dict(c) for c in self.status.conditions],
+                nominated_node_name=self.status.nominated_node_name,
+            ),
+        )
 
     def resource_requests(self) -> ResourceList:
         """Sum container requests, with upstream's non-zero defaults applied
@@ -406,7 +538,15 @@ class PersistentVolume:
     kind = "PersistentVolume"
 
     def clone(self) -> "PersistentVolume":
-        return copy.deepcopy(self)
+        return PersistentVolume(
+            metadata=self.metadata.clone(),
+            spec=PVSpec(
+                self.spec.capacity,
+                self.spec.claim_ref,
+                dict(self.spec.required_node_labels),
+                self.spec.driver,
+            ),
+        )
 
 
 @dataclass
@@ -438,7 +578,16 @@ class PersistentVolumeClaim:
     kind = "PersistentVolumeClaim"
 
     def clone(self) -> "PersistentVolumeClaim":
-        return copy.deepcopy(self)
+        return PersistentVolumeClaim(
+            metadata=self.metadata.clone(),
+            spec=PVCSpec(
+                self.spec.request,
+                self.spec.volume_name,
+                self.spec.read_only,
+                self.spec.storage_class_name,
+            ),
+            status=PVCStatus(self.status.phase),
+        )
 
 
 @dataclass

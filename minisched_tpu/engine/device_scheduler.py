@@ -33,7 +33,11 @@ from minisched_tpu.framework.types import (
     Status,
 )
 from minisched_tpu.models.constraints import build_constraint_tables
-from minisched_tpu.models.tables import build_node_table, build_pod_table, pad_to
+from minisched_tpu.models.tables import (
+    build_node_table_from_infos,
+    build_pod_table,
+    pad_to,
+)
 from minisched_tpu.ops.repair import RepairingEvaluator
 
 
@@ -135,11 +139,10 @@ class DeviceScheduler(Scheduler):
             return
         nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
         assigned = [p for ni in node_infos for p in ni.pods]
-        by_node = {ni.name: list(ni.pods) for ni in node_infos}
 
         def build_and_evaluate(qpis_):
             pods_ = [qpi.pod for qpi in qpis_]
-            node_table, node_names = build_node_table(nodes, by_node)
+            node_table, node_names = build_node_table_from_infos(node_infos)
             pod_table, _ = build_pod_table(
                 pods_, capacity=pad_to(max(len(pods_), self.max_wave))
             )
@@ -206,15 +209,22 @@ class DeviceScheduler(Scheduler):
         self, losers: List[Any], node_infos: List[Any], n_nodes: int
     ) -> None:
         """Park every wave loser, then run the host-side PostFilter chain
-        (preemption) for each — like the scalar engine's failure path.
+        (preemption) for each preemption-ELIGIBLE one — like the scalar
+        engine's failure path.
 
         Parking happens FIRST so victims' Pod/DELETE requeue events find
-        the losers in the unschedulableQ.  Each loser preempts against a
-        snapshot adjusted for the wave: this wave's assumed winners, the
-        victims earlier losers already evicted, and earlier losers'
-        nominated pods (which will consume the capacity they freed) —
-        otherwise several losers select the same victims and over-evict.
+        the losers in the unschedulableQ.  Losers whose recorded failures
+        are all node-static (NodeAffinity & co — eviction can't flip them,
+        ``preemption_might_help``) skip the chain outright: a wave can park
+        thousands of such pods and each PostFilter pass walks the whole
+        snapshot.  Each eligible loser preempts against a snapshot adjusted
+        for the wave: this wave's assumed winners, the victims earlier
+        losers already evicted, and earlier losers' nominated pods (which
+        will consume the capacity they freed) — otherwise several losers
+        select the same victims and over-evict.
         """
+        from minisched_tpu.plugins.defaultpreemption import preemption_might_help
+
         diagnoses = {}
         for qpi, pod, fails in losers:
             diagnosis = Diagnosis()
@@ -233,38 +243,58 @@ class DeviceScheduler(Scheduler):
                 )
         if not self.post_filter_plugins:
             return
-        evicted: set = set()
-        phantoms: List[Pod] = []  # nominated pods: freed capacity is spoken for
-        for qpi, pod, _fails in losers:
-            infos = self._adjusted_infos(node_infos, evicted, phantoms)
-            before = {p.metadata.uid for p in self.client.store.list("Pod")}
+        eligible = [
+            (qpi, pod)
+            for qpi, pod, _fails in losers
+            if preemption_might_help(diagnoses[pod.metadata.uid])
+        ]
+        if not eligible:
+            return
+        # ONE full merged snapshot (informer state + this wave's assumed
+        # winners); per-loser deltas (evictions, phantoms) are applied
+        # incrementally to just the touched NodeInfos
+        base = self._merged_infos(node_infos)
+        by_name = {ni.name: ni for ni in base}
+        for qpi, pod in eligible:
+            before = {
+                p.metadata.uid: p for p in self.client.store.list("Pod")
+            }
             nominated = self.run_post_filter(
-                CycleState(), pod, infos, diagnoses[pod.metadata.uid]
+                CycleState(), pod, base, diagnoses[pod.metadata.uid]
             )
             after = {p.metadata.uid for p in self.client.store.list("Pod")}
-            evicted |= before - after
+            for uid in before.keys() - after:
+                victim = before[uid]
+                ni = by_name.get(victim.spec.node_name)
+                if ni is not None:
+                    ni.remove_pod(victim)
             if nominated:
+                # the phantom consumes the freed capacity so later losers
+                # can't select the same victims and over-evict
                 ph = pod.clone()
                 ph.spec.node_name = nominated
-                phantoms.append(ph)
+                target = by_name.get(nominated)
+                if target is not None:
+                    target.add_pod(ph)
 
-    def _adjusted_infos(
-        self, node_infos: List[Any], evicted: set, phantoms: List[Pod]
-    ) -> List[Any]:
-        from minisched_tpu.framework.nodeinfo import build_node_infos
-
-        pods = [
-            p
-            for ni in node_infos
-            for p in ni.pods
-            if p.metadata.uid not in evicted
-        ] + list(phantoms)
-        known = {p.metadata.uid for p in pods}
+    def _merged_infos(self, node_infos: List[Any]) -> List[Any]:
+        """Clone of the wave snapshot with the assume-cache folded in —
+        the preemption base: capacity this wave's winners just took must
+        not be offered to victims' replacements."""
+        known = {
+            p.metadata.uid for ni in node_infos for p in ni.pods
+        }
         with self._assumed_lock:
             assumed = [
                 a for a in self._assumed.values() if a.metadata.uid not in known
             ]
-        return build_node_infos([ni.node for ni in node_infos], pods + assumed)
+        merged = [ni.clone() for ni in node_infos]
+        by_name = {ni.name: ni for ni in merged}
+        for a in assumed:
+            ni = by_name.get(a.spec.node_name)
+            if ni is not None:
+                ni.add_pod(a)
+        return merged
 
     def _drop_unencodable(self, qpis: List[QueuedPodInfo]) -> List[QueuedPodInfo]:
         """Park pods whose specs exceed the static table capacities (they
@@ -289,10 +319,15 @@ class DeviceScheduler(Scheduler):
 
     def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
         """Host-side tail of the cycle — the scalar engine's shared
-        reserve → permit → detached-bind helper (minisched.go:89-112)."""
+        reserve → permit → bind helper (minisched.go:89-112).  Binds run
+        inline unless a permit plugin asked to Wait: a wave commits
+        thousands of placements and a detached thread per bind is pure
+        overhead at that rate."""
         from minisched_tpu.framework.types import CycleState
 
-        self._reserve_permit_and_fork(qpi, pod, node_name, CycleState())
+        self._reserve_permit_and_fork(
+            qpi, pod, node_name, CycleState(), inline=True
+        )
 
 
 def new_device_scheduler(

@@ -305,7 +305,7 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._bind_lock = threading.Lock()
-        self._bind_threads: List[threading.Thread] = []
+        self._bind_threads: set = set()
         # observability hooks: fn(pod, node_name_or_None, status), and
         # per-phase timing — assign a profiling.CycleMetrics to collect
         # (the default is a no-op null object)
@@ -327,9 +327,19 @@ class Scheduler:
         )
         self._thread.start()
 
+    #: cadence of the unschedulableQ leftover flush (upstream runs
+    #: flushUnschedulableQLeftover every 30s; pods parked longer than the
+    #: queue's unschedulable_timeout_s replay even with no helping event)
+    UNSCHEDULABLE_FLUSH_INTERVAL_S = 30.0
+
     def _loop(self) -> None:
+        last_flush = time.monotonic()
         while not self._stop.is_set():
             try:
+                now = time.monotonic()
+                if now - last_flush >= self.UNSCHEDULABLE_FLUSH_INTERVAL_S:
+                    last_flush = now
+                    self.queue.flush_unschedulable_leftover()
                 self.schedule_one()
             except Exception:  # the loop must survive anything
                 import traceback
@@ -397,12 +407,22 @@ class Scheduler:
         return True
 
     def _reserve_permit_and_fork(
-        self, qpi: QueuedPodInfo, pod: Pod, node_name: str, state: CycleState
+        self,
+        qpi: QueuedPodInfo,
+        pod: Pod,
+        node_name: str,
+        state: CycleState,
+        inline: bool = False,
     ) -> bool:
         """The host-side tail every engine shares: reserve (upstream
         RunReservePlugins — rolled back on any later failure) → permit
         (minisched.go:89-94) → detach the binding cycle (minisched.go:96-112).
         Returns False when the pod failed (already sent through error_func).
+
+        ``inline=True`` runs the binding cycle on the calling thread when no
+        permit plugin asked to Wait — the wave engine binds thousands of
+        pods per wave and a thread per bind is pure overhead there; with a
+        Wait pending the cycle still detaches (the wait can be seconds).
         """
         status = self.run_reserve_plugins(state, pod, node_name)
         if not status.is_success():
@@ -420,6 +440,9 @@ class Scheduler:
                 self.on_decision(pod, None, status)
             return False
 
+        if inline and not status.is_wait():
+            self._binding_cycle(qpi, pod, node_name, state)
+            return True
         t = threading.Thread(
             target=self._binding_cycle,
             args=(qpi, pod, node_name, state),
@@ -427,7 +450,7 @@ class Scheduler:
             daemon=True,
         )
         with self._bind_lock:
-            self._bind_threads.append(t)
+            self._bind_threads.add(t)
         t.start()
         return True
 
@@ -609,11 +632,7 @@ class Scheduler:
                 self.on_decision(pod, None, Status.from_error(err))
         finally:
             with self._bind_lock:
-                self._bind_threads = [
-                    t
-                    for t in self._bind_threads
-                    if t is not threading.current_thread()
-                ]
+                self._bind_threads.discard(threading.current_thread())
 
     # -- failure path (minisched.go:283-298) ----------------------------
     def error_func(

@@ -341,23 +341,11 @@ def pod_seed(uid: str) -> int:
     return fnv1a32(uid) & 0xFFFFFFFF
 
 
-def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = None,
-                     capacity: int = None) -> Tuple[NodeTable, List[str]]:
-    """Build a NodeTable from Node objects (+ already-assigned pods).
-
-    Returns (table, node_names) where node_names[i] is row i's name; the
-    order is the given order (callers sort for determinism).
-    """
-    pods_by_node = pods_by_node or {}
-    n = len(nodes)
-    cap = capacity or pad_to(n)
-    if n > cap:
-        raise ValueError(f"{n} nodes exceed table capacity {cap}")
-
+def _node_table_skeleton(cap: int) -> Dict[str, Any]:
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)
 
-    t = dict(
+    return dict(
         name_hash=zeros(cap),
         alloc_cpu=zeros(cap), alloc_mem=zeros(cap), alloc_eph=zeros(cap),
         alloc_pods=zeros(cap),
@@ -375,47 +363,82 @@ def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = 
         used_port=zeros((cap, MAX_PORTS)), num_used_ports=zeros(cap),
         valid=np.zeros(cap, bool),
     )
+
+
+def _encode_node_static(t: Dict[str, Any], i: int, node: Any) -> None:
+    """Everything about row ``i`` that comes from the Node object itself
+    (identity, allocatable, taints, labels, images) — the assigned-pod
+    aggregates are filled by the caller."""
+    t["name_hash"][i] = fnv1a32(node.metadata.name)
+    alloc = node.status.allocatable
+    t["alloc_cpu"][i] = alloc.milli_cpu
+    t["alloc_mem"][i] = alloc.memory // MIB
+    t["alloc_eph"][i] = alloc.ephemeral_storage // MIB
+    t["alloc_pods"][i] = alloc.pods
+    t["unschedulable"][i] = node.spec.unschedulable
+    t["suffix"][i] = _name_suffix(node.metadata.name)
+    taints = node.spec.taints
+    if len(taints) > MAX_TAINTS:
+        raise ValueError(f"node {node.metadata.name}: >{MAX_TAINTS} taints")
+    for j, taint in enumerate(taints):
+        t["taint_key"][i, j] = fnv1a32(taint.key)
+        t["taint_value"][i, j] = fnv1a32(taint.value)
+        t["taint_effect"][i, j] = _EFFECT_CODES[taint.effect]
+    t["num_taints"][i] = len(taints)
+    labels = node.metadata.labels
+    if len(labels) > MAX_LABELS:
+        raise ValueError(f"node {node.metadata.name}: >{MAX_LABELS} labels")
+    for j, (k, v) in enumerate(sorted(labels.items())):
+        t["label_key"][i, j] = fnv1a32(k)
+        t["label_value"][i, j] = fnv1a32(v)
+        try:
+            t["label_numval"][i, j] = int(v)
+            t["label_num_ok"][i, j] = True
+        except ValueError:
+            pass
+    t["num_labels"][i] = len(labels)
+    images = node.status.images
+    if len(images) > MAX_IMAGES:
+        raise ValueError(f"node {node.metadata.name}: >{MAX_IMAGES} images")
+    for j, (img, size) in enumerate(sorted(images.items())):
+        t["image_key"][i, j] = fnv1a32(img)
+        t["image_size_mb"][i, j] = size // MIB
+    t["num_images"][i] = len(images)
+    t["valid"][i] = True
+
+
+def _encode_node_ports(t: Dict[str, Any], i: int, node_name: str, pods) -> None:
+    used_ports: List[int] = []
+    for p in pods:
+        for c in p.spec.containers:
+            if c.ports:
+                used_ports.extend(c.ports)
+    if len(used_ports) > MAX_PORTS:
+        raise ValueError(f"node {node_name}: >{MAX_PORTS} used ports")
+    for j, port in enumerate(used_ports):
+        t["used_port"][i, j] = port
+    t["num_used_ports"][i] = len(used_ports)
+
+
+def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = None,
+                     capacity: int = None) -> Tuple[NodeTable, List[str]]:
+    """Build a NodeTable from Node objects (+ already-assigned pods).
+
+    Returns (table, node_names) where node_names[i] is row i's name; the
+    order is the given order (callers sort for determinism).
+    """
+    pods_by_node = pods_by_node or {}
+    n = len(nodes)
+    cap = capacity or pad_to(n)
+    if n > cap:
+        raise ValueError(f"{n} nodes exceed table capacity {cap}")
+    t = _node_table_skeleton(cap)
     names: List[str] = []
     for i, node in enumerate(nodes):
         names.append(node.metadata.name)
-        t["name_hash"][i] = fnv1a32(node.metadata.name)
-        alloc = node.status.allocatable
-        t["alloc_cpu"][i] = alloc.milli_cpu
-        t["alloc_mem"][i] = alloc.memory // MIB
-        t["alloc_eph"][i] = alloc.ephemeral_storage // MIB
-        t["alloc_pods"][i] = alloc.pods
-        t["unschedulable"][i] = node.spec.unschedulable
-        t["suffix"][i] = _name_suffix(node.metadata.name)
-        taints = node.spec.taints
-        if len(taints) > MAX_TAINTS:
-            raise ValueError(f"node {node.metadata.name}: >{MAX_TAINTS} taints")
-        for j, taint in enumerate(taints):
-            t["taint_key"][i, j] = fnv1a32(taint.key)
-            t["taint_value"][i, j] = fnv1a32(taint.value)
-            t["taint_effect"][i, j] = _EFFECT_CODES[taint.effect]
-        t["num_taints"][i] = len(taints)
-        labels = node.metadata.labels
-        if len(labels) > MAX_LABELS:
-            raise ValueError(f"node {node.metadata.name}: >{MAX_LABELS} labels")
-        for j, (k, v) in enumerate(sorted(labels.items())):
-            t["label_key"][i, j] = fnv1a32(k)
-            t["label_value"][i, j] = fnv1a32(v)
-            try:
-                t["label_numval"][i, j] = int(v)
-                t["label_num_ok"][i, j] = True
-            except ValueError:
-                pass
-        t["num_labels"][i] = len(labels)
-        images = node.status.images
-        if len(images) > MAX_IMAGES:
-            raise ValueError(f"node {node.metadata.name}: >{MAX_IMAGES} images")
-        for j, (img, size) in enumerate(sorted(images.items())):
-            t["image_key"][i, j] = fnv1a32(img)
-            t["image_size_mb"][i, j] = size // MIB
-        t["num_images"][i] = len(images)
-        t["valid"][i] = True
-        used_ports: List[int] = []
-        for p in pods_by_node.get(node.metadata.name, ()):  # assigned pods
+        _encode_node_static(t, i, node)
+        assigned = pods_by_node.get(node.metadata.name, ())
+        for p in assigned:
             req = p.resource_requests()
             t["req_cpu"][i] += req.milli_cpu
             t["req_mem"][i] += req.memory // MIB
@@ -423,13 +446,35 @@ def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = 
             t["req_pods"][i] += 1
             t["nzreq_cpu"][i] += req.milli_cpu or DEFAULT_NONZERO_CPU
             t["nzreq_mem"][i] += (req.memory // MIB) or DEFAULT_NONZERO_MEM_MIB
-            for c in p.spec.containers:
-                used_ports.extend(c.ports)
-        if len(used_ports) > MAX_PORTS:
-            raise ValueError(f"node {node.metadata.name}: >{MAX_PORTS} used ports")
-        for j, port in enumerate(used_ports):
-            t["used_port"][i, j] = port
-        t["num_used_ports"][i] = len(used_ports)
+        _encode_node_ports(t, i, node.metadata.name, assigned)
+    return NodeTable(**batched_device_put(t)), names
+
+
+def build_node_table_from_infos(
+    node_infos: Sequence[Any], capacity: int = None
+) -> Tuple[NodeTable, List[str]]:
+    """NodeTable straight from NodeInfo snapshots: reuses the request
+    aggregates the snapshot already computed instead of re-walking every
+    assigned pod (NodeInfo accumulates with the same MiB-floored integer
+    discipline — see framework/nodeinfo.py — so the two builders are
+    bit-identical).  The wave engine rebuilds the table every wave; at
+    100k assigned pods the re-walk was the dominant host cost."""
+    n = len(node_infos)
+    cap = capacity or pad_to(n)
+    if n > cap:
+        raise ValueError(f"{n} nodes exceed table capacity {cap}")
+    t = _node_table_skeleton(cap)
+    names: List[str] = []
+    for i, ni in enumerate(node_infos):
+        names.append(ni.name)
+        _encode_node_static(t, i, ni.node)
+        t["req_cpu"][i] = ni.requested.milli_cpu
+        t["req_mem"][i] = ni.req_mem_mib
+        t["req_eph"][i] = ni.req_eph_mib
+        t["req_pods"][i] = len(ni.pods)
+        t["nzreq_cpu"][i] = ni.non_zero_requested.milli_cpu
+        t["nzreq_mem"][i] = ni.nzreq_mem_mib
+        _encode_node_ports(t, i, ni.name, ni.pods)
     return NodeTable(**batched_device_put(t)), names
 
 

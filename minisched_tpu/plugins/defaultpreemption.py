@@ -43,6 +43,34 @@ DEFAULT_MIN_CANDIDATE_NODES_PERCENTAGE = 10
 DEFAULT_MIN_CANDIDATE_NODES_ABSOLUTE = 100
 
 REASON_NO_CANDIDATES = "preemption: no candidate node frees enough resources"
+REASON_CANNOT_HELP = "preemption: pod failures are not pod-dependent"
+
+#: in-tree filters whose verdict never depends on which pods are assigned —
+#: evicting pods cannot flip them, so a pod that failed ONLY on these is
+#: ineligible for preemption.  This is the batch analog of upstream's
+#: per-node ``UnschedulableAndUnresolvable`` statuses (the plugins below
+#: return it, and ``nodesWherePreemptionMightHelp`` then skips the node);
+#: our wave diagnosis is per-pod, so the gate is per-pod too.  Unknown
+#: (out-of-tree) plugin names are conservatively treated as resolvable.
+NODE_STATIC_PLUGINS = frozenset(
+    {
+        "NodeUnschedulable",
+        "NodeName",
+        "NodeAffinity",
+        "TaintToleration",
+        "VolumeZone",
+        "VolumeBinding",
+    }
+)
+
+
+def preemption_might_help(diagnosis: Any) -> bool:
+    """False when every recorded failure is a node-static filter (see
+    NODE_STATIC_PLUGINS).  An empty failure set is conservatively True."""
+    failed = getattr(diagnosis, "unschedulable_plugins", None)
+    if not failed:
+        return True
+    return bool(set(failed) - NODE_STATIC_PLUGINS)
 
 
 class DefaultPreemption(Plugin):
@@ -133,6 +161,8 @@ class DefaultPreemption(Plugin):
     ) -> Tuple[Optional[str], Status]:
         if self.h is None:
             return None, Status.error(f"{NAME}: no engine handle injected")
+        if not preemption_might_help(diagnosis):
+            return None, Status.unschedulable(REASON_CANNOT_HELP).with_plugin(NAME)
         cap = self._max_candidates(len(node_infos))
         candidates: List[Tuple[NodeInfo, List[Any]]] = []
         statuses = getattr(diagnosis, "node_to_status", {}) or {}

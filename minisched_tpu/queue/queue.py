@@ -29,9 +29,11 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Set
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Set
 
 from minisched_tpu.framework.events import (
+    GVK,
     ClusterEvent,
     ClusterEventMap,
     event_helps_pod,
@@ -53,10 +55,18 @@ class SchedulingQueue:
         clock: Callable[[], float] = time.monotonic,
     ):
         self._cond = threading.Condition()
-        self._active: List[QueuedPodInfo] = []
+        self._active: Deque[QueuedPodInfo] = deque()
         # heap of (ready_time, seq, QueuedPodInfo)
         self._backoff: List[tuple] = []
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        # event-interest index over the unschedulableQ: key → the GVKs whose
+        # events could help the pod (from its failed plugins' registered
+        # events), and the reverse map an incoming event consults.  Without
+        # it every cluster event — including each of the 100k binds a full-
+        # scale run produces — scans the whole unschedulableQ
+        # (move_all_to_active_or_backoff would be O(events × parked)).
+        self._unsched_gvks: Dict[str, Set[GVK]] = {}
+        self._unsched_by_gvk: Dict[GVK, Set[str]] = {}
         self._event_map: ClusterEventMap = event_map or {}
         self._initial_backoff_s = initial_backoff_s
         self._max_backoff_s = max_backoff_s
@@ -115,12 +125,40 @@ class SchedulingQueue:
             self._queued_uids.add(uid)
             self._push_active(QueuedPodInfo(PodInfo(pod)))
 
+    def _interest_gvks(self, failed_plugins: Set[str]) -> Set[GVK]:
+        """Which GVKs' events could help a pod that failed on these plugins
+        — the index key mirroring ``event_helps_pod``'s outer loop.  A pod
+        with no recorded failures retries on ANY event (upstream), as does
+        one whose plugins registered the wildcard resource."""
+        if not failed_plugins:
+            return {GVK.WILDCARD}
+        out: Set[GVK] = set()
+        for registered, plugin_names in self._event_map.items():
+            if plugin_names & failed_plugins:
+                out.add(registered.resource)
+        return out
+
+    def _index_unschedulable(self, key: str, qpi: QueuedPodInfo) -> None:
+        gvks = self._interest_gvks(qpi.unschedulable_plugins)
+        self._unsched_gvks[key] = gvks
+        for gvk in gvks:
+            self._unsched_by_gvk.setdefault(gvk, set()).add(key)
+
+    def _unindex_unschedulable(self, key: str) -> None:
+        for gvk in self._unsched_gvks.pop(key, ()):
+            bucket = self._unsched_by_gvk.get(gvk)
+            if bucket is not None:
+                bucket.discard(key)
+
     def add_unschedulable(self, qpi: QueuedPodInfo) -> None:
         """Failed pod → unschedulableQ, stamped now (queue.go:95-107)."""
         with self._cond:
             qpi.timestamp = self._clock()
             self._queued_uids.add(self._uid(qpi.pod))
-            self._unschedulable[self._key(qpi.pod)] = qpi
+            key = self._key(qpi.pod)
+            self._unindex_unschedulable(key)  # re-park refreshes interest
+            self._unschedulable[key] = qpi
+            self._index_unschedulable(key, qpi)
 
     def update(self, old_pod, new_pod) -> None:
         """Pod object changed while queued — refresh stored pod; if it was
@@ -142,6 +180,7 @@ class SchedulingQueue:
                 qpi.pod_info.pod = new_pod
                 if _spec_changed(old_pod, new_pod):
                     del self._unschedulable[key]
+                    self._unindex_unschedulable(key)
                     if self._is_backing_off(qpi):
                         self._push_backoff(qpi)
                     else:
@@ -152,10 +191,14 @@ class SchedulingQueue:
         (queue.go:113-116's panic)."""
         with self._cond:
             uid = self._uid(pod)
-            self._active = [q for q in self._active if self._uid(q.pod) != uid]
+            self._active = deque(
+                q for q in self._active if self._uid(q.pod) != uid
+            )
             self._backoff = [e for e in self._backoff if self._uid(e[2].pod) != uid]
             heapq.heapify(self._backoff)
-            self._unschedulable.pop(self._key(pod), None)
+            key = self._key(pod)
+            if self._unschedulable.pop(key, None) is not None:
+                self._unindex_unschedulable(key)
             self._queued_uids.discard(uid)
 
     # -- event-driven requeue ---------------------------------------------
@@ -163,12 +206,22 @@ class SchedulingQueue:
         """queue.go:54-82: on a cluster event, re-activate every
         unschedulable pod the event might help."""
         with self._cond:
+            # the interest index narrows the scan to pods whose failed
+            # plugins registered for this event's resource (or wildcard);
+            # event_helps_pod then applies the precise action-type match
+            candidates = self._unsched_by_gvk.get(event.resource, set()) | (
+                self._unsched_by_gvk.get(GVK.WILDCARD, set())
+            )
             moved: List[str] = []
-            for key, qpi in self._unschedulable.items():
-                if event_helps_pod(event, qpi.unschedulable_plugins, self._event_map):
+            for key in candidates:
+                qpi = self._unschedulable.get(key)
+                if qpi is not None and event_helps_pod(
+                    event, qpi.unschedulable_plugins, self._event_map
+                ):
                     moved.append(key)
             for key in moved:
                 qpi = self._unschedulable.pop(key)
+                self._unindex_unschedulable(key)
                 if self._is_backing_off(qpi):
                     self._push_backoff(qpi)
                 else:
@@ -206,6 +259,7 @@ class SchedulingQueue:
             ]
             for key in stale:
                 qpi = self._unschedulable.pop(key)
+                self._unindex_unschedulable(key)
                 if self._is_backing_off(qpi):
                     self._push_backoff(qpi)
                 else:
@@ -242,7 +296,7 @@ class SchedulingQueue:
                 self._cond.wait(wait)
             if not self._active:
                 return None
-            qpi = self._active.pop(0)
+            qpi = self._active.popleft()
             qpi.attempts += 1
             self._queued_uids.discard(self._uid(qpi.pod))
             return qpi
@@ -256,7 +310,7 @@ class SchedulingQueue:
         batch = [first]
         with self._cond:
             while self._active and len(batch) < max_pods:
-                qpi = self._active.pop(0)
+                qpi = self._active.popleft()
                 qpi.attempts += 1
                 self._queued_uids.discard(self._uid(qpi.pod))
                 batch.append(qpi)

@@ -19,17 +19,15 @@ import os
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_cache")
 
-_enabled = False
-
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at a repo-local directory.
 
-    Idempotent; returns the directory in effect (None when disabled via
-    ``MINISCHED_CACHE=0``).  Safe to call after jax is imported — the
-    config flags take effect for every compilation that follows.
+    Idempotent (jax.config.update is repeat-safe); returns the directory in
+    effect (None when disabled via ``MINISCHED_CACHE=0``).  Safe to call
+    after jax is imported — the config flags take effect for every
+    compilation that follows.
     """
-    global _enabled
     if os.environ.get("MINISCHED_CACHE", "1") == "0":
         return None
     cache_dir = cache_dir or os.environ.get("MINISCHED_CACHE_DIR", _DEFAULT_DIR)
@@ -40,5 +38,4 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     # cache everything: the tunnel RTT dominates even trivial compiles
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    _enabled = True
     return cache_dir

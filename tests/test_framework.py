@@ -198,3 +198,59 @@ class TestObjects:
         c = n.clone()
         c.spec.unschedulable = True
         assert not n.spec.unschedulable
+
+
+class TestNodeTableFromInfos:
+    """build_node_table_from_infos must be bit-identical to the
+    pods_by_node walk — the wave engine swaps between them freely."""
+
+    def test_matches_pods_by_node_builder(self):
+        import random
+
+        import numpy as np
+
+        from minisched_tpu.framework.nodeinfo import build_node_infos
+        from minisched_tpu.models.tables import (
+            build_node_table,
+            build_node_table_from_infos,
+        )
+
+        rng = random.Random(11)
+        nodes = sorted(
+            (
+                make_node(
+                    f"n{i}",
+                    labels={"zone": f"z{rng.randrange(3)}"},
+                    unschedulable=rng.random() < 0.3,
+                )
+                for i in range(17)
+            ),
+            key=lambda n: n.metadata.name,
+        )
+        assigned = []
+        for i in range(40):
+            p = make_pod(
+                f"a{i}",
+                requests={"cpu": rng.choice(["0", "250m", "1"]),
+                          "memory": rng.choice(["0", "100Mi", "1537Ki"])},
+            )
+            p.metadata.uid = f"a{i}"
+            p.spec.node_name = rng.choice(nodes).metadata.name
+            assigned.append(p)
+        by_node = {}
+        for p in assigned:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        t1, names1 = build_node_table(nodes, by_node)
+        infos = build_node_infos(nodes, assigned)
+        t2, names2 = build_node_table_from_infos(infos)
+        assert names1 == names2
+        for field in (
+            "name_hash", "alloc_cpu", "alloc_mem", "req_cpu", "req_mem",
+            "req_eph", "req_pods", "nzreq_cpu", "nzreq_mem", "unschedulable",
+            "used_port", "num_used_ports", "valid", "label_key", "label_value",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(t1, field)),
+                np.asarray(getattr(t2, field)),
+                err_msg=field,
+            )

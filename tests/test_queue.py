@@ -228,3 +228,45 @@ def test_pop_wakes_at_backoff_expiry_not_poll_interval():
     elapsed = _time.monotonic() - t0
     assert out is not None and out.pod.metadata.name == "late"
     assert 0.1 <= elapsed < 2.0, elapsed
+
+
+class TestInterestIndex:
+    """The unschedulableQ's GVK interest index: events only scan pods whose
+    failed plugins registered for the event's resource — and the index
+    stays consistent through park/move/delete cycles."""
+
+    def test_pod_event_skips_node_interested_pod(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)  # NodeNumber registered for Node/ADD
+        pod = make_pod("p1")
+        q.add_unschedulable(qpi_for(pod, attempts=1, failed=["NodeNumber"]))
+        from minisched_tpu.framework.events import GVK, ActionType, ClusterEvent
+
+        # candidate set for a Pod event must be empty (index, not filtering)
+        assert q._unsched_by_gvk.get(GVK.POD) in (None, set())
+        q.move_all_to_active_or_backoff(ClusterEvent(GVK.POD, ActionType.ADD))
+        assert q.stats()["unschedulable"] == 1
+
+    def test_no_failed_plugins_retries_on_any_event(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        pod = make_pod("p1")
+        q.add_unschedulable(qpi_for(pod, attempts=1, failed=[]))
+        clock.advance(2.0)
+        from minisched_tpu.framework.events import GVK, ActionType, ClusterEvent
+
+        q.move_all_to_active_or_backoff(ClusterEvent(GVK.POD, ActionType.ADD))
+        assert q.stats()["active"] == 1
+
+    def test_index_cleared_on_move_and_delete(self):
+        clock = FakeClock()
+        q = make_queue(clock=clock)
+        p1, p2 = make_pod("p1"), make_pod("p2")
+        q.add_unschedulable(qpi_for(p1, attempts=1, failed=["NodeNumber"]))
+        q.add_unschedulable(qpi_for(p2, attempts=1, failed=["NodeNumber"]))
+        clock.advance(2.0)
+        q.delete(p2)
+        q.move_all_to_active_or_backoff(NODE_ADD)
+        assert q.stats() == {"active": 1, "backoff": 0, "unschedulable": 0}
+        assert not q._unsched_gvks
+        assert all(not b for b in q._unsched_by_gvk.values())
