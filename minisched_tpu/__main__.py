@@ -1,0 +1,80 @@
+"""Process entry point: boot the whole stack from environment config.
+
+Re-creates ``sched.go``'s ``main``/``start()`` boot order (sched.go:21-68):
+read the env config (PORT / FRONTEND_URL / optional external store URL),
+bring up the control plane (the REST façade on PORT — the reference boots
+a real apiserver), start the PV controller, start the scheduler service,
+then serve until interrupted.
+
+    PORT=10251 FRONTEND_URL=http://localhost:3000 python -m minisched_tpu
+
+Optional env:
+
+    MINISCHED_TPU_STORE_URL=file:///tmp/cluster.wal   durable WAL store
+                                                      (reference: etcd URL)
+    MINISCHED_DEVICE_MODE=1                           TPU wave engine
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.durable import store_from_url
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.pvcontroller import start_pv_controller
+from minisched_tpu.service.config import (
+    ProcessConfig,
+    default_full_roster_config,
+    default_scheduler_config,
+)
+from minisched_tpu.service.service import SchedulerService
+
+
+def start(cfg: ProcessConfig, device_mode: bool = False):
+    """Boot the stack; returns (client, api_base_url, stop_fn)."""
+    store = store_from_url(cfg.external_store_url)
+    client = Client(store=store)
+    backing = client.store
+    # the HTTP façade serves the SAME store the in-process client uses
+    raw = getattr(backing, "_store", backing)  # unwrap any rate limiter
+    server, base, shutdown_api = start_api_server(raw, port=cfg.port)
+    pv = start_pv_controller(client)
+    service = SchedulerService(client)
+    scheduler_cfg = (
+        default_full_roster_config() if device_mode else default_scheduler_config()
+    )
+    service.start_scheduler(scheduler_cfg, device_mode=device_mode)
+
+    def stop() -> None:
+        service.shutdown_scheduler()
+        pv.stop()
+        shutdown_api()
+        if hasattr(raw, "close"):
+            raw.close()
+
+    return client, base, stop
+
+
+def main() -> int:
+    cfg = ProcessConfig.from_env()
+    device_mode = os.environ.get("MINISCHED_DEVICE_MODE", "0") == "1"
+    if device_mode:
+        from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+        enable_persistent_cache()
+    _, base, stop = start(cfg, device_mode=device_mode)
+    print(f"minisched_tpu: API on {base} (frontend {cfg.frontend_url})", flush=True)
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,7 @@ class DurableObjectStore(ObjectStore):
         super().__init__()
         self._path = path
         self._fsync = fsync
+        self._closed = False
         self._log = None  # replay must not re-log
         self._replay()
         self._log = open(self._path, "a", encoding="utf-8")
@@ -49,9 +50,19 @@ class DurableObjectStore(ObjectStore):
         # logging them would make the WAL unopenable at replay
         return kind in KIND_TYPES
 
+    def _check_open(self) -> None:
+        """Refuse mutations on a closed store BEFORE touching in-memory
+        state — mutating first would fan watch events out to live
+        informers and only then fail the append, leaving observers and the
+        reopened WAL permanently divergent."""
+        if self._closed:
+            raise RuntimeError(
+                f"durable store {self._path!r} is closed; mutation refused"
+            )
+
     def _append(self, rec: dict) -> None:
         if self._log is None:
-            return
+            return  # replay: the record being applied is already in the log
         self._log.write(json.dumps(rec) + "\n")
         self._log.flush()
         if self._fsync:
@@ -59,6 +70,7 @@ class DurableObjectStore(ObjectStore):
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:
+            self._check_open()
             out = super().create(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(out)})
@@ -66,6 +78,7 @@ class DurableObjectStore(ObjectStore):
 
     def update(self, kind: str, obj: Any) -> Any:
         with self._lock:
+            self._check_open()
             out = super().update(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(out)})
@@ -73,6 +86,7 @@ class DurableObjectStore(ObjectStore):
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
+            self._check_open()
             super().delete(kind, namespace, name)
             if self._loggable(kind):
                 self._append(
@@ -86,6 +100,7 @@ class DurableObjectStore(ObjectStore):
 
     def restore_object(self, kind: str, obj: Any) -> None:
         with self._lock:
+            self._check_open()
             super().restore_object(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
@@ -167,6 +182,7 @@ class DurableObjectStore(ObjectStore):
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._log is not None:
                 self._log.close()
                 self._log = None

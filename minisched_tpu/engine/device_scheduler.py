@@ -225,6 +225,7 @@ class DeviceScheduler(Scheduler):
         """
         from minisched_tpu.plugins.defaultpreemption import preemption_might_help
 
+        self.metrics.observe("wave_losers", float(len(losers)))
         diagnoses = {}
         for qpi, pod, fails in losers:
             diagnosis = Diagnosis()
@@ -253,21 +254,21 @@ class DeviceScheduler(Scheduler):
         # ONE full merged snapshot (informer state + this wave's assumed
         # winners); per-loser deltas (evictions, phantoms) are applied
         # incrementally to just the touched NodeInfos
+        self.metrics.observe("wave_preempt_eligible", float(len(eligible)))
         base = self._merged_infos(node_infos)
         by_name = {ni.name: ni for ni in base}
         for qpi, pod in eligible:
-            before = {
-                p.metadata.uid: p for p in self.client.store.list("Pod")
-            }
             nominated = self.run_post_filter(
                 CycleState(), pod, base, diagnoses[pod.metadata.uid]
             )
-            after = {p.metadata.uid for p in self.client.store.list("Pod")}
-            for uid in before.keys() - after:
-                victim = before[uid]
-                ni = by_name.get(victim.spec.node_name)
-                if ni is not None:
-                    ni.remove_pod(victim)
+            # victims reported by the plugins (DefaultPreemption records
+            # them) — diffing full store listings per loser would clone
+            # the whole pod population each time
+            for pl in self.post_filter_plugins:
+                for victim in getattr(pl, "last_victims", ()):
+                    ni = by_name.get(victim.spec.node_name)
+                    if ni is not None:
+                        ni.remove_pod(victim)
             if nominated:
                 # the phantom consumes the freed capacity so later losers
                 # can't select the same victims and over-evict

@@ -82,7 +82,13 @@ class PostFilterPlugin(Protocol):
         """Attempt to make the pod schedulable (e.g. by evicting victims).
         Returns (nominated node name or None, status); a Success status
         means the pod should become schedulable there once the cluster
-        reacts (victims terminate)."""
+        reacts (victims terminate).
+
+        Contract for evicting plugins: record every pod you deleted in a
+        ``last_victims`` list attribute, reset at the start of each call.
+        The wave engine reads it to keep its shared preemption snapshot
+        consistent across a wave's losers without re-listing the store
+        (DefaultPreemption is the reference implementation)."""
         ...
 
 

@@ -82,6 +82,10 @@ class DefaultPreemption(Plugin):
         self.min_candidate_nodes_percentage = min_candidate_nodes_percentage
         self.min_candidate_nodes_absolute = min_candidate_nodes_absolute
         self.h = None  # engine handle, injected by the service
+        #: victims deleted by the most recent post_filter call — engines
+        #: read this instead of diffing full store listings (a 100k-pod
+        #: cluster makes the per-loser list() diff the dominant cost)
+        self.last_victims: List[Any] = []
 
     def name(self) -> str:
         return NAME
@@ -159,6 +163,7 @@ class DefaultPreemption(Plugin):
         node_infos: List[NodeInfo],
         diagnosis: Any,
     ) -> Tuple[Optional[str], Status]:
+        self.last_victims = []
         if self.h is None:
             return None, Status.error(f"{NAME}: no engine handle injected")
         if not preemption_might_help(diagnosis):
@@ -190,6 +195,7 @@ class DefaultPreemption(Plugin):
         for v in best_victims:
             try:
                 self.h.client.pods(v.metadata.namespace).delete(v.metadata.name)
+                self.last_victims.append(v)
             except KeyError:
                 pass  # already gone (stale snapshot) — capacity is freed
         return best_ni.name, Status.success()

@@ -188,3 +188,53 @@ def test_token_bucket_burst_clamped():
     t0 = time.monotonic()
     client.nodes().create(make_node("n1"))  # must not hang
     assert time.monotonic() - t0 < 1.0
+
+
+def test_post_close_mutation_refused(tmp_path):
+    """A closed WAL store must refuse writes — a silently-dropped record
+    would ACK a mutation the reopened store has never seen."""
+    import pytest
+
+    store = DurableObjectStore(str(tmp_path / "wal"))
+    store.create("Node", make_node("n1"))
+    store.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        store.create("Node", make_node("n2"))
+    # reopen: only the pre-close write is there
+    store2 = DurableObjectStore(str(tmp_path / "wal"))
+    assert [n.metadata.name for n in store2.list("Node")] == ["n1"]
+
+
+def test_process_entry_boots_stack_with_store_url(tmp_path):
+    """python -m minisched_tpu's start(): env config → durable store →
+    REST façade → PV controller → scheduler (sched.go:30-68 order)."""
+    import json
+    import time as _time
+    import urllib.request
+
+    from minisched_tpu.__main__ import start
+    from minisched_tpu.service.config import ProcessConfig
+
+    wal = tmp_path / "cluster.wal"
+    cfg = ProcessConfig(
+        port=0,
+        frontend_url="http://localhost:3000",
+        external_store_url=f"file://{wal}",
+    )
+    client, base, stop = start(cfg)
+    try:
+        client.nodes().create(make_node("node0"))
+        client.pods().create(make_pod("pod1"))
+        with urllib.request.urlopen(base + "/api/v1/nodes", timeout=5) as r:
+            names = [o["metadata"]["name"] for o in json.load(r)["items"]]
+        assert names == ["node0"]
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if client.pods().get("pod1").spec.node_name:
+                break
+            _time.sleep(0.05)
+        assert client.pods().get("pod1").spec.node_name == "node0"
+    finally:
+        stop()
+    reopened = DurableObjectStore(str(wal))
+    assert reopened.get("Pod", "default", "pod1").spec.node_name == "node0"

@@ -254,3 +254,58 @@ class TestNodeTableFromInfos:
                 np.asarray(getattr(t2, field)),
                 err_msg=field,
             )
+
+
+class TestCloneCompleteness:
+    """The hand-rolled structural clone() bodies (which replaced deepcopy
+    for a ~13x bind speedup) must stay field-complete as dataclasses grow:
+    every field is auto-filled with a non-default sentinel by walking
+    dataclasses.fields, so a field added later but missed by clone()
+    makes the equality assertion fail."""
+
+    def test_clones_equal_deepcopy_on_fully_populated_objects(self):
+        import copy as _copy
+        import dataclasses
+        import typing
+
+        from minisched_tpu.api import objects as om
+
+        def fill(cls, depth=0):
+            assert depth < 12, "recursive object model?"
+            hints = typing.get_type_hints(cls)
+            kwargs = {}
+            for f in dataclasses.fields(cls):
+                kwargs[f.name] = value_for(hints[f.name], f.name, depth)
+            return cls(**kwargs)
+
+        def value_for(tp, name, depth):
+            origin = typing.get_origin(tp)
+            if origin is typing.Union:  # Optional[X] → X
+                inner = [a for a in typing.get_args(tp) if a is not type(None)]
+                return value_for(inner[0], name, depth)
+            if origin in (list, typing.List):
+                (inner,) = typing.get_args(tp)
+                return [value_for(inner, name, depth)]
+            if origin in (dict, typing.Dict):
+                k, v = typing.get_args(tp)
+                return {value_for(k, name, depth): value_for(v, name, depth)}
+            if tp is int:
+                return 7
+            if tp is float:
+                return 7.5
+            if tp is bool:
+                return True
+            if tp is str:
+                return f"s-{name}"
+            if dataclasses.is_dataclass(tp):
+                return fill(tp, depth + 1)
+            raise AssertionError(f"no sentinel for type {tp!r} (field {name})")
+
+        for cls in (om.Pod, om.Node, om.PersistentVolume,
+                    om.PersistentVolumeClaim, om.ResourceList):
+            obj = fill(cls)
+            cloned = obj.clone()
+            assert cloned == _copy.deepcopy(obj), (
+                f"{cls.__name__}.clone() drops or alters a field"
+            )
+            assert cloned == obj
