@@ -22,7 +22,7 @@ import signal
 import sys
 import threading
 
-from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.client import DEFAULT_BURST, DEFAULT_QPS, Client
 from minisched_tpu.controlplane.durable import store_from_url
 from minisched_tpu.controlplane.httpserver import start_api_server
 from minisched_tpu.controlplane.pvcontroller import start_pv_controller
@@ -37,7 +37,8 @@ from minisched_tpu.service.service import SchedulerService
 def start(cfg: ProcessConfig, device_mode: bool = False):
     """Boot the stack; returns (client, api_base_url, stop_fn)."""
     store = store_from_url(cfg.external_store_url)
-    client = Client(store=store)
+    # the reference's client limits (k8sapiserver.go:57-62: QPS/Burst 5000)
+    client = Client(store=store, qps=DEFAULT_QPS, burst=DEFAULT_BURST)
     backing = client.store
     # the HTTP façade serves the SAME store the in-process client uses
     raw = getattr(backing, "_store", backing)  # unwrap any rate limiter

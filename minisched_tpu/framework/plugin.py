@@ -34,6 +34,15 @@ from minisched_tpu.framework.types import CycleState, NodeScoreList, Status
 class Plugin:
     """Base: every plugin has a stable name (framework.Plugin)."""
 
+    #: does the plugin's batch kernel read node state that intra-wave
+    #: commits update (``ops/state.apply_placements``'s req_*/nzreq_*/
+    #: used_port scatters, or the repair loop's carried volume planes)?
+    #: The conflict-repair loop re-evaluates ONLY these plugins per round;
+    #: everything else (node identity, labels, taints, cross-pod combo
+    #: planes — which are static within a wave by design) is computed once.
+    #: Resource/port/volume plugins override this to True.
+    reads_committed_state = False
+
     def name(self) -> str:
         return type(self).__name__
 

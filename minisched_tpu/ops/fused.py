@@ -164,6 +164,62 @@ class PlacementResult:
         return cls(*leaves)
 
 
+@dataclass
+class StaticWavePlanes:
+    """Round-invariant planes shared by every round of a repair wave.
+
+    Filters/scores whose kernels don't read intra-wave committed state
+    (``Plugin.reads_committed_state`` False — node identity, labels,
+    taints, the cross-pod combo planes) produce the same mask / RAW score
+    matrix in every round; the repair loop computes them ONCE and per
+    round only re-evaluates the committed-state plugins, then
+    re-NORMALIZES the cached raw scores against the round's full mask —
+    bit-identical to evaluating the whole chain per round (normalization
+    is the only mask-dependent score step)."""
+
+    static_mask: Any  # bool[P, N] conjunction of static filter masks
+    static_names: frozenset  # names of the filters folded into static_mask
+    aux: Dict[str, Dict[str, Any]]  # pre-score aux (static plugins only)
+    raw_scores: Dict[str, Any]  # plugin name → i32[P, N] raw score matrix
+
+
+def precompute_static(
+    pods,
+    nodes,
+    filter_plugins: Sequence[Any],
+    pre_score_plugins: Sequence[Any],
+    score_plugins: Sequence[Any],
+    ctx: BatchContext,
+    extra: Any = None,
+) -> StaticWavePlanes:
+    """Evaluate the round-invariant half of the chain once (traceable)."""
+    valid = pods.valid[:, None] & nodes.valid[None, :]
+    mask = valid
+    names = []
+    for pl in filter_plugins:
+        if getattr(pl, "reads_committed_state", False):
+            continue
+        names.append(pl.name())
+        if getattr(pl, "needs_extra", False):
+            mask = mask & pl.batch_filter(ctx, pods, nodes, extra)
+        else:
+            mask = mask & pl.batch_filter(ctx, pods, nodes)
+    aux: Dict[str, Dict[str, Any]] = {}
+    for pl in pre_score_plugins:
+        if not getattr(pl, "reads_committed_state", False):
+            aux[pl.name()] = pl.batch_pre_score(ctx, pods, nodes)
+    raw: Dict[str, Any] = {}
+    for pl in score_plugins:
+        if getattr(pl, "reads_committed_state", False):
+            continue
+        if getattr(pl, "needs_extra", False):
+            s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}), extra)
+        else:
+            s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))
+        raw[pl.name()] = s
+    return StaticWavePlanes(mask, frozenset(names), aux, raw)
+
+
 def evaluate(
     pods,
     nodes,
@@ -173,6 +229,7 @@ def evaluate(
     ctx: BatchContext,
     with_diagnostics: bool = False,
     extra: Any = None,
+    static: Optional[StaticWavePlanes] = None,
 ) -> PlacementResult:
     """One fused scheduling evaluation (traceable; call under jit).
 
@@ -185,11 +242,26 @@ def evaluate(
     * score → per-plugin normalize (mask-aware) → weight → sum
       (minisched.go:164-199, with the weight TODO at :187 implemented);
     * deterministic seeded masked-argmax (select_hosts).
+
+    ``static``: precomputed round-invariant planes (precompute_static) —
+    filters in ``static.static_names`` contribute via ``static_mask``
+    instead of re-running, and static scorers reuse their cached RAW
+    matrices (normalization still runs against THIS call's full mask, so
+    results are bit-identical to the unsplit evaluation).  Incompatible
+    with ``with_diagnostics`` (per-plugin masks need every filter run).
     """
     valid = pods.valid[:, None] & nodes.valid[None, :]
-    mask = valid
+    if static is not None:
+        assert not with_diagnostics, "diagnostics need the unsplit chain"
+        mask = valid & static.static_mask
+        run_filters = [
+            pl for pl in filter_plugins if pl.name() not in static.static_names
+        ]
+    else:
+        mask = valid
+        run_filters = list(filter_plugins)
     per_filter = []
-    for pl in filter_plugins:
+    for pl in run_filters:
         if getattr(pl, "needs_extra", False):
             m = pl.batch_filter(ctx, pods, nodes, extra)
         else:
@@ -198,16 +270,19 @@ def evaluate(
             per_filter.append(m)
         mask = mask & m
 
-    aux: Dict[str, Dict[str, Any]] = {}
+    aux: Dict[str, Dict[str, Any]] = dict(static.aux) if static else {}
     for pl in pre_score_plugins:
-        aux[pl.name()] = pl.batch_pre_score(ctx, pods, nodes)
+        if pl.name() not in aux:
+            aux[pl.name()] = pl.batch_pre_score(ctx, pods, nodes)
 
     P, N = mask.shape
     totals = jnp.zeros((P, N), jnp.int32)
     per_score = []
     per_raw = []
     for pl in score_plugins:
-        if getattr(pl, "needs_extra", False):
+        if static is not None and pl.name() in static.raw_scores:
+            s = static.raw_scores[pl.name()]
+        elif getattr(pl, "needs_extra", False):
             s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}), extra)
         else:
             s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}))

@@ -31,6 +31,8 @@ def _pod_ports(pod: Any) -> List[int]:
 
 
 class NodePorts(Plugin, BatchEvaluable):
+    reads_committed_state = True  # intra-wave commits change the verdict
+
     def name(self) -> str:
         return NAME
 

@@ -59,6 +59,8 @@ class NodeResourcesFit(Plugin, BatchEvaluable):
     zero request fits even an overcommitted node).
     """
 
+    reads_committed_state = True  # intra-wave commits change the verdict
+
     def __init__(self, scoring_strategy: str = "LeastAllocated"):
         if scoring_strategy != "LeastAllocated":
             raise ValueError(
@@ -135,6 +137,8 @@ class NodeResourcesLeastAllocated(Plugin, BatchEvaluable):
     averaged — all in integer floor division.
     """
 
+    reads_committed_state = True  # intra-wave commits change the verdict
+
     def name(self) -> str:
         return LEAST_ALLOCATED_NAME
 
@@ -182,6 +186,8 @@ class NodeResourcesBalancedAllocation(Plugin, BatchEvaluable):
     allocatable after placement, 0 if either fraction >= 1.  Fractions are
     quantized to 1e-4 (FRAC_SCALE) so the formula is pure int math.
     """
+
+    reads_committed_state = True  # intra-wave commits change the verdict
 
     def name(self) -> str:
         return BALANCED_ALLOCATION_NAME

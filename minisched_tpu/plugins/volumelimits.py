@@ -61,6 +61,8 @@ class VolumeLimitsCore(Plugin, BatchEvaluable):
     """Shared counting core: pod's family-f volumes + node's mounted
     family-f volumes must stay within ``max_volumes``."""
 
+    reads_committed_state = True  # intra-wave commits change the verdict
+
     needs_extra = True
     #: class-level family index; also the repair loop's marker for
     #: volume-limit plugins (ops/repair.py reads it with max_volumes)

@@ -50,6 +50,8 @@ def mounts_conflict(pvc: Any, other_pvc: Any) -> bool:
 
 
 class VolumeRestrictions(Plugin, BatchEvaluable):
+
+    reads_committed_state = True  # intra-wave commits change the verdict
     needs_extra = True
     #: the repair loop's marker (ops/repair.py): carry per-volume mount
     #: state across rounds and dedup same-round mounts

@@ -161,3 +161,106 @@ def test_randomized_safety_invariants():
                 and req_pods[i] + 1 <= alloc_pods[i]
             )
             assert not fits, f"{pod.metadata.name} still fits {node_names[i]}"
+
+
+def test_split_static_rounds_are_bit_identical():
+    """The round-invariant split (precompute static filter/score planes,
+    re-normalize per round) must produce EXACTLY the placements of the
+    unsplit per-round full-chain evaluation — on a cluster that exercises
+    resource contention (multi-round repair), affinity/spread constraint
+    tables, and mask-dependent normalization."""
+    from minisched_tpu.api.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
+    from minisched_tpu.plugins.podtopologyspread import PodTopologySpread
+    from minisched_tpu.plugins.tainttoleration import TaintToleration
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.plugins.registry import build_plugins
+
+    rng = random.Random(17)
+    nodes = sorted(
+        (
+            make_node(
+                f"n{i:03d}",
+                labels={"zone": f"z{rng.randrange(3)}"},
+                capacity={"cpu": "2", "memory": "4Gi", "pods": 110},
+                unschedulable=rng.random() < 0.2,
+            )
+            for i in range(24)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    assigned = []
+    for i in range(10):
+        p = make_pod(f"a{i}", labels={"app": f"app{rng.randrange(3)}"},
+                     requests={"cpu": "250m"})
+        p.metadata.uid = f"a{i}"
+        p.spec.node_name = rng.choice(nodes).metadata.name
+        assigned.append(p)
+    pods = []
+    for i in range(40):  # 40 pods x 500m vs 24 nodes x 2000m: contention
+        app = f"app{rng.randrange(3)}"
+        pod = make_pod(f"p{i:03d}", labels={"app": app},
+                       requests={"cpu": "500m", "memory": "256Mi"})
+        if rng.random() < 0.5:
+            pod.spec.affinity = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(label_selector=LabelSelector(match_labels={"app": app}),
+                                topology_key="zone")]))
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(max_skew=2, topology_key="zone",
+                                     when_unsatisfiable="ScheduleAnyway",
+                                     label_selector=LabelSelector(match_labels={"app": app}))
+        ]
+        pods.append(pod)
+    by_node = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    node_table, _ = build_node_table(nodes, by_node)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned,
+        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    )
+    chains = build_plugins(default_full_roster_config())
+    weights = {e.name: e.weight for e in default_full_roster_config().score.enabled}
+
+    outs = {}
+    for split in (False, True):
+        ev = RepairingEvaluator(chains.filter, chains.pre_score, chains.score,
+                                weights=weights, with_diagnostics=True,
+                                split_static=split)
+        import jax
+
+        nt = jax.tree_util.tree_map(lambda a: a.copy(), node_table)
+        outs[split] = ev(pod_table, nt, extra)
+    n0, c0, r0, u0 = outs[False]
+    n1, c1, r1, u1 = outs[True]
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    assert int(r0) == int(r1)
+    np.testing.assert_array_equal(np.asarray(u0), np.asarray(u1))
+    np.testing.assert_array_equal(np.asarray(n0.req_cpu), np.asarray(n1.req_cpu))
+    assert int((np.asarray(c0) >= 0).sum()) > 0
+    assert int(r0) > 1, "cluster should force multiple repair rounds"
+
+
+def test_static_classification_guard_fires_on_misclassified_plugin():
+    """A plugin whose kernel reads committed state but claims
+    reads_committed_state=False must be refused at construction."""
+    import pytest
+
+    from minisched_tpu.plugins.noderesources import NodeResourcesFit
+
+    class SneakyFit(NodeResourcesFit):
+        reads_committed_state = False  # wrong on purpose
+
+        def name(self):
+            return "SneakyFit"
+
+    with pytest.raises(TypeError, match="SneakyFit"):
+        RepairingEvaluator([NodeUnschedulable(), SneakyFit()], [], [])
