@@ -139,6 +139,11 @@ class Informer:
         with self._lock:
             return list(self._cache.values())
 
+    def get(self, key: str) -> Optional[Any]:
+        """O(1) cache lookup by ``namespace/name`` key (None if absent)."""
+        with self._lock:
+            return self._cache.get(key)
+
     def stop(self) -> None:
         self._stop.set()
         if self._watch is not None:

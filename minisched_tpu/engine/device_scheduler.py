@@ -34,7 +34,7 @@ from minisched_tpu.framework.types import (
 )
 from minisched_tpu.models.constraints import build_constraint_tables
 from minisched_tpu.models.tables import (
-    build_node_table_from_infos,
+    CachedNodeTableBuilder,
     build_pod_table,
     pad_to,
 )
@@ -52,6 +52,10 @@ class DeviceScheduler(Scheduler):
             for p in (*self.filter_plugins, *self.score_plugins)
         )
         self._evaluator: Optional[RepairingEvaluator] = None
+        # static node columns cached across waves, keyed on each node's
+        # (name, resource_version) — only the assigned-pod aggregates are
+        # re-encoded per wave
+        self._table_builder = CachedNodeTableBuilder()
         # assume-pod cache (upstream's scheduler cache AssumePod): a placed
         # pod counts against its node IMMEDIATELY, before the async bind
         # lands in the informer cache — without it, the next wave snapshots
@@ -71,28 +75,25 @@ class DeviceScheduler(Scheduler):
             self._assumed.pop(uid, None)
 
     def snapshot_nodes(self):
-        # ONE pod-lister read feeds both the snapshot and the assumption
-        # pruning — a second read could observe a bind the snapshot missed
-        # and prune the assumption while counting the pod nowhere
-        from minisched_tpu.framework.nodeinfo import build_node_infos
-
-        nodes = sorted(
-            self.informer_factory.informer_for("Node").lister(),
-            key=lambda n: n.metadata.name,
-        )
-        cached_pods = self.informer_factory.informer_for("Pod").lister()
-        infos = build_node_infos(nodes, cached_pods)
+        # the incremental cache supplies the snapshot (O(nodes) clones);
+        # assumption pruning uses the SAME locked read's assigned-uid view
+        # so a bind can never land between the two and be counted nowhere
+        infos, cache_assigned = self.cache.snapshot_with_assigned()
         with self._assumed_lock:
             if not self._assumed:
                 return infos
-            all_uids = {p.metadata.uid for p in cached_pods}
-            cache_assigned = {
-                p.metadata.uid for p in cached_pods if p.spec.node_name
-            }
+            pod_informer = self.informer_factory.informer_for("Pod")
             by_name = {ni.name: ni for ni in infos}
             for uid in list(self._assumed):
                 assumed = self._assumed[uid]
-                if uid in cache_assigned or uid not in all_uids:
+                current = pod_informer.get(assumed.metadata.key)
+                # uid must match: a same-name replacement (StatefulSet
+                # delete+recreate) is a DIFFERENT pod — the assumption for
+                # the old uid is dead and must not count forever
+                exists = (
+                    current is not None and current.metadata.uid == uid
+                )
+                if uid in cache_assigned or not exists:
                     # confirmed by the cache, or the pod was deleted —
                     # either way the assumption must not count again
                     del self._assumed[uid]
@@ -142,7 +143,7 @@ class DeviceScheduler(Scheduler):
 
         def build_and_evaluate(qpis_):
             pods_ = [qpi.pod for qpi in qpis_]
-            node_table, node_names = build_node_table_from_infos(node_infos)
+            node_table, node_names = self._table_builder.build(node_infos)
             pod_table, _ = build_pod_table(
                 pods_, capacity=pad_to(max(len(pods_), self.max_wave))
             )
@@ -156,10 +157,14 @@ class DeviceScheduler(Scheduler):
                     pvs=self.client.store.list("PersistentVolume"),
                     scan_planes=False,  # wave mode never runs the scan
                 )
+            import jax
+
             _, choice, _, unsched = self._get_evaluator()(
                 pod_table, node_table, extra
             )
-            # bool[K, P] → per-pod failing-plugin name sets
+            # ONE host fetch for both results (each device_get is a
+            # tunnel round-trip); bool[K, P] → per-pod failing-plugin sets
+            choice, unsched = jax.device_get((choice, unsched))
             unsched = unsched.tolist()
             plugin_names = [p.name() for p in self.filter_plugins]
             fail_sets = [

@@ -46,6 +46,7 @@ class NodeInfo:
         "req_mem_mib",
         "req_eph_mib",
         "nzreq_mem_mib",
+        "used_ports",
     )
 
     def __init__(self, node: Optional[Node] = None):
@@ -56,6 +57,10 @@ class NodeInfo:
         self.req_mem_mib: int = 0
         self.req_eph_mib: int = 0
         self.nzreq_mem_mib: int = 0
+        #: host ports claimed by assigned pods, in pod-then-container order
+        #: (the NodeTable used_port encoding reads this directly instead of
+        #: re-walking every pod's containers per wave)
+        self.used_ports: List[int] = []
 
     @property
     def name(self) -> str:
@@ -71,19 +76,27 @@ class NodeInfo:
         self.nzreq_mem_mib += (req.memory // MIB) or (
             DEFAULT_POD_MEMORY_REQUEST // MIB
         )
+        for c in pod.spec.containers:
+            if c.ports:
+                self.used_ports.extend(c.ports)
 
     def remove_pod(self, pod: Pod) -> None:
         for i, p in enumerate(self.pods):
             if p.metadata.uid == pod.metadata.uid:
                 del self.pods[i]
-                req = pod.resource_requests()
+                # subtract what the STORED object contributed (the caller's
+                # copy may differ, e.g. an update refreshing the object)
+                req = p.resource_requests()
                 self.requested.sub(req)
-                self.non_zero_requested.sub(non_zero_requests(pod))
+                self.non_zero_requested.sub(non_zero_requests(p))
                 self.req_mem_mib -= req.memory // MIB
                 self.req_eph_mib -= req.ephemeral_storage // MIB
                 self.nzreq_mem_mib -= (req.memory // MIB) or (
                     DEFAULT_POD_MEMORY_REQUEST // MIB
                 )
+                for c in p.spec.containers:
+                    for port in c.ports:
+                        self.used_ports.remove(port)
                 return
 
     def clone(self) -> "NodeInfo":
@@ -94,6 +107,7 @@ class NodeInfo:
         ni.req_mem_mib = self.req_mem_mib
         ni.req_eph_mib = self.req_eph_mib
         ni.nzreq_mem_mib = self.nzreq_mem_mib
+        ni.used_ports = list(self.used_ports)
         return ni
 
 

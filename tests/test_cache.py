@@ -1,0 +1,184 @@
+"""SchedulerCache: the incremental NodeInfo cache must agree, after any
+event sequence, with a from-scratch build_node_infos over the same state
+(the upstream scheduler-cache invariant)."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.engine.cache import SchedulerCache
+from minisched_tpu.framework.nodeinfo import build_node_infos
+
+
+def _equivalent(cache: SchedulerCache, nodes, pods):
+    want = build_node_infos(
+        sorted(nodes, key=lambda n: n.metadata.name), pods
+    )
+    got = cache.snapshot()
+    assert [ni.name for ni in got] == [ni.name for ni in want]
+    for g, w in zip(got, want):
+        assert g.requested == w.requested, (g.name, g.requested, w.requested)
+        assert g.non_zero_requested == w.non_zero_requested
+        assert g.req_mem_mib == w.req_mem_mib
+        assert g.nzreq_mem_mib == w.nzreq_mem_mib
+        assert sorted(g.used_ports) == sorted(w.used_ports), g.name
+        assert sorted(p.metadata.uid for p in g.pods) == sorted(
+            p.metadata.uid for p in w.pods
+        )
+
+
+def test_randomized_event_sequences_match_rebuild():
+    rng = random.Random(42)
+    cache = SchedulerCache()
+    nodes = {}
+    pods = {}
+    for step in range(600):
+        op = rng.random()
+        if op < 0.15 or not nodes:
+            name = f"n{rng.randrange(12)}"
+            if name not in nodes:
+                node = make_node(name, labels={"z": str(rng.randrange(3))})
+                nodes[name] = node
+                cache.add_node(node)
+        elif op < 0.25:
+            name = rng.choice(list(nodes))
+            node = nodes.pop(name)
+            cache.delete_node(node)
+            # NOTE: the pods bound to the node stay in the cluster view —
+            # their own DELETE events come separately (and if the node
+            # re-registers first, their accounting must come back)
+        elif op < 0.35:
+            # node update (labels change; rv bump)
+            name = rng.choice(list(nodes))
+            old = nodes[name]
+            new = old.clone()
+            new.metadata.labels["z"] = str(rng.randrange(3))
+            new.metadata.resource_version += 1
+            nodes[name] = new
+            cache.update_node(old, new)
+        elif op < 0.75:
+            uid = f"u{step}"
+            pod = make_pod(
+                f"p{step}",
+                requests={
+                    "cpu": rng.choice(["0", "250m", "1"]),
+                    "memory": rng.choice(["0", "100Mi", "700Ki"]),
+                },
+            )
+            if rng.random() < 0.3:
+                pod.spec.containers[0].ports = [rng.randrange(1000, 1004)]
+            pod.metadata.uid = uid
+            pod.spec.node_name = rng.choice(list(nodes))
+            pods[uid] = pod
+            # half arrive as ADD (pre-bound replay), half as bind UPDATE
+            if rng.random() < 0.5:
+                cache.add_pod(pod)
+            else:
+                pending = pod.clone()
+                pending.spec.node_name = ""
+                cache.update_pod(pending, pod)
+        elif pods:
+            uid = rng.choice(list(pods))
+            pod = pods.pop(uid)
+            cache.delete_pod(pod)
+    _equivalent(cache, list(nodes.values()), list(pods.values()))
+
+
+def test_orphaned_pod_adopted_when_node_arrives():
+    cache = SchedulerCache()
+    pod = make_pod("p1", requests={"cpu": "1"})
+    pod.metadata.uid = "u1"
+    pod.spec.node_name = "late-node"
+    cache.add_pod(pod)  # node unknown yet
+    assert cache.snapshot() == []
+    cache.add_node(make_node("late-node"))
+    [ni] = cache.snapshot()
+    assert [p.metadata.uid for p in ni.pods] == ["u1"]
+    assert ni.requested.milli_cpu == 1000
+
+
+def test_snapshot_clones_are_caller_owned():
+    cache = SchedulerCache()
+    cache.add_node(make_node("n1"))
+    pod = make_pod("p1", requests={"cpu": "1"})
+    pod.metadata.uid = "u1"
+    pod.spec.node_name = "n1"
+    cache.add_pod(pod)
+    [ni] = cache.snapshot()
+    ni.add_pod(_assumed("u2", "n1"))  # caller mutates its copy
+    [ni2] = cache.snapshot()
+    assert len(ni2.pods) == 1  # cache unaffected
+
+
+def _assumed(uid, node_name):
+    p = make_pod(f"pod-{uid}", requests={"cpu": "1"})
+    p.metadata.uid = uid
+    p.spec.node_name = node_name
+    return p
+
+
+def test_live_engine_snapshot_matches_store_state():
+    """End-to-end: after creates/binds/deletes through the real control
+    plane, the engine's cache snapshot equals a rebuild from the store."""
+    from minisched_tpu.api.objects import Binding
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.service.config import default_scheduler_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    try:
+        sched = svc.scheduler
+        for i in range(6):
+            client.nodes().create(make_node(f"node{i}", unschedulable=i == 0))
+        # wait for the engine to bind every pod it can
+        for i in range(8):
+            client.pods().create(make_pod(f"pod{i}", requests={"cpu": "100m"}))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            bound = [p for p in client.pods().list() if p.spec.node_name]
+            if len(bound) == 8:
+                break
+            time.sleep(0.05)
+        assert len(bound) == 8
+        client.pods().delete("pod0")
+        time.sleep(0.3)  # let the informer dispatch the delete
+        nodes = client.nodes().list()
+        pods = client.pods().list()
+        _equivalent(sched.cache, nodes, pods)
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_node_delete_and_readd_restores_pod_accounting():
+    """A node delete + re-registration (same name) must re-adopt the
+    still-bound pods' accounting — an empty NodeInfo would make the
+    scheduler overcommit the node (upstream keeps a phantom entry)."""
+    cache = SchedulerCache()
+    node = make_node("n1")
+    cache.add_node(node)
+    pod = _assumed("u1", "n1")
+    cache.add_pod(pod)
+    cache.delete_node(node)
+    assert cache.snapshot() == []
+    cache.add_node(make_node("n1"))
+    [ni] = cache.snapshot()
+    assert [p.metadata.uid for p in ni.pods] == ["u1"]
+    assert ni.requested.milli_cpu == 1000
+
+
+def test_update_for_unknown_node_adopts_orphans():
+    """A MODIFIED event reaching the handler before its ADD replay drains
+    must still adopt waiting orphans."""
+    cache = SchedulerCache()
+    pod = _assumed("u1", "n1")
+    cache.add_pod(pod)  # orphan: node unknown
+    old = make_node("n1")
+    new = old.clone()
+    new.metadata.resource_version = 5
+    cache.update_node(old, new)
+    [ni] = cache.snapshot()
+    assert [p.metadata.uid for p in ni.pods] == ["u1"]
