@@ -13,6 +13,8 @@ Optional env:
     MINISCHED_TPU_STORE_URL=file:///tmp/cluster.wal   durable WAL store
                                                       (reference: etcd URL)
     MINISCHED_DEVICE_MODE=1                           TPU wave engine
+    MINISCHED_MESH_DEVICES=8                          shard waves over an
+                                                      N-device mesh
 """
 
 from __future__ import annotations
@@ -34,7 +36,7 @@ from minisched_tpu.service.config import (
 from minisched_tpu.service.service import SchedulerService
 
 
-def start(cfg: ProcessConfig, device_mode: bool = False):
+def start(cfg: ProcessConfig, device_mode: bool = False, mesh_devices: int = 0):
     """Boot the stack; returns (client, api_base_url, stop_fn)."""
     store = store_from_url(cfg.external_store_url)
     # the reference's client limits (k8sapiserver.go:57-62: QPS/Burst 5000)
@@ -48,7 +50,19 @@ def start(cfg: ProcessConfig, device_mode: bool = False):
     scheduler_cfg = (
         default_full_roster_config() if device_mode else default_scheduler_config()
     )
-    service.start_scheduler(scheduler_cfg, device_mode=device_mode)
+    if mesh_devices and not device_mode:
+        raise ValueError(
+            "MINISCHED_MESH_DEVICES requires MINISCHED_DEVICE_MODE=1 — the "
+            "scalar engine cannot shard waves"
+        )
+    mesh = None
+    if device_mode and mesh_devices:
+        from minisched_tpu.parallel.sharding import make_mesh
+
+        mesh = make_mesh(mesh_devices)
+    service.start_scheduler(
+        scheduler_cfg, device_mode=device_mode, device_mesh=mesh
+    )
 
     def stop() -> None:
         service.shutdown_scheduler()
@@ -63,11 +77,14 @@ def start(cfg: ProcessConfig, device_mode: bool = False):
 def main() -> int:
     cfg = ProcessConfig.from_env()
     device_mode = os.environ.get("MINISCHED_DEVICE_MODE", "0") == "1"
+    mesh_devices = int(os.environ.get("MINISCHED_MESH_DEVICES", "0"))
     if device_mode:
         from minisched_tpu.utils.compilecache import enable_persistent_cache
 
         enable_persistent_cache()
-    _, base, stop = start(cfg, device_mode=device_mode)
+    _, base, stop = start(
+        cfg, device_mode=device_mode, mesh_devices=mesh_devices
+    )
     print(f"minisched_tpu: API on {base} (frontend {cfg.frontend_url})", flush=True)
     done = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

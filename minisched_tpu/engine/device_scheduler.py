@@ -44,9 +44,14 @@ from minisched_tpu.ops.repair import RepairingEvaluator
 class DeviceScheduler(Scheduler):
     """Scheduler whose evaluation step runs on device, a wave at a time."""
 
-    def __init__(self, *args, max_wave: int = 1024, **kwargs):
+    def __init__(self, *args, max_wave: int = 1024, mesh: Any = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.max_wave = max_wave
+        #: optional jax.sharding.Mesh — waves then evaluate SHARDED over
+        #: the (pods × nodes) device mesh (parallel/sharding.py): pod rows
+        #: data-parallel, node columns model-parallel, XLA collectives
+        #: over ICI.  None = single-device.
+        self.mesh = mesh
         self._needs_extra = any(
             getattr(p, "needs_extra", False)
             for p in (*self.filter_plugins, *self.score_plugins)
@@ -119,6 +124,7 @@ class DeviceScheduler(Scheduler):
                 # event-gated requeue sees the ACTUAL failing plugins, not
                 # the whole chain
                 with_diagnostics=True,
+                mesh=self.mesh,
             )
         return self._evaluator
 
@@ -341,9 +347,11 @@ def new_device_scheduler(
     informer_factory: Any,
     cfg: Any = None,
     max_wave: int = 1024,
+    mesh: Any = None,
 ) -> DeviceScheduler:
     """Build a DeviceScheduler from a SchedulerConfig (default: the full
-    roster) — the device-mode analog of service.build_scheduler_from_config."""
+    roster) — the device-mode analog of service.build_scheduler_from_config.
+    ``mesh``: evaluate waves sharded over a jax.sharding.Mesh."""
     from minisched_tpu.plugins.registry import build_plugins
     from minisched_tpu.service.config import default_full_roster_config
 
@@ -361,6 +369,7 @@ def new_device_scheduler(
         score_weights=cfg.score_weights(),
         queue_opts=cfg.queue_opts,
         max_wave=max_wave,
+        mesh=mesh,
     )
     from minisched_tpu.service.service import _inject
 

@@ -410,7 +410,14 @@ def repair_wave_step(
 
 
 class RepairingEvaluator:
-    """Compiled wrapper (argument order matches FusedEvaluator)."""
+    """Compiled wrapper (argument order matches FusedEvaluator).
+
+    ``mesh``: a jax.sharding.Mesh — the repair loop then runs SHARDED over
+    the (pods × nodes) device mesh (parallel/sharding.py), inputs are
+    re-placed onto the mesh per call, and the SAME construction-time
+    guards run (batch-protocol validation + the static-classification
+    probe) — a config must behave identically single-device and sharded.
+    """
 
     def __init__(
         self,
@@ -421,6 +428,7 @@ class RepairingEvaluator:
         max_rounds: int = 16,
         with_diagnostics: bool = False,
         split_static: bool = True,
+        mesh: Any = None,
     ):
         from minisched_tpu.ops.fused import validate_batch_chains
 
@@ -446,18 +454,45 @@ class RepairingEvaluator:
                 ],
                 ctx,
             )
-        self._fn = jax.jit(
-            partial(
-                repair_wave_step,
-                filter_plugins=tuple(filter_plugins),
-                pre_score_plugins=tuple(pre_score_plugins),
-                score_plugins=tuple(score_plugins),
-                ctx=ctx,
+        self._mesh = mesh
+        if mesh is not None:
+            from minisched_tpu.parallel.sharding import sharded_repair_step
+
+            self._fn = sharded_repair_step(
+                mesh,
+                filter_plugins,
+                pre_score_plugins,
+                score_plugins,
+                ctx,
                 max_rounds=max_rounds,
                 with_diagnostics=with_diagnostics,
                 split_static=split_static,
-            ),
-        )
+            )
+        else:
+            self._fn = jax.jit(
+                partial(
+                    repair_wave_step,
+                    filter_plugins=tuple(filter_plugins),
+                    pre_score_plugins=tuple(pre_score_plugins),
+                    score_plugins=tuple(score_plugins),
+                    ctx=ctx,
+                    max_rounds=max_rounds,
+                    with_diagnostics=with_diagnostics,
+                    split_static=split_static,
+                ),
+            )
 
     def __call__(self, pods: PodTable, nodes: NodeTable, extra: Any = None):
+        if self._mesh is not None:
+            from minisched_tpu.parallel.sharding import (
+                constraint_sharding,
+                shard_tables,
+            )
+
+            pods, nodes = shard_tables(self._mesh, pods, nodes)
+            if extra is not None:
+                extra = jax.device_put(
+                    extra, constraint_sharding(self._mesh, extra)
+                )
+            return self._fn(nodes, pods, extra)
         return self._fn(nodes, pods, extra=extra)

@@ -165,12 +165,17 @@ def sharded_repair_step(
     score_plugins,
     ctx,
     max_rounds: int = 16,
+    with_diagnostics: bool = False,
+    split_static: bool = True,
 ):
     """The conflict-repair wave loop (ops/repair.repair_wave_step) jitted
     with explicit shardings over ``mesh`` — same placement contract as
     ``sharded_wave_step`` but never double-books a node.  The accept rule's
     sort/segment scans run replicated per pod shard; the evaluate inside
-    each round keeps the (pods × nodes) tiles sharded on both axes."""
+    each round keeps the (pods × nodes) tiles sharded on both axes.
+    ``with_diagnostics``/``split_static`` pass through to repair_wave_step
+    (the live engine runs with diagnostics for per-pod failing-plugin
+    requeue gating)."""
     from functools import partial
 
     from minisched_tpu.ops.repair import repair_wave_step
@@ -182,6 +187,8 @@ def sharded_repair_step(
         score_plugins=tuple(score_plugins),
         ctx=ctx,
         max_rounds=max_rounds,
+        with_diagnostics=with_diagnostics,
+        split_static=split_static,
     )
     return _CompiledShardedStep(mesh, step)
 

@@ -27,6 +27,7 @@ class SchedulerService:
         self._record_results = False
         self._device_mode = False
         self._max_wave = 1024
+        self._device_mesh = None
 
     # scheduler/scheduler.go:50-80
     def start_scheduler(
@@ -35,6 +36,7 @@ class SchedulerService:
         record_results: bool = False,
         device_mode: bool = False,
         max_wave: int = 1024,
+        device_mesh=None,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
@@ -45,7 +47,9 @@ class SchedulerService:
         ``device_mode=True`` runs the TPU wave engine
         (engine/device_scheduler.py) instead of the scalar loop: queue
         drained in waves of up to ``max_wave``, evaluated on device in
-        conflict-repairing mode.
+        conflict-repairing mode.  ``device_mesh``: a jax.sharding.Mesh —
+        waves then evaluate SHARDED across the mesh (pod rows data-
+        parallel, node columns model-parallel; parallel/sharding.py).
         """
         if self._scheduler is not None:
             raise RuntimeError("scheduler already running; use restart_scheduler")
@@ -77,7 +81,8 @@ class SchedulerService:
             from minisched_tpu.engine.device_scheduler import new_device_scheduler
 
             sched = new_device_scheduler(
-                self._client, self._factory, cfg, max_wave=max_wave
+                self._client, self._factory, cfg, max_wave=max_wave,
+                mesh=device_mesh,
             )
         else:
             sched = build_scheduler_from_config(self._client, self._factory, cfg)
@@ -107,6 +112,7 @@ class SchedulerService:
         self._record_results = record_results
         self._device_mode = device_mode
         self._max_wave = max_wave
+        self._device_mesh = device_mesh
         return sched
 
     # scheduler/scheduler.go:40-47
@@ -117,6 +123,7 @@ class SchedulerService:
             record_results=self._record_results,
             device_mode=self._device_mode,
             max_wave=self._max_wave,
+            device_mesh=self._device_mesh,
         )
 
     # scheduler/scheduler.go:82-87
