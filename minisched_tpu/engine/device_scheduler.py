@@ -61,6 +61,13 @@ class DeviceScheduler(Scheduler):
         # (name, resource_version) — only the assigned-pod aggregates are
         # re-encoded per wave
         self._table_builder = CachedNodeTableBuilder()
+        #: observability.resultstore.Store — set by the service when
+        #: record_results is on: each wave then also runs a diagnostics
+        #: evaluation and records the same per-plugin artifact scalar
+        #: cycles produce (O(pods × nodes × plugins) host dicts — a
+        #: simulator feature, not for headline-scale waves)
+        self.result_store: Any = None
+        self._diag_evaluator: Any = None
         # assume-pod cache (upstream's scheduler cache AssumePod): a placed
         # pod counts against its node IMMEDIATELY, before the async bind
         # lands in the informer cache — without it, the next wave snapshots
@@ -165,6 +172,10 @@ class DeviceScheduler(Scheduler):
                 )
             import jax
 
+            if self.result_store is not None:
+                self._record_wave(
+                    pods_, pod_table, node_table, node_names, extra
+                )
             _, choice, _, unsched = self._get_evaluator()(
                 pod_table, node_table, extra
             )
@@ -328,6 +339,47 @@ class DeviceScheduler(Scheduler):
                 continue
             good.append(qpi)
         return good
+
+    def _record_wave(
+        self, pods_, pod_table, node_table, node_names, extra
+    ) -> None:
+        """record_results support for the wave path: one diagnostics-
+        enabled fused evaluation of the wave against the pre-wave snapshot
+        (the decision basis), ingested via ``Store.record_batch_result`` —
+        the wave emits the same per-plugin artifact the scalar recorders
+        produce (SURVEY §2 row 10): same annotation keys, same canonical
+        rejection strings — flushed onto pod annotations by the store's
+        update hook when the binds land."""
+        from minisched_tpu.ops.fused import FusedEvaluator
+        from minisched_tpu.plugins.registry import canonical_filter_reasons
+
+        if self._diag_evaluator is None:
+            self._diag_evaluator = FusedEvaluator(
+                self.filter_plugins,
+                self.pre_score_plugins,
+                self.score_plugins,
+                weights=self.score_weights,
+                with_diagnostics=True,
+            )
+        try:
+            result = self._diag_evaluator(pod_table, node_table, extra)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return
+
+        def unwrap(pl) -> str:
+            return getattr(pl, "original_name", None) or pl.name()
+
+        self.result_store.record_batch_result(
+            result,
+            [p.metadata.key for p in pods_],
+            node_names,
+            [unwrap(pl) for pl in self.filter_plugins],
+            [unwrap(pl) for pl in self.score_plugins],
+            reasons=canonical_filter_reasons(),
+        )
 
     def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
         """Host-side tail of the cycle — the scalar engine's shared

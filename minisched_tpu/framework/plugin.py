@@ -250,4 +250,9 @@ def implements_enqueue(p: Any) -> bool:
 
 
 def implements_batch(p: Any) -> bool:
-    return isinstance(p, BatchEvaluable) and p.has_batch
+    # duck-typed, not isinstance: delegating wrappers (the simulator
+    # recorders, plugins/simulator.py) forward ``has_batch`` and the batch
+    # kernels through __getattr__ without subclassing BatchEvaluable — an
+    # isinstance check would wrongly reject a wrapped batch plugin and
+    # break device_mode + record_results
+    return bool(getattr(p, "has_batch", False))

@@ -66,11 +66,18 @@ NODE_STATIC_PLUGINS = frozenset(
 
 def preemption_might_help(diagnosis: Any) -> bool:
     """False when every recorded failure is a node-static filter (see
-    NODE_STATIC_PLUGINS).  An empty failure set is conservatively True."""
+    NODE_STATIC_PLUGINS).  An empty failure set is conservatively True.
+
+    Simulator-wrapped plugins fail under their ``<name>ForSimulator``
+    alias (plugins/simulator.py) — the comparison strips the suffix so
+    record_results mode keeps the same preemption gating."""
     failed = getattr(diagnosis, "unschedulable_plugins", None)
     if not failed:
         return True
-    return bool(set(failed) - NODE_STATIC_PLUGINS)
+    from minisched_tpu.plugins.simulator import SUFFIX
+
+    stripped = {name.removesuffix(SUFFIX) for name in failed}
+    return bool(stripped - NODE_STATIC_PLUGINS)
 
 
 class DefaultPreemption(Plugin):

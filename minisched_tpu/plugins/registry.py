@@ -187,3 +187,39 @@ def build_plugins(cfg: SchedulerConfig) -> PluginChains:
         if hasattr(inst, "store_client"):
             chains.needs_client.append(inst)
     return chains
+
+
+def canonical_filter_reasons() -> dict:
+    """Plugin name → the canonical rejection message its scalar filter
+    emits — the ``reasons`` mapping for batch result ingestion
+    (observability.resultstore.record_batch_result), so wave-path
+    annotations carry the same human-readable strings scalar cycles do.
+    Imports the plugins' own REASON constants where one exists; plugins
+    whose scalar messages are per-case (resources, ports) get their
+    upstream-flavored summary string."""
+    from minisched_tpu.plugins.interpodaffinity import REASON_AFFINITY
+    from minisched_tpu.plugins.nodeunschedulable import REASON as REASON_UNSCHED
+    from minisched_tpu.plugins.podtopologyspread import REASON_SKEW
+    from minisched_tpu.plugins.volumebinding import REASON_NO_PV
+    from minisched_tpu.plugins.volumelimits import REASON_LIMIT
+    from minisched_tpu.plugins.volumerestrictions import REASON_CONFLICT
+    from minisched_tpu.plugins.volumezone import REASON_ZONE
+
+    return {
+        "NodeUnschedulable": REASON_UNSCHED,
+        "NodeName": "node(s) didn't match the requested node name",
+        "TaintToleration": "node(s) had taints that the pod didn't tolerate",
+        "NodeAffinity": "node(s) didn't match Pod's node affinity/selector",
+        "NodePorts": "node(s) didn't have free ports for the requested pod ports",
+        "NodeResourcesFit": "node(s) didn't have enough resources",
+        "VolumeRestrictions": REASON_CONFLICT,
+        "EBSLimits": REASON_LIMIT,
+        "GCEPDLimits": REASON_LIMIT,
+        "NodeVolumeLimits": REASON_LIMIT,
+        "AzureDiskLimits": REASON_LIMIT,
+        "VolumeBinding": REASON_NO_PV,
+        "VolumeZone": REASON_ZONE,
+        "PodTopologySpread": REASON_SKEW,
+        "InterPodAffinity": REASON_AFFINITY,
+        "NodeNumber": "node(s) rejected by nodenumber",
+    }

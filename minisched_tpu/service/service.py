@@ -84,6 +84,10 @@ class SchedulerService:
                 self._client, self._factory, cfg, max_wave=max_wave,
                 mesh=device_mesh,
             )
+            if record_results:
+                # the wave path records the same per-plugin artifact the
+                # scalar simulator wrappers produce, via batch ingestion
+                sched.result_store = self.result_store
         else:
             sched = build_scheduler_from_config(self._client, self._factory, cfg)
         self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")

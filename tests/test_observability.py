@@ -394,3 +394,64 @@ def test_record_batch_result_from_diagnostics():
     assert filt["n1"]["NodeUnschedulable"] == PASSED_FILTER_MESSAGE
     assert score["n1"]["NodeNumber"] == 10  # raw score (pre-normalize)
     assert final["n1"]["NodeNumber"] == 10
+
+
+def test_device_mode_records_wave_results_onto_annotations():
+    """record_results=True + device_mode=True: the wave engine ingests a
+    diagnostics evaluation per wave (record_batch_result) and the flush
+    hook lands the same scheduler-simulator/* annotations the scalar
+    recorders produce (SURVEY §2 row 10 — the batch path emits the same
+    artifact)."""
+    import json
+    import time
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.observability.annotation import (
+        FILTER_RESULT,
+        SCORE_RESULT,
+    )
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    for i in range(4):
+        client.nodes().create(
+            make_node(f"node{i}", capacity={"cpu": "2", "memory": "4Gi",
+                                            "pods": 110})
+        )
+    for i in range(3):
+        client.pods().create(make_pod(f"pod{i}", requests={"cpu": "250m"}))
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(), record_results=True, device_mode=True,
+        max_wave=8,
+    )
+    try:
+        deadline = time.time() + 60
+        annotated = None
+        while time.time() < deadline:
+            pods = client.pods().list()
+            bound = [p for p in pods if p.spec.node_name]
+            withann = [
+                p for p in bound
+                if FILTER_RESULT in p.metadata.annotations
+            ]
+            if len(bound) == 3 and len(withann) == 3:
+                annotated = withann
+                break
+            time.sleep(0.1)
+        assert annotated, "pods never got wave result annotations"
+        rec = json.loads(
+            annotated[0].metadata.annotations[FILTER_RESULT]
+        )
+        # per-node filter verdicts for the in-tree roster, unwrapped names
+        assert "node0" in rec
+        assert rec["node0"]["NodeUnschedulable"] == "passed"
+        assert "NodeResourcesFit" in rec["node0"]
+        score = json.loads(
+            annotated[0].metadata.annotations[SCORE_RESULT]
+        )
+        assert "TaintToleration" in score["node0"]
+    finally:
+        svc.shutdown_scheduler()
