@@ -35,6 +35,26 @@ POD_AXIS = "pods"
 NODE_AXIS = "nodes"
 
 
+def default_pod_shards(n_devices: int, n_processes: int = 1) -> int:
+    """The pod-axis size of the 2D mesh factoring.
+
+    Multi-host: the pod axis is DATA-parallel — per-pod decisions need no
+    cross-pod-shard collectives — while the node axis carries the
+    argmax/argmin reductions.  So hosts belong on the POD axis (the
+    inter-host DCN link only moves the final per-pod results) and each
+    host's chips on the NODE axis (the per-wave collectives ride ICI) —
+    the standard "DCN on the data axis, ICI on the model axis" recipe.
+    Single host: largest power-of-two divisor ≤ √n keeps the per-device
+    (P, N) tiles near-square (HBM-friendly).
+    """
+    if n_processes > 1 and n_devices % n_processes == 0:
+        return n_processes
+    shards = 1
+    while shards * 2 <= math.isqrt(n_devices) and n_devices % (shards * 2) == 0:
+        shards *= 2
+    return shards
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     pod_shards: Optional[int] = None,
@@ -42,9 +62,12 @@ def make_mesh(
 ) -> Mesh:
     """A 2D (pods × nodes) Mesh over the first ``n_devices`` devices.
 
-    Factoring: pod axis gets the largest power-of-two divisor ≤ √n unless
-    ``pod_shards`` pins it — both matrix axes shrink per device, keeping
-    per-device tiles near-square (HBM-friendly for the (P, N) intermediates).
+    Factoring: ``default_pod_shards`` — hosts land on the pod axis (DCN
+    carries no per-wave collectives there; the node-axis reductions stay
+    on ICI), per-host chips on the node axis; ``pod_shards`` pins it.
+    ``jax.devices()`` orders devices host-major, so reshaping to
+    (processes, chips-per-process) puts each row's node shards on one
+    host's ICI domain.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = n_devices or len(devices)
@@ -52,9 +75,7 @@ def make_mesh(
         raise ValueError(f"requested {n} devices, only {len(devices)} available")
     devices = devices[:n]
     if pod_shards is None:
-        pod_shards = 1
-        while pod_shards * 2 <= math.isqrt(n) and n % (pod_shards * 2) == 0:
-            pod_shards *= 2
+        pod_shards = default_pod_shards(n, jax.process_count())
     if n % pod_shards:
         raise ValueError(f"{n} devices not divisible by pod_shards={pod_shards}")
     grid = np.array(devices).reshape(pod_shards, n // pod_shards)

@@ -100,3 +100,22 @@ def test_graft_entry_hooks():
     jax.block_until_ready(out)
     assert int((out[0] >= 0).sum()) > 0
     ge.dryrun_multichip(8)
+
+
+def test_default_pod_shards_factoring():
+    """Single host: near-square power-of-two factoring.  Multi-host: the
+    collective-free pod axis takes the host count (DCN), node-axis
+    reductions stay within each host's ICI domain."""
+    from minisched_tpu.parallel.sharding import default_pod_shards
+
+    assert default_pod_shards(1) == 1
+    assert default_pod_shards(8) == 2
+    assert default_pod_shards(16) == 4
+    assert default_pod_shards(64) == 8
+    assert default_pod_shards(6) == 2
+    # multi-host
+    assert default_pod_shards(8, n_processes=2) == 2
+    assert default_pod_shards(32, n_processes=4) == 4
+    assert default_pod_shards(32, n_processes=8) == 8
+    # host count not dividing the device count: fall back to square-ish
+    assert default_pod_shards(6, n_processes=4) == 2
