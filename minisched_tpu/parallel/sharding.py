@@ -69,13 +69,21 @@ def make_mesh(
     (processes, chips-per-process) puts each row's node shards on one
     host's ICI domain.
     """
+    full_roster = devices is None
     devices = list(devices if devices is not None else jax.devices())
     n = n_devices or len(devices)
     if n > len(devices):
         raise ValueError(f"requested {n} devices, only {len(devices)} available")
+    covers_all = full_roster and n == len(devices)
     devices = devices[:n]
     if pod_shards is None:
-        pod_shards = default_pod_shards(n, jax.process_count())
+        # the hosts-on-pod-axis factoring relies on the (processes,
+        # chips-per-process) reshape aligning mesh rows with hosts — only
+        # true for the full host-major jax.devices() roster; a truncated
+        # or caller-supplied list falls back to the square-ish factoring
+        pod_shards = default_pod_shards(
+            n, jax.process_count() if covers_all else 1
+        )
     if n % pod_shards:
         raise ValueError(f"{n} devices not divisible by pod_shards={pod_shards}")
     grid = np.array(devices).reshape(pod_shards, n // pod_shards)
