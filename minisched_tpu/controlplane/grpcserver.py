@@ -1,0 +1,220 @@
+"""gRPC shim: the device evaluator served to external callers.
+
+SURVEY.md §7 stage 9's optional tail — "a gRPC shim exposing the evaluator
+to external callers".  The reference has no analog (its only wire surface
+is the kube REST API); this makes the TPU wave evaluator callable from any
+language: send a cluster, get placements.
+
+Transport design mirrors the §2-row-4 decision to carry no generated
+schema code: gRPC *framing* (HTTP/2 streams, deadlines, status codes) with
+the language-neutral checkpoint JSON codec as the payload — the same
+encoding the WAL, checkpoint files, and REST façade speak — registered
+through ``grpc.method_handlers_generic_handler`` with bytes
+serializers.  A non-Python caller needs only a gRPC stack and JSON.
+
+Service ``minisched.Evaluator``:
+
+* ``Health``  — {} → {"ok": true}
+* ``Evaluate`` — {"nodes": [Node...], "pods": [Pod...],
+  "assigned": [Pod...], "pvcs": [...], "pvs": [...],
+  "mode": "wave"|"repair"} →
+  {"placements": {pod key: node name or null}, "rounds": n}
+
+Placements follow the same deterministic semantics as the in-process
+engine: full default roster, conflict-repairing commit (mode "repair",
+the default) or the stateless wave (mode "wave").
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any, Callable, Optional, Tuple
+
+from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
+
+SERVICE = "minisched.Evaluator"
+
+
+# ---------------------------------------------------------------------------
+# evaluation core (shared by server + in-process callers)
+# ---------------------------------------------------------------------------
+
+
+#: mode → (config, chains, evaluator) — evaluators hold the jit caches, so
+#: repeat calls at the same table capacities skip tracing entirely
+_EVALUATORS: dict = {}
+
+
+def _mode_evaluator(mode: str):
+    if mode not in _EVALUATORS:
+        from minisched_tpu.ops.fused import FusedEvaluator
+        from minisched_tpu.ops.repair import RepairingEvaluator
+        from minisched_tpu.plugins.registry import build_plugins
+        from minisched_tpu.service.config import default_full_roster_config
+
+        cfg = default_full_roster_config()
+        chains = build_plugins(cfg)
+        if mode == "wave":
+            ev = FusedEvaluator(
+                chains.filter, chains.pre_score, chains.score,
+                weights=cfg.score_weights(),
+            )
+        else:
+            ev = RepairingEvaluator(
+                chains.filter, chains.pre_score, chains.score,
+                weights=cfg.score_weights(),
+            )
+        _EVALUATORS[mode] = ev
+    return _EVALUATORS[mode]
+
+
+def evaluate_cluster(request: dict) -> dict:
+    """Schedule the request's pending pods against its nodes; pure
+    function of the request (no control-plane state)."""
+    import numpy as np
+
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+
+    mode = request.get("mode", "repair")
+    if mode not in ("wave", "repair"):
+        raise ValueError(f"unknown mode {mode!r} (wave|repair)")
+
+    def decode_list(key: str, kind: str):
+        return [_decode(KIND_TYPES[kind], o) for o in request.get(key, ())]
+
+    nodes = sorted(
+        decode_list("nodes", "Node"), key=lambda n: n.metadata.name
+    )
+    pods = decode_list("pods", "Pod")
+    assigned = decode_list("assigned", "Pod")
+    pvcs = decode_list("pvcs", "PersistentVolumeClaim")
+    pvs = decode_list("pvs", "PersistentVolume")
+    if not nodes or not pods:
+        return {"placements": {}, "rounds": 0}
+
+    by_node: dict = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    node_table, node_names = build_node_table(nodes, by_node)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned,
+        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+        pvcs=pvcs, pvs=pvs, scan_planes=False,
+    )
+    ev = _mode_evaluator(mode)
+    if mode == "wave":
+        choice = np.asarray(ev(pod_table, node_table, extra).choice)
+        rounds = 1
+    else:  # "repair" (mode validated above)
+        _, choice, rounds = ev(pod_table, node_table, extra)
+        choice, rounds = np.asarray(choice), int(rounds)
+    placements = {
+        pod.metadata.key: (
+            node_names[int(choice[i])] if int(choice[i]) >= 0 else None
+        )
+        for i, pod in enumerate(pods)
+    }
+    return {"placements": placements, "rounds": rounds}
+
+
+# ---------------------------------------------------------------------------
+# gRPC plumbing (generic handlers; JSON bytes on the wire)
+# ---------------------------------------------------------------------------
+
+
+def _handlers():
+    import grpc
+
+    def health(request_bytes: bytes, context) -> bytes:
+        return json.dumps({"ok": True}).encode()
+
+    def evaluate(request_bytes: bytes, context) -> bytes:
+        try:
+            request = json.loads(request_bytes.decode("utf-8"))
+            return json.dumps(evaluate_cluster(request)).encode()
+        except (ValueError, KeyError) as err:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+
+    rpcs = {
+        "Health": grpc.unary_unary_rpc_method_handler(
+            health,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        ),
+        "Evaluate": grpc.unary_unary_rpc_method_handler(
+            evaluate,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE, rpcs)
+
+
+def start_grpc_server(
+    port: int = 0, max_workers: int = 4
+) -> Tuple[Any, str, Callable[[], None]]:
+    """Serve the evaluator; returns (server, address, shutdown_fn) — the
+    start_api_server shape (controlplane/httpserver.py)."""
+    import grpc
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_handlers(),))
+    bound_port = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    address = f"127.0.0.1:{bound_port}"
+
+    def shutdown() -> None:
+        server.stop(grace=1.0).wait()
+
+    return server, address, shutdown
+
+
+class EvaluatorClient:
+    """Minimal Python client over the JSON-payload contract (any gRPC
+    stack can do the same with bytes in/out)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+
+    def _call(self, method: str, payload: dict, timeout: float = 120.0) -> dict:
+        fn = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        raw = fn(json.dumps(payload).encode(), timeout=timeout)
+        return json.loads(raw.decode("utf-8"))
+
+    def health(self) -> dict:
+        return self._call("Health", {})
+
+    def evaluate(
+        self,
+        nodes,
+        pods,
+        assigned=(),
+        pvcs=(),
+        pvs=(),
+        mode: str = "repair",
+        timeout: float = 120.0,
+    ) -> dict:
+        return self._call(
+            "Evaluate",
+            {
+                "nodes": [_encode(n) for n in nodes],
+                "pods": [_encode(p) for p in pods],
+                "assigned": [_encode(p) for p in assigned],
+                "pvcs": [_encode(c) for c in pvcs],
+                "pvs": [_encode(v) for v in pvs],
+                "mode": mode,
+            },
+            timeout=timeout,
+        )
+
+    def close(self) -> None:
+        self._channel.close()

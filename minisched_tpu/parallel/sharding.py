@@ -69,6 +69,8 @@ def make_mesh(
     (processes, chips-per-process) puts each row's node shards on one
     host's ICI domain.
     """
+    if n_devices is not None and n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
     full_roster = devices is None
     devices = list(devices if devices is not None else jax.devices())
     n = n_devices or len(devices)
