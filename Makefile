@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start bench clean
+.PHONY: test native start serve bench clean
 
 test: native
 	python -m pytest tests/ -q
@@ -20,6 +20,13 @@ $(NATIVE_SO): $(NATIVE_SRC)
 # hack/start_simulator.sh:35 — no etcd/env vars needed here)
 start: native
 	python -m minisched_tpu.scenario.runner
+
+# standalone process: REST control plane on PORT + PV controller +
+# scheduler (sched.go's boot order); see minisched_tpu/__main__.py for
+# the optional WAL-store / device-mode / mesh env knobs
+serve: native
+	PORT=$${PORT:-10251} FRONTEND_URL=$${FRONTEND_URL:-http://localhost:3000} \
+		python -m minisched_tpu
 
 bench: native
 	python bench.py
