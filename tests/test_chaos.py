@@ -1,0 +1,98 @@
+"""Chaos composition: the subsystems proven separately, together.
+
+One scenario exercising the WAL-durable store, the mesh-sharded device
+wave engine, apiserver fault injection on bind writes, the error → park →
+event-gated-requeue recovery path, the safety audit, and crash recovery —
+the closest thing to the reference's full-stack scenario at the scale the
+reference can't reach.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.parallel.sharding import make_mesh
+from minisched_tpu.service.config import default_full_roster_config
+from minisched_tpu.service.service import SchedulerService
+
+
+def test_wal_mesh_faults_requeue_audit_recovery(tmp_path):
+    wal = str(tmp_path / "chaos.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+
+    # every 7th Pod write fails once (transient apiserver): binds error,
+    # pods park, and the next cluster event replays them
+    fail_lock = threading.Lock()
+    state = {"count": 0, "failed": set()}
+
+    def flaky(op, kind, key):
+        if op != "update" or kind != "Pod":
+            return
+        with fail_lock:
+            state["count"] += 1
+            if state["count"] % 7 == 0 and key not in state["failed"]:
+                state["failed"].add(key)
+                raise RuntimeError("injected: apiserver unavailable")
+
+    for i in range(16):
+        client.nodes().create(
+            make_node(
+                f"node{i:02d}",
+                unschedulable=i % 8 == 0,
+                capacity={"cpu": "4", "memory": "8Gi", "pods": 110},
+            )
+        )
+    for i in range(40):
+        client.pods().create(make_pod(f"pod{i}", requests={"cpu": "500m"}))
+
+    svc = SchedulerService(client)
+    store.fault_injector = flaky
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=16,
+        device_mesh=make_mesh(8),
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            bound = [p for p in client.pods().list() if p.spec.node_name]
+            if len(bound) == 40:
+                break
+            if sched.queue.stats()["unschedulable"]:
+                # parked by an injected failure: any node event replays
+                # (the parked pods' diagnosis allows Node-event wakeups)
+                sched.queue.flush_unschedulable_leftover()
+                sched.queue.flush_backoff_completed()
+            time.sleep(0.25)
+        assert len(bound) == 40, (
+            f"only {len(bound)} bound; queue={sched.queue.stats()} "
+            f"injected={len(state['failed'])}"
+        )
+        assert state["failed"], "fault injector never fired"
+        # safety audit: no node over allocatable, nothing on cordoned nodes
+        per_node: dict = {}
+        for p in bound:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+            node = client.nodes().get(p.spec.node_name)
+            assert not node.spec.unschedulable, p.metadata.name
+        for name, cnt in per_node.items():
+            assert cnt * 500 <= 4000, (name, cnt)
+        placements = {p.metadata.name: p.spec.node_name for p in bound}
+    finally:
+        store.fault_injector = None
+        svc.shutdown_scheduler()
+        store.close()
+
+    # crash recovery: every bind the first life acknowledged survives
+    store2 = DurableObjectStore(wal)
+    recovered = {
+        p.metadata.name: p.spec.node_name
+        for p in store2.list("Pod")
+        if p.spec.node_name
+    }
+    assert recovered == placements
+    store2.close()
