@@ -41,6 +41,20 @@ from minisched_tpu.models.tables import (
 from minisched_tpu.ops.repair import RepairingEvaluator
 
 
+def _is_cross_pod(pod: Pod) -> bool:
+    """Pods that read or write intra-wave cross-pod coupling state
+    (topology spread / pod (anti-)affinity).  The repair wave evaluates
+    every pod against wave-start combo planes, so two such pods in one
+    wave would be blind to each other — they ride the sequential scan
+    instead (bind-exact; ops/sequential.py carries the combo planes)."""
+    if pod.spec.topology_spread_constraints:
+        return True
+    aff = pod.spec.affinity
+    if aff is None:
+        return False
+    return aff.pod_affinity is not None or aff.pod_anti_affinity is not None
+
+
 class DeviceScheduler(Scheduler):
     """Scheduler whose evaluation step runs on device, a wave at a time."""
 
@@ -56,7 +70,19 @@ class DeviceScheduler(Scheduler):
             getattr(p, "needs_extra", False)
             for p in (*self.filter_plugins, *self.score_plugins)
         )
+        # chains with a combo-carrying (cross-pod) plugin route constrained
+        # pods through the sequential scan; volume-only chains never do —
+        # nothing in them evaluates spread/affinity constraints.  Unknown
+        # cross-pod plugins without the attribute get the safe default.
+        self._has_cross_pod = any(
+            getattr(p, "needs_extra", False)
+            and "combos" in getattr(
+                p, "scan_carried_planes", ("combos", "volumes")
+            )
+            for p in (*self.filter_plugins, *self.score_plugins)
+        )
         self._evaluator: Optional[RepairingEvaluator] = None
+        self._scan_scheduler: Any = None  # lazy SequentialScheduler
         # static node columns cached across waves, keyed on each node's
         # (name, resource_version) — only the assigned-pod aggregates are
         # re-encoded per wave
@@ -135,6 +161,106 @@ class DeviceScheduler(Scheduler):
             )
         return self._evaluator
 
+    #: scan chunks pad to power-of-two capacities ≥ this (few executables,
+    #: each persistent-cached) and never exceed this many pods per chunk
+    #: times 8 — chunking bounds executable size; chunk k+1 re-snapshots so
+    #: it sees chunk k's binds (sequential semantics across chunks)
+    SCAN_MIN_CAP = 128
+    SCAN_MAX_CHUNK = 1024
+
+    def _get_scan_scheduler(self):
+        if self._scan_scheduler is None:
+            from minisched_tpu.ops.sequential import SequentialScheduler
+
+            self._scan_scheduler = SequentialScheduler(
+                self.filter_plugins,
+                self.pre_score_plugins,
+                self.score_plugins,
+                weights=self.score_weights,
+            )
+        return self._scan_scheduler
+
+    def _evaluate_or_park(self, qpis: List[QueuedPodInfo], build_fn):
+        """The shared park-on-failure scaffold around a device evaluation:
+        a ValueError means some pod exceeds a static table capacity — drop
+        the offenders (parked individually) and retry once; any other
+        failure requeues the whole batch via error_func.  Returns
+        (surviving qpis, build_fn result or None)."""
+        try:
+            return qpis, build_fn(qpis)
+        except ValueError:
+            qpis = self._drop_unencodable(qpis)
+            if not qpis:
+                return qpis, None
+            try:
+                return qpis, build_fn(qpis)
+            except Exception as err:
+                for qpi in qpis:  # never lose a popped wave: requeue all
+                    self.error_func(qpi, err)
+                return qpis, None
+        except Exception as err:
+            for qpi in qpis:
+                self.error_func(qpi, err)
+            return qpis, None
+
+    def _schedule_scan(
+        self, qpis: List[QueuedPodInfo], node_infos: List[Any]
+    ) -> None:
+        """Bind-exact path for cross-pod-constrained pods: chunks of the
+        sequential device scan, committed chunk by chunk."""
+        import jax
+
+        chunk = self.SCAN_MAX_CHUNK
+        for start in range(0, len(qpis), chunk):
+            part = qpis[start : start + chunk]
+            if start > 0:
+                node_infos = self.snapshot_nodes()
+            nodes = [ni.node for ni in node_infos]
+            assigned = [p for ni in node_infos for p in ni.pods]
+            cap = max(self.SCAN_MIN_CAP, 1 << (len(part) - 1).bit_length())
+
+            def build_and_scan(part_):
+                pods_ = [qpi.pod for qpi in part_]
+                node_table, node_names = self._table_builder.build(node_infos)
+                pod_table, _ = build_pod_table(pods_, capacity=cap)
+                extra = build_constraint_tables(
+                    pods_, nodes, assigned,
+                    pod_capacity=cap,
+                    node_capacity=node_table.capacity,
+                    pvcs=self.client.store.list("PersistentVolumeClaim"),
+                    pvs=self.client.store.list("PersistentVolume"),
+                    scan_planes=True,  # the scan's commit updates need it
+                )
+                if self.result_store is not None:
+                    # scan pods get the same per-plugin artifact as wave
+                    # pods (diagnostics against the pre-decision snapshot)
+                    self._record_wave(
+                        pods_, pod_table, node_table, node_names, extra
+                    )
+                with self.metrics.timed("scan_evaluate"):
+                    _, choice, _ = self._get_scan_scheduler()(
+                        pod_table, node_table, extra
+                    )
+                    choice = jax.device_get(choice)
+                return node_names, choice.tolist()[: len(pods_)]
+
+            part, result = self._evaluate_or_park(part, build_and_scan)
+            if result is None:
+                continue
+            node_names, placements = result
+
+            losers: List[Any] = []
+            for qpi, c in zip(part, placements):
+                if c < 0:
+                    # no per-plugin masks from the scan: fall back to the
+                    # whole chain so event-gated requeue can't strand
+                    losers.append((qpi, qpi.pod, set()))
+                    continue
+                self._assume(qpi.pod, node_names[c])
+                self._permit_and_bind(qpi, qpi.pod, node_names[c])
+            if losers:
+                self._handle_wave_losers(losers, node_infos, len(nodes))
+
     # the loop: one wave per iteration instead of one pod ------------------
     def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
         qpis = self.queue.pop_batch(self.max_wave, timeout=timeout)
@@ -151,69 +277,42 @@ class DeviceScheduler(Scheduler):
             for qpi in qpis:
                 self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
             return
+
+        # cross-pod-constrained pods are scheduled FIRST, one at a time on
+        # device via the sequential scan (they see each other's commits in
+        # the carried combo planes — bind-exact semantics the repair wave
+        # cannot give them); the plain remainder then rides the repair
+        # wave against a re-snapshot that includes the scan's winners.
+        # The wave thus equals the sequential order [constrained…, plain…].
+        # A chain WITHOUT cross-pod plugins never evaluates the constraints
+        # at all (reference semantics with the plugin disabled) — no scan.
+        constrained = (
+            [qpi for qpi in qpis if _is_cross_pod(qpi.pod)]
+            if self._has_cross_pod
+            else []
+        )
+        if constrained:
+            plain = [qpi for qpi in qpis if not _is_cross_pod(qpi.pod)]
+            self._schedule_scan(constrained, node_infos)
+            if not plain:
+                self.metrics.observe("wave", time.monotonic() - t_wave)
+                return
+            qpis = plain
+            node_infos = self.snapshot_nodes()
+
         nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
         assigned = [p for ni in node_infos for p in ni.pods]
 
         def build_and_evaluate(qpis_):
-            pods_ = [qpi.pod for qpi in qpis_]
-            node_table, node_names = self._table_builder.build(node_infos)
-            pod_table, _ = build_pod_table(
-                pods_, capacity=pad_to(max(len(pods_), self.max_wave))
-            )
-            extra = None
-            if self._needs_extra:
-                extra = build_constraint_tables(
-                    pods_, nodes, assigned,
-                    pod_capacity=pod_table.capacity,
-                    node_capacity=node_table.capacity,
-                    pvcs=self.client.store.list("PersistentVolumeClaim"),
-                    pvs=self.client.store.list("PersistentVolume"),
-                    scan_planes=False,  # wave mode never runs the scan
-                )
-            import jax
-
-            if self.result_store is not None:
-                self._record_wave(
-                    pods_, pod_table, node_table, node_names, extra
-                )
-            _, choice, _, unsched = self._get_evaluator()(
-                pod_table, node_table, extra
-            )
-            # ONE host fetch for both results (each device_get is a
-            # tunnel round-trip); bool[K, P] → per-pod failing-plugin sets
-            choice, unsched = jax.device_get((choice, unsched))
-            unsched = unsched.tolist()
-            plugin_names = [p.name() for p in self.filter_plugins]
-            fail_sets = [
-                {
-                    name
-                    for k, name in enumerate(plugin_names)
-                    if unsched[k][i]
-                }
-                for i in range(len(pods_))
-            ]
-            return node_names, choice.tolist()[: len(pods_)], fail_sets
-
-        try:
             with self.metrics.timed("wave_evaluate"):
-                node_names, placements, fail_sets = build_and_evaluate(qpis)
-        except ValueError:
-            # a pod exceeding a static table capacity (MAX_* in
-            # models/tables.py, MAX_VOLUMES in constraints.py) must be
-            # parked alone — not take the whole popped wave down
-            qpis = self._drop_unencodable(qpis)
-            if not qpis:
-                return
-            try:
-                node_names, placements, fail_sets = build_and_evaluate(qpis)
-            except Exception as err:
-                for qpi in qpis:  # never lose a popped wave: requeue all
-                    self.error_func(qpi, err)
-                return
-        except Exception as err:
-            for qpi in qpis:
-                self.error_func(qpi, err)
+                return self._build_and_evaluate(
+                    qpis_, node_infos, nodes, assigned
+                )
+
+        qpis, result = self._evaluate_or_park(qpis, build_and_evaluate)
+        if result is None:
             return
+        node_names, placements, fail_sets = result
         pods = [qpi.pod for qpi in qpis]
 
         losers: List[Any] = []
@@ -226,6 +325,42 @@ class DeviceScheduler(Scheduler):
         if losers:
             self._handle_wave_losers(losers, node_infos, len(nodes))
         self.metrics.observe("wave", time.monotonic() - t_wave)
+
+    def _build_and_evaluate(self, qpis_, node_infos, nodes, assigned):
+        """One repair-wave evaluation: tables → fused repair evaluator →
+        (node_names, placements, per-pod failing-plugin sets)."""
+        import jax
+
+        pods_ = [qpi.pod for qpi in qpis_]
+        node_table, node_names = self._table_builder.build(node_infos)
+        pod_table, _ = build_pod_table(
+            pods_, capacity=pad_to(max(len(pods_), self.max_wave))
+        )
+        extra = None
+        if self._needs_extra:
+            extra = build_constraint_tables(
+                pods_, nodes, assigned,
+                pod_capacity=pod_table.capacity,
+                node_capacity=node_table.capacity,
+                pvcs=self.client.store.list("PersistentVolumeClaim"),
+                pvs=self.client.store.list("PersistentVolume"),
+                scan_planes=False,  # wave mode never runs the scan
+            )
+        if self.result_store is not None:
+            self._record_wave(pods_, pod_table, node_table, node_names, extra)
+        _, choice, _, unsched = self._get_evaluator()(
+            pod_table, node_table, extra
+        )
+        # ONE host fetch for both results (each device_get is a tunnel
+        # round-trip); bool[K, P] → per-pod failing-plugin sets
+        choice, unsched = jax.device_get((choice, unsched))
+        unsched = unsched.tolist()
+        plugin_names = [p.name() for p in self.filter_plugins]
+        fail_sets = [
+            {name for k, name in enumerate(plugin_names) if unsched[k][i]}
+            for i in range(len(pods_))
+        ]
+        return node_names, choice.tolist()[: len(pods_)], fail_sets
 
     def _handle_wave_losers(
         self, losers: List[Any], node_infos: List[Any], n_nodes: int

@@ -16,6 +16,7 @@ Gt/Lt) in one fused kernel, no per-object work at schedule time.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, List, Tuple
 
 import jax.numpy as jnp
@@ -84,23 +85,40 @@ class NodeAffinity(Plugin, BatchEvaluable):
         return required_node_affinity_mask(pods, nodes)
 
     def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
-        term_match = terms_match(
-            (
-                pods.pref_key,
-                pods.pref_op,
-                pods.pref_vals,
-                pods.pref_nvals,
-                pods.pref_numval,
-                pods.pref_nreqs,
-            ),
-            nodes,
-        )  # (P,T,N)
-        T = pods.pref_key.shape[1]
-        term_in_range = jnp.arange(T)[None, :] < pods.pref_nterms[:, None]
-        weights = jnp.where(
-            term_match & term_in_range[:, :, None], pods.pref_weight[:, :, None], 0
+        import jax
+
+        P = pods.pref_key.shape[0]
+        N = nodes.label_key.shape[0]
+
+        def compute(_):
+            term_match = terms_match(
+                (
+                    pods.pref_key,
+                    pods.pref_op,
+                    pods.pref_vals,
+                    pods.pref_nvals,
+                    pods.pref_numval,
+                    pods.pref_nreqs,
+                ),
+                nodes,
+            )  # (P,T,N)
+            T = pods.pref_key.shape[1]
+            term_in_range = jnp.arange(T)[None, :] < pods.pref_nterms[:, None]
+            weights = jnp.where(
+                term_match & term_in_range[:, :, None],
+                pods.pref_weight[:, :, None],
+                0,
+            )
+            return jnp.sum(weights, axis=1).astype(jnp.int32)
+
+        # a wave with no preferred terms scores 0 everywhere — skip the
+        # whole (P, T, R, N, L) term machinery
+        return jax.lax.cond(
+            jnp.any(pods.pref_nterms > 0),
+            compute,
+            lambda _: jnp.zeros((P, N), jnp.int32),
+            None,
         )
-        return jnp.sum(weights, axis=1).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -161,39 +179,69 @@ def terms_match(prefix_arrays, nodes: Any):
 
 def required_node_affinity_mask(pods: Any, nodes: Any):
     """bool[P, N]: node passes the pod's spec.nodeSelector AND required
-    node affinity (the NodeAffinity filter predicate)."""
-    # spec.nodeSelector: AND over (key, value) pairs
+    node affinity (the NodeAffinity filter predicate).
+
+    Cost scales with what the wave actually carries: each nodeSelector
+    slot and the whole required-affinity term machinery are behind
+    ``lax.cond`` — a wave of plain pods reduces to O(P) predicates, one
+    with a single selector pair costs one (P, N, L) pass.
+    """
+    import jax
+
+    P = pods.sel_key.shape[0]
+    N = nodes.label_key.shape[0]
     S = pods.sel_key.shape[1]
-    sel_in_range = jnp.arange(S)[None, :] < pods.num_sel[:, None]  # (P,S)
     lab_in_range = (
         jnp.arange(nodes.label_key.shape[1])[None, :]
         < nodes.num_labels[:, None]
     )  # (N,L)
-    pair_ok = jnp.any(
-        (pods.sel_key[:, None, :, None] == nodes.label_key[None, :, None, :])
-        & (pods.sel_value[:, None, :, None] == nodes.label_value[None, :, None, :])
-        & lab_in_range[None, :, None, :],
-        axis=3,
-    )  # (P,N,S)
-    sel_ok = jnp.all(pair_ok | ~sel_in_range[:, None, :], axis=2)  # (P,N)
 
-    # required affinity: OR over terms (no terms → pass)
-    term_match = terms_match(
-        (
-            pods.aff_key,
-            pods.aff_op,
-            pods.aff_vals,
-            pods.aff_nvals,
-            pods.aff_numval,
-            pods.aff_nreqs,
-        ),
-        nodes,
-    )  # (P,T,N)
-    T = pods.aff_key.shape[1]
-    term_in_range = jnp.arange(T)[None, :] < pods.aff_nterms[:, None]  # (P,T)
-    any_term = jnp.any(term_match & term_in_range[:, :, None], axis=1)  # (P,N)
-    # a required affinity with an empty term list matches nothing —
-    # any_term over zero in-range terms is already False, so gate only
-    # on the requirement's *presence* (upstream MatchNodeSelectorTerms)
-    aff_ok = jnp.where(pods.aff_required[:, None], any_term, True)
+    def all_true(_):
+        return jnp.ones((P, N), bool)
+
+    def sel_slot(s, _):
+        # spec.nodeSelector slot s: node must carry the exact label pair
+        ok = jnp.any(
+            (pods.sel_key[:, s][:, None, None] == nodes.label_key[None, :, :])
+            & (
+                pods.sel_value[:, s][:, None, None]
+                == nodes.label_value[None, :, :]
+            )
+            & lab_in_range[None, :, :],
+            axis=2,
+        )  # (P, N)
+        return ok | (pods.num_sel <= s)[:, None]
+
+    sel_ok = jnp.ones((P, N), bool)
+    for s in range(S):
+        sel_ok = sel_ok & jax.lax.cond(
+            jnp.any(pods.num_sel > s), partial(sel_slot, s), all_true, None
+        )
+
+    def aff(_):
+        # required affinity: OR over terms (no terms → pass)
+        term_match = terms_match(
+            (
+                pods.aff_key,
+                pods.aff_op,
+                pods.aff_vals,
+                pods.aff_nvals,
+                pods.aff_numval,
+                pods.aff_nreqs,
+            ),
+            nodes,
+        )  # (P,T,N)
+        T = pods.aff_key.shape[1]
+        term_in_range = (
+            jnp.arange(T)[None, :] < pods.aff_nterms[:, None]
+        )  # (P,T)
+        any_term = jnp.any(
+            term_match & term_in_range[:, :, None], axis=1
+        )  # (P,N)
+        # a required affinity with an empty term list matches nothing —
+        # any_term over zero in-range terms is already False, so gate only
+        # on the requirement's *presence* (upstream MatchNodeSelectorTerms)
+        return jnp.where(pods.aff_required[:, None], any_term, True)
+
+    aff_ok = jax.lax.cond(jnp.any(pods.aff_required), aff, all_true, None)
     return sel_ok & aff_ok

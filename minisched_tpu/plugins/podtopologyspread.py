@@ -24,6 +24,7 @@ eligibility kernel.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -180,49 +181,97 @@ class PodTopologySpread(Plugin, BatchEvaluable):
 
     # -- batch -------------------------------------------------------------
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any, extra: Any):
+        """MXU-shaped spread filter.
+
+        Never materializes a (P, D, N) tensor: per-domain sums contract
+        over the node axis as one (P, N) × (N, K·D) matmul (exact in f32
+        while every count stays < 2²⁴ — domain counts are bounded by the
+        cluster's assigned-pod total), then per-pod rows are selected by
+        topology-key index.  Waves with no DoNotSchedule constraint in a
+        slot skip that slot's work entirely via ``lax.cond`` — the common
+        plain-pod wave costs O(P) here instead of the dense kernels.
+        """
         if extra is None:
             raise ValueError(
                 "PodTopologySpread batch kernels need the wave's "
                 "ConstraintTables (models/constraints.py) — pass `extra`"
             )
-        elig = required_node_affinity_mask(pods, nodes) & nodes.valid[None, :]
+        import jax
+
+        C = extra.ts_combo.shape[1]
+        P = extra.ts_combo.shape[0]
+        N = nodes.valid.shape[0]
         active = (
-            (jnp.arange(extra.ts_combo.shape[1])[None, :] < extra.ts_n[:, None])
+            (jnp.arange(C)[None, :] < extra.ts_n[:, None])
             & (extra.ts_mode == TS_DO_NOT_SCHEDULE)
         )  # (P, C)
-        D = extra.topo_onehot.shape[1]
-        oks = []
-        for c in range(extra.ts_combo.shape[1]):  # static, MAX_TSC slots
-            combo = extra.ts_combo[:, c]  # (P,)
-            haskey = extra.combo_haskey[combo]  # (P, N)
-            # domain sums restricted to the pod's ELIGIBLE nodes (upstream
-            # PreFilter skips ineligible nodes entirely)
-            x = jnp.where(elig, extra.combo_here[combo], 0)  # (P, N)
-            key = extra.combo_key[combo]  # (P,)
-            unique = extra.topo_unique[key]  # (P,)
-            # zone-like path: one-hot matmul per domain, then gather back
-            onehot = extra.topo_onehot[key]  # (P, D, N)
-            A = jnp.einsum("pn,pdn->pd", x, onehot)  # (P, D) per-domain sums
-            dom = extra.topo_domain[key]  # (P, N); == D when keyless
-            dsum_z = jnp.take_along_axis(
-                A, jnp.minimum(dom, D - 1), axis=1
-            )  # (P, N)
-            exists = jnp.any(onehot & elig[:, None, :], axis=2)  # (P, D)
-            m_z = jnp.min(jnp.where(exists, A, _INF), axis=1)  # (P,)
-            # hostname-like path: every domain is one node
-            dsum_u = x
-            m_u = jnp.min(
-                jnp.where(elig & haskey, x, _INF), axis=1
-            )  # (P,)
-            dsum = jnp.where(unique[:, None], dsum_u, dsum_z)
-            m = jnp.where(unique, m_u, m_z)
-            ok = (
-                haskey
-                & (m < _INF)[:, None]
-                & (dsum + 1 - m[:, None] <= extra.ts_skew[:, c, None])
+
+        def all_ok(_):
+            return jnp.ones((P, N), bool)
+
+        def compute_all(_):
+            elig = (
+                required_node_affinity_mask(pods, nodes)
+                & nodes.valid[None, :]
             )
-            oks.append(ok | ~active[:, c, None])
-        return jnp.all(jnp.stack(oks), axis=0)
+            K, D, _ = extra.topo_onehot.shape
+            # (N, K·D) one-hot, shared by every slot's contractions.
+            # Precision.HIGHEST keeps the f32 dots exact (counts < 2²⁴) —
+            # TPU default precision feeds bf16 into the MXU and would
+            # silently round counts above 256
+            dot = partial(
+                jnp.matmul, precision=jax.lax.Precision.HIGHEST
+            )
+            onehot_t = jnp.reshape(
+                extra.topo_onehot, (K * D, N)
+            ).T.astype(jnp.float32)
+            # exists[p, k, d]: some ELIGIBLE node sits in domain d of key k
+            e_all = dot(
+                elig.astype(jnp.float32), onehot_t
+            ).reshape(P, K, D) > 0
+
+            def slot(c, _):
+                combo = extra.ts_combo[:, c]  # (P,)
+                haskey = extra.combo_haskey[combo]  # (P, N)
+                # domain sums restricted to the pod's ELIGIBLE nodes
+                # (upstream PreFilter skips ineligible nodes entirely)
+                x = jnp.where(elig, extra.combo_here[combo], 0)  # (P, N)
+                key = extra.combo_key[combo]  # (P,)
+                unique = extra.topo_unique[key]  # (P,)
+                # zone-like path: per-domain sums via the MXU, then select
+                # each pod's key row and gather per-node domain sums back
+                a_all = dot(x.astype(jnp.float32), onehot_t).reshape(P, K, D)
+                A = jnp.take_along_axis(
+                    a_all, key[:, None, None], axis=1
+                )[:, 0].astype(jnp.int32)  # (P, D)
+                exists = jnp.take_along_axis(
+                    e_all, key[:, None, None], axis=1
+                )[:, 0]  # (P, D)
+                dom = extra.topo_domain[key]  # (P, N); == D when keyless
+                dsum_z = jnp.take_along_axis(
+                    A, jnp.minimum(dom, D - 1), axis=1
+                )  # (P, N)
+                m_z = jnp.min(jnp.where(exists, A, _INF), axis=1)  # (P,)
+                # hostname-like path: every domain is one node
+                dsum_u = x
+                m_u = jnp.min(jnp.where(elig & haskey, x, _INF), axis=1)
+                dsum = jnp.where(unique[:, None], dsum_u, dsum_z)
+                m = jnp.where(unique, m_u, m_z)
+                ok = (
+                    haskey
+                    & (m < _INF)[:, None]
+                    & (dsum + 1 - m[:, None] <= extra.ts_skew[:, c, None])
+                )
+                return ok | ~active[:, c, None]
+
+            out = jnp.ones((P, N), bool)
+            for c in range(C):  # static, MAX_TSC slots
+                out = out & jax.lax.cond(
+                    jnp.any(active[:, c]), partial(slot, c), all_ok, None
+                )
+            return out
+
+        return jax.lax.cond(jnp.any(active), compute_all, all_ok, None)
 
     def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any],
                     extra: Any):
@@ -231,17 +280,47 @@ class PodTopologySpread(Plugin, BatchEvaluable):
                 "PodTopologySpread batch kernels need the wave's "
                 "ConstraintTables (models/constraints.py) — pass `extra`"
             )
+        import jax
+
+        C = extra.ts_combo.shape[1]
+        P = extra.ts_combo.shape[0]
+        N = nodes.valid.shape[0]
         active = (
-            (jnp.arange(extra.ts_combo.shape[1])[None, :] < extra.ts_n[:, None])
+            (jnp.arange(C)[None, :] < extra.ts_n[:, None])
             & (extra.ts_mode != TS_DO_NOT_SCHEDULE)
         )  # (P, C)
-        dsum = extra.combo_dsum[extra.ts_combo]  # (P, C, N)
-        haskey = extra.combo_haskey[extra.ts_combo]
-        worst = jnp.max(jnp.where(haskey, dsum, 0), axis=2, keepdims=True)
-        contrib = jnp.where(haskey, dsum, worst)
-        return jnp.sum(
-            jnp.where(active[:, :, None], contrib, 0), axis=1
-        ).astype(jnp.int32)
+
+        def zero(_):
+            # no soft constraint in the wave: every node scores 0, and the
+            # reversed min-max normalization fills MAX everywhere — same as
+            # the scalar path's empty-constraint total
+            return jnp.zeros((P, N), jnp.int32)
+
+        def compute(_):
+            # slot loop instead of a (P, C, N) gather: waves rarely carry
+            # more than one soft constraint, and each inactive slot skips
+            # its (P, N) planes via lax.cond
+            total = jnp.zeros((P, N), jnp.int32)
+            for c in range(C):
+                def slot(_c=c):
+                    combo = extra.ts_combo[:, _c]
+                    dsum = extra.combo_dsum[combo]  # (P, N)
+                    haskey = extra.combo_haskey[combo]
+                    worst = jnp.max(
+                        jnp.where(haskey, dsum, 0), axis=1, keepdims=True
+                    )
+                    contrib = jnp.where(haskey, dsum, worst)
+                    return jnp.where(active[:, _c, None], contrib, 0)
+
+                total = total + jax.lax.cond(
+                    jnp.any(active[:, c]),
+                    lambda _, _c=c: slot(_c),
+                    zero,
+                    None,
+                )
+            return total
+
+        return jax.lax.cond(jnp.any(active), compute, zero, None)
 
     def batch_normalize(self, ctx: Any, scores, mask):
         return minmax_normalize_batch(

@@ -1,0 +1,72 @@
+"""Time the full-roster RepairingEvaluator on the real device at config5
+wave shapes — is the 6.1s/wave host build or device compute?"""
+
+import os
+import sys
+import time
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import random
+
+import jax
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_node_table, build_pod_table, pad_to
+from minisched_tpu.ops.repair import RepairingEvaluator
+from minisched_tpu.plugins.registry import build_plugins
+from minisched_tpu.service.config import default_full_roster_config
+
+print("backend:", jax.default_backend(), file=sys.stderr)
+
+N_NODES = int(os.environ.get("PN", 10_000))
+WAVE = int(os.environ.get("PW", 8_192))
+
+rng = random.Random(55)
+nodes = sorted(
+    (
+        make_node(
+            f"node{i:05d}",
+            unschedulable=rng.random() < 0.2,
+            capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            labels={"zone": f"z{i % 16}"},
+        )
+        for i in range(N_NODES)
+    ),
+    key=lambda n: n.metadata.name,
+)
+pods = [
+    make_pod(f"pod{i:06d}", requests={"cpu": "500m", "memory": "256Mi"})
+    for i in range(WAVE)
+]
+
+cfg = default_full_roster_config()
+chains = build_plugins(cfg)
+ev = RepairingEvaluator(
+    chains.filter, chains.pre_score, chains.score,
+    weights=cfg.score_weights(), with_diagnostics=True,
+)
+
+t0 = time.monotonic()
+node_table, names = build_node_table(nodes)
+pod_table, _ = build_pod_table(pods, capacity=pad_to(WAVE))
+extra = build_constraint_tables(
+    pods, nodes, [],
+    pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    scan_planes=False,
+)
+print(f"host build: {time.monotonic()-t0:.2f}s", file=sys.stderr)
+
+for rep in range(4):
+    t0 = time.monotonic()
+    out = ev(pod_table, node_table, extra)
+    jax.block_until_ready(out[1])
+    rounds = int(out[2])
+    print(
+        f"rep {rep}: {time.monotonic()-t0:.3f}s (rounds={rounds}, "
+        f"placed={int((out[1] >= 0).sum())})",
+        file=sys.stderr,
+    )
