@@ -1,0 +1,36 @@
+# Container image for the standalone scheduler process — the run-surface
+# analog of the reference's Dockerfile (/root/reference/Dockerfile:1-20,
+# which containerizes the Go simulator next to etcd).  Here there is no
+# etcd sidecar: L0 durability is the in-process WAL store, mounted as a
+# volume (docker-compose.yml).
+#
+# The image runs the CPU backend by default; on a TPU VM, base off a
+# TPU-enabled JAX image and set MINISCHED_DEVICE_MODE=1.
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+# jax (CPU) is the only hard runtime dependency of the scheduler process
+RUN pip install --no-cache-dir "jax[cpu]" numpy
+
+WORKDIR /app
+COPY Makefile ./
+COPY native ./native
+COPY minisched_tpu ./minisched_tpu
+
+# build the native host-table kernels into the package (Makefile `native`)
+RUN make native
+
+ENV PORT=10251 \
+    FRONTEND_URL=http://localhost:3000 \
+    MINISCHED_TPU_STORE_URL=file:///data/cluster.wal \
+    JAX_PLATFORMS=cpu
+
+EXPOSE 10251
+VOLUME /data
+
+# the standalone process entry (reference sched.go boot order: store →
+# API server → PV controller → scheduler; SIGTERM-clean)
+CMD ["python", "-m", "minisched_tpu"]

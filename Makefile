@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench clean
+.PHONY: test native start serve bench docker clean
 
 test: native
 	python -m pytest tests/ -q
@@ -30,6 +30,11 @@ serve: native
 
 bench: native
 	python bench.py
+
+# containerized `make serve` with the WAL on a named volume (the
+# reference's docker-compose runs etcd + simulator; see docker-compose.yml)
+docker:
+	docker compose up --build
 
 clean:
 	rm -f $(NATIVE_SO)

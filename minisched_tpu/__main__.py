@@ -38,6 +38,14 @@ from minisched_tpu.service.service import SchedulerService
 
 def start(cfg: ProcessConfig, device_mode: bool = False, mesh_devices: int = 0):
     """Boot the stack; returns (client, api_base_url, stop_fn)."""
+    # validate the flag combination BEFORE booting any component — failing
+    # after the store/API server/PV controller are live would leak their
+    # threads and the open WAL with no stop path
+    if mesh_devices and not device_mode:
+        raise ValueError(
+            "MINISCHED_MESH_DEVICES requires MINISCHED_DEVICE_MODE=1 — the "
+            "scalar engine cannot shard waves"
+        )
     store = store_from_url(cfg.external_store_url)
     # the reference's client limits (k8sapiserver.go:57-62: QPS/Burst 5000)
     client = Client(store=store, qps=DEFAULT_QPS, burst=DEFAULT_BURST)
@@ -50,11 +58,6 @@ def start(cfg: ProcessConfig, device_mode: bool = False, mesh_devices: int = 0):
     scheduler_cfg = (
         default_full_roster_config() if device_mode else default_scheduler_config()
     )
-    if mesh_devices and not device_mode:
-        raise ValueError(
-            "MINISCHED_MESH_DEVICES requires MINISCHED_DEVICE_MODE=1 — the "
-            "scalar engine cannot shard waves"
-        )
     mesh = None
     if device_mode and mesh_devices:
         from minisched_tpu.parallel.sharding import make_mesh

@@ -599,6 +599,35 @@ class Binding:
     node_name: str
 
 
+@dataclass
+class Event:
+    """events.k8s.io/v1 Event equivalent — what the reference's events
+    broadcaster writes through the API (scheduler/scheduler.go:55-59:
+    ``events.NewBroadcaster(&events.EventSinkImpl{...})`` records real
+    ``eventsv1`` objects a client can list).  Stored as a VOLATILE kind:
+    list/watch-able like any object, excluded from WAL/checkpoint."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    #: "namespace/name" key of the object the event is about ('' for
+    #: scheduler lifecycle events with no subject)
+    regarding: str = ""
+    #: the component that emitted it (reportingController)
+    reporting_controller: str = "minisched-tpu"
+
+    def clone(self) -> "Event":
+        return Event(
+            self.metadata.clone(),
+            self.type,
+            self.reason,
+            self.message,
+            self.regarding,
+            self.reporting_controller,
+        )
+
+
 # ---------------------------------------------------------------------------
 # Convenience constructors (the shapes sched.go:74-133 builds)
 # ---------------------------------------------------------------------------

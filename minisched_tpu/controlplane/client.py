@@ -60,7 +60,9 @@ class _ThrottledStore:
     limiter covers requests, not watch deliveries)."""
 
     _THROTTLED = frozenset(
-        ("create", "get", "list", "update", "delete", "mutate", "watch")
+        # mutate_many is ONE API request (a batch bind), so one token
+        ("create", "get", "list", "update", "delete", "mutate",
+         "mutate_many", "watch")
     )
 
     def __init__(self, store: ObjectStore, limiter: TokenBucket):
@@ -150,18 +152,37 @@ class _PodAPI:
         The real apiserver rejects a second bind; preserving that guard is
         what makes wave-scheduling conflict detection observable.
         """
+        [res] = self.bind_many([binding])
+        if isinstance(res, BaseException):
+            raise res
+        return res
 
-        def apply(pod: Pod) -> Pod:
-            if pod.spec.node_name:
-                raise AlreadyBound(
-                    f"pod {pod.metadata.key} already bound to {pod.spec.node_name}"
-                )
-            pod.spec.node_name = binding.node_name
-            pod.status = PodStatus(phase="Running")
-            return pod
+    def bind_many(self, bindings: List[Binding]) -> List[Any]:
+        """Batch form of the binding subresource: a wave's placements in
+        one store transaction (the reference binds one pod per cycle,
+        minisched.go:267-273 — a TPU wave commits thousands).  Returns a
+        list aligned with ``bindings``: the bound Pod, or the exception
+        (AlreadyBound, missing-pod KeyError) for that entry."""
 
-        return self._store.mutate(
-            KIND_POD, binding.pod_namespace, binding.pod_name, apply
+        def apply_for(binding: Binding):
+            def apply(pod: Pod) -> Pod:
+                if pod.spec.node_name:
+                    raise AlreadyBound(
+                        f"pod {pod.metadata.key} already bound to "
+                        f"{pod.spec.node_name}"
+                    )
+                pod.spec.node_name = binding.node_name
+                pod.status = PodStatus(phase="Running")
+                return pod
+
+            return apply
+
+        return self._store.mutate_many(
+            KIND_POD,
+            [
+                (b.pod_namespace, b.pod_name, apply_for(b))
+                for b in bindings
+            ],
         )
 
 
@@ -197,18 +218,102 @@ class Client:
 
 
 class EventRecorder:
-    """Events-broadcaster stand-in (scheduler/scheduler.go:55-59): records
-    scheduler lifecycle events as plain dicts on an in-memory list."""
+    """Events broadcaster (scheduler/scheduler.go:55-59): records scheduler
+    lifecycle + per-decision events.
 
-    def __init__(self) -> None:
-        self.events: List[Any] = []
+    With a ``store``, each event is written as a real ``Event`` API object
+    (the reference's ``events.NewBroadcaster(&events.EventSinkImpl{...})``
+    records ``eventsv1`` objects a client can list) — list/watch-able over
+    the store and the REST façade; the kind is volatile (no WAL).  Writes
+    happen on a dedicated writer thread, like upstream's broadcaster
+    goroutines: ``eventf`` on the scheduling hot path only enqueues (a
+    device wave emits thousands of decisions — synchronous store writes
+    there would eat the batched-bind win).  ``flush()`` waits for the
+    queue to drain (call before asserting/reading in tests or shutdown).
+
+    ``max_events`` bounds growth on BOTH sides (kube events expire by
+    TTL; a 100k-pod run would otherwise accrete 100k objects): the
+    in-process ``events`` deque drops its oldest dicts, and the oldest
+    Event object is deleted from the store as the cap is passed.
+    """
+
+    def __init__(self, store: Any = None, max_events: int = 2048) -> None:
+        from collections import deque
+
+        self.events: Any = deque(maxlen=max_events)
+        self._store = store
+        self._max_events = max_events
+        self._seq = 0
+        self._mu = threading.Lock()
+        if store is not None:
+            import queue as _queue
+
+            self._live: Any = deque()  # (namespace, name) in emit order
+            self._q: Any = _queue.Queue()
+            self._writer = threading.Thread(
+                target=self._drain, name="event-writer", daemon=True
+            )
+            self._writer.start()
 
     def eventf(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        meta = getattr(obj, "metadata", None)
+        regarding = getattr(meta, "key", "") if meta is not None else ""
         self.events.append(
             {
-                "object": getattr(getattr(obj, "metadata", None), "key", str(obj)),
+                "object": regarding or str(obj),
                 "type": event_type,
                 "reason": reason,
                 "message": message,
             }
         )
+        if self._store is None:
+            return
+        from minisched_tpu.api.objects import Event, ObjectMeta
+
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+        subject = getattr(meta, "name", "") if meta is not None else ""
+        namespace = (
+            getattr(meta, "namespace", "") if meta is not None else ""
+        ) or "default"
+        self._q.put(
+            Event(
+                metadata=ObjectMeta(
+                    name=f"{subject or 'scheduler'}.{seq:x}",
+                    namespace=namespace,
+                ),
+                type=event_type,
+                reason=reason,
+                message=message,
+                regarding=regarding,
+            )
+        )
+
+    def _drain(self) -> None:
+        while True:
+            evt = self._q.get()
+            try:
+                self._store.create(KIND_EVENT, evt)
+                ns, name = evt.metadata.namespace, evt.metadata.name
+                self._live.append((ns, name))
+                if len(self._live) > self._max_events:
+                    drop = self._live.popleft()
+                    try:
+                        self._store.delete(KIND_EVENT, drop[0], drop[1])
+                    except KeyError:
+                        pass  # already gone (store swapped/cleared)
+            except Exception:
+                pass  # a full/closed store must not kill the writer
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Block until every enqueued event has been written (bounded)."""
+        if self._store is None:
+            return
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.01)

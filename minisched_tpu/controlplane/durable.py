@@ -38,6 +38,7 @@ class DurableObjectStore(ObjectStore):
         self._path = path
         self._fsync = fsync
         self._closed = False
+        self._defer_flush = False  # batch mutations share one flush
         self._log = None  # replay must not re-log
         self._replay()
         self._log = open(self._path, "a", encoding="utf-8")
@@ -64,9 +65,26 @@ class DurableObjectStore(ObjectStore):
         if self._log is None:
             return  # replay: the record being applied is already in the log
         self._log.write(json.dumps(rec) + "\n")
+        if self._defer_flush:
+            return  # mutate_many flushes once for the whole batch
         self._log.flush()
         if self._fsync:
             os.fsync(self._log.fileno())
+
+    def mutate_many(self, kind: str, items) -> list:
+        """Batch read-modify-write with ONE log flush: every record is
+        written (durability order preserved — same lock, same order), but
+        the flush/fsync is paid once per batch instead of per bind."""
+        with self._lock:
+            self._defer_flush = True
+            try:
+                return super().mutate_many(kind, items)
+            finally:
+                self._defer_flush = False
+                if self._log is not None:
+                    self._log.flush()
+                    if self._fsync:
+                        os.fsync(self._log.fileno())
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:

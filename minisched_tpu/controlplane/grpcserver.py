@@ -28,6 +28,7 @@ the default) or the stateless wave (mode "wave").
 from __future__ import annotations
 
 import json
+import threading
 from concurrent import futures
 from typing import Any, Callable, Optional, Tuple
 
@@ -42,31 +43,36 @@ SERVICE = "minisched.Evaluator"
 
 
 #: mode → (config, chains, evaluator) — evaluators hold the jit caches, so
-#: repeat calls at the same table capacities skip tracing entirely
+#: repeat calls at the same table capacities skip tracing entirely.  The
+#: lock serializes first-call construction under the multi-worker server
+#: (evaluator construction runs the static-classification probe — paying
+#: it once per concurrent first caller would be seconds each).
 _EVALUATORS: dict = {}
+_EVALUATORS_LOCK = threading.Lock()
 
 
 def _mode_evaluator(mode: str):
-    if mode not in _EVALUATORS:
-        from minisched_tpu.ops.fused import FusedEvaluator
-        from minisched_tpu.ops.repair import RepairingEvaluator
-        from minisched_tpu.plugins.registry import build_plugins
-        from minisched_tpu.service.config import default_full_roster_config
+    with _EVALUATORS_LOCK:
+        if mode not in _EVALUATORS:
+            from minisched_tpu.ops.fused import FusedEvaluator
+            from minisched_tpu.ops.repair import RepairingEvaluator
+            from minisched_tpu.plugins.registry import build_plugins
+            from minisched_tpu.service.config import default_full_roster_config
 
-        cfg = default_full_roster_config()
-        chains = build_plugins(cfg)
-        if mode == "wave":
-            ev = FusedEvaluator(
-                chains.filter, chains.pre_score, chains.score,
-                weights=cfg.score_weights(),
-            )
-        else:
-            ev = RepairingEvaluator(
-                chains.filter, chains.pre_score, chains.score,
-                weights=cfg.score_weights(),
-            )
-        _EVALUATORS[mode] = ev
-    return _EVALUATORS[mode]
+            cfg = default_full_roster_config()
+            chains = build_plugins(cfg)
+            if mode == "wave":
+                ev = FusedEvaluator(
+                    chains.filter, chains.pre_score, chains.score,
+                    weights=cfg.score_weights(),
+                )
+            else:
+                ev = RepairingEvaluator(
+                    chains.filter, chains.pre_score, chains.score,
+                    weights=cfg.score_weights(),
+                )
+            _EVALUATORS[mode] = ev
+        return _EVALUATORS[mode]
 
 
 def evaluate_cluster(request: dict) -> dict:
@@ -84,26 +90,33 @@ def evaluate_cluster(request: dict) -> dict:
     def decode_list(key: str, kind: str):
         return [_decode(KIND_TYPES[kind], o) for o in request.get(key, ())]
 
-    nodes = sorted(
-        decode_list("nodes", "Node"), key=lambda n: n.metadata.name
-    )
-    pods = decode_list("pods", "Pod")
-    assigned = decode_list("assigned", "Pod")
-    pvcs = decode_list("pvcs", "PersistentVolumeClaim")
-    pvs = decode_list("pvs", "PersistentVolume")
-    if not nodes or not pods:
-        return {"placements": {}, "rounds": 0}
+    # request decode + table build = the CALLER's payload: any failure in
+    # here (including TypeError/AttributeError from malformed object
+    # shapes) is a bad argument.  Evaluator failures past this point are
+    # server bugs and must surface loudly, NOT as INVALID_ARGUMENT.
+    try:
+        nodes = sorted(
+            decode_list("nodes", "Node"), key=lambda n: n.metadata.name
+        )
+        pods = decode_list("pods", "Pod")
+        assigned = decode_list("assigned", "Pod")
+        pvcs = decode_list("pvcs", "PersistentVolumeClaim")
+        pvs = decode_list("pvs", "PersistentVolume")
+        if not nodes or not pods:
+            return {"placements": {}, "rounds": 0}
 
-    by_node: dict = {}
-    for p in assigned:
-        by_node.setdefault(p.spec.node_name, []).append(p)
-    node_table, node_names = build_node_table(nodes, by_node)
-    pod_table, _ = build_pod_table(pods)
-    extra = build_constraint_tables(
-        pods, nodes, assigned,
-        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
-        pvcs=pvcs, pvs=pvs, scan_planes=False,
-    )
+        by_node: dict = {}
+        for p in assigned:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        node_table, node_names = build_node_table(nodes, by_node)
+        pod_table, _ = build_pod_table(pods)
+        extra = build_constraint_tables(
+            pods, nodes, assigned,
+            pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+            pvcs=pvcs, pvs=pvs, scan_planes=False,
+        )
+    except (TypeError, AttributeError) as err:
+        raise ValueError(f"malformed request: {err}") from err
     ev = _mode_evaluator(mode)
     if mode == "wave":
         choice = np.asarray(ev(pod_table, node_table, extra).choice)
@@ -136,6 +149,9 @@ def _handlers():
             request = json.loads(request_bytes.decode("utf-8"))
             return json.dumps(evaluate_cluster(request)).encode()
         except (ValueError, KeyError) as err:
+            # evaluate_cluster re-raises malformed-payload TypeErrors as
+            # ValueError; evaluator bugs deliberately fall through as
+            # server errors
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
 
     rpcs = {

@@ -43,7 +43,17 @@ from minisched_tpu.controlplane.store import ObjectStore
 def _kind_for(collection: str) -> str:
     return {"nodes": "Node", "pods": "Pod",
             "persistentvolumes": "PersistentVolume",
-            "persistentvolumeclaims": "PersistentVolumeClaim"}[collection]
+            "persistentvolumeclaims": "PersistentVolumeClaim",
+            "events": "Event"}[collection]
+
+
+#: kinds the REST façade serves: the durable roster plus the volatile
+#: Event kind (the reference's broadcaster records eventsv1 objects a
+#: client can list — scheduler/scheduler.go:55-59; Events stay out of the
+#: WAL codec's KIND_TYPES on purpose)
+from minisched_tpu.api import objects as _objects  # noqa: E402
+
+REST_KINDS = {**KIND_TYPES, "Event": _objects.Event}
 
 
 #: kinds stored under namespace "" regardless of URL/body (kube semantics)
@@ -183,7 +193,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, str(e))
             return
         try:
-            obj = _decode(KIND_TYPES[kind], self._body())
+            obj = _decode(REST_KINDS[kind], self._body())
         except Exception as e:
             self._error(400, f"malformed body: {e}")
             return
@@ -205,7 +215,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
             return
         try:
-            obj = _decode(KIND_TYPES[kind], self._body())
+            obj = _decode(REST_KINDS[kind], self._body())
         except Exception as e:
             self._error(400, f"malformed body: {e}")
             return

@@ -189,6 +189,27 @@ class ObjectStore:
             updated = fn(obj) or obj
             return self.update(kind, updated)
 
+    def mutate_many(
+        self, kind: str, items: List[Tuple[str, str, Callable[[Any], Any]]]
+    ) -> List[Any]:
+        """Apply many read-modify-writes under ONE lock hold — the wave
+        engine's batch bind (a wave commits thousands of placements; a
+        lock round-trip per bind dominated the e2e profile).
+
+        ``items``: (namespace, name, fn) triples.  Returns a list aligned
+        with ``items`` holding the updated object — or the exception that
+        item raised: one failed bind (AlreadyBound, deleted pod) must not
+        abort the rest of the wave's commits.
+        """
+        out: List[Any] = []
+        with self._lock:
+            for namespace, name, fn in items:
+                try:
+                    out.append(self.mutate(kind, namespace, name, fn))
+                except Exception as err:  # noqa: BLE001 — returned, not lost
+                    out.append(err)
+        return out
+
     @property
     def resource_version(self) -> int:
         with self._lock:

@@ -250,6 +250,7 @@ class DeviceScheduler(Scheduler):
             node_names, placements = result
 
             losers: List[Any] = []
+            winners: List[Any] = []
             for qpi, c in zip(part, placements):
                 if c < 0:
                     # no per-plugin masks from the scan: fall back to the
@@ -257,7 +258,8 @@ class DeviceScheduler(Scheduler):
                     losers.append((qpi, qpi.pod, set()))
                     continue
                 self._assume(qpi.pod, node_names[c])
-                self._permit_and_bind(qpi, qpi.pod, node_names[c])
+                winners.append((qpi, qpi.pod, node_names[c]))
+            self._commit_winners(winners)
             if losers:
                 self._handle_wave_losers(losers, node_infos, len(nodes))
 
@@ -316,12 +318,14 @@ class DeviceScheduler(Scheduler):
         pods = [qpi.pod for qpi in qpis]
 
         losers: List[Any] = []
+        winners: List[Any] = []
         for qpi, pod, c, fails in zip(qpis, pods, placements, fail_sets):
             if c < 0:
                 losers.append((qpi, pod, fails))
                 continue
             self._assume(pod, node_names[c])
-            self._permit_and_bind(qpi, pod, node_names[c])
+            winners.append((qpi, pod, node_names[c]))
+        self._commit_winners(winners)
         if losers:
             self._handle_wave_losers(losers, node_infos, len(nodes))
         self.metrics.observe("wave", time.monotonic() - t_wave)
@@ -422,7 +426,13 @@ class DeviceScheduler(Scheduler):
             # them) — diffing full store listings per loser would clone
             # the whole pod population each time
             for pl in self.post_filter_plugins:
-                for victim in getattr(pl, "last_victims", ()):
+                # consume-on-read: run_post_filter short-circuits on the
+                # first Success, so a plugin NOT invoked for this loser
+                # must not replay victims recorded for an earlier one
+                victims = getattr(pl, "last_victims", ())
+                if victims:
+                    pl.last_victims = []
+                for victim in victims:
                     ni = by_name.get(victim.spec.node_name)
                     if ni is not None:
                         ni.remove_pod(victim)
@@ -516,17 +526,71 @@ class DeviceScheduler(Scheduler):
             reasons=canonical_filter_reasons(),
         )
 
-    def _permit_and_bind(self, qpi: QueuedPodInfo, pod: Pod, node_name: str) -> None:
-        """Host-side tail of the cycle — the scalar engine's shared
-        reserve → permit → bind helper (minisched.go:89-112).  Binds run
-        inline unless a permit plugin asked to Wait: a wave commits
-        thousands of placements and a detached thread per bind is pure
-        overhead at that rate."""
+    def _commit_winners(self, winners: List[Any]) -> None:
+        """Host-side tail of the wave for every placed pod: reserve →
+        permit per pod (host plugin chains, minisched.go:89-112), then ONE
+        batched bind transaction for all immediately-bindable pods — a
+        wave commits thousands of placements and a store round-trip per
+        bind dominated the e2e profile.  Pods a permit plugin parked in
+        Wait still get a detached binding cycle (the wait can be seconds).
+
+        ``winners``: (qpi, pod, node_name) triples, already assumed.
+        """
         from minisched_tpu.framework.types import CycleState
 
-        self._reserve_permit_and_fork(
-            qpi, pod, node_name, CycleState(), inline=True
-        )
+        ready: List[Any] = []
+        for qpi, pod, node_name in winners:
+            state = CycleState()
+            status = self.run_reserve_plugins(state, pod, node_name)
+            if not status.is_success():
+                self.error_func(qpi, status.as_error(), plugin=status.plugin)
+                if self.on_decision:
+                    self.on_decision(pod, None, status)
+                continue
+            with self.metrics.timed("permit"):
+                status = self.run_permit_plugins(state, pod, node_name)
+            if not status.is_success() and not status.is_wait():
+                self.run_unreserve_plugins(state, pod, node_name)
+                self.error_func(qpi, status.as_error(), plugin=status.plugin)
+                if self.on_decision:
+                    self.on_decision(pod, None, status)
+                continue
+            if status.is_wait():
+                t = threading.Thread(
+                    target=self._binding_cycle,
+                    args=(qpi, pod, node_name, state),
+                    name=f"bind-{pod.metadata.name}",
+                    daemon=True,
+                )
+                with self._bind_lock:
+                    self._bind_threads.add(t)
+                t.start()
+                continue
+            ready.append((qpi, pod, node_name, state))
+        if not ready:
+            return
+
+        from minisched_tpu.api.objects import Binding
+
+        bindings = [
+            Binding(pod.metadata.name, pod.metadata.namespace, node_name)
+            for _, pod, node_name, _ in ready
+        ]
+        with self.metrics.timed("bind"):
+            results = self.client.pods().bind_many(bindings)
+        # the binds changed cluster state NOW; the informer events land on
+        # the dispatch thread later.  Record the move request synchronously
+        # so this wave's losers re-queue through backoff instead of parking
+        # past the event (the event-to-park race).
+        self.queue.note_move_request()
+        for (qpi, pod, node_name, state), res in zip(ready, results):
+            if isinstance(res, BaseException):
+                self.run_unreserve_plugins(state, pod, node_name)
+                self.error_func(qpi, res)
+                if self.on_decision:
+                    self.on_decision(pod, None, Status.from_error(res))
+            elif self.on_decision:
+                self.on_decision(pod, node_name, Status.success())
 
 
 def new_device_scheduler(

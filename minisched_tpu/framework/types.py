@@ -256,6 +256,11 @@ class QueuedPodInfo:
     attempts: int = 0
     initial_attempt_timestamp: float = field(default_factory=time.monotonic)
     unschedulable_plugins: Set[str] = field(default_factory=set)
+    #: queue scheduling-cycle number stamped at pop time (upstream
+    #: podSchedulingCycle): lets the queue detect a cluster move-request
+    #: that fired DURING this pod's attempt and route the failure to the
+    #: backoffQ instead of stranding it in the unschedulableQ
+    scheduling_cycle: int = 0
 
     @property
     def pod(self) -> Any:

@@ -76,6 +76,16 @@ class SchedulingQueue:
         self._closed = False
         # identity keys currently tracked, to drop duplicate adds
         self._queued_uids: Set[str] = set()
+        # upstream's schedulingCycle / moveRequestCycle pair: pops stamp
+        # the pod with the current cycle; cluster move requests record the
+        # cycle they fired in.  A pod whose attempt OVERLAPPED a move
+        # request (move >= its stamp) failed against state the event may
+        # have changed — it re-queues through backoff instead of parking,
+        # closing the event-to-park race that otherwise strands it until
+        # the 60s leftover flush (queue.go's unimplemented analog; upstream
+        # PriorityQueue.AddUnschedulableIfNotPresent).
+        self._scheduling_cycle = 0
+        self._move_request_cycle = -1
 
     @staticmethod
     def _uid(pod) -> str:
@@ -151,10 +161,18 @@ class SchedulingQueue:
                 bucket.discard(key)
 
     def add_unschedulable(self, qpi: QueuedPodInfo) -> None:
-        """Failed pod → unschedulableQ, stamped now (queue.go:95-107)."""
+        """Failed pod → unschedulableQ, stamped now (queue.go:95-107) —
+        unless a move request fired during its attempt, in which case it
+        goes through backoff (upstream AddUnschedulableIfNotPresent)."""
         with self._cond:
             qpi.timestamp = self._clock()
             self._queued_uids.add(self._uid(qpi.pod))
+            if self._move_request_cycle >= qpi.scheduling_cycle:
+                if self._is_backing_off(qpi):
+                    self._push_backoff(qpi)
+                else:
+                    self._push_active(qpi)
+                return
             key = self._key(qpi.pod)
             self._unindex_unschedulable(key)  # re-park refreshes interest
             self._unschedulable[key] = qpi
@@ -202,10 +220,20 @@ class SchedulingQueue:
             self._queued_uids.discard(uid)
 
     # -- event-driven requeue ---------------------------------------------
+    def note_move_request(self) -> None:
+        """Record a cluster state change as a move request WITHOUT a scan:
+        pods currently mid-attempt will re-queue through backoff on
+        failure.  The wave engine calls this synchronously after a batch
+        bind — the informer events arrive on the dispatch thread later,
+        after the wave's losers may already have parked."""
+        with self._cond:
+            self._move_request_cycle = self._scheduling_cycle
+
     def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
         """queue.go:54-82: on a cluster event, re-activate every
         unschedulable pod the event might help."""
         with self._cond:
+            self._move_request_cycle = self._scheduling_cycle
             # the interest index narrows the scan to pods whose failed
             # plugins registered for this event's resource (or wildcard);
             # event_helps_pod then applies the precise action-type match
@@ -298,6 +326,8 @@ class SchedulingQueue:
                 return None
             qpi = self._active.popleft()
             qpi.attempts += 1
+            self._scheduling_cycle += 1
+            qpi.scheduling_cycle = self._scheduling_cycle
             self._queued_uids.discard(self._uid(qpi.pod))
             return qpi
 
@@ -312,6 +342,8 @@ class SchedulingQueue:
             while self._active and len(batch) < max_pods:
                 qpi = self._active.popleft()
                 qpi.attempts += 1
+                self._scheduling_cycle += 1
+                qpi.scheduling_cycle = self._scheduling_cycle
                 self._queued_uids.discard(self._uid(qpi.pod))
                 batch.append(qpi)
         return batch

@@ -22,7 +22,13 @@ class SchedulerService:
         self._current_cfg: Optional[SchedulerConfig] = None
         self._scheduler: Optional[Scheduler] = None
         self._factory: Optional[SharedInformerFactory] = None
-        self.recorder = EventRecorder()
+        # events land in the store as real (volatile) Event objects —
+        # list/watch-able like the reference's broadcaster-written eventsv1.
+        # The RAW store: event writes are control-plane internal and must
+        # not consume (or block on) the client's API rate-limit tokens.
+        self.recorder = EventRecorder(
+            store=getattr(client.store, "_store", client.store)
+        )
         self.result_store = None  # set by start_scheduler(record_results=True)
         self._record_results = False
         self._device_mode = False
@@ -37,6 +43,8 @@ class SchedulerService:
         device_mode: bool = False,
         max_wave: int = 1024,
         device_mesh=None,
+        on_decision=None,
+        metrics=None,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
@@ -94,6 +102,12 @@ class SchedulerService:
         self._factory.start()
         if not self._factory.wait_for_cache_sync():
             raise RuntimeError("informer caches failed to sync")
+        # observability hooks must be live BEFORE the engine thread starts —
+        # installing them on the returned scheduler races the first waves
+        if on_decision is not None:
+            sched.on_decision = on_decision
+        if metrics is not None:
+            sched.metrics = metrics
         # per-decision cluster events (the reference's events broadcaster,
         # scheduler.go:55-59: upstream emits Scheduled/FailedScheduling)
         if sched.on_decision is None:
@@ -139,6 +153,8 @@ class SchedulerService:
         if self._factory is not None:
             self._factory.shutdown()
             self._factory = None
+        # a clean shutdown leaves every emitted Event visible in the store
+        self.recorder.flush()
 
     # scheduler/scheduler.go:89-91
     def get_scheduler_config(self) -> Optional[SchedulerConfig]:

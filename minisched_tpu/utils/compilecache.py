@@ -14,14 +14,42 @@ with ``MINISCHED_CACHE=0``; relocate with ``MINISCHED_CACHE_DIR``.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), ".jax_cache")
 
 
+def _machine_key() -> str:
+    """Fingerprint of the host CPU the cache entries were compiled for.
+
+    XLA:CPU serves AOT executables out of the persistent cache keyed on
+    the computation only — an artifact compiled on a host with (say)
+    AVX-512 subfeatures loads on a host without them and warns of
+    potential SIGILL.  Namespacing the cache directory by (arch, CPU
+    flags) makes cross-machine loads impossible while same-type hosts
+    still share everything.
+    """
+    flags = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass  # non-Linux: arch alone still separates the big classes
+    digest = hashlib.sha1(
+        f"{platform.machine()}|{flags}".encode()
+    ).hexdigest()[:12]
+    return f"{platform.machine()}-{digest}"
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
-    """Point JAX's persistent compilation cache at a repo-local directory.
+    """Point JAX's persistent compilation cache at a repo-local directory,
+    namespaced per host machine type (see ``_machine_key``).
 
     Idempotent (jax.config.update is repeat-safe); returns the directory in
     effect (None when disabled via ``MINISCHED_CACHE=0``).  Safe to call
@@ -31,6 +59,7 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     if os.environ.get("MINISCHED_CACHE", "1") == "0":
         return None
     cache_dir = cache_dir or os.environ.get("MINISCHED_CACHE_DIR", _DEFAULT_DIR)
+    cache_dir = os.path.join(cache_dir, _machine_key())
     import jax
 
     os.makedirs(cache_dir, exist_ok=True)

@@ -290,9 +290,12 @@ class TestCloneCompleteness:
                 k, v = typing.get_args(tp)
                 return {value_for(k, name, depth): value_for(v, name, depth)}
             if tp is int:
-                return 7
+                # distinct per-field sentinel: a clone that transposes two
+                # same-typed positional args (e.g. milli_cpu/memory) must
+                # produce an UNEQUAL object, not a lucky match
+                return 7 + sum(name.encode()) % 911
             if tp is float:
-                return 7.5
+                return 0.5 + sum(name.encode()) % 911
             if tp is bool:
                 return True
             if tp is str:

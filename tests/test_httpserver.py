@@ -192,3 +192,29 @@ def test_readme_scenario_over_http(api):
         assert http.pods().get("pod1").spec.node_name == "node10"
     finally:
         svc.shutdown_scheduler()
+
+
+def test_scheduler_events_visible_over_rest():
+    """Scheduled/FailedScheduling decisions are recorded as real Event API
+    objects (the reference's broadcaster writes eventsv1 through the API,
+    scheduler/scheduler.go:55-59) — list-able over the REST facade."""
+    from minisched_tpu.scenario.runner import ScenarioHarness, readme_scenario
+    from minisched_tpu.service.config import default_scheduler_config
+
+    with ScenarioHarness(default_scheduler_config(time_scale=0.01)) as h:
+        bound = readme_scenario(h, log=lambda *_: None)
+        assert bound == "node10"
+        h.service.recorder.flush()  # event writes are async (broadcaster)
+        server, base, shutdown = start_api_server(h.client.store, port=0)
+        try:
+            with urllib.request.urlopen(f"{base}/api/v1/events") as resp:
+                items = json.load(resp)["items"]
+        finally:
+            shutdown()
+    reasons = {e["reason"] for e in items}
+    assert "Scheduled" in reasons, reasons
+    # pod1 first failed on the 9 cordoned nodes, then bound to node10
+    assert "FailedScheduling" in reasons, reasons
+    scheduled = [e for e in items if e["reason"] == "Scheduled"]
+    assert any("node10" in e["message"] for e in scheduled)
+    assert all(e["metadata"]["namespace"] == "default" for e in scheduled)

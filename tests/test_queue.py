@@ -270,3 +270,27 @@ class TestInterestIndex:
         assert q.stats() == {"active": 1, "backoff": 0, "unschedulable": 0}
         assert not q._unsched_gvks
         assert all(not b for b in q._unsched_by_gvk.values())
+
+
+def test_move_request_during_attempt_routes_to_backoff():
+    """A cluster move request that fires while a pod is mid-attempt must
+    send its failure through backoff, not strand it in the unschedulableQ
+    until the leftover flush (upstream's moveRequestCycle semantics)."""
+    q = SchedulingQueue()
+    q.add(make_pod("racer"))
+    qpi = q.pop(timeout=1)
+    assert qpi is not None
+    # the event fires DURING the attempt (e.g. the wave's own binds)
+    q.note_move_request()
+    qpi.unschedulable_plugins = {"NodeAffinity"}
+    q.add_unschedulable(qpi)
+    stats = q.stats()
+    assert stats["unschedulable"] == 0
+    assert stats["backoff"] + stats["active"] == 1
+
+    # a SECOND failure with no overlapping move request parks normally
+    qpi2 = q.pop(timeout=2)
+    assert qpi2 is not None
+    qpi2.unschedulable_plugins = {"NodeAffinity"}
+    q.add_unschedulable(qpi2)
+    assert q.stats()["unschedulable"] == 1
