@@ -303,42 +303,6 @@ def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int):
     return rng, normal_nodes
 
 
-def _prewarm_full_roster_evaluator(pod_capacity: int, n_nodes: int) -> None:
-    """Compile (or disk-load) the full-roster repair evaluator for the
-    wave shapes config5 will use, so the measured run pays executable
-    load at most — not the 30-50s tunnel compile."""
-    import jax
-
-    from minisched_tpu.api.objects import make_node, make_pod
-    from minisched_tpu.models.constraints import build_constraint_tables
-    from minisched_tpu.models.tables import (
-        build_node_table,
-        build_pod_table,
-        pad_to,
-    )
-    from minisched_tpu.ops.repair import RepairingEvaluator
-    from minisched_tpu.plugins.registry import build_plugins
-    from minisched_tpu.service.config import default_full_roster_config
-
-    cfg = default_full_roster_config()
-    chains = build_plugins(cfg)
-    ev = RepairingEvaluator(
-        chains.filter, chains.pre_score, chains.score,
-        weights=cfg.score_weights(), with_diagnostics=True,
-    )
-    node_capacity = pad_to(n_nodes)
-    nodes = [make_node("warm0"), make_node("warm1")]
-    pods = [make_pod("warmpod", requests={"cpu": "1"})]
-    node_table, _ = build_node_table(nodes, capacity=node_capacity)
-    pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
-    extra = build_constraint_tables(
-        pods, nodes, [], pod_capacity=pod_capacity,
-        node_capacity=node_capacity, scan_planes=False,
-    )
-    out = ev(pod_table, node_table, extra)
-    jax.block_until_ready(out[1])
-
-
 def bench_config5_fullchain() -> dict:
     """The REAL config 5 (BASELINE.md:33): full default plugin roster,
     10k nodes × 100k pods, driven through the LIVE DeviceScheduler — the
@@ -370,18 +334,6 @@ def bench_config5_fullchain() -> dict:
         f"({n_nodes} nodes, {n_pods} pods incl. {n_special} initially-unschedulable)"
     )
 
-    # pre-warm the wave evaluator executable for the exact capacities the
-    # engine will use (compile/first-load of the full-roster repair graph
-    # costs ~30-50s on the tunnel; the persistent cache serves reruns) —
-    # reported separately, like the headline's compile+warmup line
-    from minisched_tpu.models.tables import pad_to
-
-    t_warm = time.monotonic()
-    _prewarm_full_roster_evaluator(
-        pod_capacity=pad_to(max(max_wave, 128)), n_nodes=n_nodes
-    )
-    log(f"[config5/full-chain] evaluator warmup: {time.monotonic()-t_warm:.1f}s")
-
     # count binds through the decision hook, installed BEFORE the engine
     # thread starts (a hook wrapped afterwards can miss early binds)
     bound_n = 0
@@ -399,11 +351,17 @@ def bench_config5_fullchain() -> dict:
 
     service = SchedulerService(client)
     metrics = CycleMetrics()
-    t0 = time.monotonic()
+    # prewarm=True: the service compiles/cache-loads the wave executable
+    # for the live shapes before the engine thread starts (~15-50s on the
+    # tunnel, reported as warmup) — the timed run then measures scheduling,
+    # not executable load
+    t_warm = time.monotonic()
     sched = service.start_scheduler(
         default_full_roster_config(), device_mode=True, max_wave=max_wave,
-        on_decision=counting_emit, metrics=metrics,
+        on_decision=counting_emit, metrics=metrics, prewarm=True,
     )
+    t0 = time.monotonic()
+    log(f"[config5/full-chain] engine warmup+start: {t0-t_warm:.1f}s")
 
     def wait_until(pred, timeout, what):
         deadline = time.monotonic() + timeout

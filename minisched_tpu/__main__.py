@@ -68,7 +68,7 @@ def start(cfg: ProcessConfig, device_mode: bool = False, mesh_devices: int = 0):
     )
 
     def stop() -> None:
-        service.shutdown_scheduler()
+        service.close()
         pv.stop()
         shutdown_api()
         if hasattr(raw, "close"):

@@ -240,11 +240,12 @@ class EventRecorder:
     def __init__(self, store: Any = None, max_events: int = 2048) -> None:
         from collections import deque
 
-        self.events: Any = deque(maxlen=max_events)
+        self._events: Any = deque(maxlen=max_events)
         self._store = store
         self._max_events = max_events
         self._seq = 0
         self._mu = threading.Lock()
+        self._writer = None
         if store is not None:
             import queue as _queue
 
@@ -255,17 +256,27 @@ class EventRecorder:
             )
             self._writer.start()
 
+    @property
+    def events(self) -> list:
+        """Snapshot of the in-process event dicts.  A list COPY under the
+        lock: the engine thread appends while observers iterate, and at
+        maxlen every deque append also pops the left end — iterating the
+        live deque raises 'deque mutated during iteration'."""
+        with self._mu:
+            return list(self._events)
+
     def eventf(self, obj: Any, event_type: str, reason: str, message: str) -> None:
         meta = getattr(obj, "metadata", None)
         regarding = getattr(meta, "key", "") if meta is not None else ""
-        self.events.append(
-            {
-                "object": regarding or str(obj),
-                "type": event_type,
-                "reason": reason,
-                "message": message,
-            }
-        )
+        with self._mu:
+            self._events.append(
+                {
+                    "object": regarding or str(obj),
+                    "type": event_type,
+                    "reason": reason,
+                    "message": message,
+                }
+            )
         if self._store is None:
             return
         from minisched_tpu.api.objects import Event, ObjectMeta
@@ -293,6 +304,9 @@ class EventRecorder:
     def _drain(self) -> None:
         while True:
             evt = self._q.get()
+            if evt is None:  # close() sentinel
+                self._q.task_done()
+                return
             try:
                 self._store.create(KIND_EVENT, evt)
                 ns, name = evt.metadata.namespace, evt.metadata.name
@@ -317,3 +331,15 @@ class EventRecorder:
             if time.monotonic() > deadline:
                 return
             time.sleep(0.01)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain and terminate the writer thread.  Idempotent; eventf
+        after close still records the in-process dict but its store write
+        is silently dropped (the writer is gone) — callers close only on
+        service teardown."""
+        if self._writer is None:
+            return
+        self.flush(timeout)
+        self._q.put(None)
+        self._writer.join(timeout=timeout)
+        self._writer = None

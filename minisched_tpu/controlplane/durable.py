@@ -73,9 +73,11 @@ class DurableObjectStore(ObjectStore):
 
     def mutate_many(self, kind: str, items) -> list:
         """Batch read-modify-write with ONE log flush: every record is
-        written (durability order preserved — same lock, same order), but
-        the flush/fsync is paid once per batch instead of per bind."""
+        written (durability order preserved — same lock, same order via
+        the _on_batch_commit hook), but the flush/fsync is paid once per
+        batch instead of per bind."""
         with self._lock:
+            self._check_open()
             self._defer_flush = True
             try:
                 return super().mutate_many(kind, items)
@@ -85,6 +87,12 @@ class DurableObjectStore(ObjectStore):
                     self._log.flush()
                     if self._fsync:
                         os.fsync(self._log.fileno())
+
+    def _on_batch_commit(self, kind: str, obj: Any) -> None:
+        # the inlined batch path commits without calling update() — log
+        # each stored object here, inside the same lock hold and order
+        if self._loggable(kind):
+            self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:

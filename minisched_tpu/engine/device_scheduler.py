@@ -167,6 +167,50 @@ class DeviceScheduler(Scheduler):
     #: it sees chunk k's binds (sequential semantics across chunks)
     SCAN_MIN_CAP = 128
     SCAN_MAX_CHUNK = 1024
+    #: cap on PostFilter (preemption) passes per wave — each is
+    #: O(nodes × pods) host work (see _handle_wave_losers)
+    MAX_PREEMPT_PER_WAVE = 256
+
+    def prewarm(self) -> None:
+        """Compile (or cache-load) the wave evaluator executable for the
+        shapes this engine will use, before the run loop starts.  The
+        full-roster repair graph costs 30-50s to compile (~15s to load
+        from the persistent cache over the tunnel); paying that inside the
+        first wave stalls the whole first drain.  Called by the service
+        when ``prewarm=True`` — between informer sync and run().
+
+        Shapes must match the live waves exactly or the warm executable is
+        wasted: pod capacity is the wave capacity (_build_and_evaluate
+        pads to max_wave), node capacity is pad_to(current node count).
+        A throwaway table builder keeps the real one's static-column cache
+        out of it.
+        """
+        import jax
+
+        from minisched_tpu.api.objects import make_node, make_pod
+        from minisched_tpu.framework.nodeinfo import build_node_infos
+
+        # count via the (already-synced) informer cache — store.list would
+        # deep-clone every Node object just to take len()
+        n_nodes = len(self.informer_factory.informer_for("Node").lister())
+        node_capacity = pad_to(max(n_nodes, 2))
+        pod_capacity = pad_to(max(self.max_wave, 128))
+        nodes = [make_node("warm0"), make_node("warm1")]
+        pods = [make_pod("warmpod", requests={"cpu": "1"})]
+        infos = build_node_infos(nodes, [])
+        node_table, _ = CachedNodeTableBuilder().build(
+            infos, capacity=node_capacity
+        )
+        pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
+        extra = None
+        if self._needs_extra:
+            extra = build_constraint_tables(
+                pods, nodes, [],
+                pod_capacity=pod_capacity, node_capacity=node_capacity,
+                scan_planes=False,
+            )
+        out = self._get_evaluator()(pod_table, node_table, extra)
+        jax.block_until_ready(out[1])
 
     def _get_scan_scheduler(self):
         if self._scan_scheduler is None:
@@ -274,7 +318,8 @@ class DeviceScheduler(Scheduler):
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
         t_wave = time.monotonic()
         self.metrics.observe("wave_size", float(len(qpis)))
-        node_infos = self.snapshot_nodes()
+        with self.metrics.timed("wave_snapshot"):
+            node_infos = self.snapshot_nodes()
         if not node_infos:
             for qpi in qpis:
                 self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
@@ -302,8 +347,9 @@ class DeviceScheduler(Scheduler):
             qpis = plain
             node_infos = self.snapshot_nodes()
 
-        nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
-        assigned = [p for ni in node_infos for p in ni.pods]
+        with self.metrics.timed("wave_assigned_list"):
+            nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
+            assigned = [p for ni in node_infos for p in ni.pods]
 
         def build_and_evaluate(qpis_):
             with self.metrics.timed("wave_evaluate"):
@@ -336,28 +382,31 @@ class DeviceScheduler(Scheduler):
         import jax
 
         pods_ = [qpi.pod for qpi in qpis_]
-        node_table, node_names = self._table_builder.build(node_infos)
-        pod_table, _ = build_pod_table(
-            pods_, capacity=pad_to(max(len(pods_), self.max_wave))
-        )
+        with self.metrics.timed("wave_build_tables"):
+            node_table, node_names = self._table_builder.build(node_infos)
+            pod_table, _ = build_pod_table(
+                pods_, capacity=pad_to(max(len(pods_), self.max_wave))
+            )
         extra = None
         if self._needs_extra:
-            extra = build_constraint_tables(
-                pods_, nodes, assigned,
-                pod_capacity=pod_table.capacity,
-                node_capacity=node_table.capacity,
-                pvcs=self.client.store.list("PersistentVolumeClaim"),
-                pvs=self.client.store.list("PersistentVolume"),
-                scan_planes=False,  # wave mode never runs the scan
-            )
+            with self.metrics.timed("wave_build_constraints"):
+                extra = build_constraint_tables(
+                    pods_, nodes, assigned,
+                    pod_capacity=pod_table.capacity,
+                    node_capacity=node_table.capacity,
+                    pvcs=self.client.store.list("PersistentVolumeClaim"),
+                    pvs=self.client.store.list("PersistentVolume"),
+                    scan_planes=False,  # wave mode never runs the scan
+                )
         if self.result_store is not None:
             self._record_wave(pods_, pod_table, node_table, node_names, extra)
-        _, choice, _, unsched = self._get_evaluator()(
-            pod_table, node_table, extra
-        )
-        # ONE host fetch for both results (each device_get is a tunnel
-        # round-trip); bool[K, P] → per-pod failing-plugin sets
-        choice, unsched = jax.device_get((choice, unsched))
+        with self.metrics.timed("wave_device"):
+            _, choice, _, unsched = self._get_evaluator()(
+                pod_table, node_table, extra
+            )
+            # ONE host fetch for both results (each device_get is a tunnel
+            # round-trip); bool[K, P] → per-pod failing-plugin sets
+            choice, unsched = jax.device_get((choice, unsched))
         unsched = unsched.tolist()
         plugin_names = [p.name() for p in self.filter_plugins]
         fail_sets = [
@@ -412,12 +461,47 @@ class DeviceScheduler(Scheduler):
         ]
         if not eligible:
             return
+        # victim-availability gate: preemption can only evict pods with
+        # priority BELOW the loser's, so a loser at or under the cluster's
+        # lowest assigned priority has zero possible victims — running
+        # DefaultPreemption for it would walk every node's pod list for
+        # nothing.  A replay wave can strand thousands of equal-priority
+        # losers at once (config5: ~2k losers × 10k nodes × ~10 pods each
+        # ground the engine for minutes finding no victims); the floor
+        # check skips the whole pass in O(assigned).
+        prio_floor = None
+        for ni in node_infos:
+            for p in ni.pods:
+                if prio_floor is None or p.spec.priority < prio_floor:
+                    prio_floor = p.spec.priority
+        with self._assumed_lock:
+            for a in self._assumed.values():
+                if prio_floor is None or a.spec.priority < prio_floor:
+                    prio_floor = a.spec.priority
+        eligible = [
+            (qpi, pod)
+            for qpi, pod in eligible
+            if prio_floor is not None and pod.spec.priority > prio_floor
+        ]
+        if not eligible:
+            return
         # ONE full merged snapshot (informer state + this wave's assumed
         # winners); per-loser deltas (evictions, phantoms) are applied
         # incrementally to just the touched NodeInfos
         self.metrics.observe("wave_preempt_eligible", float(len(eligible)))
         base = self._merged_infos(node_infos)
         by_name = {ni.name: ni for ni in base}
+        # a wave processes at most MAX_PREEMPT_PER_WAVE losers through the
+        # PostFilter chain (each pass is O(nodes × pods) host work; upstream
+        # runs preemption once per scheduling cycle, so its throughput is
+        # naturally bounded — an 8k-pod wave's losers are not).  Budget
+        # goes to the HIGHEST-priority losers (stable within a class), so
+        # truncation can never starve a high-priority pod behind a crowd
+        # of lower ones; the skipped rest are already parked and retry.
+        if len(eligible) > self.MAX_PREEMPT_PER_WAVE:
+            eligible = sorted(
+                eligible, key=lambda e: -e[1].spec.priority
+            )[: self.MAX_PREEMPT_PER_WAVE]
         for qpi, pod in eligible:
             nominated = self.run_post_filter(
                 CycleState(), pod, base, diagnoses[pod.metadata.uid]
@@ -539,6 +623,14 @@ class DeviceScheduler(Scheduler):
         from minisched_tpu.framework.types import CycleState
 
         ready: List[Any] = []
+        if not self.reserve_plugins and not self.permit_plugins:
+            # both chains empty (the default full roster): nothing to run
+            # per pod — go straight to the batched bind.  One shared
+            # CycleState is safe: it is only consulted by unreserve on a
+            # failed bind, and there is nothing to unreserve.
+            state = CycleState()
+            ready = [(qpi, pod, node_name, state) for qpi, pod, node_name in winners]
+            winners = []
         for qpi, pod, node_name in winners:
             state = CycleState()
             status = self.run_reserve_plugins(state, pod, node_name)

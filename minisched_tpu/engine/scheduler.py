@@ -545,6 +545,11 @@ class Scheduler:
         node arms a zero-delay timer, nodenumber.go:112) cannot lose the
         signal — the race the reference has (see waitingpod.py docstring).
         """
+        if not self.permit_plugins:
+            # empty chain: nothing could ever Allow/Reject — skip the
+            # WaitingPod registration (per-pod lock + allocation; a wave
+            # commits thousands)
+            return Status.success()
         wp = WaitingPod(pod)
         with self._waiting_lock:
             self._waiting_pods[pod.metadata.uid] = wp

@@ -43,7 +43,7 @@ class ScenarioHarness:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.service.shutdown_scheduler()
+        self.service.close()
         self.pv_controller.stop()
 
     # condition-based wait (replaces sched.go's time.Sleep)

@@ -45,6 +45,7 @@ class SchedulerService:
         device_mesh=None,
         on_decision=None,
         metrics=None,
+        prewarm: bool = False,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
@@ -124,6 +125,10 @@ class SchedulerService:
                     )
 
             sched.on_decision = emit
+        if prewarm and device_mode:
+            # compile/load the wave executable for the live shapes BEFORE
+            # the engine thread starts — otherwise the first wave pays it
+            sched.prewarm()
         sched.run()
         self._scheduler = sched
         self._current_cfg = orig_cfg
@@ -155,6 +160,13 @@ class SchedulerService:
             self._factory = None
         # a clean shutdown leaves every emitted Event visible in the store
         self.recorder.flush()
+
+    def close(self) -> None:
+        """Full teardown: shutdown plus the recorder's writer thread —
+        call when the SERVICE is done for good (restart_scheduler keeps
+        working after shutdown_scheduler alone; not after close)."""
+        self.shutdown_scheduler()
+        self.recorder.close()
 
     # scheduler/scheduler.go:89-91
     def get_scheduler_config(self) -> Optional[SchedulerConfig]:
