@@ -157,12 +157,15 @@ class _PodAPI:
             raise res
         return res
 
-    def bind_many(self, bindings: List[Binding]) -> List[Any]:
+    def bind_many(
+        self, bindings: List[Binding], return_objects: bool = True
+    ) -> List[Any]:
         """Batch form of the binding subresource: a wave's placements in
         one store transaction (the reference binds one pod per cycle,
         minisched.go:267-273 — a TPU wave commits thousands).  Returns a
-        list aligned with ``bindings``: the bound Pod, or the exception
-        (AlreadyBound, missing-pod KeyError) for that entry."""
+        list aligned with ``bindings``: the bound Pod (None with
+        ``return_objects=False`` — skips a clone per bind), or the
+        exception (AlreadyBound, missing-pod KeyError) for that entry."""
 
         def apply_for(binding: Binding):
             def apply(pod: Pod) -> Pod:
@@ -183,6 +186,7 @@ class _PodAPI:
                 (b.pod_namespace, b.pod_name, apply_for(b))
                 for b in bindings
             ],
+            return_objects=return_objects,
         )
 
 

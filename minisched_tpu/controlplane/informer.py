@@ -54,15 +54,20 @@ class Informer:
     def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
         with self._lock:
             self._handlers.append(handlers)
-            if self._synced.is_set():
-                # client-go replays the cache as adds to late registrants;
-                # the dispatch thread delivers (see _drain_replays)
-                replay = [
-                    WatchEvent(EventType.ADDED, obj)
-                    for obj in self._cache.values()
-                ]
-                if replay:
-                    self._pending_replays.append((handlers, replay))
+            # client-go replays the cache as adds to late registrants; the
+            # dispatch thread delivers (see _drain_replays).  Replay is
+            # keyed on CACHE content, not on the synced flag: a handler
+            # registered mid-sync (the informer already dispatched k of N
+            # snapshot events with no handlers attached) must still see
+            # those k objects.  It may then see a duplicate ADD for an
+            # object whose live event also arrives — every consumer
+            # (queue, caches, index) dedupes ADDs by uid.
+            replay = [
+                WatchEvent(EventType.ADDED, obj)
+                for obj in self._cache.values()
+            ]
+            if replay:
+                self._pending_replays.append((handlers, replay))
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -160,13 +165,19 @@ class SharedInformerFactory:
     def __init__(self, store: ObjectStore):
         self._store = store
         self._informers: Dict[str, Informer] = {}
+        self._started = False
 
     def informer_for(self, kind: str) -> Informer:
         if kind not in self._informers:
             self._informers[kind] = Informer(self._store, kind)
+            if self._started:
+                # factory already running: the late informer joins live
+                # (its watch replays the current snapshot, so it syncs)
+                self._informers[kind].start()
         return self._informers[kind]
 
     def start(self) -> None:
+        self._started = True
         for inf in self._informers.values():
             inf.start()
 

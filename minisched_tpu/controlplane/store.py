@@ -190,7 +190,10 @@ class ObjectStore:
             return self.update(kind, updated)
 
     def mutate_many(
-        self, kind: str, items: List[Tuple[str, str, Callable[[Any], Any]]]
+        self,
+        kind: str,
+        items: List[Tuple[str, str, Callable[[Any], Any]]],
+        return_objects: bool = True,
     ) -> List[Any]:
         """Apply many read-modify-writes under ONE lock hold — the wave
         engine's batch bind (a wave commits thousands of placements; a
@@ -226,7 +229,7 @@ class ObjectStore:
                     work.metadata.resource_version = self._bump()
                     objs[key] = work
                     self._on_batch_commit(kind, work)
-                    out.append(work.clone())
+                    out.append(work.clone() if return_objects else None)
                     self._fanout(
                         kind, WatchEvent(EventType.MODIFIED, work.clone(), old)
                     )

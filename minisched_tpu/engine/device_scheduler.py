@@ -66,10 +66,6 @@ class DeviceScheduler(Scheduler):
         #: data-parallel, node columns model-parallel, XLA collectives
         #: over ICI.  None = single-device.
         self.mesh = mesh
-        self._needs_extra = any(
-            getattr(p, "needs_extra", False)
-            for p in (*self.filter_plugins, *self.score_plugins)
-        )
         # chains with a combo-carrying (cross-pod) plugin route constrained
         # pods through the sequential scan; volume-only chains never do —
         # nothing in them evaluates spread/affinity constraints.  Unknown
@@ -85,8 +81,9 @@ class DeviceScheduler(Scheduler):
         self._scan_scheduler: Any = None  # lazy SequentialScheduler
         # static node columns cached across waves, keyed on each node's
         # (name, resource_version) — only the assigned-pod aggregates are
-        # re-encoded per wave
-        self._table_builder = CachedNodeTableBuilder()
+        # re-encoded per wave.  Device-resident statics only off-mesh:
+        # the sharded steps donate the node table (see the builder)
+        self._table_builder = CachedNodeTableBuilder(device_static=mesh is None)
         #: observability.resultstore.Store — set by the service when
         #: record_results is on: each wave then also runs a diagnostics
         #: evaluation and records the same per-plugin artifact scalar
@@ -100,6 +97,51 @@ class DeviceScheduler(Scheduler):
         # stale state and can double-book the capacity wave N just used
         self._assumed: dict = {}  # uid → pod clone with node_name set
         self._assumed_lock = threading.Lock()
+
+    def _wire_pre_cache(self, informer_factory: Any) -> None:
+        """Create + wire the incremental constraint index when the chains
+        read cross-pod/volume planes.  Registered BEFORE the NodeInfo
+        cache (see Scheduler.__init__): the assume-cache prunes against
+        the cache, so an index that lagged it could drop a just-confirmed
+        bind from the planes for one wave; index-ahead is harmless (the
+        assumed fold checks index membership first)."""
+        self._needs_extra = any(
+            getattr(p, "needs_extra", False)
+            for p in (*self.filter_plugins, *self.score_plugins)
+        )
+        self.constraint_index = None
+        if self._needs_extra:
+            from minisched_tpu.models.constraint_index import ConstraintIndex
+
+            self.constraint_index = ConstraintIndex()
+            self.constraint_index.wire(informer_factory)
+
+    def _build_constraints(self, pods_, nodes, assigned, **kw) -> Any:
+        """Constraint tables for one wave/chunk.  With a live index the
+        assumed-pod membership check and the aggregate reads happen under
+        ONE index lock hold — otherwise a bind event landing in between
+        would count its pod both as "assumed" and in the index planes
+        (TOCTOU double-count)."""
+        import contextlib
+
+        index = self.constraint_index
+        with index.lock() if index is not None else contextlib.nullcontext():
+            extra: Any = ()
+            if index is not None:
+                uids = index.assigned_uids()
+                with self._assumed_lock:
+                    extra = [
+                        a for uid, a in self._assumed.items()
+                        if uid not in uids
+                    ]
+            return build_constraint_tables(
+                pods_, nodes, assigned,
+                pvcs=self.client.store.list("PersistentVolumeClaim"),
+                pvs=self.client.store.list("PersistentVolume"),
+                index=index,
+                extra_assigned=extra,
+                **kw,
+            )
 
     # -- assume-pod cache ---------------------------------------------------
     def _assume(self, pod: Pod, node_name: str) -> None:
@@ -260,19 +302,21 @@ class DeviceScheduler(Scheduler):
             if start > 0:
                 node_infos = self.snapshot_nodes()
             nodes = [ni.node for ni in node_infos]
-            assigned = [p for ni in node_infos for p in ni.pods]
+            assigned = (
+                ()
+                if self.constraint_index is not None
+                else [p for ni in node_infos for p in ni.pods]
+            )
             cap = max(self.SCAN_MIN_CAP, 1 << (len(part) - 1).bit_length())
 
             def build_and_scan(part_):
                 pods_ = [qpi.pod for qpi in part_]
                 node_table, node_names = self._table_builder.build(node_infos)
                 pod_table, _ = build_pod_table(pods_, capacity=cap)
-                extra = build_constraint_tables(
+                extra = self._build_constraints(
                     pods_, nodes, assigned,
                     pod_capacity=cap,
                     node_capacity=node_table.capacity,
-                    pvcs=self.client.store.list("PersistentVolumeClaim"),
-                    pvs=self.client.store.list("PersistentVolume"),
                     scan_planes=True,  # the scan's commit updates need it
                 )
                 if self.result_store is not None:
@@ -349,7 +393,12 @@ class DeviceScheduler(Scheduler):
 
         with self.metrics.timed("wave_assigned_list"):
             nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
-            assigned = [p for ni in node_infos for p in ni.pods]
+            # with a live index the build never walks the population
+            assigned = (
+                ()
+                if self.constraint_index is not None
+                else [p for ni in node_infos for p in ni.pods]
+            )
 
         def build_and_evaluate(qpis_):
             with self.metrics.timed("wave_evaluate"):
@@ -390,12 +439,10 @@ class DeviceScheduler(Scheduler):
         extra = None
         if self._needs_extra:
             with self.metrics.timed("wave_build_constraints"):
-                extra = build_constraint_tables(
+                extra = self._build_constraints(
                     pods_, nodes, assigned,
                     pod_capacity=pod_table.capacity,
                     node_capacity=node_table.capacity,
-                    pvcs=self.client.store.list("PersistentVolumeClaim"),
-                    pvs=self.client.store.list("PersistentVolume"),
                     scan_planes=False,  # wave mode never runs the scan
                 )
         if self.result_store is not None:
@@ -661,7 +708,15 @@ class DeviceScheduler(Scheduler):
             ready.append((qpi, pod, node_name, state))
         if not ready:
             return
+        # the batch bind runs ON the engine thread: a worker-thread
+        # pipeline was tried and regressed ~40% — the bind is pure-Python
+        # host work, so overlapping it with the next wave's (also
+        # Python) snapshot/build just thrashes the GIL.  The informer
+        # dispatch of its events naturally overlaps the next wave's
+        # GIL-free device call instead.
+        self._bind_batch(ready)
 
+    def _bind_batch(self, ready: List[Any]) -> None:
         from minisched_tpu.api.objects import Binding
 
         bindings = [
@@ -669,11 +724,16 @@ class DeviceScheduler(Scheduler):
             for _, pod, node_name, _ in ready
         ]
         with self.metrics.timed("bind"):
-            results = self.client.pods().bind_many(bindings)
+            # return_objects=False: the engine only inspects failures —
+            # cloning 8k bound pods back to a caller that drops them was
+            # a third of the bind's copy cost
+            results = self.client.pods().bind_many(
+                bindings, return_objects=False
+            )
         # the binds changed cluster state NOW; the informer events land on
-        # the dispatch thread later.  Record the move request synchronously
-        # so this wave's losers re-queue through backoff instead of parking
-        # past the event (the event-to-park race).
+        # the dispatch thread later.  Record the move request so losers
+        # whose attempts overlapped the commit re-queue through backoff
+        # instead of parking past the event (the event-to-park race).
         self.queue.note_move_request()
         for (qpi, pod, node_name, state), res in zip(ready, results):
             if isinstance(res, BaseException):

@@ -320,12 +320,20 @@ class Scheduler:
         # registration order = invocation order)
         from minisched_tpu.engine.cache import SchedulerCache
 
+        # engine-specific handlers that must register before the cache's
+        # (the device engine's ConstraintIndex: the assume-cache is pruned
+        # against the cache, so the index may never lag it)
+        self._wire_pre_cache(informer_factory)
         self.cache = SchedulerCache()
         self.cache.wire(informer_factory)
 
         eventhandlers.add_all_event_handlers(
             self, informer_factory, unioned_gvks(self.event_map)
         )
+
+    def _wire_pre_cache(self, informer_factory: Any) -> None:
+        """Hook for subclasses that need informer handlers registered
+        BEFORE the NodeInfo cache's (see __init__)."""
 
     # ------------------------------------------------------------------
     # lifecycle (minisched.go:28-30)

@@ -47,6 +47,7 @@ class NodeInfo:
         "req_eph_mib",
         "nzreq_mem_mib",
         "used_ports",
+        "_cow",
     )
 
     def __init__(self, node: Optional[Node] = None):
@@ -61,12 +62,24 @@ class NodeInfo:
         #: (the NodeTable used_port encoding reads this directly instead of
         #: re-walking every pod's containers per wave)
         self.used_ports: List[int] = []
+        #: copy-on-write: clone() shares the mutable state and flags BOTH
+        #: sides; the first mutation on either materializes private copies
+        self._cow = False
 
     @property
     def name(self) -> str:
         return self.node.metadata.name if self.node else ""
 
+    def _materialize(self) -> None:
+        if self._cow:
+            self.pods = list(self.pods)
+            self.used_ports = list(self.used_ports)
+            self.requested = self.requested.clone()
+            self.non_zero_requested = self.non_zero_requested.clone()
+            self._cow = False
+
     def add_pod(self, pod: Pod) -> None:
+        self._materialize()
         self.pods.append(pod)
         req = pod.resource_requests()
         self.requested.add(req)
@@ -81,6 +94,7 @@ class NodeInfo:
                 self.used_ports.extend(c.ports)
 
     def remove_pod(self, pod: Pod) -> None:
+        self._materialize()
         for i, p in enumerate(self.pods):
             if p.metadata.uid == pod.metadata.uid:
                 del self.pods[i]
@@ -100,14 +114,21 @@ class NodeInfo:
                 return
 
     def clone(self) -> "NodeInfo":
+        """O(1) copy-on-write clone.  Both sides keep reading the shared
+        pods/ports/request state; whichever mutates first (via
+        add_pod/remove_pod) materializes its own copies.  A 10k-node
+        snapshot clone was ~200ms per wave of list/ResourceList copying
+        for nodes that mostly don't change; now only touched nodes pay."""
+        self._cow = True
         ni = NodeInfo(self.node)
-        ni.pods = list(self.pods)
-        ni.requested = self.requested.clone()
-        ni.non_zero_requested = self.non_zero_requested.clone()
+        ni.pods = self.pods
+        ni.requested = self.requested
+        ni.non_zero_requested = self.non_zero_requested
         ni.req_mem_mib = self.req_mem_mib
         ni.req_eph_mib = self.req_eph_mib
         ni.nzreq_mem_mib = self.nzreq_mem_mib
-        ni.used_ports = list(self.used_ports)
+        ni.used_ports = self.used_ports
+        ni._cow = True
         return ni
 
 

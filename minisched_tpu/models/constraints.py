@@ -282,6 +282,8 @@ def build_constraint_tables(
     pvcs: Sequence[Any] = (),
     pvs: Sequence[Any] = (),
     scan_planes: bool = True,
+    index: Any = None,
+    extra_assigned: Sequence[Any] = (),
 ) -> ConstraintTables:
     """Build the wave's coupling tables.
 
@@ -295,11 +297,25 @@ def build_constraint_tables(
     default — all-False would silently break scan parity — wave-only
     callers (DeviceScheduler, bench wave paths) pass False to skip the
     host-side matching cost.
+
+    ``index``: a ``constraint_index.ConstraintIndex`` — the assigned-pod
+    planes then come from its event-maintained aggregates in
+    O(nonzero + planes) instead of walking ``assigned_pods`` (pass ``()``).
+    ``extra_assigned``: assigned pods the index hasn't seen yet (the
+    engine's still-assumed binds), folded through the same per-pod logic
+    the from-scratch walk uses.
     """
     P = pod_capacity or pad_to(len(pending_pods))
     N = node_capacity or pad_to(len(nodes))
     node_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
     assigned = [p for p in assigned_pods if p.spec.node_name in node_idx]
+    if index is not None:
+        # the fold below re-applies the from-scratch per-pod logic to just
+        # these; pods on nodes outside this wave's view are skipped the
+        # same way the assigned filter above skips them
+        extra_assigned = [
+            p for p in extra_assigned if p.spec.node_name in node_idx
+        ]
 
     reg = _ComboRegistry()
     pod_rows: List[Dict[str, List]] = []
@@ -374,15 +390,36 @@ def build_constraint_tables(
             pod_matches_combo[: len(pending_pods), cid] = match_cache[mkey]
     for cid, (nss, sel, topo) in enumerate(reg.combos):
         combo_key[cid] = key_ids[topo]
-        matching = [p for p in assigned if _matches(sel, nss, p)]
-        combo_global[cid] = len(matching)
         domain_count: Dict[str, int] = {}
-        for p in matching:
-            i = node_idx[p.spec.node_name]
-            combo_here[cid, i] += 1
-            val = nodes[i].metadata.labels.get(topo)
-            if val is not None:
-                domain_count[val] = domain_count.get(val, 0) + 1
+        if index is not None:
+            # O(nonzero): per-node counts from the index, assumed pods
+            # folded through the same matcher; domain sums derive from the
+            # CURRENT node labels so label churn self-heals
+            here = index.combo_aggregate(nss, sel, topo)
+            for p in extra_assigned:
+                if _matches(sel, nss, p):
+                    node = p.spec.node_name
+                    here[node] = here.get(node, 0) + 1
+            total = 0
+            for node_name, cnt in here.items():
+                i = node_idx.get(node_name)
+                if i is None:
+                    continue  # node outside this wave's view
+                total += cnt
+                combo_here[cid, i] = cnt
+                val = nodes[i].metadata.labels.get(topo)
+                if val is not None:
+                    domain_count[val] = domain_count.get(val, 0) + cnt
+            combo_global[cid] = total
+        else:
+            matching = [p for p in assigned if _matches(sel, nss, p)]
+            combo_global[cid] = len(matching)
+            for p in matching:
+                i = node_idx[p.spec.node_name]
+                combo_here[cid, i] += 1
+                val = nodes[i].metadata.labels.get(topo)
+                if val is not None:
+                    domain_count[val] = domain_count.get(val, 0) + 1
         for i, node in enumerate(nodes):
             val = node.metadata.labels.get(topo)
             if val is not None:
@@ -393,10 +430,11 @@ def build_constraint_tables(
     # and one topology domain collapse to a single row) --------------------
     ex_ids: Dict[Tuple, int] = {}
     ex_terms: List[Tuple[Tuple[str, ...], LabelSelector, str, str]] = []
-    for p in assigned:
+
+    def _add_ex_terms_of(p: Any) -> None:
         aff = p.spec.affinity
         if aff is None or aff.pod_anti_affinity is None:
-            continue
+            return
         for term in aff.pod_anti_affinity.required:
             owner_val = nodes[node_idx[p.spec.node_name]].metadata.labels.get(
                 term.topology_key
@@ -411,6 +449,19 @@ def build_constraint_tables(
                 ex_terms.append(
                     (nss, term.label_selector, term.topology_key, owner_val)
                 )
+
+    if index is not None:
+        for key, sel_obj, owner_nodes in index.ex_term_list():
+            if key in ex_ids or not any(n in node_idx for n in owner_nodes):
+                continue
+            nss_k, _sig, topo_k, owner_val = key
+            ex_ids[key] = len(ex_terms)
+            ex_terms.append((nss_k, sel_obj, topo_k, owner_val))
+        for p in extra_assigned:
+            _add_ex_terms_of(p)
+    else:
+        for p in assigned:
+            _add_ex_terms_of(p)
     T = pad_to(max(len(ex_terms), 1), 8)
     ex_domain = np.zeros((T, N), bool)
     pod_matches_ex = np.zeros((P, T), bool)
@@ -432,12 +483,14 @@ def build_constraint_tables(
     pvc_by_key = {pvc.metadata.key: pvc for pvc in pvcs}
     pv_by_name = {pv.metadata.name: pv for pv in pvs}
     # claims mounted by assigned pods, grouped per node (restriction and
-    # family counting both walk these)
+    # family counting both walk these) — skipped on the index path, which
+    # supplies the equivalent per-node aggregates below
     node_claims: List[List[Any]] = [[] for _ in range(len(nodes))]
-    for p in assigned:
-        for vol in p.spec.volumes:
-            opvc = pvc_by_key.get(f"{p.metadata.namespace}/{vol}")
-            node_claims[node_idx[p.spec.node_name]].append(opvc)
+    if index is None:
+        for p in assigned:
+            for vol in p.spec.volumes:
+                opvc = pvc_by_key.get(f"{p.metadata.namespace}/{vol}")
+                node_claims[node_idx[p.spec.node_name]].append(opvc)
 
     # counting key of a claim: its bound PV, else the claim itself —
     # upstream's attach limits count unique VOLUMES, so claims sharing a
@@ -520,22 +573,58 @@ def build_constraint_tables(
     vol_any = np.zeros((Vd, N), bool)
     vol_rw = np.zeros((Vd, N), bool)
     node_vols_fam = np.zeros((F, N), np.int32)
-    for n, claims in enumerate(node_claims):
-        seen_node: set = set()
-        for opvc in claims:
-            if opvc is None:
-                # no identity: each unresolvable mount counts by itself
-                node_vols_fam[0, n] += 1
-                continue
-            ck = count_key(opvc)
-            if ck not in seen_node:  # distinct volumes per node
-                seen_node.add(ck)
-                node_vols_fam[volume_family(opvc, pv_by_name), n] += 1
-            v = vol_ids.get(ck)
-            if v is not None:
-                vol_any[v, n] = True
+    if index is not None:
+        # O(nonzero): the index's per-node volume state, assumed pods
+        # folded through the wave's own PVC/PV view
+        nvs = index.node_vol_state()
+        for p in extra_assigned:
+            nv = nvs.setdefault(p.spec.node_name, {})
+            for j, vol in enumerate(p.spec.volumes):
+                opvc = pvc_by_key.get(f"{p.metadata.namespace}/{vol}")
+                if opvc is None:
+                    ent = nv.setdefault(
+                        ("miss", p.metadata.uid, j),
+                        [0, 0, volume_family(None, pv_by_name)],
+                    )
+                    ent[0] += 1
+                    continue
+                ck = count_key(opvc)
+                fam = volume_family(opvc, pv_by_name)
+                ent = nv.setdefault(ck, [0, 0, fam])
+                ent[0] += 1
+                ent[2] = fam
                 if opvc.spec.volume_name and not opvc.spec.read_only:
-                    vol_rw[v, n] = True
+                    ent[1] += 1
+        for node_name, entries in nvs.items():
+            n = node_idx.get(node_name)
+            if n is None:
+                continue
+            for vk, (mounts, rw_mounts, fam) in entries.items():
+                if mounts <= 0:
+                    continue
+                node_vols_fam[fam, n] += 1  # distinct volumes per node
+                v = vol_ids.get(vk)
+                if v is not None:
+                    vol_any[v, n] = True
+                    if rw_mounts > 0:
+                        vol_rw[v, n] = True
+    else:
+        for n, claims in enumerate(node_claims):
+            seen_node: set = set()
+            for opvc in claims:
+                if opvc is None:
+                    # no identity: each unresolvable mount counts by itself
+                    node_vols_fam[0, n] += 1
+                    continue
+                ck = count_key(opvc)
+                if ck not in seen_node:  # distinct volumes per node
+                    seen_node.add(ck)
+                    node_vols_fam[volume_family(opvc, pv_by_name), n] += 1
+                v = vol_ids.get(ck)
+                if v is not None:
+                    vol_any[v, n] = True
+                    if opvc.spec.volume_name and not opvc.spec.read_only:
+                        vol_rw[v, n] = True
 
     # --- per-pod constraint arrays ----------------------------------------
     ts_combo = np.zeros((P, MAX_TSC), np.int32)

@@ -516,9 +516,17 @@ class CachedNodeTableBuilder:
     NodeInfos' incrementally-maintained sums.
     """
 
-    def __init__(self):
+    def __init__(self, device_static: bool = True):
         self._sig = None
         self._static: Dict[str, Any] = {}
+        self._static_dev: Dict[str, Any] = {}
+        #: keep the static columns device-resident between builds.  Turn
+        #: OFF when the consumer donates its node-table argument against
+        #: a sharding that could alias these buffers (the mesh engine:
+        #: sharded steps donate argnum 0 — a 1-device mesh's device_put
+        #: may alias instead of copy, and a donated cached buffer poisons
+        #: every later wave)
+        self._device_static = device_static
         self._names: List[str] = []
 
     def build(self, node_infos: Sequence[Any], capacity: int = None):
@@ -540,9 +548,16 @@ class CachedNodeTableBuilder:
                 names.append(ni.name)
                 _encode_node_static(t, i, ni.node)
             self._static = {k: t[k] for k in _NODE_STATIC_COLS}
+            # static columns live on DEVICE between waves: re-uploading
+            # the label/taint/image planes for 10k+ nodes every wave cost
+            # tens of MB of tunnel bandwidth per wave for bytes that only
+            # change when a node object changes
+            if self._device_static:
+                self._static_dev = batched_device_put(self._static)
+                self._static = {}  # device copy is the only consumer
             self._names = names
             self._sig = sig
-        t = {k: self._static[k] for k in _NODE_STATIC_COLS}
+        t: Dict[str, Any] = {}
         for k in _NODE_AGG_COLS:
             t[k] = (
                 np.zeros((cap, MAX_PORTS), np.int32)
@@ -551,7 +566,14 @@ class CachedNodeTableBuilder:
             )
         for i, ni in enumerate(node_infos):
             _fill_aggregate_row(t, i, ni)
-        return NodeTable(**batched_device_put(t)), list(self._names)
+        if self._device_static:
+            cols = dict(self._static_dev)
+            cols.update(batched_device_put(t))
+        else:
+            cols = dict(self._static)
+            cols.update(t)
+            cols = batched_device_put(cols)
+        return NodeTable(**cols), list(self._names)
 
 
 def _encode_terms(t: Dict[str, Any], prefix: str, i: int, terms, max_terms: int,
