@@ -232,16 +232,22 @@ class DeviceScheduler(Scheduler):
         from minisched_tpu.api.objects import make_node, make_pod
         from minisched_tpu.framework.nodeinfo import build_node_infos
 
-        # count via the (already-synced) informer cache — store.list would
-        # deep-clone every Node object just to take len()
-        n_nodes = len(self.informer_factory.informer_for("Node").lister())
-        node_capacity = pad_to(max(n_nodes, 2))
+        # shapes from the (already-synced) informer cache — store.list
+        # would deep-clone every Node object just to take len().  The
+        # PROFILE capacity must come from the real roster too: a cluster
+        # with >64 label/taint signatures would otherwise warm at the
+        # synthetic nodes' Dp=64 and recompile on the first live wave.
+        from minisched_tpu.models.tables import node_profile_capacity
+
+        live_nodes = self.informer_factory.informer_for("Node").lister()
+        node_capacity = pad_to(max(len(live_nodes), 2))
+        prof_capacity = node_profile_capacity(live_nodes)
         pod_capacity = pad_to(max(self.max_wave, 128))
         nodes = [make_node("warm0"), make_node("warm1")]
         pods = [make_pod("warmpod", requests={"cpu": "1"})]
         infos = build_node_infos(nodes, [])
         node_table, _ = CachedNodeTableBuilder().build(
-            infos, capacity=node_capacity
+            infos, capacity=node_capacity, prof_capacity=prof_capacity
         )
         pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
         extra = None

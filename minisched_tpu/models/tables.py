@@ -240,17 +240,26 @@ class NodeTable:
     unschedulable: Any  # bool[N] (spec.unschedulable)
     # nodenumber plugin
     suffix: Any  # i32[N] trailing-digit of name, -1 if none
-    # taints
-    taint_key: Any  # i32[N, MAX_TAINTS] fnv hash
-    taint_value: Any  # i32[N, MAX_TAINTS]
-    taint_effect: Any  # i32[N, MAX_TAINTS] effect code
-    num_taints: Any  # i32[N]
-    # labels
-    label_key: Any  # i32[N, MAX_LABELS]
-    label_value: Any  # i32[N, MAX_LABELS]
-    label_numval: Any  # i32[N, MAX_LABELS] label value parsed as int (Gt/Lt)
-    label_num_ok: Any  # bool[N, MAX_LABELS] label value was an integer
-    num_labels: Any  # i32[N]
+    # label/taint PROFILES: real clusters are built from node pools, so
+    # 10k nodes collapse to a handful of distinct (labels, taints)
+    # signatures.  Label/taint-dependent kernels (NodeAffinity,
+    # TaintToleration, spread's eligibility gate) evaluate per
+    # (pod × profile) — the heavy unrolled expression machinery shrinks
+    # by N/Dp (~300× at config5 scale) — and expand to (pod × node) with
+    # ONE gather through profile_id.  Padded node rows point at profile
+    # 0; the evaluators' valid mask excludes them regardless.
+    profile_id: Any  # i32[N] node → profile row
+    # per-profile taints
+    prof_taint_key: Any  # i32[Dp, MAX_TAINTS] fnv hash
+    prof_taint_value: Any  # i32[Dp, MAX_TAINTS]
+    prof_taint_effect: Any  # i32[Dp, MAX_TAINTS] effect code
+    prof_num_taints: Any  # i32[Dp]
+    # per-profile labels
+    prof_label_key: Any  # i32[Dp, MAX_LABELS]
+    prof_label_value: Any  # i32[Dp, MAX_LABELS]
+    prof_label_numval: Any  # i32[Dp, MAX_LABELS] value parsed as int (Gt/Lt)
+    prof_label_num_ok: Any  # bool[Dp, MAX_LABELS] value was an integer
+    prof_num_labels: Any  # i32[Dp]
     # cached images (ImageLocality)
     image_key: Any  # i32[N, MAX_IMAGES] fnv of image name
     image_size_mb: Any  # i32[N, MAX_IMAGES]
@@ -341,7 +350,16 @@ def pod_seed(uid: str) -> int:
     return fnv1a32(uid) & 0xFFFFFFFF
 
 
-def _node_table_skeleton(cap: int) -> Dict[str, Any]:
+#: NodeTable columns with a leading PROFILE axis (replicated on a mesh —
+#: they are tiny and the node sharding must not split them)
+NODE_PROFILE_COLS = (
+    "prof_taint_key", "prof_taint_value", "prof_taint_effect",
+    "prof_num_taints", "prof_label_key", "prof_label_value",
+    "prof_label_numval", "prof_label_num_ok", "prof_num_labels",
+)
+
+
+def _node_table_skeleton(cap: int, prof_cap: int) -> Dict[str, Any]:
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)
 
@@ -352,12 +370,16 @@ def _node_table_skeleton(cap: int) -> Dict[str, Any]:
         req_cpu=zeros(cap), req_mem=zeros(cap), req_eph=zeros(cap),
         req_pods=zeros(cap), nzreq_cpu=zeros(cap), nzreq_mem=zeros(cap),
         unschedulable=np.zeros(cap, bool), suffix=np.full(cap, -1, np.int32),
-        taint_key=zeros((cap, MAX_TAINTS)), taint_value=zeros((cap, MAX_TAINTS)),
-        taint_effect=zeros((cap, MAX_TAINTS)), num_taints=zeros(cap),
-        label_key=zeros((cap, MAX_LABELS)), label_value=zeros((cap, MAX_LABELS)),
-        label_numval=zeros((cap, MAX_LABELS)),
-        label_num_ok=np.zeros((cap, MAX_LABELS), bool),
-        num_labels=zeros(cap),
+        profile_id=zeros(cap),
+        prof_taint_key=zeros((prof_cap, MAX_TAINTS)),
+        prof_taint_value=zeros((prof_cap, MAX_TAINTS)),
+        prof_taint_effect=zeros((prof_cap, MAX_TAINTS)),
+        prof_num_taints=zeros(prof_cap),
+        prof_label_key=zeros((prof_cap, MAX_LABELS)),
+        prof_label_value=zeros((prof_cap, MAX_LABELS)),
+        prof_label_numval=zeros((prof_cap, MAX_LABELS)),
+        prof_label_num_ok=np.zeros((prof_cap, MAX_LABELS), bool),
+        prof_num_labels=zeros(prof_cap),
         image_key=zeros((cap, MAX_IMAGES)), image_size_mb=zeros((cap, MAX_IMAGES)),
         num_images=zeros(cap),
         used_port=zeros((cap, MAX_PORTS)), num_used_ports=zeros(cap),
@@ -365,10 +387,91 @@ def _node_table_skeleton(cap: int) -> Dict[str, Any]:
     )
 
 
-def _encode_node_static(t: Dict[str, Any], i: int, node: Any) -> None:
+class _ProfileRegistry:
+    """Dedupes nodes into (labels, taints) profiles.  Pass 1 assigns ids
+    (``pid_for``); the skeleton is then sized ``capacity`` (a multiple of
+    64 — see there) and pass 2 encodes one row per profile
+    (``encode_rows``)."""
+
+    def __init__(self) -> None:
+        self.ids: Dict[Tuple, int] = {}
+        self.nodes: List[Any] = []  # representative node per profile
+
+    def pid_for(self, node: Any) -> int:
+        labels = node.metadata.labels
+        if len(labels) > MAX_LABELS:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_LABELS} labels")
+        taints = node.spec.taints
+        if len(taints) > MAX_TAINTS:
+            raise ValueError(f"node {node.metadata.name}: >{MAX_TAINTS} taints")
+        sig = (
+            tuple(sorted(labels.items())),
+            # sorted: taint matching is order-independent, so [A,B] and
+            # [B,A] must share a profile (spurious profiles waste Dp rows
+            # and can cross the 64 boundary → recompile)
+            tuple(sorted((t.key, t.value, t.effect) for t in taints)),
+        )
+        pid = self.ids.get(sig)
+        if pid is None:
+            pid = self.ids[sig] = len(self.nodes)
+            self.nodes.append(node)
+        return pid
+
+    @property
+    def capacity(self) -> int:
+        # quantized HARD (multiples of 64): Dp is an executable shape, so
+        # every distinct value is a fresh compile — a cluster gaining its
+        # 17th label signature mid-run must not recompile the wave
+        # evaluator (measured: a 75s compile inside a wave).  64 covers
+        # any sane pool layout; past each multiple of 64 the next step
+        # (and one recompile) is unavoidable.
+        return pad_to(max(len(self.nodes), 1), 64)
+
+    def encode_rows(self, t: Dict[str, Any]) -> None:
+        for pid, node in enumerate(self.nodes):
+            for j, taint in enumerate(node.spec.taints):
+                t["prof_taint_key"][pid, j] = fnv1a32(taint.key)
+                t["prof_taint_value"][pid, j] = fnv1a32(taint.value)
+                t["prof_taint_effect"][pid, j] = _EFFECT_CODES[taint.effect]
+            t["prof_num_taints"][pid] = len(node.spec.taints)
+            labels = node.metadata.labels
+            for j, (k, v) in enumerate(sorted(labels.items())):
+                t["prof_label_key"][pid, j] = fnv1a32(k)
+                t["prof_label_value"][pid, j] = fnv1a32(v)
+                try:
+                    t["prof_label_numval"][pid, j] = int(v)
+                    t["prof_label_num_ok"][pid, j] = True
+                except ValueError:
+                    pass
+            t["prof_num_labels"][pid] = len(labels)
+
+
+def _prof_cap(reg: "_ProfileRegistry", requested: int = None) -> int:
+    """Requested profile capacity, validated against the registry —
+    warm builds pass the LIVE cluster's Dp so shapes match."""
+    if requested is None:
+        return reg.capacity
+    if len(reg.nodes) > requested:
+        raise ValueError(
+            f"{len(reg.nodes)} profiles exceed requested capacity {requested}"
+        )
+    return requested
+
+
+def node_profile_capacity(nodes: Sequence[Any]) -> int:
+    """The profile-axis capacity (Dp) a table over ``nodes`` will get —
+    for warm builds that must match the live executable's shapes."""
+    reg = _ProfileRegistry()
+    for node in nodes:
+        reg.pid_for(node)
+    return reg.capacity
+
+
+def _encode_node_static(t: Dict[str, Any], i: int, node: Any, pid: int) -> None:
     """Everything about row ``i`` that comes from the Node object itself
-    (identity, allocatable, taints, labels, images) — the assigned-pod
-    aggregates are filled by the caller."""
+    (identity, allocatable, images, profile membership) — the assigned-pod
+    aggregates are filled by the caller, the label/taint planes live on
+    the profile rows."""
     t["name_hash"][i] = fnv1a32(node.metadata.name)
     alloc = node.status.allocatable
     t["alloc_cpu"][i] = alloc.milli_cpu
@@ -377,26 +480,7 @@ def _encode_node_static(t: Dict[str, Any], i: int, node: Any) -> None:
     t["alloc_pods"][i] = alloc.pods
     t["unschedulable"][i] = node.spec.unschedulable
     t["suffix"][i] = _name_suffix(node.metadata.name)
-    taints = node.spec.taints
-    if len(taints) > MAX_TAINTS:
-        raise ValueError(f"node {node.metadata.name}: >{MAX_TAINTS} taints")
-    for j, taint in enumerate(taints):
-        t["taint_key"][i, j] = fnv1a32(taint.key)
-        t["taint_value"][i, j] = fnv1a32(taint.value)
-        t["taint_effect"][i, j] = _EFFECT_CODES[taint.effect]
-    t["num_taints"][i] = len(taints)
-    labels = node.metadata.labels
-    if len(labels) > MAX_LABELS:
-        raise ValueError(f"node {node.metadata.name}: >{MAX_LABELS} labels")
-    for j, (k, v) in enumerate(sorted(labels.items())):
-        t["label_key"][i, j] = fnv1a32(k)
-        t["label_value"][i, j] = fnv1a32(v)
-        try:
-            t["label_numval"][i, j] = int(v)
-            t["label_num_ok"][i, j] = True
-        except ValueError:
-            pass
-    t["num_labels"][i] = len(labels)
+    t["profile_id"][i] = pid
     images = node.status.images
     if len(images) > MAX_IMAGES:
         raise ValueError(f"node {node.metadata.name}: >{MAX_IMAGES} images")
@@ -421,7 +505,8 @@ def _encode_node_ports(t: Dict[str, Any], i: int, node_name: str, pods) -> None:
 
 
 def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = None,
-                     capacity: int = None) -> Tuple[NodeTable, List[str]]:
+                     capacity: int = None,
+                     prof_capacity: int = None) -> Tuple[NodeTable, List[str]]:
     """Build a NodeTable from Node objects (+ already-assigned pods).
 
     Returns (table, node_names) where node_names[i] is row i's name; the
@@ -432,11 +517,14 @@ def build_node_table(nodes: Sequence[Any], pods_by_node: Dict[str, List[Any]] = 
     cap = capacity or pad_to(n)
     if n > cap:
         raise ValueError(f"{n} nodes exceed table capacity {cap}")
-    t = _node_table_skeleton(cap)
+    reg = _ProfileRegistry()
+    pids = [reg.pid_for(node) for node in nodes]
+    t = _node_table_skeleton(cap, _prof_cap(reg, prof_capacity))
+    reg.encode_rows(t)
     names: List[str] = []
     for i, node in enumerate(nodes):
         names.append(node.metadata.name)
-        _encode_node_static(t, i, node)
+        _encode_node_static(t, i, node, pids[i])
         assigned = pods_by_node.get(node.metadata.name, ())
         for p in assigned:
             req = p.resource_requests()
@@ -463,11 +551,14 @@ def build_node_table_from_infos(
     cap = capacity or pad_to(n)
     if n > cap:
         raise ValueError(f"{n} nodes exceed table capacity {cap}")
-    t = _node_table_skeleton(cap)
+    reg = _ProfileRegistry()
+    pids = [reg.pid_for(ni.node) for ni in node_infos]
+    t = _node_table_skeleton(cap, reg.capacity)
+    reg.encode_rows(t)
     names: List[str] = []
     for i, ni in enumerate(node_infos):
         names.append(ni.name)
-        _encode_node_static(t, i, ni.node)
+        _encode_node_static(t, i, ni.node, pids[i])
         _fill_aggregate_row(t, i, ni)
     return NodeTable(**batched_device_put(t)), names
 
@@ -494,10 +585,9 @@ def _fill_aggregate_row(t: Dict[str, Any], i: int, ni: Any) -> None:
 #: (cheap, re-filled per wave from NodeInfo's incremental sums)
 _NODE_STATIC_COLS = (
     "name_hash", "alloc_cpu", "alloc_mem", "alloc_eph", "alloc_pods",
-    "unschedulable", "suffix", "taint_key", "taint_value", "taint_effect",
-    "num_taints", "label_key", "label_value", "label_numval", "label_num_ok",
-    "num_labels", "image_key", "image_size_mb", "num_images", "valid",
-)
+    "unschedulable", "suffix", "profile_id",
+    "image_key", "image_size_mb", "num_images", "valid",
+) + NODE_PROFILE_COLS
 _NODE_AGG_COLS = (
     "req_cpu", "req_mem", "req_eph", "req_pods", "nzreq_cpu", "nzreq_mem",
     "used_port", "num_used_ports",
@@ -529,24 +619,29 @@ class CachedNodeTableBuilder:
         self._device_static = device_static
         self._names: List[str] = []
 
-    def build(self, node_infos: Sequence[Any], capacity: int = None):
+    def build(self, node_infos: Sequence[Any], capacity: int = None,
+              prof_capacity: int = None):
         n = len(node_infos)
         cap = capacity or pad_to(n)
         if n > cap:
             raise ValueError(f"{n} nodes exceed table capacity {cap}")
         sig = (
             cap,
+            prof_capacity,
             tuple(
                 (ni.node.metadata.name, ni.node.metadata.resource_version)
                 for ni in node_infos
             ),
         )
         if sig != self._sig:
-            t = _node_table_skeleton(cap)
+            reg = _ProfileRegistry()
+            pids = [reg.pid_for(ni.node) for ni in node_infos]
+            t = _node_table_skeleton(cap, _prof_cap(reg, prof_capacity))
+            reg.encode_rows(t)
             names: List[str] = []
             for i, ni in enumerate(node_infos):
                 names.append(ni.name)
-                _encode_node_static(t, i, ni.node)
+                _encode_node_static(t, i, ni.node, pids[i])
             self._static = {k: t[k] for k in _NODE_STATIC_COLS}
             # static columns live on DEVICE between waves: re-uploading
             # the label/taint/image planes for 10k+ nodes every wave cost

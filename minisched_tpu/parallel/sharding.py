@@ -92,13 +92,24 @@ def make_mesh(
     return Mesh(grid, (POD_AXIS, NODE_AXIS))
 
 
-def _table_sharding(mesh: Mesh, table: Any, axis: str) -> Any:
-    """NamedSharding pytree: leading dim on ``axis``, trailing dims replicated."""
-    def leaf_spec(leaf):
-        extra = (None,) * (leaf.ndim - 1)
-        return NamedSharding(mesh, P(axis, *extra))
+def _table_sharding(
+    mesh: Mesh, table: Any, axis: str, replicated: tuple = ()
+) -> Any:
+    """NamedSharding pytree: leading dim on ``axis``, trailing dims
+    replicated; fields named in ``replicated`` replicate fully (their
+    leading dim is NOT the table's primary axis — e.g. the NodeTable's
+    tiny per-profile label/taint planes)."""
+    from dataclasses import fields as dc_fields
 
-    return jax.tree_util.tree_map(leaf_spec, table)
+    specs = {}
+    for f in dc_fields(type(table)):
+        leaf = getattr(table, f.name)
+        if f.name in replicated:
+            specs[f.name] = NamedSharding(mesh, P())
+        else:
+            extra = (None,) * (leaf.ndim - 1)
+            specs[f.name] = NamedSharding(mesh, P(axis, *extra))
+    return type(table)(**specs)
 
 
 def pod_sharding(mesh: Mesh, table: PodTable):
@@ -106,7 +117,9 @@ def pod_sharding(mesh: Mesh, table: PodTable):
 
 
 def node_sharding(mesh: Mesh, table: NodeTable):
-    return _table_sharding(mesh, table, NODE_AXIS)
+    from minisched_tpu.models.tables import NODE_PROFILE_COLS
+
+    return _table_sharding(mesh, table, NODE_AXIS, replicated=NODE_PROFILE_COLS)
 
 
 #: ConstraintTables field → mesh placement, derived from the single

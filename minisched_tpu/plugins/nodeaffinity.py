@@ -10,8 +10,12 @@ match expressions); Score sums the weights of matching
 Batch form: expressions are encoded host-side into fixed-capacity operator/
 operand arrays (models/tables.py: MAX_AFF_TERMS × MAX_AFF_REQS ×
 MAX_AFF_VALS) and evaluated as pure broadcast-reduces against the node
-label table — all six selector operators (In/NotIn/Exists/DoesNotExist/
-Gt/Lt) in one fused kernel, no per-object work at schedule time.
+LABEL PROFILES — all six selector operators (In/NotIn/Exists/DoesNotExist/
+Gt/Lt) in one fused kernel, no per-object work at schedule time.  Nodes
+dedupe to Dp distinct label signatures (node pools), so the unrolled
+(P, terms, reqs, ·, L) expression machinery runs over Dp rows instead of
+N nodes — ~N/Dp less VPU work and HBM traffic — and the verdict expands
+to (P, N) with one gather through ``nodes.profile_id``.
 """
 
 from __future__ import annotations
@@ -88,7 +92,7 @@ class NodeAffinity(Plugin, BatchEvaluable):
         import jax
 
         P = pods.pref_key.shape[0]
-        N = nodes.label_key.shape[0]
+        N = nodes.profile_id.shape[0]
 
         def compute(_):
             term_match = terms_match(
@@ -101,7 +105,7 @@ class NodeAffinity(Plugin, BatchEvaluable):
                     pods.pref_nreqs,
                 ),
                 nodes,
-            )  # (P,T,N)
+            )  # (P,T,Dp)
             T = pods.pref_key.shape[1]
             term_in_range = jnp.arange(T)[None, :] < pods.pref_nterms[:, None]
             weights = jnp.where(
@@ -109,10 +113,11 @@ class NodeAffinity(Plugin, BatchEvaluable):
                 pods.pref_weight[:, :, None],
                 0,
             )
-            return jnp.sum(weights, axis=1).astype(jnp.int32)
+            per_profile = jnp.sum(weights, axis=1).astype(jnp.int32)  # (P,Dp)
+            return jnp.take(per_profile, nodes.profile_id, axis=1)
 
         # a wave with no preferred terms scores 0 everywhere — skip the
-        # whole (P, T, R, N, L) term machinery
+        # whole (P, T, R, Dp, L) term machinery
         return jax.lax.cond(
             jnp.any(pods.pref_nterms > 0),
             compute,
@@ -129,38 +134,44 @@ class NodeAffinity(Plugin, BatchEvaluable):
 
 
 def terms_match(prefix_arrays, nodes: Any):
-    """Evaluate encoded NodeSelectorTerms against the node label table.
+    """Evaluate encoded NodeSelectorTerms against the node label PROFILES.
 
     prefix_arrays: (key, op, vals, nvals, numval, nreqs) with shapes
     (P,T,R), (P,T,R), (P,T,R,V), (P,T,R), (P,T,R), (P,T).
-    Returns bool[P, T, N]: term t of pod p matches node n.
+    Returns bool[P, T, Dp]: term t of pod p matches label profile d —
+    expand to nodes with ``jnp.take(·, nodes.profile_id, axis=-1)``.
     """
     key, op, vals, nvals, numval, nreqs = prefix_arrays
     P, T, R = key.shape
-    N, L = nodes.label_key.shape
-    # label lookup over (P,T,R,N,L), reduced immediately over L.  Node
-    # label keys are unique, so a masked sum *selects* the value of the
-    # (at most one) label slot matching the requirement's key — keeping
-    # every intermediate at rank ≤ 5 with the smallest axes innermost.
-    lab_in_range = (jnp.arange(L)[None, :] < nodes.num_labels[:, None])  # (N,L)
-    key_eq = key[:, :, :, None, None] == nodes.label_key[None, None, None, :, :]
-    present = key_eq & lab_in_range[None, None, None, :, :]  # (P,T,R,N,L)
-    has_key = jnp.any(present, axis=4)  # (P,T,R,N)
+    D, L = nodes.prof_label_key.shape
+    # label lookup over (P,T,R,Dp,L), reduced immediately over L.  Label
+    # keys are unique within a profile, so a masked sum *selects* the
+    # value of the (at most one) slot matching the requirement's key —
+    # keeping every intermediate at rank ≤ 5 with the smallest axes
+    # innermost.
+    lab_in_range = (
+        jnp.arange(L)[None, :] < nodes.prof_num_labels[:, None]
+    )  # (Dp,L)
+    key_eq = key[:, :, :, None, None] == nodes.prof_label_key[None, None, None, :, :]
+    present = key_eq & lab_in_range[None, None, None, :, :]  # (P,T,R,Dp,L)
+    has_key = jnp.any(present, axis=4)  # (P,T,R,Dp)
     node_val = jnp.sum(
-        jnp.where(present, nodes.label_value[None, None, None, :, :], 0), axis=4
-    )  # (P,T,R,N) — the node's value-hash for this key (0 if absent)
-    num_ok = present & nodes.label_num_ok[None, None, None, :, :]
-    has_num = jnp.any(num_ok, axis=4)  # (P,T,R,N)
+        jnp.where(present, nodes.prof_label_value[None, None, None, :, :], 0),
+        axis=4,
+    )  # (P,T,R,Dp) — the profile's value-hash for this key (0 if absent)
+    num_ok = present & nodes.prof_label_num_ok[None, None, None, :, :]
+    has_num = jnp.any(num_ok, axis=4)  # (P,T,R,Dp)
     node_num = jnp.sum(
-        jnp.where(num_ok, nodes.label_numval[None, None, None, :, :], 0), axis=4
+        jnp.where(num_ok, nodes.prof_label_numval[None, None, None, :, :], 0),
+        axis=4,
     )
-    # value-set membership: node's value ∈ operand set (V is tiny)
+    # value-set membership: profile's value ∈ operand set (V is tiny)
     v_in_range = jnp.arange(vals.shape[3])[None, None, None, :] < nvals[:, :, :, None]
     in_set = has_key & jnp.any(
         (node_val[:, :, :, :, None] == vals[:, :, :, None, :])
         & v_in_range[:, :, :, None, :],
         axis=4,
-    )  # (P,T,R,N)
+    )  # (P,T,R,Dp)
     num_gt = has_num & (node_num > numval[:, :, :, None])
     num_lt = has_num & (node_num < numval[:, :, :, None])
     op_b = op[:, :, :, None]
@@ -171,9 +182,9 @@ def terms_match(prefix_arrays, nodes: Any):
         | ((op_b == tables.OP_DOES_NOT_EXIST) & ~has_key)
         | ((op_b == tables.OP_GT) & num_gt)
         | ((op_b == tables.OP_LT) & num_lt)
-    )  # (P,T,R,N)
+    )  # (P,T,R,Dp)
     req_in_range = (jnp.arange(R)[None, None, :] < nreqs[:, :, None])  # (P,T,R)
-    term_match = jnp.all(req_ok | ~req_in_range[:, :, :, None], axis=2)  # (P,T,N)
+    term_match = jnp.all(req_ok | ~req_in_range[:, :, :, None], axis=2)  # (P,T,Dp)
     return term_match
 
 
@@ -183,36 +194,37 @@ def required_node_affinity_mask(pods: Any, nodes: Any):
 
     Cost scales with what the wave actually carries: each nodeSelector
     slot and the whole required-affinity term machinery are behind
-    ``lax.cond`` — a wave of plain pods reduces to O(P) predicates, one
-    with a single selector pair costs one (P, N, L) pass.
+    ``lax.cond``, and everything runs per label PROFILE (Dp rows) with
+    one (P, N) gather at the end — a wave of plain pods reduces to O(P)
+    predicates.
     """
     import jax
 
     P = pods.sel_key.shape[0]
-    N = nodes.label_key.shape[0]
+    D = nodes.prof_label_key.shape[0]
     S = pods.sel_key.shape[1]
     lab_in_range = (
-        jnp.arange(nodes.label_key.shape[1])[None, :]
-        < nodes.num_labels[:, None]
-    )  # (N,L)
+        jnp.arange(nodes.prof_label_key.shape[1])[None, :]
+        < nodes.prof_num_labels[:, None]
+    )  # (Dp,L)
 
     def all_true(_):
-        return jnp.ones((P, N), bool)
+        return jnp.ones((P, D), bool)
 
     def sel_slot(s, _):
-        # spec.nodeSelector slot s: node must carry the exact label pair
+        # spec.nodeSelector slot s: profile must carry the exact label pair
         ok = jnp.any(
-            (pods.sel_key[:, s][:, None, None] == nodes.label_key[None, :, :])
+            (pods.sel_key[:, s][:, None, None] == nodes.prof_label_key[None, :, :])
             & (
                 pods.sel_value[:, s][:, None, None]
-                == nodes.label_value[None, :, :]
+                == nodes.prof_label_value[None, :, :]
             )
             & lab_in_range[None, :, :],
             axis=2,
-        )  # (P, N)
+        )  # (P, Dp)
         return ok | (pods.num_sel <= s)[:, None]
 
-    sel_ok = jnp.ones((P, N), bool)
+    sel_ok = jnp.ones((P, D), bool)
     for s in range(S):
         sel_ok = sel_ok & jax.lax.cond(
             jnp.any(pods.num_sel > s), partial(sel_slot, s), all_true, None
@@ -230,18 +242,18 @@ def required_node_affinity_mask(pods: Any, nodes: Any):
                 pods.aff_nreqs,
             ),
             nodes,
-        )  # (P,T,N)
+        )  # (P,T,Dp)
         T = pods.aff_key.shape[1]
         term_in_range = (
             jnp.arange(T)[None, :] < pods.aff_nterms[:, None]
         )  # (P,T)
         any_term = jnp.any(
             term_match & term_in_range[:, :, None], axis=1
-        )  # (P,N)
+        )  # (P,Dp)
         # a required affinity with an empty term list matches nothing —
         # any_term over zero in-range terms is already False, so gate only
         # on the requirement's *presence* (upstream MatchNodeSelectorTerms)
         return jnp.where(pods.aff_required[:, None], any_term, True)
 
     aff_ok = jax.lax.cond(jnp.any(pods.aff_required), aff, all_true, None)
-    return sel_ok & aff_ok
+    return jnp.take(sel_ok & aff_ok, nodes.profile_id, axis=1)  # (P, N)

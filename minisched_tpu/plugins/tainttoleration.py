@@ -6,9 +6,10 @@ per defaultconfig): Filter rejects nodes carrying a NoSchedule/NoExecute
 taint the pod does not tolerate; Score counts intolerable PreferNoSchedule
 taints and normalizes reversed (more intolerable taints → lower score).
 
-Batch form: taint×toleration matching is a pure (P, N, taints, tols)
-broadcast-reduce — XLA fuses it without materializing the rank-4
-intermediate.
+Batch form: taint×toleration matching is a pure (P, Dp, taints, tols)
+broadcast-reduce over the node TAINT PROFILES (nodes dedupe to a handful
+of distinct taint signatures — node pools), expanded to (P, N) with one
+gather through ``nodes.profile_id``.
 """
 
 from __future__ import annotations
@@ -101,55 +102,62 @@ class TaintToleration(Plugin, BatchEvaluable):
     # -- batch -------------------------------------------------------------
     @staticmethod
     def _tolerates_matrix(pods: Any, nodes: Any, tol_effect_ok):
-        """bool[P, N, Tn]: pod p tolerates node n's taint slot t.
+        """bool[P, Dp, Tn]: pod p tolerates taint slot t of taint
+        PROFILE d.
 
         tol_effect_ok: bool[P, Tp] — which toleration slots are eligible
         (filter vs score consider different effect classes).
         """
-        # shapes: pods.tol_* (P, Tp); nodes.taint_* (N, Tn)
+        # shapes: pods.tol_* (P, Tp); nodes.prof_taint_* (Dp, Tn)
         tol_in_range = (
             jnp.arange(pods.tol_key.shape[1])[None, :] < pods.num_tols[:, None]
         )  # (P, Tp)
         tol_ok = tol_in_range & tol_effect_ok  # (P, Tp)
         # effect compatibility: toleration effect "" matches all; else equal
         eff_match = (pods.tol_effect[:, None, None, :] == tables.EFFECT_NONE) | (
-            pods.tol_effect[:, None, None, :] == nodes.taint_effect[None, :, :, None]
-        )  # (P, N, Tn, Tp)
+            pods.tol_effect[:, None, None, :]
+            == nodes.prof_taint_effect[None, :, :, None]
+        )  # (P, Dp, Tn, Tp)
         exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE  # (P, Tp)
         wildcard = (pods.tol_empty_key & exists)[:, None, None, :]
         key_eq = (
-            pods.tol_key[:, None, None, :] == nodes.taint_key[None, :, :, None]
+            pods.tol_key[:, None, None, :] == nodes.prof_taint_key[None, :, :, None]
         )
         val_eq = (
-            pods.tol_value[:, None, None, :] == nodes.taint_value[None, :, :, None]
+            pods.tol_value[:, None, None, :]
+            == nodes.prof_taint_value[None, :, :, None]
         )
         value_ok = exists[:, None, None, :] | val_eq
         covers = eff_match & (wildcard | (key_eq & value_ok))
-        return jnp.any(covers & tol_ok[:, None, None, :], axis=3)  # (P, N, Tn)
+        return jnp.any(covers & tol_ok[:, None, None, :], axis=3)  # (P, Dp, Tn)
 
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
         taint_in_range = (
-            jnp.arange(nodes.taint_key.shape[1])[None, :] < nodes.num_taints[:, None]
-        )  # (N, Tn)
-        hard = (nodes.taint_effect == tables.EFFECT_NO_SCHEDULE) | (
-            nodes.taint_effect == tables.EFFECT_NO_EXECUTE
-        )  # (N, Tn)
+            jnp.arange(nodes.prof_taint_key.shape[1])[None, :]
+            < nodes.prof_num_taints[:, None]
+        )  # (Dp, Tn)
+        hard = (nodes.prof_taint_effect == tables.EFFECT_NO_SCHEDULE) | (
+            nodes.prof_taint_effect == tables.EFFECT_NO_EXECUTE
+        )  # (Dp, Tn)
         all_tols_ok = jnp.ones(pods.tol_key.shape, bool)
-        tolerated = self._tolerates_matrix(pods, nodes, all_tols_ok)  # (P, N, Tn)
+        tolerated = self._tolerates_matrix(pods, nodes, all_tols_ok)  # (P, Dp, Tn)
         blocking = (taint_in_range & hard)[None, :, :] & ~tolerated
-        return ~jnp.any(blocking, axis=2)
+        ok = ~jnp.any(blocking, axis=2)  # (P, Dp)
+        return jnp.take(ok, nodes.profile_id, axis=1)  # (P, N)
 
     def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
         taint_in_range = (
-            jnp.arange(nodes.taint_key.shape[1])[None, :] < nodes.num_taints[:, None]
+            jnp.arange(nodes.prof_taint_key.shape[1])[None, :]
+            < nodes.prof_num_taints[:, None]
         )
-        prefer = nodes.taint_effect == tables.EFFECT_PREFER_NO_SCHEDULE
+        prefer = nodes.prof_taint_effect == tables.EFFECT_PREFER_NO_SCHEDULE
         tol_eligible = (pods.tol_effect == tables.EFFECT_NONE) | (
             pods.tol_effect == tables.EFFECT_PREFER_NO_SCHEDULE
         )
         tolerated = self._tolerates_matrix(pods, nodes, tol_eligible)
         intolerable = (taint_in_range & prefer)[None, :, :] & ~tolerated
-        return jnp.sum(intolerable, axis=2).astype(jnp.int32)
+        counts = jnp.sum(intolerable, axis=2).astype(jnp.int32)  # (P, Dp)
+        return jnp.take(counts, nodes.profile_id, axis=1)  # (P, N)
 
     def batch_normalize(self, ctx: Any, scores, mask):
         max_count = jnp.max(jnp.where(mask, scores, 0), axis=1, keepdims=True)

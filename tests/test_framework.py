@@ -247,7 +247,8 @@ class TestNodeTableFromInfos:
         for field in (
             "name_hash", "alloc_cpu", "alloc_mem", "req_cpu", "req_mem",
             "req_eph", "req_pods", "nzreq_cpu", "nzreq_mem", "unschedulable",
-            "used_port", "num_used_ports", "valid", "label_key", "label_value",
+            "used_port", "num_used_ports", "valid", "profile_id",
+            "prof_label_key", "prof_label_value",
         ):
             np.testing.assert_array_equal(
                 np.asarray(getattr(t1, field)),
