@@ -271,10 +271,18 @@ def bench_config4() -> dict:
     }
 
 
-def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int):
+def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int,
+                n_crosspod: int = 0):
     """The config5 cluster: 20% cordoned nodes, plain pods + 2% pods that
-    need a node label no node has yet."""
-    from minisched_tpu.api.objects import make_node, make_pod
+    need a node label no node has yet (+ optionally ``n_crosspod`` pods
+    carrying a zone topology-spread constraint — they ride the live
+    engine's bind-exact sequential scan)."""
+    from minisched_tpu.api.objects import (
+        LabelSelector,
+        TopologySpreadConstraint,
+        make_node,
+        make_pod,
+    )
 
     rng = random.Random(55)
     normal_nodes = []
@@ -288,10 +296,26 @@ def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int):
         client.nodes().create(node)
         if not node.spec.unschedulable:
             normal_nodes.append(node.metadata.name)
-    for i in range(n_pods - n_special):
+    for i in range(n_pods - n_special - n_crosspod):
         client.pods().create(
             make_pod(f"pod{i:06d}", requests={"cpu": "500m", "memory": "256Mi"})
         )
+    for i in range(n_crosspod):
+        app = f"app{i % 32}"
+        pod = make_pod(
+            f"spread{i:05d}",
+            requests={"cpu": "500m", "memory": "256Mi"},
+            labels={"app": app},
+        )
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=4,
+                topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": app}),
+            )
+        ]
+        client.pods().create(pod)
     for i in range(n_special):
         client.pods().create(
             make_pod(
@@ -325,13 +349,20 @@ def bench_config5_fullchain() -> dict:
     n_pods = int(os.environ.get("BENCH_C5_PODS", 100_000))
     max_wave = int(os.environ.get("BENCH_C5_WAVE", 8_192))
     n_special = max(n_pods // 50, 1)  # 2%: parked until nodes gain the label
+    # 5% carry a real topology-spread constraint: they exercise the live
+    # engine's bind-exact sequential scan (cross-pod coupling at scale),
+    # interleaved with the plain pods' repair waves
+    n_crosspod = int(os.environ.get("BENCH_C5_CROSSPOD", "0"))
 
     client = Client()  # unthrottled: the limiter is for API fairness tests
     t_setup = time.monotonic()
-    rng, normal_nodes = _c5_cluster(client, n_nodes, n_pods, n_special)
+    rng, normal_nodes = _c5_cluster(
+        client, n_nodes, n_pods, n_special, n_crosspod
+    )
     log(
         f"[config5/full-chain] cluster created in {time.monotonic()-t_setup:.1f}s "
-        f"({n_nodes} nodes, {n_pods} pods incl. {n_special} initially-unschedulable)"
+        f"({n_nodes} nodes, {n_pods} pods incl. {n_special} initially-"
+        f"unschedulable and {n_crosspod} topology-spread-constrained)"
     )
 
     # count binds through the decision hook, installed BEFORE the engine
@@ -442,6 +473,37 @@ def bench_config5_fullchain() -> dict:
             f"[config5/full-chain] selector violation: {misplaced[:10]}"
         )
 
+    if n_crosspod:
+        # hard audit of the DoNotSchedule spread constraints: per app,
+        # max-min zone spread over schedulable nodes must respect max_skew
+        zone_of = {
+            n.metadata.name: n.metadata.labels.get("zone")
+            for n in client.nodes().list()
+        }
+        per_app: dict = {}
+        for p in client.pods().list():
+            if not p.metadata.name.startswith("spread"):
+                continue
+            app = p.metadata.labels.get("app")
+            zone = zone_of.get(p.spec.node_name)
+            per_app.setdefault(app, {}).setdefault(zone, 0)
+            per_app[app][zone] += 1
+        # domains from the cluster itself, not a duplicated naming scheme
+        all_zones = sorted({z for z in zone_of.values() if z})
+        violations = []
+        for app, zones in per_app.items():
+            counts = [zones.get(z, 0) for z in all_zones]
+            if max(counts) - min(counts) > 4:
+                violations.append((app, counts))
+        if violations:
+            raise SystemExit(
+                f"[config5/full-chain] SPREAD SKEW VIOLATED: {violations[:3]}"
+            )
+        log(
+            f"[config5/full-chain] spread audit OK: {len(per_app)} apps × "
+            f"{len(all_zones)} zones within max_skew=4"
+        )
+
     snap = metrics.snapshot()
     waves = int(snap.get("wave", {}).get("count", 0))
     log(
@@ -462,6 +524,7 @@ def bench_config5_fullchain() -> dict:
         "first_drain_s": round(t_drain, 1),
         "requeue_tail_s": round(elapsed - t_drain, 1),
         "total_s": round(elapsed, 1),
+        "crosspod_pods": n_crosspod,
         "wave_evaluate_mean_s": phase("wave_evaluate", "mean_s"),
         "wave_evaluate_total_s": phase("wave_evaluate", "total_s"),
         "scan_evaluate_total_s": phase("scan_evaluate", "total_s"),
@@ -763,14 +826,17 @@ ROLES = {
 }
 
 
-def _run_child(role: str) -> dict:
+def _run_child(role: str, extra_env: dict = None) -> dict:
     """One config in its own process (fresh backend; the persistent
     compile cache makes re-init cheap).  Returns the child's JSON dict."""
     t0 = time.monotonic()
+    env = dict(os.environ)
+    env.update(extra_env or {})
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--only", role],
         stdout=subprocess.PIPE,
         cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
     )
     if proc.returncode != 0:
         raise RuntimeError(f"bench child {role!r} exited rc={proc.returncode}")
@@ -797,6 +863,12 @@ def main() -> None:
     optional = []
     if os.environ.get("BENCH_C5", "1") != "0":
         optional.append(("config5_full_chain", "c5"))
+    if os.environ.get("BENCH_C5X", "1") != "0":
+        # config5 with 5% topology-spread-constrained pods: the live
+        # engine routes them through the bind-exact sequential scan,
+        # interleaved with the plain repair waves, and the run ends with
+        # a hard max-skew audit
+        optional.append(("config5_crosspod", "c5x"))
     if os.environ.get("BENCH_FULLCHAIN_PARITY", "1") != "0":
         optional.append(("fullchain_parity", "fullchain_parity"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
@@ -807,7 +879,11 @@ def main() -> None:
     for field, role in optional:
         # an optional config's crash must not discard the headline record
         try:
-            record[field] = _run_child(role)
+            crosspod = str(int(os.environ.get("BENCH_C5_PODS", 100_000)) // 20)
+            record[field] = _run_child(
+                "c5" if role == "c5x" else role,
+                extra_env={"BENCH_C5_CROSSPOD": crosspod} if role == "c5x" else None,
+            )
         except BaseException as err:
             log(f"[bench] {role} FAILED: {err!r}")
             record[field] = {"error": str(err)}

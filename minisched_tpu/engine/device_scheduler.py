@@ -245,6 +245,23 @@ class DeviceScheduler(Scheduler):
         pod_capacity = pad_to(max(self.max_wave, 128))
         nodes = [make_node("warm0"), make_node("warm1")]
         pods = [make_pod("warmpod", requests={"cpu": "1"})]
+        # pod tables have TWO packed-transfer schemas per capacity: the
+        # vectorized fast path (simple pods; zero columns declared, not
+        # shipped) and the full slow path (any pod with tolerations/
+        # selector/affinity).  The fast schemas are warmed by the table
+        # builds below; warm the SLOW one per capacity the engine uses —
+        # the first wave containing a non-simple pod otherwise compiles
+        # its splitter mid-run (~10-20s on the tunnel).  force_packed:
+        # small-capacity slow tables fall under the packed-path size
+        # threshold and would silently warm nothing.
+        complex_pod = make_pod(
+            "warmsel", requests={"cpu": "1"}, node_selector={"warm": "true"}
+        )
+        warm_caps = {pod_capacity}
+        if self._has_cross_pod:
+            warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
+        for cap in warm_caps:
+            build_pod_table([complex_pod], capacity=cap, force_packed=True)
         infos = build_node_infos(nodes, [])
         node_table, _ = CachedNodeTableBuilder().build(
             infos, capacity=node_capacity, prof_capacity=prof_capacity
@@ -259,6 +276,27 @@ class DeviceScheduler(Scheduler):
             )
         out = self._get_evaluator()(pod_table, node_table, extra)
         jax.block_until_ready(out[1])
+        if self._has_cross_pod:
+            # cross-pod-constrained pods ride the sequential scan — warm
+            # BOTH chunk capacities (_schedule_scan uses exactly these
+            # two; a partial chunk compiling the small one mid-run cost
+            # ~13s).  Fresh node table: the mesh-mode repair warm above
+            # donates its (re-sharded) argument and must not alias this.
+            node_table, _ = CachedNodeTableBuilder().build(
+                infos, capacity=node_capacity, prof_capacity=prof_capacity
+            )
+            for cap in (self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK):
+                scan_pods, _ = build_pod_table(pods, capacity=cap)
+                scan_extra = build_constraint_tables(
+                    pods, nodes, [],
+                    pod_capacity=cap,
+                    node_capacity=node_capacity,
+                    scan_planes=True,
+                )
+                _, choice, _ = self._get_scan_scheduler()(
+                    scan_pods, node_table, scan_extra
+                )
+                jax.block_until_ready(choice)
 
     def _get_scan_scheduler(self):
         if self._scan_scheduler is None:
@@ -313,7 +351,15 @@ class DeviceScheduler(Scheduler):
                 if self.constraint_index is not None
                 else [p for ni in node_infos for p in ni.pods]
             )
-            cap = max(self.SCAN_MIN_CAP, 1 << (len(part) - 1).bit_length())
+            # exactly TWO chunk capacities (128 for small waves, 1024
+            # otherwise): every distinct cap is a scan-executable shape,
+            # and a ~30s tunnel compile inside a wave costs more than
+            # masked no-op steps ever will
+            cap = (
+                self.SCAN_MIN_CAP
+                if len(part) <= self.SCAN_MIN_CAP
+                else self.SCAN_MAX_CHUNK
+            )
 
             def build_and_scan(part_):
                 pods_ = [qpi.pod for qpi in part_]

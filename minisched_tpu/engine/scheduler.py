@@ -491,9 +491,9 @@ class Scheduler:
         diagnosis: Diagnosis,
     ) -> Optional[str]:
         """Run the PostFilter chain on a scheduling failure; on success the
-        nominated node lands in pod.status.nominated_node_name (upstream's
-        nominatedNodeName).  Never raises — a preemption failure must not
-        mask the original FitError path."""
+        nominated node lands in status.nominated_node_name through the
+        API (upstream's nominatedNodeName).  Never raises — a preemption
+        failure must not mask the original FitError path."""
         if not self.post_filter_plugins:
             return None
         try:
@@ -506,11 +506,13 @@ class Scheduler:
             traceback.print_exc()
             return None
         if status.is_success() and nominated:
-            pod.status.nominated_node_name = nominated
-
-            # surface it through the API too (upstream patches
-            # status.nominatedNodeName); binding later resets the status,
-            # clearing the nomination exactly like upstream
+            # the nomination goes through the API ONLY (upstream patches
+            # status.nominatedNodeName); the informer MODIFIED event then
+            # refreshes the parked pod in the queue.  Never write the
+            # local object in place: pods flow into the engine as watch-
+            # event objects, which since the fanout-clone removal ARE the
+            # store's canonical objects — an in-place write would mutate
+            # the store outside its lock, unversioned and un-WAL-logged.
             def set_nominated(p):
                 p.status.nominated_node_name = nominated
                 return p

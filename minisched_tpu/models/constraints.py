@@ -52,6 +52,11 @@ MAX_DOMAINS = 64
 TS_DO_NOT_SCHEDULE = 0
 TS_SCHEDULE_ANYWAY = 1
 
+#: capacity quantum for the combo/ex-term/claim/volume axes — every
+#: distinct padded size is a separate compiled executable (see the combo
+#: matrices comment in build_constraint_tables)
+CAP_QUANTUM = 32
+
 
 @_register_table
 @dataclass
@@ -220,7 +225,9 @@ def _topo_key_axis(combos, nodes) -> Tuple[Dict[str, int], Any, Any, Any]:
     N = len(nodes)
     keys = sorted({topo for (_, _, topo) in combos})
     key_ids = {k: i for i, k in enumerate(keys)}
-    K = max(len(keys), 1)
+    # K is an executable shape too — quantize to 4 so adding a second
+    # topology key doesn't recompile (the onehot plane costs K×D×N bools)
+    K = pad_to(max(len(keys), 1), 4)
     values: List[Dict[str, int]] = [{} for _ in range(K)]
     vals_per_node: List[List[Optional[int]]] = [[None] * N for _ in range(K)]
     for k, key in enumerate(keys):
@@ -358,7 +365,11 @@ def build_constraint_tables(
         pod_rows.append(row)
 
     # --- combo matrices ----------------------------------------------------
-    C = pad_to(max(len(reg.combos), 1), 8)
+    # capacity quantum 32 (not 8): C/T/C2/Vd are EXECUTABLE shapes — a
+    # wave whose combo count steps over a small quantum recompiles the
+    # whole evaluator mid-run (~30s on the tunnel).  32 keeps one shape
+    # for realistic rosters at the cost of a few spare 1-MB planes.
+    C = pad_to(max(len(reg.combos), 1), CAP_QUANTUM)
     combo_dsum = np.zeros((C, N), np.int32)
     combo_haskey = np.zeros((C, N), bool)
     combo_global = np.zeros(C, np.int32)
@@ -462,7 +473,7 @@ def build_constraint_tables(
     else:
         for p in assigned:
             _add_ex_terms_of(p)
-    T = pad_to(max(len(ex_terms), 1), 8)
+    T = pad_to(max(len(ex_terms), 1), CAP_QUANTUM)
     ex_domain = np.zeros((T, N), bool)
     pod_matches_ex = np.zeros((P, T), bool)
     for t, (nss, sel, topo, owner_val) in enumerate(ex_terms):
@@ -552,7 +563,7 @@ def build_constraint_tables(
             pod_claims[i, j] = claim_ids[key]
             pod_claim_valid[i, j] = True
         vol_ok[i] = ok
-    C2 = pad_to(max(len(claim_rows), 1), 8)
+    C2 = pad_to(max(len(claim_rows), 1), CAP_QUANTUM)
     claim_mask = np.zeros((C2, N), bool)
     claim_zone_ok = np.zeros((C2, N), bool)
     claim_vol = np.full(C2, -1, np.int32)
@@ -569,7 +580,7 @@ def build_constraint_tables(
     # per-volume mount state from assigned pods: one pre-pass over node
     # claims (O(assigned mounts)), rows only for volumes the wave's claims
     # reference; last row stays a dummy scatter target
-    Vd = pad_to(len(vol_ids) + 1, 8)
+    Vd = pad_to(len(vol_ids) + 1, CAP_QUANTUM)
     vol_any = np.zeros((Vd, N), bool)
     vol_rw = np.zeros((Vd, N), bool)
     node_vols_fam = np.zeros((F, N), np.int32)

@@ -23,6 +23,8 @@ from __future__ import annotations
 from dataclasses import dataclass, fields
 from typing import Any, Dict, List, Sequence, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -168,6 +170,7 @@ def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]]
 def batched_device_put(
     t: Dict[str, Any],
     zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
+    force_packed: bool = False,
 ) -> Dict[str, Any]:
     """Move a dict of host numpy columns to device in ONE transfer.
 
@@ -186,7 +189,18 @@ def batched_device_put(
     metas = _col_metas(arrays)
     total = sum(v.size for v in arrays.values())
     _SCHEMA_SEEN[metas] = _SCHEMA_SEEN.get(metas, 0) + 1
-    if not zero_metas and total < 50_000 and _SCHEMA_SEEN[metas] < 2:
+    if _SCHEMA_SEEN[metas] == 1 and os.environ.get("MINISCHED_LOG_SCHEMAS"):
+        import sys as _sys
+        import time as _time
+
+        cols = ",".join(f"{k}{list(v.shape)}" for k, v in arrays.items())
+        print(
+            f"[schema t={_time.monotonic():.1f}] total={total} {cols[:400]}",
+            file=_sys.stderr,
+            flush=True,
+        )
+    if (not force_packed and not zero_metas and total < 50_000
+            and _SCHEMA_SEEN[metas] < 2):
         # small one-shot tables (tests, tiny scenarios): per-leaf puts are
         # fine.  Anything big OR repeated takes the packed path — the
         # splitter's compile is served by the persistent compilation cache
@@ -804,7 +818,8 @@ def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
     )
 
 
-def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable, List[str]]:
+def build_pod_table(pods: Sequence[Any], capacity: int = None,
+                    force_packed: bool = False) -> Tuple[PodTable, List[str]]:
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
@@ -950,4 +965,4 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None) -> Tuple[PodTable
             for j, port in enumerate(ports):
                 t["port"][i, j] = port
             t["num_ports"][i] = len(ports)
-    return PodTable(**batched_device_put(t)), names
+    return PodTable(**batched_device_put(t, force_packed=force_packed)), names
