@@ -271,6 +271,10 @@ def bench_config4() -> dict:
     }
 
 
+#: max_skew used by the c5x spread pods AND enforced by the audit
+C5_MAX_SKEW = 4
+
+
 def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int,
                 n_crosspod: int = 0):
     """The config5 cluster: 20% cordoned nodes, plain pods + 2% pods that
@@ -309,7 +313,7 @@ def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int,
         )
         pod.spec.topology_spread_constraints = [
             TopologySpreadConstraint(
-                max_skew=4,
+                max_skew=C5_MAX_SKEW,
                 topology_key="zone",
                 when_unsatisfiable="DoNotSchedule",
                 label_selector=LabelSelector(match_labels={"app": app}),
@@ -476,10 +480,12 @@ def bench_config5_fullchain() -> dict:
     if n_crosspod:
         # hard audit of the DoNotSchedule spread constraints: per app,
         # max-min zone spread over schedulable nodes must respect max_skew
-        zone_of = {
-            n.metadata.name: n.metadata.labels.get("zone")
-            for n in client.nodes().list()
-        }
+        zone_of = {}
+        eligible_zones = set()
+        for n in client.nodes().list():
+            zone_of[n.metadata.name] = n.metadata.labels.get("zone")
+            if not n.spec.unschedulable and n.metadata.labels.get("zone"):
+                eligible_zones.add(n.metadata.labels["zone"])
         per_app: dict = {}
         for p in client.pods().list():
             if not p.metadata.name.startswith("spread"):
@@ -488,12 +494,13 @@ def bench_config5_fullchain() -> dict:
             zone = zone_of.get(p.spec.node_name)
             per_app.setdefault(app, {}).setdefault(zone, 0)
             per_app[app][zone] += 1
-        # domains from the cluster itself, not a duplicated naming scheme
-        all_zones = sorted({z for z in zone_of.values() if z})
+        # domains from the cluster itself — only zones a pod COULD land
+        # in (a fully-cordoned zone legitimately stays at 0)
+        all_zones = sorted(eligible_zones)
         violations = []
         for app, zones in per_app.items():
             counts = [zones.get(z, 0) for z in all_zones]
-            if max(counts) - min(counts) > 4:
+            if max(counts) - min(counts) > C5_MAX_SKEW:
                 violations.append((app, counts))
         if violations:
             raise SystemExit(
@@ -501,7 +508,7 @@ def bench_config5_fullchain() -> dict:
             )
         log(
             f"[config5/full-chain] spread audit OK: {len(per_app)} apps × "
-            f"{len(all_zones)} zones within max_skew=4"
+            f"{len(all_zones)} zones within max_skew={C5_MAX_SKEW}"
         )
 
     snap = metrics.snapshot()
@@ -826,9 +833,11 @@ ROLES = {
 }
 
 
-def _run_child(role: str, extra_env: dict = None) -> dict:
+def _run_child(role: str, extra_env: dict = None, label: str = None) -> dict:
     """One config in its own process (fresh backend; the persistent
-    compile cache makes re-init cheap).  Returns the child's JSON dict."""
+    compile cache makes re-init cheap).  Returns the child's JSON dict.
+    ``label`` names the run in logs when one role serves two configs."""
+    label = label or role
     t0 = time.monotonic()
     env = dict(os.environ)
     env.update(extra_env or {})
@@ -839,12 +848,12 @@ def _run_child(role: str, extra_env: dict = None) -> dict:
         env=env,
     )
     if proc.returncode != 0:
-        raise RuntimeError(f"bench child {role!r} exited rc={proc.returncode}")
+        raise RuntimeError(f"bench child {label!r} exited rc={proc.returncode}")
     lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
     if not lines:
-        raise RuntimeError(f"bench child {role!r} produced no JSON")
+        raise RuntimeError(f"bench child {label!r} produced no JSON")
     out = json.loads(lines[-1])
-    log(f"[bench] {role} done in {time.monotonic()-t0:.0f}s")
+    log(f"[bench] {label} done in {time.monotonic()-t0:.0f}s")
     return out
 
 
@@ -860,32 +869,33 @@ def main() -> None:
         return
 
     record = _run_child("headline")  # a headline failure fails the bench
-    optional = []
+    optional = []  # (record field, cli role, extra env, label)
     if os.environ.get("BENCH_C5", "1") != "0":
-        optional.append(("config5_full_chain", "c5"))
+        optional.append(("config5_full_chain", "c5", None, "c5"))
     if os.environ.get("BENCH_C5X", "1") != "0":
         # config5 with 5% topology-spread-constrained pods: the live
         # engine routes them through the bind-exact sequential scan,
         # interleaved with the plain repair waves, and the run ends with
         # a hard max-skew audit
-        optional.append(("config5_crosspod", "c5x"))
+        crosspod = str(int(os.environ.get("BENCH_C5_PODS", 100_000)) // 20)
+        optional.append(
+            ("config5_crosspod", "c5", {"BENCH_C5_CROSSPOD": crosspod}, "c5x")
+        )
     if os.environ.get("BENCH_FULLCHAIN_PARITY", "1") != "0":
-        optional.append(("fullchain_parity", "fullchain_parity"))
+        optional.append(
+            ("fullchain_parity", "fullchain_parity", None, "fullchain_parity")
+        )
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
-            ("config1", "c1"), ("config2", "c2"),
-            ("config3", "c3"), ("config4", "c4"),
+            ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),
+            ("config3", "c3", None, "c3"), ("config4", "c4", None, "c4"),
         ]
-    for field, role in optional:
+    for field, role, extra_env, label in optional:
         # an optional config's crash must not discard the headline record
         try:
-            crosspod = str(int(os.environ.get("BENCH_C5_PODS", 100_000)) // 20)
-            record[field] = _run_child(
-                "c5" if role == "c5x" else role,
-                extra_env={"BENCH_C5_CROSSPOD": crosspod} if role == "c5x" else None,
-            )
+            record[field] = _run_child(role, extra_env=extra_env, label=label)
         except BaseException as err:
-            log(f"[bench] {role} FAILED: {err!r}")
+            log(f"[bench] {label} FAILED: {err!r}")
             record[field] = {"error": str(err)}
     print(json.dumps(record), flush=True)
 
