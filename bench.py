@@ -876,11 +876,16 @@ def main() -> None:
         # config5 with 5% topology-spread-constrained pods: the live
         # engine routes them through the bind-exact sequential scan,
         # interleaved with the plain repair waves, and the run ends with
-        # a hard max-skew audit
-        crosspod = str(int(os.environ.get("BENCH_C5_PODS", 100_000)) // 20)
-        optional.append(
-            ("config5_crosspod", "c5", {"BENCH_C5_CROSSPOD": crosspod}, "c5x")
-        )
+        # a hard max-skew audit.  A malformed BENCH_C5_PODS must not
+        # crash main() before the headline record prints.
+        try:
+            crosspod = str(int(os.environ.get("BENCH_C5_PODS", 100_000)) // 20)
+        except ValueError as err:
+            log(f"[bench] c5x skipped: bad BENCH_C5_PODS ({err})")
+        else:
+            optional.append(
+                ("config5_crosspod", "c5", {"BENCH_C5_CROSSPOD": crosspod}, "c5x")
+            )
     if os.environ.get("BENCH_FULLCHAIN_PARITY", "1") != "0":
         optional.append(
             ("fullchain_parity", "fullchain_parity", None, "fullchain_parity")

@@ -213,6 +213,14 @@ class DeviceScheduler(Scheduler):
     #: O(nodes × pods) host work (see _handle_wave_losers)
     MAX_PREEMPT_PER_WAVE = 256
 
+    @classmethod
+    def _scan_cap(cls, n_pods: int) -> int:
+        """Exactly TWO chunk capacities (128 for small waves, 1024
+        otherwise): every distinct cap is a scan-executable shape, and a
+        ~30s tunnel compile inside a wave costs more than masked no-op
+        steps ever will.  tests/test_shape_discipline.py pins this."""
+        return cls.SCAN_MIN_CAP if n_pods <= cls.SCAN_MIN_CAP else cls.SCAN_MAX_CHUNK
+
     def prewarm(self) -> None:
         """Compile (or cache-load) the wave evaluator executable for the
         shapes this engine will use, before the run loop starts.  The
@@ -351,15 +359,7 @@ class DeviceScheduler(Scheduler):
                 if self.constraint_index is not None
                 else [p for ni in node_infos for p in ni.pods]
             )
-            # exactly TWO chunk capacities (128 for small waves, 1024
-            # otherwise): every distinct cap is a scan-executable shape,
-            # and a ~30s tunnel compile inside a wave costs more than
-            # masked no-op steps ever will
-            cap = (
-                self.SCAN_MIN_CAP
-                if len(part) <= self.SCAN_MIN_CAP
-                else self.SCAN_MAX_CHUNK
-            )
+            cap = self._scan_cap(len(part))
 
             def build_and_scan(part_):
                 pods_ = [qpi.pod for qpi in part_]

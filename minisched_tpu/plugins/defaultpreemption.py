@@ -102,19 +102,71 @@ class DefaultPreemption(Plugin):
         by_pct = n_nodes * self.min_candidate_nodes_percentage // 100
         return max(min(max(by_pct, self.min_candidate_nodes_absolute), n_nodes), 1)
 
+    @staticmethod
+    def _own_terms_trivial(pod: Any) -> bool:
+        """True when eviction deltas cannot change the pod's OWN
+        pre-filter state: no (anti-)affinity terms (InterPodAffinity's
+        domain counts) and no DoNotSchedule spread constraint
+        (PodTopologySpread's hard counts).  The remaining pre-filter
+        component — the reverse anti-affinity forbidden set — depends on
+        ASSIGNED pods, and reusing it across probes is conservative: a
+        victim's ban may outlive its dry-run eviction, so a feasible
+        candidate can be missed but never unsafely accepted."""
+        aff = pod.spec.affinity
+        if aff is not None and (
+            aff.pod_affinity is not None or aff.pod_anti_affinity is not None
+        ):
+            return False
+        return not any(
+            c.when_unsatisfiable == "DoNotSchedule"
+            for c in pod.spec.topology_spread_constraints
+        )
+
+    def _shared_prefilter_state(
+        self, pod: Any, node_infos: List[NodeInfo]
+    ) -> Optional[CycleState]:
+        """ONE pre-filter pass against the base snapshot, reused by every
+        candidate probe (see _own_terms_trivial).  The per-probe rebuild
+        was O(cluster) host work — InterPodAffinity's reverse walk alone
+        made a 256-loser wave with real victims effectively hang
+        (measured: 0 preemptions completed in 240s at 2k nodes).
+        Returns None when the pod's own terms require exact per-probe
+        recomputation, or a state marked infeasible when the pre-filter
+        itself rejects."""
+        from minisched_tpu.engine.scheduler import run_pre_filter_plugins
+        from minisched_tpu.framework.plugin import implements_pre_filter
+        from minisched_tpu.framework.types import is_success
+
+        filters = self.h.filter_plugins
+        if not any(implements_pre_filter(pl) for pl in filters):
+            return None  # chains without pre-filter use the plain fast path
+        if not self._own_terms_trivial(pod):
+            return None  # exact slow path per probe
+        # note: no per-node "nodeinfo/*" writes — the filter phase reads
+        # its pre-filter keys only (scoring, which does read them, never
+        # runs in preemption probes), and 10k lock-guarded writes per
+        # preempting pod is exactly the hot-path waste being removed
+        state = CycleState()
+        status, _ = run_pre_filter_plugins(filters, state, pod, node_infos)
+        if not is_success(status):
+            state.write("preempt/prefilter-failed", True)
+        return state
+
     def _feasible_after(
         self,
         pod: Any,
         target: NodeInfo,
         remaining: List[Any],
         node_infos: List[NodeInfo],
+        shared_state: Optional[CycleState] = None,
     ) -> bool:
         """Would the pod pass the full filter chain on ``target`` with only
-        ``remaining`` pods assigned there?  When some filter implements
-        pre-filter, it runs against the whole (substituted) snapshot so
-        cross-pod aggregates see the evictions; chains without pre-filter
-        skip the full-snapshot rebuild entirely (the common fast path —
-        this probe runs once per victim prefix)."""
+        ``remaining`` pods assigned there?  ``shared_state``: the
+        once-per-loser pre-filter artifacts (see _shared_prefilter_state);
+        otherwise, when some filter implements pre-filter, it runs against
+        the whole (substituted) snapshot so cross-pod aggregates see the
+        evictions; chains without pre-filter skip the full-snapshot
+        rebuild entirely."""
         from minisched_tpu.engine.scheduler import (
             run_filter_plugins,
             run_pre_filter_plugins,
@@ -124,20 +176,29 @@ class DefaultPreemption(Plugin):
 
         filters = self.h.filter_plugins
         [trimmed] = build_node_infos([target.node], remaining)
-        state = CycleState()
-        if any(implements_pre_filter(pl) for pl in filters):
-            infos = [
-                trimmed if ni.name == target.name else ni for ni in node_infos
-            ]
-            for ni in infos:
-                state.write("nodeinfo/" + ni.name, ni)
-            state.write("nodeinfos", infos)
-            status, _ = run_pre_filter_plugins(filters, state, pod, infos)
-            if not is_success(status):
-                return False
+        if shared_state is not None:
+            try:
+                if shared_state.read("preempt/prefilter-failed"):
+                    return False
+            except KeyError:
+                pass
+            state = shared_state  # filters read prefilter keys only
         else:
-            state.write("nodeinfo/" + trimmed.name, trimmed)
-            state.write("nodeinfos", [trimmed])
+            state = CycleState()
+            if any(implements_pre_filter(pl) for pl in filters):
+                infos = [
+                    trimmed if ni.name == target.name else ni
+                    for ni in node_infos
+                ]
+                for ni in infos:
+                    state.write("nodeinfo/" + ni.name, ni)
+                state.write("nodeinfos", infos)
+                status, _ = run_pre_filter_plugins(filters, state, pod, infos)
+                if not is_success(status):
+                    return False
+            else:
+                state.write("nodeinfo/" + trimmed.name, trimmed)
+                state.write("nodeinfos", [trimmed])
         try:
             feasible, _ = run_filter_plugins(filters, state, pod, [trimmed])
         except Exception:
@@ -145,7 +206,11 @@ class DefaultPreemption(Plugin):
         return bool(feasible)
 
     def _select_victims(
-        self, pod: Any, ni: NodeInfo, node_infos: List[NodeInfo]
+        self,
+        pod: Any,
+        ni: NodeInfo,
+        node_infos: List[NodeInfo],
+        shared_state: Optional[CycleState] = None,
     ) -> Optional[List[Any]]:
         lower = sorted(
             (p for p in ni.pods if p.spec.priority < pod.spec.priority),
@@ -158,7 +223,7 @@ class DefaultPreemption(Plugin):
         for v in lower:
             remaining.remove(v)
             victims.append(v)
-            if self._feasible_after(pod, ni, remaining, node_infos):
+            if self._feasible_after(pod, ni, remaining, node_infos, shared_state):
                 return victims
         return None
 
@@ -178,11 +243,12 @@ class DefaultPreemption(Plugin):
         cap = self._max_candidates(len(node_infos))
         candidates: List[Tuple[NodeInfo, List[Any]]] = []
         statuses = getattr(diagnosis, "node_to_status", {}) or {}
+        shared_state = self._shared_prefilter_state(pod, node_infos)
         for ni in node_infos:  # name-sorted snapshot → deterministic order
             st = statuses.get(ni.name)
             if st is not None and st.code.name == "UNSCHEDULABLE_AND_UNRESOLVABLE":
                 continue  # eviction can't fix these (upstream skips them)
-            victims = self._select_victims(pod, ni, node_infos)
+            victims = self._select_victims(pod, ni, node_infos, shared_state)
             if victims is not None:
                 candidates.append((ni, victims))
                 if len(candidates) >= cap:

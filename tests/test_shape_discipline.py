@@ -79,13 +79,7 @@ def test_constraint_capacities_stable_under_growth():
 
 
 def test_scan_chunks_use_exactly_two_capacities():
-    caps = set()
-    for n in (1, 64, 128, 129, 700, 1024):
-        caps.add(
-            DeviceScheduler.SCAN_MIN_CAP
-            if n <= DeviceScheduler.SCAN_MIN_CAP
-            else DeviceScheduler.SCAN_MAX_CHUNK
-        )
+    caps = {DeviceScheduler._scan_cap(n) for n in (1, 64, 128, 129, 700, 1024)}
     assert caps == {DeviceScheduler.SCAN_MIN_CAP, DeviceScheduler.SCAN_MAX_CHUNK}
 
 
