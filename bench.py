@@ -536,6 +536,18 @@ def bench_config5_fullchain() -> dict:
         "wave_evaluate_total_s": phase("wave_evaluate", "total_s"),
         "scan_evaluate_total_s": phase("scan_evaluate", "total_s"),
         "bind_total_s": phase("bind", "total_s"),
+        # per-wave breakdown of the evaluate wall (VERDICT r3 item 1):
+        # snapshot → table build → constraint build → device call; the
+        # device term includes the packed flat-buffer transfer + fetch
+        "wave_breakdown": {
+            "snapshot_total_s": phase("wave_snapshot", "total_s"),
+            "build_tables_total_s": phase("wave_build_tables", "total_s"),
+            "build_constraints_total_s": phase(
+                "wave_build_constraints", "total_s"
+            ),
+            "device_total_s": phase("wave_device", "total_s"),
+            "device_mean_s": phase("wave_device", "mean_s"),
+        },
     }
 
 

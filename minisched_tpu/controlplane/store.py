@@ -62,6 +62,18 @@ class Watch:
             self._events.append(event)
             self._cond.notify_all()
 
+    def _deliver_many(self, events: List[WatchEvent]) -> None:
+        """Batch delivery: ONE condvar hold + notify for the whole list.
+        A wave's batch bind fans out thousands of events; per-event lock/
+        notify round-trips were a measurable slice of the bind wall."""
+        if not events:
+            return
+        with self._cond:
+            if self._stopped:
+                return
+            self._events.extend(events)
+            self._cond.notify_all()
+
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         import time as _time
 
@@ -79,6 +91,25 @@ class Watch:
             if self._events:
                 return self._events.pop(0)
             return None
+
+    def next_batch(self, timeout: Optional[float] = None) -> List[WatchEvent]:
+        """Drain EVERYTHING queued in one condvar hold (empty list on
+        timeout/stop).  The informer dispatch thread consumes batches so a
+        wave's thousands of bind events cost one lock round-trip, not one
+        each — the per-event form starved the GIL-free device window."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._cond:
+            while not self._events and not self._stopped:
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        break
+            out, self._events = self._events, []
+            return out
 
     def stop(self) -> None:
         with self._cond:
@@ -222,6 +253,7 @@ class ObjectStore:
         object's clone only because callers expect the update() contract.
         """
         out: List[Any] = []
+        events: List[WatchEvent] = []
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             for namespace, name, fn in items:
@@ -238,11 +270,13 @@ class ObjectStore:
                     objs[key] = work
                     self._on_batch_commit(kind, work)
                     out.append(work.clone() if return_objects else None)
-                    self._fanout(
-                        kind, WatchEvent(EventType.MODIFIED, work, old)
-                    )
+                    events.append(WatchEvent(EventType.MODIFIED, work, old))
                 except Exception as err:  # noqa: BLE001 — returned, not lost
                     out.append(err)
+            # ONE batched fanout per watcher, still under the store lock so
+            # queue order equals mutation order across concurrent mutators
+            for w in list(self._watches.get(kind, ())):
+                w._deliver_many(events)
         return out
 
     def _on_batch_commit(self, kind: str, obj: Any) -> None:
@@ -291,8 +325,12 @@ class ObjectStore:
             w = Watch(self, kind)
             snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
             if send_initial:
-                for obj in snapshot:
-                    w._deliver(WatchEvent(EventType.ADDED, obj.clone()))
+                w._deliver_many(
+                    [
+                        WatchEvent(EventType.ADDED, obj.clone())
+                        for obj in snapshot
+                    ]
+                )
             self._watches.setdefault(kind, []).append(w)
         return w, snapshot
 

@@ -67,16 +67,19 @@ class SchedulerCache:
 
     def delete_node(self, node: Any) -> None:
         with self._mu:
-            ni = self._nodes.pop(node.metadata.name, None)
-            self._sorted = None
-            if ni is not None:
-                # the pods are still bound in the cluster view and will
-                # emit no further events — re-orphan them so a node
-                # re-registration with the same name re-adopts their
-                # accounting instead of starting from an empty NodeInfo
-                for p in ni.pods:
-                    self._pod_node.pop(p.metadata.uid, None)
-                    self._orphans[p.metadata.uid] = p
+            self._delete_node_locked(node)
+
+    def _delete_node_locked(self, node: Any) -> None:
+        ni = self._nodes.pop(node.metadata.name, None)
+        self._sorted = None
+        if ni is not None:
+            # the pods are still bound in the cluster view and will
+            # emit no further events — re-orphan them so a node
+            # re-registration with the same name re-adopts their
+            # accounting instead of starting from an empty NodeInfo
+            for p in ni.pods:
+                self._pod_node.pop(p.metadata.uid, None)
+                self._orphans[p.metadata.uid] = p
 
     # -- pod events (assigned pods only — the informer filter gates) ------
     def add_pod(self, pod: Any) -> None:
@@ -85,18 +88,21 @@ class SchedulerCache:
 
     def update_pod(self, old: Any, new: Any) -> None:
         with self._mu:
-            uid = new.metadata.uid
-            prev = self._pod_node.get(uid)
-            if prev == new.spec.node_name:
-                # same node: refresh the stored object (requests can't
-                # change post-bind in kube semantics, but keep exact)
-                ni = self._nodes.get(prev)
-                if ni is not None:
-                    ni.remove_pod(new)
-                    ni.add_pod(new)
-                return
-            self._remove(new)
-            self._place(new)
+            self._update_pod_locked(new)
+
+    def _update_pod_locked(self, new: Any) -> None:
+        uid = new.metadata.uid
+        prev = self._pod_node.get(uid)
+        if prev == new.spec.node_name:
+            # same node: refresh the stored object (requests can't
+            # change post-bind in kube semantics, but keep exact)
+            ni = self._nodes.get(prev)
+            if ni is not None:
+                ni.remove_pod(new)
+                ni.add_pod(new)
+            return
+        self._remove(new)
+        self._place(new)
 
     def delete_pod(self, pod: Any) -> None:
         with self._mu:
@@ -140,30 +146,65 @@ class SchedulerCache:
                 )
             return [ni.clone() for ni in self._sorted], set(self._pod_node)
 
+    # -- batch ingestion (informer on_batch fast path) ---------------------
+    def _pod_batch(self, events: List[Any]) -> None:
+        """A whole informer batch under ONE lock hold — a wave's thousands
+        of bind events each cost dict ops, not a lock round-trip.  Applies
+        the assigned-pod filter itself (batch handlers see the raw batch);
+        errors are contained PER EVENT (one malformed object must not
+        drop the rest of the batch from this consumer while others apply
+        it — the per-event dispatch path had that containment)."""
+        from minisched_tpu.controlplane.store import EventType
+
+        with self._mu:
+            for ev in events:
+                try:
+                    if not ev.obj.spec.node_name:
+                        continue
+                    if ev.type == EventType.DELETED:
+                        self._remove(ev.obj)
+                    elif ev.type == EventType.ADDED:
+                        self._place(ev.obj)
+                    else:
+                        self._update_pod_locked(ev.obj)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _node_batch(self, events: List[Any]) -> None:
+        from minisched_tpu.controlplane.store import EventType
+
+        with self._mu:
+            for ev in events:
+                try:
+                    node = ev.obj
+                    if ev.type == EventType.DELETED:
+                        self._delete_node_locked(node)
+                        continue
+                    ni = self._nodes.get(node.metadata.name)
+                    if ni is None:
+                        self._create_node(node)
+                    else:
+                        ni.node = node
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
     def wire(self, informer_factory: Any) -> None:
         """Register the cache's handlers.  MUST run before the queue's
         handlers are registered so a requeued pod's next snapshot already
         reflects the event that woke it."""
         from minisched_tpu.controlplane.informer import ResourceEventHandlers
 
-        def assigned(pod: Any) -> bool:
-            return bool(pod.spec.node_name)
-
-        # the filter gates on the event's (new) object: pending pods never
-        # reach the cache; a bind arrives as an UPDATE whose new object is
-        # assigned (update_pod places it), deletes of assigned pods pass
+        # batch handlers: the dispatch thread hands over whole event
+        # batches; the pod path gates on assignment internally (pending
+        # pods never reach the cache; a bind arrives as MODIFIED whose new
+        # object is assigned, deletes of assigned pods pass)
         informer_factory.informer_for("Pod").add_event_handlers(
-            ResourceEventHandlers(
-                on_add=self.add_pod,
-                on_update=self.update_pod,
-                on_delete=self.delete_pod,
-                filter=assigned,
-            )
+            ResourceEventHandlers(on_batch=self._pod_batch)
         )
         informer_factory.informer_for("Node").add_event_handlers(
-            ResourceEventHandlers(
-                on_add=self.add_node,
-                on_update=self.update_node,
-                on_delete=self.delete_node,
-            )
+            ResourceEventHandlers(on_batch=self._node_batch)
         )

@@ -188,6 +188,16 @@ class DeviceScheduler(Scheduler):
         self._forget(qpi.pod.metadata.uid)
         super().error_func(qpi, err, plugin)
 
+    @property
+    def _packed_mode(self) -> bool:
+        """Single-program packed waves: tables ride as flat host buffers
+        unpacked inside the evaluator's program.  Off under a mesh (the
+        sharded step shards device tables) and under record_results (the
+        diagnostics evaluation needs device tables).  One definition —
+        prewarm and the live paths must never disagree, or the first live
+        wave compiles mid-run (~30s on the tunnel)."""
+        return self.mesh is None and self.result_store is None
+
     def _get_evaluator(self) -> RepairingEvaluator:
         if self._evaluator is None:
             self._evaluator = RepairingEvaluator(
@@ -265,31 +275,79 @@ class DeviceScheduler(Scheduler):
         complex_pod = make_pod(
             "warmsel", requests={"cpu": "1"}, node_selector={"warm": "true"}
         )
-        warm_caps = {pod_capacity}
-        if self._has_cross_pod:
-            warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
-        for cap in warm_caps:
-            build_pod_table([complex_pod], capacity=cap, force_packed=True)
+        packed_mode = self._packed_mode
+        if not packed_mode:
+            # the unpacked path ships pod tables through per-capacity
+            # splitter executables; packed mode never invokes them
+            warm_caps = {pod_capacity}
+            if self._has_cross_pod:
+                warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
+            for cap in warm_caps:
+                build_pod_table([complex_pod], capacity=cap, force_packed=True)
         infos = build_node_infos(nodes, [])
-        node_table, _ = CachedNodeTableBuilder().build(
-            infos, capacity=node_capacity, prof_capacity=prof_capacity
-        )
-        pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
-        extra = None
-        if self._needs_extra:
-            extra = build_constraint_tables(
-                pods, nodes, [],
-                pod_capacity=pod_capacity, node_capacity=node_capacity,
-                scan_planes=False,
+        if packed_mode:
+            # warm the single-program packed entry points for BOTH pod
+            # schemas a live wave can take: the fast (simple-pod) schema
+            # and the slow one (any pod with selector/affinity/...), each
+            # a distinct executable keyed on the packed metas
+            node_static, node_agg, _ = CachedNodeTableBuilder().build_packed(
+                infos, capacity=node_capacity, prof_capacity=prof_capacity
             )
-        out = self._get_evaluator()(pod_table, node_table, extra)
-        jax.block_until_ready(out[1])
+            for warm_pods in (pods, pods + [complex_pod]):
+                pt, _ = build_pod_table(
+                    warm_pods, capacity=pod_capacity, device=False
+                )
+                extra = None
+                if self._needs_extra:
+                    extra = build_constraint_tables(
+                        warm_pods, nodes, [],
+                        pod_capacity=pod_capacity,
+                        node_capacity=node_capacity,
+                        scan_planes=False, device=False,
+                    )
+                out = self._get_evaluator().call_packed(
+                    pt, node_static, node_agg, extra
+                )
+                jax.block_until_ready(out[1])
+        else:
+            node_table, _ = CachedNodeTableBuilder().build(
+                infos, capacity=node_capacity, prof_capacity=prof_capacity
+            )
+            pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
+            extra = None
+            if self._needs_extra:
+                extra = build_constraint_tables(
+                    pods, nodes, [],
+                    pod_capacity=pod_capacity, node_capacity=node_capacity,
+                    scan_planes=False,
+                )
+            out = self._get_evaluator()(pod_table, node_table, extra)
+            jax.block_until_ready(out[1])
         if self._has_cross_pod:
             # cross-pod-constrained pods ride the sequential scan — warm
             # BOTH chunk capacities (_schedule_scan uses exactly these
             # two; a partial chunk compiling the small one mid-run cost
             # ~13s).  Fresh node table: the mesh-mode repair warm above
             # donates its (re-sharded) argument and must not alias this.
+            if packed_mode:
+                # scan chunks carry cross-pod pods, which are never
+                # "simple" — the live schema is the SLOW pod table; warm
+                # exactly that packed entry per chunk capacity
+                for cap in (self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK):
+                    scan_pods, _ = build_pod_table(
+                        pods + [complex_pod], capacity=cap, device=False
+                    )
+                    scan_extra = build_constraint_tables(
+                        pods + [complex_pod], nodes, [],
+                        pod_capacity=cap,
+                        node_capacity=node_capacity,
+                        scan_planes=True, device=False,
+                    )
+                    _, choice, _ = self._get_scan_scheduler().call_packed(
+                        scan_pods, node_static, node_agg, scan_extra
+                    )
+                    jax.block_until_ready(choice)
+                return
             node_table, _ = CachedNodeTableBuilder().build(
                 infos, capacity=node_capacity, prof_capacity=prof_capacity
             )
@@ -363,6 +421,29 @@ class DeviceScheduler(Scheduler):
 
             def build_and_scan(part_):
                 pods_ = [qpi.pod for qpi in part_]
+                packed_mode = self._packed_mode
+                if packed_mode:
+                    # single-program chunk: flat host buffers unpacked
+                    # inside the scan executable (see _build_and_evaluate)
+                    node_static, node_agg, node_names = (
+                        self._table_builder.build_packed(node_infos)
+                    )
+                    pod_table, _ = build_pod_table(
+                        pods_, capacity=cap, device=False
+                    )
+                    extra = self._build_constraints(
+                        pods_, nodes, assigned,
+                        pod_capacity=cap,
+                        node_capacity=node_agg.capacity,
+                        scan_planes=True,  # the scan's commits need it
+                        device=False,
+                    )
+                    with self.metrics.timed("scan_evaluate"):
+                        _, choice, _ = self._get_scan_scheduler().call_packed(
+                            pod_table, node_static, node_agg, extra
+                        )
+                        choice = jax.device_get(choice)
+                    return node_names, choice.tolist()[: len(pods_)]
                 node_table, node_names = self._table_builder.build(node_infos)
                 pod_table, _ = build_pod_table(pods_, capacity=cap)
                 extra = self._build_constraints(
@@ -479,30 +560,54 @@ class DeviceScheduler(Scheduler):
 
     def _build_and_evaluate(self, qpis_, node_infos, nodes, assigned):
         """One repair-wave evaluation: tables → fused repair evaluator →
-        (node_names, placements, per-pod failing-plugin sets)."""
+        (node_names, placements, per-pod failing-plugin sets).
+
+        Single-device waves take the PACKED path: tables stay host-side as
+        flat buffers and the evaluator unpacks them inside its one jitted
+        program — separate per-table splitter programs alternating with
+        the evaluator stalled ~1.4s per wave on the tunneled runtime
+        (program-switch cost).  Mesh mode and record_results (which needs
+        device tables for the diagnostics evaluation) keep the unpacked
+        path."""
         import jax
 
         pods_ = [qpi.pod for qpi in qpis_]
+        packed_mode = self._packed_mode
+        pod_capacity = pad_to(max(len(pods_), self.max_wave))
         with self.metrics.timed("wave_build_tables"):
-            node_table, node_names = self._table_builder.build(node_infos)
-            pod_table, _ = build_pod_table(
-                pods_, capacity=pad_to(max(len(pods_), self.max_wave))
-            )
+            if packed_mode:
+                node_static, node_agg, node_names = (
+                    self._table_builder.build_packed(node_infos)
+                )
+                node_capacity = node_agg.capacity
+                pod_table, _ = build_pod_table(
+                    pods_, capacity=pod_capacity, device=False
+                )
+            else:
+                node_table, node_names = self._table_builder.build(node_infos)
+                node_capacity = node_table.capacity
+                pod_table, _ = build_pod_table(pods_, capacity=pod_capacity)
         extra = None
         if self._needs_extra:
             with self.metrics.timed("wave_build_constraints"):
                 extra = self._build_constraints(
                     pods_, nodes, assigned,
-                    pod_capacity=pod_table.capacity,
-                    node_capacity=node_table.capacity,
+                    pod_capacity=pod_capacity,
+                    node_capacity=node_capacity,
                     scan_planes=False,  # wave mode never runs the scan
+                    device=not packed_mode,
                 )
         if self.result_store is not None:
             self._record_wave(pods_, pod_table, node_table, node_names, extra)
         with self.metrics.timed("wave_device"):
-            _, choice, _, unsched = self._get_evaluator()(
-                pod_table, node_table, extra
-            )
+            if packed_mode:
+                _, choice, _, unsched = self._get_evaluator().call_packed(
+                    pod_table, node_static, node_agg, extra
+                )
+            else:
+                _, choice, _, unsched = self._get_evaluator()(
+                    pod_table, node_table, extra
+                )
             # ONE host fetch for both results (each device_get is a tunnel
             # round-trip); bool[K, P] → per-pod failing-plugin sets
             choice, unsched = jax.device_get((choice, unsched))

@@ -48,27 +48,61 @@ def add_all_event_handlers(
     """eventhandler.go:14-77, driven by the unioned GVK→ActionType map from
     plugin registrations (initialize.go:169-179)."""
     # --- pods: the scheduling workload itself (always wired) -----------
+    from minisched_tpu.controlplane.store import EventType
+
     pod_informer = informer_factory.informer_for("Pod")
+
+    def unassigned_batch(events):
+        """Pending pods feed the queue.  ADD floods (cluster creation
+        replays every pending pod) take the one-lock batch path; updates
+        and deletes are rare and go one at a time."""
+        adds = [
+            ev.obj
+            for ev in events
+            if ev.type == EventType.ADDED and not assigned(ev.obj)
+        ]
+        if adds:
+            sched.queue.add_batch(adds)
+        for ev in events:
+            try:
+                if assigned(ev.obj) or ev.type == EventType.ADDED:
+                    continue
+                if ev.type == EventType.MODIFIED:
+                    sched.queue.update(ev.old_obj, ev.obj)
+                else:
+                    sched.queue.delete(ev.obj)
+            except Exception:  # one bad event must not drop the rest
+                import traceback
+
+                traceback.print_exc()
+
     pod_informer.add_event_handlers(
-        ResourceEventHandlers(
-            on_add=lambda pod: sched.queue.add(pod),
-            on_update=lambda old, new: sched.queue.update(old, new),
-            on_delete=lambda pod: sched.queue.delete(pod),
-            filter=lambda pod: not assigned(pod),
-        )
+        ResourceEventHandlers(on_batch=unassigned_batch)
     )
+
     # assigned pods may unblock pods waiting on inter-pod constraints;
     # their DELETION frees capacity (it is how preemption victims make
-    # room), so it replays pods whose failed plugins registered Pod/DELETE
-    pod_informer.add_event_handlers(
-        ResourceEventHandlers(
-            on_add=lambda pod: sched.queue.assigned_pod_added(pod),
-            on_update=lambda old, new: sched.queue.assigned_pod_updated(new),
-            on_delete=lambda pod: sched.queue.move_all_to_active_or_backoff(
+    # room), so it replays pods whose failed plugins registered Pod/DELETE.
+    # move_all_to_active_or_backoff is pod-independent — one call per
+    # action type present covers the whole batch (a wave's 8k binds used
+    # to cost 8k queue-lock round-trips finding the same empty candidates)
+    def assigned_batch(events):
+        types = {ev.type for ev in events if assigned(ev.obj)}
+        if EventType.ADDED in types:
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.ADD)
+            )
+        if EventType.MODIFIED in types:
+            sched.queue.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.POD, ActionType.UPDATE)
+            )
+        if EventType.DELETED in types:
+            sched.queue.move_all_to_active_or_backoff(
                 ClusterEvent(GVK.POD, ActionType.DELETE)
-            ),
-            filter=assigned,
-        )
+            )
+
+    pod_informer.add_event_handlers(
+        ResourceEventHandlers(on_batch=assigned_batch)
     )
 
     # --- other GVKs, gated on what plugins registered -------------------

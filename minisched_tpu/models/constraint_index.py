@@ -146,12 +146,7 @@ class ConstraintIndex:
         self._pv_lister = lambda name: pv_inf.get(f"/{name}")
         self._node_get = lambda name: node_inf.get(f"/{name}")
         informer_factory.informer_for("Pod").add_event_handlers(
-            ResourceEventHandlers(
-                on_add=self.add_pod,
-                on_update=self.update_pod,
-                on_delete=self.delete_pod,
-                filter=assigned,
-            )
+            ResourceEventHandlers(on_batch=self._pod_batch)
         )
         informer_factory.informer_for("Node").add_event_handlers(
             ResourceEventHandlers(
@@ -178,6 +173,31 @@ class ConstraintIndex:
         )
 
     # -- event handlers ----------------------------------------------------
+    def _pod_batch(self, events: List[Any]) -> None:
+        """Informer batch fast path: one lock hold for a whole wave's bind
+        events.  Gates on assignment itself (batch handlers receive the
+        raw batch; pending pods never touch the planes); errors are
+        contained per event so one malformed object cannot drop the rest
+        of the batch from the index."""
+        from minisched_tpu.controlplane.store import EventType
+
+        with self._mu:
+            for ev in events:
+                try:
+                    if not ev.obj.spec.node_name:
+                        continue
+                    if ev.type == EventType.DELETED:
+                        self._remove(ev.obj.metadata.uid)
+                    elif ev.type == EventType.ADDED:
+                        self._add(ev.obj)
+                    else:
+                        self._remove(ev.obj.metadata.uid)
+                        self._add(ev.obj)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
     def add_pod(self, pod: Any) -> None:
         with self._mu:
             self._add(pod)

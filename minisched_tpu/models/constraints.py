@@ -291,7 +291,8 @@ def build_constraint_tables(
     scan_planes: bool = True,
     index: Any = None,
     extra_assigned: Sequence[Any] = (),
-) -> ConstraintTables:
+    device: bool = True,
+):
     """Build the wave's coupling tables.
 
     ``nodes`` must be in the SAME order as the NodeTable build (name-sorted)
@@ -664,10 +665,14 @@ def build_constraint_tables(
             ppa_combo[i, j], ppa_w[i, j] = cid, w
         ppa_n[i] = len(row["ppa"])
 
-    # one batched transfer (per-array device_put pays a dispatch RTT each)
-    from minisched_tpu.models.tables import batched_device_put
+    # one batched transfer (per-array device_put pays a dispatch RTT each);
+    # device=False instead returns the still-on-host PackedTable for
+    # consumers that unpack inside their own program (ops/repair packed
+    # mode — a separate splitter program alternating with the evaluator
+    # stalled ~1.4s per wave on the tunneled runtime)
+    from minisched_tpu.models.tables import batched_device_put, pack_table
 
-    as_j = batched_device_put(dict(
+    host_cols = dict(
             combo_dsum=combo_dsum, combo_haskey=combo_haskey,
             combo_global=combo_global, combo_here=combo_here,
             combo_key=combo_key, topo_domain=topo_domain,
@@ -686,5 +691,7 @@ def build_constraint_tables(
             claim_family=claim_family, claim_ro=claim_ro,
             pod_claim_valid=pod_claim_valid, pod_missing=pod_missing,
             vol_any=vol_any, vol_rw=vol_rw,
-        ))
-    return ConstraintTables(**as_j)
+        )
+    if not device:
+        return pack_table(host_cols, (), P)
+    return ConstraintTables(**batched_device_put(host_cols))

@@ -115,37 +115,155 @@ import functools
 _SCHEMA_SEEN: Dict[Tuple, int] = {}
 
 
+_ZERO_DT = {"bool": jnp.bool_, "uint32": jnp.uint32, "int32": jnp.int32}
+
+
+def unpack_columns(
+    flat,
+    metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...],
+    zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
+) -> Dict[str, Any]:
+    """TRACEABLE inverse of ``pack_columns``: slice the flat int32 buffer
+    back into named, dtyped columns (+ all-zero columns materialized in
+    place).  Usable inside a larger jit — the wave evaluator unpacks its
+    tables inside its OWN program so a wave costs one executable, not an
+    alternation of splitter programs with the evaluator (each switch
+    stalled ~1.4s on the tunneled runtime)."""
+    out = {}
+    off = 0
+    for name, kind, shape in metas:
+        size = 1
+        for d in shape:
+            size *= d
+        seg = flat[off : off + size].reshape(shape)
+        off += size
+        if kind == "bool":
+            out[name] = seg != 0
+        elif kind == "uint32":
+            out[name] = jax.lax.bitcast_convert_type(seg, jnp.uint32)
+        else:
+            out[name] = seg
+    for name, kind, shape in zero_metas:
+        out[name] = jnp.zeros(shape, _ZERO_DT[kind])
+    return out
+
+
+def pack_columns(
+    host: Dict[str, Any],
+) -> Tuple[Tuple[Tuple[str, str, Tuple[int, ...]], ...], Any]:
+    """(metas, flat int32 buffer): the host half of ``batched_device_put``
+    without the device call — callers hand the flat buffer to a jitted
+    function that runs ``unpack_columns`` with these metas inside."""
+    arrays = {k: np.asarray(v) for k, v in host.items()}
+    metas = _col_metas(arrays)
+    parts = []
+    for (k, kind, _shape), v in zip(metas, arrays.values()):
+        if kind == "bool":
+            parts.append(v.ravel().astype(np.int32))
+        elif kind == "uint32":
+            parts.append(v.ravel().view(np.int32))
+        else:
+            parts.append(np.ascontiguousarray(v.ravel(), dtype=np.int32))
+    flat = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+    return metas, flat
+
+
 @functools.lru_cache(maxsize=None)
 def _flat_splitter(
     metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...],
     zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
 ):
-    """Jitted device-side splitter for one packed-table schema: slices the
-    flat int32 buffer back into named columns with their dtypes, plus any
-    all-zero columns materialized directly on device (no wire bytes)."""
-
-    _DT = {"bool": jnp.bool_, "uint32": jnp.uint32, "int32": jnp.int32}
+    """Jitted device-side splitter for one packed-table schema."""
 
     def split(flat):
-        out = {}
-        off = 0
-        for name, kind, shape in metas:
-            size = 1
-            for d in shape:
-                size *= d
-            seg = flat[off : off + size].reshape(shape)
-            off += size
-            if kind == "bool":
-                out[name] = seg != 0
-            elif kind == "uint32":
-                out[name] = jax.lax.bitcast_convert_type(seg, jnp.uint32)
-            else:
-                out[name] = seg
-        for name, kind, shape in zero_metas:
-            out[name] = jnp.zeros(shape, _DT[kind])
-        return out
+        return unpack_columns(flat, metas, zero_metas)
 
     return jax.jit(split)
+
+
+@dataclass
+class PackedTable:
+    """A table still on the host, packed for single-buffer transfer: the
+    consumer jit takes ``flat`` as an argument and rebuilds the columns
+    with ``unpack_columns(flat, metas, zero_metas)`` INSIDE its own
+    program.  ``metas``/``zero_metas`` are static (part of the consumer's
+    jit cache key); equal schemas hit the same executable."""
+
+    metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...]
+    zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...]
+    flat: Any  # np.int32[total]
+    capacity: int = 0
+
+    @property
+    def schema(self) -> Tuple:
+        return (self.metas, self.zero_metas)
+
+    def unpack(self, flat=None) -> Dict[str, Any]:
+        return unpack_columns(
+            self.flat if flat is None else flat, self.metas, self.zero_metas
+        )
+
+
+def pack_table(
+    host: Dict[str, Any],
+    zero_metas: Tuple = (),
+    capacity: int = 0,
+) -> PackedTable:
+    metas, flat = pack_columns(host)
+    return PackedTable(metas, tuple(zero_metas), flat, capacity)
+
+
+class PackedCaller:
+    """Per-schema jit cache around a ``consumer(pods, nodes, extra)``
+    function: arguments arrive as PackedTables (+ the device-resident
+    static node columns) and are unpacked INSIDE the consumer's one jitted
+    program.  Separate splitter programs alternating with the evaluator
+    stalled ~1.4s per program switch on the tunneled runtime; this keeps a
+    wave to one executable and three flat transfers.
+
+    Schemas are static jit-cache keys, so capacities must follow the same
+    quantization discipline as device-table consumers."""
+
+    def __init__(self, consumer):
+        self._consumer = consumer
+        self._fns: Dict[Tuple, Any] = {}
+
+    def __call__(self, pod_packed, node_static, node_agg_packed,
+                 extra_packed=None):
+        ex_schema = extra_packed.schema if extra_packed is not None else None
+        key = (pod_packed.schema, node_agg_packed.schema, ex_schema,
+               tuple(sorted(node_static)))
+        fn = self._fns.get(key)
+        if fn is None:
+            from minisched_tpu.models.constraints import ConstraintTables
+
+            pod_metas, pod_zeros = pod_packed.schema
+            agg_metas, _ = node_agg_packed.schema
+            ex_metas = extra_packed.metas if extra_packed is not None else None
+            consumer = self._consumer
+
+            def run(pod_flat, agg_flat, ex_flat, static_cols):
+                pods = PodTable(
+                    **unpack_columns(pod_flat, pod_metas, pod_zeros)
+                )
+                nodes = NodeTable(
+                    **static_cols, **unpack_columns(agg_flat, agg_metas)
+                )
+                extra = (
+                    ConstraintTables(**unpack_columns(ex_flat, ex_metas))
+                    if ex_metas is not None
+                    else None
+                )
+                return consumer(pods, nodes, extra)
+
+            fn = jax.jit(run)
+            self._fns[key] = fn
+        ex_flat = (
+            extra_packed.flat
+            if extra_packed is not None
+            else np.zeros(0, np.int32)
+        )
+        return fn(pod_packed.flat, node_agg_packed.flat, ex_flat, node_static)
 
 
 def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
@@ -207,15 +325,7 @@ def batched_device_put(
         # (utils/compilecache.py) after the first-ever build, so even a
         # one-shot 39-column constraint table beats 39 tunnel round-trips.
         return {k: jnp.asarray(v) for k, v in arrays.items()}
-    parts = []
-    for (k, kind, _shape), v in zip(metas, arrays.values()):
-        if kind == "bool":
-            parts.append(v.ravel().astype(np.int32))
-        elif kind == "uint32":
-            parts.append(v.ravel().view(np.int32))
-        else:
-            parts.append(np.ascontiguousarray(v.ravel(), dtype=np.int32))
-    flat = np.concatenate(parts) if parts else np.zeros(0, np.int32)
+    _, flat = pack_columns(arrays)
     return _flat_splitter(metas, zero_metas)(flat)
 
 
@@ -633,12 +743,10 @@ class CachedNodeTableBuilder:
         self._device_static = device_static
         self._names: List[str] = []
 
-    def build(self, node_infos: Sequence[Any], capacity: int = None,
-              prof_capacity: int = None):
-        n = len(node_infos)
-        cap = capacity or pad_to(n)
-        if n > cap:
-            raise ValueError(f"{n} nodes exceed table capacity {cap}")
+    def _ensure_static(self, node_infos: Sequence[Any], cap: int,
+                       prof_capacity: int) -> None:
+        """Re-encode + (optionally) re-upload the static columns only when
+        the name-sorted (name, resource_version) signature changes."""
         sig = (
             cap,
             prof_capacity,
@@ -647,25 +755,29 @@ class CachedNodeTableBuilder:
                 for ni in node_infos
             ),
         )
-        if sig != self._sig:
-            reg = _ProfileRegistry()
-            pids = [reg.pid_for(ni.node) for ni in node_infos]
-            t = _node_table_skeleton(cap, _prof_cap(reg, prof_capacity))
-            reg.encode_rows(t)
-            names: List[str] = []
-            for i, ni in enumerate(node_infos):
-                names.append(ni.name)
-                _encode_node_static(t, i, ni.node, pids[i])
-            self._static = {k: t[k] for k in _NODE_STATIC_COLS}
-            # static columns live on DEVICE between waves: re-uploading
-            # the label/taint/image planes for 10k+ nodes every wave cost
-            # tens of MB of tunnel bandwidth per wave for bytes that only
-            # change when a node object changes
-            if self._device_static:
-                self._static_dev = batched_device_put(self._static)
-                self._static = {}  # device copy is the only consumer
-            self._names = names
-            self._sig = sig
+        if sig == self._sig:
+            return
+        reg = _ProfileRegistry()
+        pids = [reg.pid_for(ni.node) for ni in node_infos]
+        t = _node_table_skeleton(cap, _prof_cap(reg, prof_capacity))
+        reg.encode_rows(t)
+        names: List[str] = []
+        for i, ni in enumerate(node_infos):
+            names.append(ni.name)
+            _encode_node_static(t, i, ni.node, pids[i])
+        self._static = {k: t[k] for k in _NODE_STATIC_COLS}
+        # static columns live on DEVICE between builds: re-uploading the
+        # label/taint/image planes for 10k+ nodes every wave cost tens of
+        # MB of tunnel bandwidth per wave for bytes that only change when
+        # a node object changes
+        if self._device_static:
+            self._static_dev = batched_device_put(self._static)
+            self._static = {}  # device copy is the only consumer
+        self._names = names
+        self._sig = sig
+
+    @staticmethod
+    def _fill_aggregates(node_infos: Sequence[Any], cap: int) -> Dict[str, Any]:
         t: Dict[str, Any] = {}
         for k in _NODE_AGG_COLS:
             t[k] = (
@@ -675,6 +787,21 @@ class CachedNodeTableBuilder:
             )
         for i, ni in enumerate(node_infos):
             _fill_aggregate_row(t, i, ni)
+        return t
+
+    @staticmethod
+    def _cap_for(node_infos: Sequence[Any], capacity) -> int:
+        n = len(node_infos)
+        cap = capacity or pad_to(n)
+        if n > cap:
+            raise ValueError(f"{n} nodes exceed table capacity {cap}")
+        return cap
+
+    def build(self, node_infos: Sequence[Any], capacity: int = None,
+              prof_capacity: int = None):
+        cap = self._cap_for(node_infos, capacity)
+        self._ensure_static(node_infos, cap, prof_capacity)
+        t = self._fill_aggregates(node_infos, cap)
         if self._device_static:
             cols = dict(self._static_dev)
             cols.update(batched_device_put(t))
@@ -683,6 +810,19 @@ class CachedNodeTableBuilder:
             cols.update(t)
             cols = batched_device_put(cols)
         return NodeTable(**cols), list(self._names)
+
+    def build_packed(self, node_infos: Sequence[Any], capacity: int = None,
+                     prof_capacity: int = None):
+        """Single-program variant: (static device cols, PackedTable of the
+        per-wave aggregate columns, names).  The consumer jit unpacks the
+        aggregates and merges the device-resident statics inside its own
+        program — no splitter executable per wave.  Requires
+        ``device_static=True`` (the statics must already live on device)."""
+        assert self._device_static, "build_packed needs device-resident statics"
+        cap = self._cap_for(node_infos, capacity)
+        self._ensure_static(node_infos, cap, prof_capacity)
+        t = self._fill_aggregates(node_infos, cap)
+        return self._static_dev, pack_table(t, (), cap), list(self._names)
 
 
 def _encode_terms(t: Dict[str, Any], prefix: str, i: int, terms, max_terms: int,
@@ -729,7 +869,8 @@ def _pod_is_simple(pod: Any) -> bool:
     )
 
 
-def _build_pod_table_fast(pods: Sequence[Any], cap: int) -> Tuple[PodTable, List[str]]:
+def _build_pod_table_fast(pods: Sequence[Any], cap: int,
+                          device: bool = True):
     """Columnar fast path for simple pods: per-field list comprehensions +
     native batch string kernels (minisched_tpu.native) instead of the
     per-pod row-write loop — ~10× on the host build that feeds the device
@@ -775,6 +916,8 @@ def _build_pod_table_fast(pods: Sequence[Any], cap: int) -> Tuple[PodTable, List
     # wire bytes, no second executable) — the table is ~50× wider than its
     # live fast-path columns and PCIe/tunnel bandwidth on the host build
     # was the wave pipeline's bottleneck.
+    if not device:
+        return pack_table(host, _zero_pod_metas(cap), cap), names
     cols = batched_device_put(host, zero_metas=_zero_pod_metas(cap))
     return PodTable(**cols), names
 
@@ -819,14 +962,17 @@ def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
 
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None,
-                    force_packed: bool = False) -> Tuple[PodTable, List[str]]:
+                    force_packed: bool = False, device: bool = True):
+    """``device=False`` returns (PackedTable, names) instead of a
+    device-resident PodTable — for consumers that unpack the flat
+    buffer inside their own jitted program (ops/repair packed mode)."""
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
         raise ValueError(f"{p} pods exceed table capacity {cap}")
 
     if all(_pod_is_simple(pod) for pod in pods):
-        return _build_pod_table_fast(pods, cap)
+        return _build_pod_table_fast(pods, cap, device=device)
 
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)
@@ -965,4 +1111,6 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
             for j, port in enumerate(ports):
                 t["port"][i, j] = port
             t["num_ports"][i] = len(ports)
+    if not device:
+        return pack_table(t, (), cap), names
     return PodTable(**batched_device_put(t, force_packed=force_packed)), names

@@ -455,6 +455,15 @@ class RepairingEvaluator:
                 ctx,
             )
         self._mesh = mesh
+        # packed-mode state: jitted (flat buffers → results) entry points,
+        # keyed on the (pod, node-agg, extra) schemas — see call_packed
+        self._chains = (tuple(filter_plugins), tuple(pre_score_plugins),
+                        tuple(score_plugins))
+        self._ctx = ctx
+        self._max_rounds = max_rounds
+        self._with_diagnostics = with_diagnostics
+        self._split_static = split_static
+        self._packed_caller = None
         if mesh is not None:
             from minisched_tpu.parallel.sharding import sharded_repair_step
 
@@ -481,6 +490,42 @@ class RepairingEvaluator:
                     split_static=split_static,
                 ),
             )
+
+    def call_packed(
+        self,
+        pod_packed: Any,
+        node_static: Any,
+        node_agg_packed: Any,
+        extra_packed: Any = None,
+    ):
+        """Single-program wave: tables arrive as PACKED host buffers plus
+        the device-resident static node columns and are unpacked inside
+        the one jitted program (models/tables.PackedCaller — program
+        alternation on the tunneled runtime stalled ~1.4s per switch).
+        Single-device only (the mesh path shards device tables instead)."""
+        assert self._mesh is None, "packed mode is single-device"
+        if self._packed_caller is None:
+            from minisched_tpu.models.tables import PackedCaller
+
+            filters, pre_scores, scores = self._chains
+
+            def consume(pods, nodes, extra):
+                return repair_wave_step(
+                    nodes, pods,
+                    filter_plugins=filters,
+                    pre_score_plugins=pre_scores,
+                    score_plugins=scores,
+                    ctx=self._ctx,
+                    extra=extra,
+                    max_rounds=self._max_rounds,
+                    with_diagnostics=self._with_diagnostics,
+                    split_static=self._split_static,
+                )
+
+            self._packed_caller = PackedCaller(consume)
+        return self._packed_caller(
+            pod_packed, node_static, node_agg_packed, extra_packed
+        )
 
     def __call__(self, pods: PodTable, nodes: NodeTable, extra: Any = None):
         if self._mesh is not None:

@@ -232,6 +232,10 @@ class SequentialScheduler:
         ctx = BatchContext(
             weights=tuple(sorted((weights or {}).items())), in_scan=True
         )
+        self._chains = (tuple(filter_plugins), tuple(pre_score_plugins),
+                        tuple(score_plugins))
+        self._ctx = ctx
+        self._packed_caller = None
         self._fn = jax.jit(
             partial(
                 scan_schedule,
@@ -248,3 +252,34 @@ class SequentialScheduler:
         if extra is not None:
             return self._fn(nodes, pods, extra=extra)
         return self._fn(nodes, pods)
+
+    def call_packed(
+        self,
+        pod_packed: Any,
+        node_static: Any,
+        node_agg_packed: Any,
+        extra_packed: Any = None,
+    ):
+        """Single-program scan chunk: tables arrive as packed host flat
+        buffers (+ device-resident static node columns) and are unpacked
+        INSIDE the jitted program (models/tables.PackedCaller — same
+        rationale as RepairingEvaluator.call_packed)."""
+        if self._packed_caller is None:
+            from minisched_tpu.models.tables import PackedCaller
+
+            filters, pre_scores, scores = self._chains
+
+            def consume(pods, nodes, extra):
+                return scan_schedule(
+                    nodes, pods,
+                    filter_plugins=filters,
+                    pre_score_plugins=pre_scores,
+                    score_plugins=scores,
+                    ctx=self._ctx,
+                    extra=extra,
+                )
+
+            self._packed_caller = PackedCaller(consume)
+        return self._packed_caller(
+            pod_packed, node_static, node_agg_packed, extra_packed
+        )

@@ -126,14 +126,27 @@ class SchedulingQueue:
         self._cond.notify_all()
 
     # -- producer side -----------------------------------------------------
+    def _add_locked(self, pod) -> None:
+        """Caller holds self._cond and notifies afterwards."""
+        uid = self._uid(pod)
+        if uid in self._queued_uids:
+            return
+        self._queued_uids.add(uid)
+        self._active.append(QueuedPodInfo(PodInfo(pod)))
+
     def add(self, pod) -> None:
         """New pending pod → activeQ (queue.go:35-43)."""
         with self._cond:
-            uid = self._uid(pod)
-            if uid in self._queued_uids:
-                return
-            self._queued_uids.add(uid)
-            self._push_active(QueuedPodInfo(PodInfo(pod)))
+            self._add_locked(pod)
+            self._cond.notify_all()
+
+    def add_batch(self, pods) -> None:
+        """Batch add under ONE lock hold + one notify — the informer's
+        batch dispatch feeds a 100k-pod creation flood through here."""
+        with self._cond:
+            for pod in pods:
+                self._add_locked(pod)
+            self._cond.notify_all()
 
     def _interest_gvks(self, failed_plugins: Set[str]) -> Set[GVK]:
         """Which GVKs' events could help a pod that failed on these plugins

@@ -264,3 +264,88 @@ def test_static_classification_guard_fires_on_misclassified_plugin():
 
     with pytest.raises(TypeError, match="SneakyFit"):
         RepairingEvaluator([NodeUnschedulable(), SneakyFit()], [], [])
+
+
+def test_packed_call_matches_unpacked():
+    """call_packed (flat host buffers unpacked inside the program) must be
+    bit-identical to the device-table __call__ path — same executable
+    semantics, different transfer strategy."""
+    import numpy as np
+
+    from minisched_tpu.api.objects import Toleration
+    from minisched_tpu.framework.nodeinfo import build_node_infos
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.models.tables import CachedNodeTableBuilder, build_pod_table
+    from minisched_tpu.plugins.registry import build_plugins
+    from minisched_tpu.service.config import default_full_roster_config
+
+    rng = random.Random(7)
+    nodes = sorted(
+        (
+            make_node(
+                f"n{i:03d}",
+                capacity={"cpu": "4", "memory": "8Gi", "pods": 10},
+                labels={"zone": f"z{i % 3}"},
+                unschedulable=rng.random() < 0.2,
+            )
+            for i in range(40)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    pods = [
+        make_pod(
+            f"p{i:03d}",
+            requests={"cpu": f"{rng.randrange(100, 900)}m"},
+            node_selector={"zone": "z1"} if rng.random() < 0.3 else None,
+        )
+        for i in range(60)
+    ]
+    cfg = default_full_roster_config()
+    chains = build_plugins(cfg)
+    ev = RepairingEvaluator(
+        chains.filter, chains.pre_score, chains.score,
+        weights=cfg.score_weights(), with_diagnostics=True,
+    )
+    infos = build_node_infos(nodes, [])
+
+    # unpacked reference
+    nt, names = CachedNodeTableBuilder().build(infos)
+    pt, _ = build_pod_table(pods, capacity=128)
+    ex = build_constraint_tables(
+        pods, nodes, [], pod_capacity=128, node_capacity=nt.capacity,
+        scan_planes=False,
+    )
+    _, choice_ref, _, unsched_ref = ev(pt, nt, ex)
+
+    # packed
+    static, agg, names2 = CachedNodeTableBuilder().build_packed(infos)
+    assert names2 == names
+    ptp, _ = build_pod_table(pods, capacity=128, device=False)
+    exp = build_constraint_tables(
+        pods, nodes, [], pod_capacity=128, node_capacity=agg.capacity,
+        scan_planes=False, device=False,
+    )
+    _, choice_pk, _, unsched_pk = ev.call_packed(ptp, static, agg, exp)
+    assert np.array_equal(np.asarray(choice_ref), np.asarray(choice_pk))
+    assert np.array_equal(np.asarray(unsched_ref), np.asarray(unsched_pk))
+
+    # slow pod schema (a pod with tolerations forces the full table) also
+    # round-trips through the packed path
+    pods2 = pods + [
+        make_pod("tol0", requests={"cpu": "100m"},
+                 tolerations=[Toleration(key="k", operator="Exists")]),
+    ]
+    nt2, _ = CachedNodeTableBuilder().build(infos)
+    pt2, _ = build_pod_table(pods2, capacity=128)
+    ex2 = build_constraint_tables(
+        pods2, nodes, [], pod_capacity=128, node_capacity=nt2.capacity,
+        scan_planes=False,
+    )
+    _, c_ref2, _, _ = ev(pt2, nt2, ex2)
+    pt2p, _ = build_pod_table(pods2, capacity=128, device=False)
+    ex2p = build_constraint_tables(
+        pods2, nodes, [], pod_capacity=128, node_capacity=agg.capacity,
+        scan_planes=False, device=False,
+    )
+    _, c_pk2, _, _ = ev.call_packed(pt2p, static, agg, ex2p)
+    assert np.array_equal(np.asarray(c_ref2), np.asarray(c_pk2))
