@@ -693,5 +693,5 @@ def build_constraint_tables(
             vol_any=vol_any, vol_rw=vol_rw,
         )
     if not device:
-        return pack_table(host_cols, (), P)
+        return pack_table(host_cols, (), P, elide_zeros=True)
     return ConstraintTables(**batched_device_put(host_cols))

@@ -208,7 +208,32 @@ def pack_table(
     host: Dict[str, Any],
     zero_metas: Tuple = (),
     capacity: int = 0,
+    elide_zeros: bool = False,
 ) -> PackedTable:
+    """``elide_zeros``: move columns that are entirely zero into
+    ``zero_metas`` (materialized on device by the consumer's unpack, zero
+    wire bytes).  Host→device transfer degrades ~50× once a large program
+    is resident on the tunneled runtime, so bytes not shipped are the
+    cheapest bytes: a plain config5 wave's 10MB constraint table is
+    almost entirely zero planes.  NOTE: the zero-set is part of the
+    schema — a column flipping nonzero compiles a new consumer
+    executable, so flips must be rare/one-way (combo planes go nonzero
+    once cross-pod pods land and stay there)."""
+    if elide_zeros:
+        live: Dict[str, Any] = {}
+        zeros = list(zero_metas)
+        for k, v in host.items():
+            arr = np.asarray(v)
+            if not arr.any():
+                kind = (
+                    "bool"
+                    if arr.dtype == np.bool_
+                    else "uint32" if arr.dtype == np.uint32 else "int32"
+                )
+                zeros.append((k, kind, tuple(arr.shape)))
+            else:
+                live[k] = arr
+        host, zero_metas = live, tuple(zeros)
     metas, flat = pack_columns(host)
     return PackedTable(metas, tuple(zero_metas), flat, capacity)
 
@@ -238,8 +263,7 @@ class PackedCaller:
             from minisched_tpu.models.constraints import ConstraintTables
 
             pod_metas, pod_zeros = pod_packed.schema
-            agg_metas, _ = node_agg_packed.schema
-            ex_metas = extra_packed.metas if extra_packed is not None else None
+            agg_metas, agg_zeros = node_agg_packed.schema
             consumer = self._consumer
 
             def run(pod_flat, agg_flat, ex_flat, static_cols):
@@ -247,11 +271,14 @@ class PackedCaller:
                     **unpack_columns(pod_flat, pod_metas, pod_zeros)
                 )
                 nodes = NodeTable(
-                    **static_cols, **unpack_columns(agg_flat, agg_metas)
+                    **static_cols,
+                    **unpack_columns(agg_flat, agg_metas, agg_zeros),
                 )
                 extra = (
-                    ConstraintTables(**unpack_columns(ex_flat, ex_metas))
-                    if ex_metas is not None
+                    ConstraintTables(
+                        **unpack_columns(ex_flat, *ex_schema)
+                    )
+                    if ex_schema is not None
                     else None
                 )
                 return consumer(pods, nodes, extra)
@@ -734,6 +761,13 @@ class CachedNodeTableBuilder:
         self._sig = None
         self._static: Dict[str, Any] = {}
         self._static_dev: Dict[str, Any] = {}
+        # incremental-rebuild state: host copy of the static columns, the
+        # persistent profile registry, and the encoded profile capacity —
+        # a node UPDATE re-encodes just its row instead of all N (a 2k-
+        # node label change used to re-encode 10k nodes, ~1.2s host work)
+        self._host_static: Dict[str, Any] = {}
+        self._reg: Any = None
+        self._prof_cap_val: int = 0
         #: keep the static columns device-resident between builds.  Turn
         #: OFF when the consumer donates its node-table argument against
         #: a sharding that could alias these buffers (the mesh engine:
@@ -757,6 +791,8 @@ class CachedNodeTableBuilder:
         )
         if sig == self._sig:
             return
+        if self._patch_rows(node_infos, sig):
+            return
         reg = _ProfileRegistry()
         pids = [reg.pid_for(ni.node) for ni in node_infos]
         t = _node_table_skeleton(cap, _prof_cap(reg, prof_capacity))
@@ -765,16 +801,62 @@ class CachedNodeTableBuilder:
         for i, ni in enumerate(node_infos):
             names.append(ni.name)
             _encode_node_static(t, i, ni.node, pids[i])
-        self._static = {k: t[k] for k in _NODE_STATIC_COLS}
+        self._host_static = {k: t[k] for k in _NODE_STATIC_COLS}
+        self._reg = reg
+        self._prof_cap_val = _prof_cap(reg, prof_capacity)
         # static columns live on DEVICE between builds: re-uploading the
         # label/taint/image planes for 10k+ nodes every wave cost tens of
         # MB of tunnel bandwidth per wave for bytes that only change when
-        # a node object changes
+        # a node object changes.  The host copy is retained for row
+        # patching (~2MB at 10k nodes).
+        self._static = {} if self._device_static else dict(self._host_static)
         if self._device_static:
-            self._static_dev = batched_device_put(self._static)
-            self._static = {}  # device copy is the only consumer
+            self._static_dev = batched_device_put(self._host_static)
         self._names = names
         self._sig = sig
+
+    def _patch_rows(self, node_infos: Sequence[Any], sig: Tuple) -> bool:
+        """Incremental static update: same node set/order/capacities, only
+        some nodes' resource_versions changed — re-encode just those rows
+        in the host copy and re-upload.  Returns False (caller does a full
+        rebuild) on membership/order/capacity changes, a stepped profile
+        capacity, or an encode error."""
+        cap, prof_capacity, rows = sig
+        if (
+            self._sig is None
+            or not self._host_static
+            or self._sig[0] != cap
+            or self._sig[1] != prof_capacity
+            or len(self._sig[2]) != len(rows)
+            or any(a[0] != b[0] for a, b in zip(self._sig[2], rows))
+        ):
+            return False
+        changed = [
+            i for i, (a, b) in enumerate(zip(self._sig[2], rows)) if a[1] != b[1]
+        ]
+        t = self._host_static
+        try:
+            for i in changed:
+                node = node_infos[i].node
+                pid = self._reg.pid_for(node)
+                if _prof_cap(self._reg, prof_capacity) != self._prof_cap_val:
+                    return False  # Dp stepped: schema change, rebuild fully
+                # clear variable-length slots a shorter re-encode would
+                # leave stale
+                t["image_key"][i] = 0
+                t["image_size_mb"][i] = 0
+                _encode_node_static(t, i, node, pid)
+        except ValueError:
+            return False
+        # profile planes: new profiles appended by pid_for get encoded;
+        # existing rows are rewritten in place (idempotent)
+        self._reg.encode_rows(t)
+        if self._device_static:
+            self._static_dev = batched_device_put(t)
+        else:
+            self._static = dict(t)
+        self._sig = sig
+        return True
 
     @staticmethod
     def _fill_aggregates(node_infos: Sequence[Any], cap: int) -> Dict[str, Any]:

@@ -344,21 +344,47 @@ class SchedulingQueue:
             self._queued_uids.discard(self._uid(qpi.pod))
             return qpi
 
-    def pop_batch(self, max_pods: int, timeout: Optional[float] = None) -> List[QueuedPodInfo]:
+    def pop_batch(
+        self,
+        max_pods: int,
+        timeout: Optional[float] = None,
+        gather_backoff_s: float = 0.35,
+    ) -> List[QueuedPodInfo]:
         """Drain up to ``max_pods`` in FIFO order — the wave the TPU batch
-        evaluator schedules in one fused kernel call."""
+        evaluator schedules in one fused kernel call.
+
+        ``gather_backoff_s``: after draining the activeQ, if the batch has
+        room and more pods' backoff expires within this window, wait for
+        them and take them too.  A requeue burst (an event re-activating
+        thousands of parked pods through 1-2s of per-pod backoff,
+        queue.go:218-235 semantics) then rides ONE wave instead of
+        trickling through several — each its own full evaluation — which
+        made the tail of a run cost seconds for 2% of its pods.  Backoff
+        expiry times are unchanged (pods never leave early); only the
+        wave boundary waits for them."""
         first = self.pop(timeout)
         if first is None:
             return []
         batch = [first]
         with self._cond:
-            while self._active and len(batch) < max_pods:
-                qpi = self._active.popleft()
-                qpi.attempts += 1
-                self._scheduling_cycle += 1
-                qpi.scheduling_cycle = self._scheduling_cycle
-                self._queued_uids.discard(self._uid(qpi.pod))
-                batch.append(qpi)
+            while True:
+                while self._active and len(batch) < max_pods:
+                    qpi = self._active.popleft()
+                    qpi.attempts += 1
+                    self._scheduling_cycle += 1
+                    qpi.scheduling_cycle = self._scheduling_cycle
+                    self._queued_uids.discard(self._uid(qpi.pod))
+                    batch.append(qpi)
+                if len(batch) >= max_pods or not self._backoff:
+                    break
+                wait = self._backoff[0][0] - self._clock()
+                if wait > gather_backoff_s:
+                    break
+                # releases the lock; producers/events can land meanwhile
+                self._cond.wait(max(wait, 0.0) + 0.001)
+                self.flush_backoff_completed_locked()
+                if not self._active:
+                    break
         return batch
 
     def flush_backoff_completed_locked(self) -> None:

@@ -294,3 +294,33 @@ def test_move_request_during_attempt_routes_to_backoff():
     qpi2.unschedulable_plugins = {"NodeAffinity"}
     q.add_unschedulable(qpi2)
     assert q.stats()["unschedulable"] == 1
+
+
+def test_pop_batch_gathers_imminent_backoff_burst():
+    """A requeue burst whose backoff expiries are spread inside the gather
+    window rides ONE wave instead of trickling through several."""
+    import time as _time
+
+    q = SchedulingQueue()
+    # park 10 pods, then wake them through backoff (attempts=1 -> 1s);
+    # compress: use a tiny initial backoff so the test stays fast
+    q = SchedulingQueue(initial_backoff_s=0.05, max_backoff_s=0.2)
+    now = _time.monotonic
+    for i in range(10):
+        pod = make_pod(f"b{i}")
+        q.add(pod)
+    popped = q.pop_batch(100, timeout=1.0)
+    assert len(popped) == 10
+    # fail them all -> unschedulable; then a move request requeues through
+    # backoff (expiries ~50ms out, spread by timestamps)
+    for qpi in popped:
+        qpi.unschedulable_plugins = set()
+        q.add_unschedulable(qpi)
+    q.move_all_to_active_or_backoff(
+        ClusterEvent(GVK.WILDCARD, ActionType.ADD)
+    )
+    t0 = now()
+    batch = q.pop_batch(100, timeout=2.0, gather_backoff_s=0.3)
+    # ONE wave captured the whole burst once backoff expired
+    assert len(batch) == 10, len(batch)
+    assert now() - t0 < 1.0
