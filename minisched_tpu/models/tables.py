@@ -1194,5 +1194,10 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
                 t["port"][i, j] = port
             t["num_ports"][i] = len(ports)
     if not device:
+        # NO zero-elision here (unlike the constraint tables): the slow
+        # pod schema's zero-set varies with each wave's feature mix, and
+        # every distinct set is a fresh consumer executable — measured as
+        # ~50s of mid-run compiles at config5 scale.  The fast path's
+        # FIXED _zero_pod_metas already covers the common all-simple wave.
         return pack_table(t, (), cap), names
     return PodTable(**batched_device_put(t, force_packed=force_packed)), names
