@@ -893,10 +893,97 @@ def bench_headline() -> dict:
     }
 
 
+def bench_wire() -> dict:
+    """Scheduler-over-HTTP (VERDICT r3 item 3): the device wave engine at
+    moderate scale with EVERY informer event and every bind crossing the
+    REST boundary (controlplane/remote.py — the reference's client-go ↔
+    httptest.Server path, scheduler.go:54,72-73).  Reports the e2e cost
+    of the wire next to the in-process numbers."""
+    import threading
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.remote import RemoteClient
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    n_nodes = int(os.environ.get("BENCH_WIRE_NODES", 1_000))
+    n_pods = int(os.environ.get("BENCH_WIRE_PODS", 10_000))
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        rng = random.Random(55)
+        t0 = time.monotonic()
+        for i in range(n_nodes):
+            client.nodes().create(
+                make_node(
+                    f"node{i:05d}",
+                    unschedulable=rng.random() < 0.2,
+                    capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+                    labels={"zone": f"z{i % 16}"},
+                )
+            )
+        for i in range(n_pods):
+            client.pods().create(
+                make_pod(
+                    f"pod{i:06d}",
+                    requests={"cpu": "500m", "memory": "256Mi"},
+                )
+            )
+        setup_dt = time.monotonic() - t0
+        log(
+            f"[wire] cluster created over HTTP in {setup_dt:.1f}s "
+            f"({n_nodes} nodes, {n_pods} pods)"
+        )
+
+        bound_n = 0
+        mu = threading.Lock()
+
+        def counting(pod, node_name, status):
+            nonlocal bound_n
+            if node_name:
+                with mu:
+                    bound_n += 1
+
+        svc = SchedulerService(client)
+        t_warm = time.monotonic()
+        sched = svc.start_scheduler(
+            default_full_roster_config(), device_mode=True, max_wave=4096,
+            on_decision=counting, prewarm=True,
+        )
+        t0 = time.monotonic()
+        log(f"[wire] engine warmup+start: {t0 - t_warm:.1f}s")
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            with mu:
+                if bound_n >= n_pods:
+                    break
+            time.sleep(0.2)
+        elapsed = time.monotonic() - t0
+        svc.shutdown_scheduler()
+        if bound_n < n_pods:
+            raise SystemExit(f"[wire] only {bound_n}/{n_pods} bound")
+        log(
+            f"[wire] {n_pods} pods scheduled OVER HTTP in {elapsed:.1f}s "
+            f"→ {n_pods/elapsed:,.0f} pods/s e2e (informers + binds on "
+            f"the wire)"
+        )
+        return {
+            "pods_per_sec_e2e": round(n_pods / elapsed, 1),
+            "total_s": round(elapsed, 1),
+            "nodes": n_nodes,
+            "pods": n_pods,
+            "setup_s": round(setup_dt, 1),
+        }
+    finally:
+        shutdown()
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
     "fullchain_parity": bench_fullchain_parity,
+    "wire": bench_wire,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -961,6 +1048,8 @@ def main() -> None:
         optional.append(
             ("fullchain_parity", "fullchain_parity", None, "fullchain_parity")
         )
+    if os.environ.get("BENCH_WIRE", "1") != "0":
+        optional.append(("scheduler_over_http", "wire", None, "wire"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

@@ -171,6 +171,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self.active_watches.discard(watch)
 
     def do_POST(self) -> None:
+        if self.path.partition("?")[0] == "/api/v1/bindings":
+            self._bind_many()
+            return
         try:
             kind, ns, name, sub = _route(self.path)
         except (KeyError, ValueError):
@@ -207,6 +210,41 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(201, _encode(self.store.create(kind, obj)))
         except KeyError as e:
             self._error(409, str(e))
+
+    def _bind_many(self) -> None:
+        """Batch binding subresource: a wave's placements in ONE request
+        (one HTTP round-trip per bind would serialize the TPU wave; the
+        store transaction below is the same bind_many the in-process
+        client uses).  Per-item errors are returned per entry —
+        AlreadyBound / missing pod never abort the rest of the batch."""
+        data = self._body()
+        items = data.get("items", [])
+        return_objects = data.get("return_objects", True)
+        bindings = []
+        for it in items:
+            if not it.get("name") or not it.get("node_name"):
+                self._error(400, "each binding requires name and node_name")
+                return
+            bindings.append(
+                Binding(
+                    it["name"], it.get("namespace") or "default",
+                    it["node_name"],
+                )
+            )
+        results = Client(self.store).pods().bind_many(
+            bindings, return_objects=return_objects
+        )
+        out = []
+        for res in results:
+            if isinstance(res, AlreadyBound):
+                out.append({"error": str(res), "type": "AlreadyBound"})
+            elif isinstance(res, BaseException):
+                out.append({"error": str(res), "type": "NotFound"})
+            elif res is not None:
+                out.append({"object": _encode(res)})
+            else:
+                out.append({})
+        self._send(200, {"items": out})
 
     def do_PUT(self) -> None:
         try:

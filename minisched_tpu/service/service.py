@@ -101,7 +101,11 @@ class SchedulerService:
             sched = build_scheduler_from_config(self._client, self._factory, cfg)
         self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")
         self._factory.start()
-        if not self._factory.wait_for_cache_sync():
+        # generous timeout: over-the-wire informers (controlplane/remote.py)
+        # replay the whole snapshot through JSON decode — a 100k-object
+        # cluster takes tens of seconds; in-process sync returns as soon
+        # as the counts match, so the ceiling costs nothing there
+        if not self._factory.wait_for_cache_sync(timeout=300.0):
             raise RuntimeError("informer caches failed to sync")
         # observability hooks must be live BEFORE the engine thread starts —
         # installing them on the returned scheduler races the first waves
