@@ -508,11 +508,24 @@ class Pod:
 
     def resource_requests(self) -> ResourceList:
         """Sum container requests, with upstream's non-zero defaults applied
-        only by the LeastAllocated scorer (which asks for them explicitly)."""
+        only by the LeastAllocated scorer (which asks for them explicitly).
+
+        Memoized on the SPEC (kube semantics: container requests are
+        immutable for a created pod, and the bind path shares the spec
+        structurally between the pending and bound object — one walk
+        serves the table build, the assume-cache, and the scheduler
+        cache).  Callers must treat the result as read-only; anything that
+        does mutate a spec's containers in place (tests building fixtures)
+        must do so before the first call."""
+        spec = self.spec
+        memo = spec.__dict__.get("_req_memo")
+        if memo is not None:
+            return memo
         total = ResourceList()
-        for c in self.spec.containers:
+        for c in spec.containers:
             total.add(c.requests)
         total.pods = max(total.pods, 1)
+        spec.__dict__["_req_memo"] = total
         return total
 
 

@@ -71,7 +71,8 @@ class DurableObjectStore(ObjectStore):
         if self._fsync:
             os.fsync(self._log.fileno())
 
-    def mutate_many(self, kind: str, items, return_objects: bool = True) -> list:
+    def mutate_many(self, kind: str, items, return_objects: bool = True,
+                    clone_for_write: bool = True) -> list:
         """Batch read-modify-write with ONE log flush: every record is
         written (durability order preserved — same lock, same order via
         the _on_batch_commit hook), but the flush/fsync is paid once per
@@ -80,7 +81,9 @@ class DurableObjectStore(ObjectStore):
             self._check_open()
             self._defer_flush = True
             try:
-                return super().mutate_many(kind, items, return_objects)
+                return super().mutate_many(
+                    kind, items, return_objects, clone_for_write
+                )
             finally:
                 self._defer_flush = False
                 if self._log is not None:

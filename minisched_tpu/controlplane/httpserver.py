@@ -145,6 +145,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         try:
+            # first line: how many snapshot events this stream will replay
+            # (ns-filtered), taken ATOMICALLY with the watch registration —
+            # a client-side LIST-then-watch can't get this count right (a
+            # delete in the gap strands its sync barrier forever)
+            n_initial = sum(
+                1
+                for o in snapshot
+                if not ns or o.metadata.namespace == ns
+            )
+            chunk(
+                json.dumps({"type": "SYNC", "count": n_initial}).encode()
+                + b"\n"
+            )
             while True:
                 ev = watch.next(timeout=0.5)
                 if ev is None:
@@ -217,20 +230,27 @@ class _Handler(BaseHTTPRequestHandler):
         store transaction below is the same bind_many the in-process
         client uses).  Per-item errors are returned per entry —
         AlreadyBound / missing pod never abort the rest of the batch."""
-        data = self._body()
-        items = data.get("items", [])
-        return_objects = data.get("return_objects", True)
-        bindings = []
-        for it in items:
-            if not it.get("name") or not it.get("node_name"):
-                self._error(400, "each binding requires name and node_name")
-                return
-            bindings.append(
-                Binding(
-                    it["name"], it.get("namespace") or "default",
-                    it["node_name"],
+        try:
+            data = self._body()
+            items = data.get("items", [])
+            return_objects = data.get("return_objects", True)
+            bindings = []
+            for it in items:
+                if not it.get("name") or not it.get("node_name"):
+                    self._error(400, "each binding requires name and node_name")
+                    return
+                bindings.append(
+                    Binding(
+                        it["name"], it.get("namespace") or "default",
+                        it["node_name"],
+                    )
                 )
-            )
+        except Exception as e:
+            # malformed JSON / non-dict body / non-dict items: a client
+            # mistake must get a 400 like every other handler, not a
+            # dropped connection
+            self._error(400, f"malformed body: {e}")
+            return
         results = Client(self.store).pods().bind_many(
             bindings, return_objects=return_objects
         )

@@ -62,6 +62,14 @@ class Informer:
         self._watch = None
         self._synced = threading.Event()
         self._stop = threading.Event()
+        # dispatch gate (set = running).  The wave engine clears it for the
+        # host-side stretch of a wave (snapshot/table build) so handler
+        # work for the previous wave's thousands of bind events lands in
+        # the GIL-free device-call window instead of contending with the
+        # engine's own Python.  Soft pause: the timed wait bounds how long
+        # a forgotten gate can stall the stream.
+        self._gate = threading.Event()
+        self._gate.set()
 
     def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
         with self._lock:
@@ -105,12 +113,26 @@ class Informer:
         seen = 0
         if self._initial == 0:
             self._synced.set()
+        # reflector resync state: >0 means the next N stream events are a
+        # reconnect's snapshot replay, to be DIFFED against the cache
+        # (unchanged objects suppressed, changed delivered as MODIFIED,
+        # vanished delivered as DELETED at replay end)
+        self._replay_pending = 0
+        self._replay_seen: set = set()
         while not self._stop.is_set():
             self._drain_replays()
             batch = self._watch.next_batch(timeout=0.1)
+            if batch and not self._gate.is_set():
+                # a gated batch is HELD, not dropped: the engine closes the
+                # gate just before delivering a wave's bind events and
+                # opens it entering the next device call, so this work
+                # runs in that GIL-free window.  The timed wait bounds a
+                # forgotten gate; processing then proceeds regardless.
+                self._gate.wait(timeout=2.0)
             if not batch:
                 if self._watch.stopped:
-                    return
+                    if self._stop.is_set() or not self._reconnect():
+                        return
                 continue
             # normalize the whole batch under ONE cache-lock hold (DELETED
             # resolves to the cached object, MODIFIED picks up old_obj)
@@ -118,6 +140,28 @@ class Informer:
             with self._lock:
                 for ev in batch:
                     key = ev.obj.metadata.key
+                    if self._replay_pending > 0:
+                        self._replay_pending -= 1
+                        self._replay_seen.add(key)
+                        old = self._cache.get(key)
+                        self._cache[key] = ev.obj
+                        if old is not None:
+                            same = (
+                                old.metadata.resource_version
+                                == ev.obj.metadata.resource_version
+                            )
+                            if not same:
+                                normalized.append(
+                                    WatchEvent(EventType.MODIFIED, ev.obj, old)
+                                )
+                            # unchanged: consumers already saw this state
+                        else:
+                            normalized.append(
+                                WatchEvent(EventType.ADDED, ev.obj)
+                            )
+                        if self._replay_pending == 0:
+                            normalized.extend(self._finish_replay_locked())
+                        continue
                     if ev.type == EventType.DELETED:
                         old = self._cache.pop(key, None)
                         if old is not None:
@@ -136,6 +180,52 @@ class Informer:
             seen += len(normalized)
             if seen >= self._initial:
                 self._synced.set()
+
+    def _finish_replay_locked(self) -> List[WatchEvent]:
+        """End of a reconnect's snapshot replay: everything cached that
+        the replay did NOT mention was deleted while the watch was down."""
+        gone = [k for k in self._cache if k not in self._replay_seen]
+        out = [
+            WatchEvent(EventType.DELETED, self._cache.pop(key)) for key in gone
+        ]
+        self._replay_seen = set()
+        return out
+
+    def _reconnect(self) -> bool:
+        """The watch died underneath us (remote stream failure — the
+        in-process store's watch only stops via Informer.stop): re-open
+        it with a snapshot replay, client-go-reflector style, retrying
+        with backoff until stopped.  The replayed snapshot is diffed
+        against the cache by the _run loop so consumers converge on the
+        post-outage state without replaying what they already saw.
+        Returns False only when the informer is shutting down."""
+        backoff = 0.5
+        while not self._stop.is_set():
+            try:
+                self._watch, snapshot = self._store.watch(
+                    self._kind, send_initial=True
+                )
+            except Exception as err:
+                print(
+                    f"informer-{self._kind}: re-watch failed ({err!r}); "
+                    f"retrying in {backoff:.1f}s"
+                )
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            stale: List[WatchEvent] = []
+            with self._lock:
+                self._replay_pending = len(snapshot)
+                self._replay_seen = set()
+                if self._replay_pending == 0:
+                    # empty server: everything we cached is gone
+                    stale = self._finish_replay_locked()
+                handlers = list(self._handlers)
+            if stale:
+                for h in handlers:
+                    self._invoke(h, stale)
+            return True
+        return False
 
     def _invoke(self, h: ResourceEventHandlers, events: List[WatchEvent]) -> None:
         """One handler over a batch: a registered ``on_batch`` takes the
@@ -182,6 +272,20 @@ class Informer:
         with self._lock:
             return self._cache.get(key)
 
+    def get_many(self, keys: List[str]) -> List[Optional[Any]]:
+        """Bulk ``get`` under ONE lock hold — the wave engine resolves a
+        whole assume-cache's worth of keys per snapshot, and a lock
+        round-trip per key races the dispatch thread's batch normalization
+        (which holds the same lock for the full batch)."""
+        with self._lock:
+            return [self._cache.get(k) for k in keys]
+
+    def pause_dispatch(self) -> None:
+        self._gate.clear()
+
+    def resume_dispatch(self) -> None:
+        self._gate.set()
+
     def stop(self) -> None:
         self._stop.set()
         if self._watch is not None:
@@ -224,6 +328,16 @@ class SharedInformerFactory:
                 return False
         return True
 
+    def pause_dispatch(self) -> None:
+        """Hold event dispatch for every informer (see Informer._gate)."""
+        for inf in self._informers.values():
+            inf.pause_dispatch()
+
+    def resume_dispatch(self) -> None:
+        for inf in self._informers.values():
+            inf.resume_dispatch()
+
     def shutdown(self) -> None:
         for inf in self._informers.values():
+            inf.resume_dispatch()
             inf.stop()

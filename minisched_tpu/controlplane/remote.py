@@ -62,7 +62,13 @@ class RemoteWatch:
         self._cond = threading.Condition()
         self._events: List[WatchEvent] = []
         self._stopped = False
+        self._explicit_stop = False
         self._typ = _kind_types()[kind]
+        #: snapshot-replay count from the server's SYNC first line, set by
+        #: the reader thread; ``initial_count()`` blocks on it — this is
+        #: what makes the informer's sync barrier exact (a LIST taken
+        #: before/after opening the stream can't be atomic with it)
+        self._sync_count: Optional[int] = None
         self._resp = urllib.request.urlopen(url, timeout=3600.0)
         self._thread = threading.Thread(
             target=self._read, name=f"remote-watch-{kind}", daemon=True
@@ -78,6 +84,11 @@ class RemoteWatch:
                 if not line:
                     continue
                 msg = json.loads(line)
+                if msg["type"] == "SYNC":
+                    with self._cond:
+                        self._sync_count = int(msg["count"])
+                        self._cond.notify_all()
+                    continue
                 ev = WatchEvent(
                     EventType(msg["type"]), _decode(self._typ, msg["object"])
                 )
@@ -87,11 +98,32 @@ class RemoteWatch:
                     self._events.append(ev)
                     self._cond.notify_all()
         except Exception:
-            pass  # connection torn down (shutdown or network) → stream ends
+            if self._explicit_stop:
+                pass  # shutdown teardown: expected
+            else:
+                import traceback
+
+                traceback.print_exc()  # network failure: the informer's
+                # reconnect path re-lists; the trace says why it had to
         finally:
             with self._cond:
                 self._stopped = True
                 self._cond.notify_all()
+
+    def initial_count(self, timeout: float = 30.0) -> int:
+        """Block until the server's SYNC line arrives (how many snapshot
+        events this stream replays before live events)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while self._sync_count is None and not self._stopped:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    break
+            if self._sync_count is None:
+                raise RuntimeError("watch stream sent no SYNC line")
+            return self._sync_count
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         batch = self._wait(timeout, take_all=False)
@@ -121,6 +153,7 @@ class RemoteWatch:
 
     def stop(self) -> None:
         with self._cond:
+            self._explicit_stop = True
             self._stopped = True
             self._cond.notify_all()
         try:
@@ -167,17 +200,18 @@ class RemoteStore:
 
     # -- store surface ------------------------------------------------------
     def watch(self, kind: str, send_initial: bool = True) -> Tuple[RemoteWatch, List[Any]]:
-        """(watch, snapshot): the stream replays the server-side snapshot
-        as ADDED events (send_initial is server behavior); the snapshot
-        returned here comes from a LIST taken first, so the informer's
-        sync barrier counts a lower bound of what the stream replays —
-        consumers dedupe ADDs by uid, exactly as with late-registration
-        replays in the in-process path."""
-        snapshot = self.list(kind)
+        """(watch, snapshot placeholder): the stream replays the
+        server-side snapshot as ADDED events and announces its exact
+        count in a SYNC first line (atomic with the watch registration —
+        a LIST taken separately can miscount across a delete in the gap
+        and strand the informer's sync barrier).  The returned snapshot
+        list is sized to that count; its entries are None — the informer
+        only measures ``len``, and the objects themselves arrive through
+        the stream."""
         w = RemoteWatch(
             f"{self._base}{self._path(kind)}?watch=true", kind
         )
-        return w, snapshot
+        return w, [None] * w.initial_count()
 
     def list(self, kind: str) -> List[Any]:
         typ = _kind_types()[kind]

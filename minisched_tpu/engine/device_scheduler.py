@@ -96,6 +96,12 @@ class DeviceScheduler(Scheduler):
         # lands in the informer cache — without it, the next wave snapshots
         # stale state and can double-book the capacity wave N just used
         self._assumed: dict = {}  # uid → pod clone with node_name set
+        #: uid → (milli_cpu, mem_mib, eph_mib, nz_milli_cpu, nz_mem_mib,
+        #: ports) with NodeInfo.add_pod's exact quantization — computed
+        #: once at assume time so per-wave snapshots fold assumptions as
+        #: numeric aggregate deltas instead of per-pod add_pod calls
+        #: (~250ms/16k-pod wave of duplicated host work)
+        self._assumed_agg: dict = {}
         self._assumed_lock = threading.Lock()
 
     def _wire_pre_cache(self, informer_factory: Any) -> None:
@@ -145,43 +151,96 @@ class DeviceScheduler(Scheduler):
 
     # -- assume-pod cache ---------------------------------------------------
     def _assume(self, pod: Pod, node_name: str) -> None:
+        from minisched_tpu.api.objects import (
+            DEFAULT_POD_CPU_REQUEST,
+            DEFAULT_POD_MEMORY_REQUEST,
+            MIB,
+        )
+
         assumed = pod.clone()
         assumed.spec.node_name = node_name
+        req = pod.resource_requests()
+        mem_mib = req.memory // MIB
+        agg = (
+            req.milli_cpu,
+            mem_mib,
+            req.ephemeral_storage // MIB,
+            req.milli_cpu or DEFAULT_POD_CPU_REQUEST,
+            mem_mib or (DEFAULT_POD_MEMORY_REQUEST // MIB),
+            tuple(
+                port for c in pod.spec.containers if c.ports for port in c.ports
+            ),
+        )
         with self._assumed_lock:
             self._assumed[pod.metadata.uid] = assumed
+            self._assumed_agg[pod.metadata.uid] = agg
 
     def _forget(self, uid: str) -> None:
         with self._assumed_lock:
             self._assumed.pop(uid, None)
+            self._assumed_agg.pop(uid, None)
 
     def snapshot_nodes(self):
-        # the incremental cache supplies the snapshot (O(nodes) clones);
-        # assumption pruning uses the SAME locked read's assigned-uid view
-        # so a bind can never land between the two and be counted nowhere
-        infos, cache_assigned = self.cache.snapshot_with_assigned()
-        with self._assumed_lock:
-            if not self._assumed:
-                return infos
-            pod_informer = self.informer_factory.informer_for("Pod")
+        """Object-level snapshot (scalar cycles, tests): the surviving
+        assumptions are folded INTO the cloned NodeInfos.  One prune
+        implementation — this is _snapshot_for_wave plus the per-pod
+        fold the wave path replaces with the numeric delta."""
+        infos, _delta, leftover = self._snapshot_for_wave()
+        if leftover:
             by_name = {ni.name: ni for ni in infos}
-            for uid in list(self._assumed):
-                assumed = self._assumed[uid]
-                current = pod_informer.get(assumed.metadata.key)
-                # uid must match: a same-name replacement (StatefulSet
-                # delete+recreate) is a DIFFERENT pod — the assumption for
-                # the old uid is dead and must not count forever
-                exists = (
-                    current is not None and current.metadata.uid == uid
-                )
-                if uid in cache_assigned or not exists:
-                    # confirmed by the cache, or the pod was deleted —
-                    # either way the assumption must not count again
-                    del self._assumed[uid]
-                    continue
+            for assumed in leftover:
                 ni = by_name.get(assumed.spec.node_name)
                 if ni is not None:
                     ni.add_pod(assumed)
         return infos
+
+    def _snapshot_for_wave(self):
+        """(node infos, aggregate delta, surviving assumed pods) — the wave
+        path's snapshot.  Unlike ``snapshot_nodes`` the assume-cache is NOT
+        folded into the NodeInfos pod-by-pod; it comes back as a numeric
+        per-node delta (see CachedNodeTableBuilder._apply_agg_delta) that
+        the table build adds into the aggregate columns.  Same pruning
+        rule: an assumption confirmed by the cache or whose pod vanished is
+        dropped.  Consumers that need assumed pods as OBJECTS (preemption's
+        _merged_infos, the index-less constraint build) use the returned
+        list or the live assume-cache — both disjoint from the snapshot's
+        pod population by this prune."""
+        infos, cache_assigned = self.cache.snapshot_with_assigned()
+        delta: dict = {}
+        with self._assumed_lock:
+            if not self._assumed:
+                return infos, delta, []
+            uids = list(self._assumed)
+            keys = [self._assumed[u].metadata.key for u in uids]
+        # one bulk cache read outside the assume lock (the informer lock is
+        # held batch-long by the dispatch thread; nesting the two invites
+        # stalls); re-check each uid under the lock after
+        currents = self.informer_factory.informer_for("Pod").get_many(keys)
+        leftover = []
+        with self._assumed_lock:
+            for uid, current in zip(uids, currents):
+                assumed = self._assumed.get(uid)
+                if assumed is None:
+                    continue  # forgotten (failed bind) meanwhile
+                exists = current is not None and current.metadata.uid == uid
+                if uid in cache_assigned or not exists:
+                    del self._assumed[uid]
+                    self._assumed_agg.pop(uid, None)
+                    continue
+                agg = self._assumed_agg[uid]
+                leftover.append(assumed)
+                d = delta.get(assumed.spec.node_name)
+                if d is None:
+                    delta[assumed.spec.node_name] = d = [0, 0, 0, 0, 0, 0, []]
+                d[0] += agg[0]
+                d[1] += agg[1]
+                d[2] += agg[2]
+                d[3] += 1
+                d[4] += agg[3]
+                d[5] += agg[4]
+                if agg[5]:
+                    d[6].extend(agg[5])
+        return infos, delta, leftover
 
     def error_func(self, qpi: QueuedPodInfo, err, plugin: str = "") -> None:
         # a failed permit/bind releases the assumed capacity
@@ -400,22 +459,30 @@ class DeviceScheduler(Scheduler):
             return qpis, None
 
     def _schedule_scan(
-        self, qpis: List[QueuedPodInfo], node_infos: List[Any]
+        self,
+        qpis: List[QueuedPodInfo],
+        node_infos: List[Any],
+        agg_delta: Any = None,
+        assumed_pods: Any = (),
     ) -> None:
         """Bind-exact path for cross-pod-constrained pods: chunks of the
         sequential device scan, committed chunk by chunk."""
         import jax
 
+        # the scan interleaves host builds with device chunks too finely
+        # for wave-style dispatch gating to pay — run it ungated
+        self.informer_factory.resume_dispatch()
         chunk = self.SCAN_MAX_CHUNK
         for start in range(0, len(qpis), chunk):
             part = qpis[start : start + chunk]
             if start > 0:
-                node_infos = self.snapshot_nodes()
+                node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
             nodes = [ni.node for ni in node_infos]
             assigned = (
                 ()
                 if self.constraint_index is not None
                 else [p for ni in node_infos for p in ni.pods]
+                + list(assumed_pods)
             )
             cap = self._scan_cap(len(part))
 
@@ -426,7 +493,9 @@ class DeviceScheduler(Scheduler):
                     # single-program chunk: flat host buffers unpacked
                     # inside the scan executable (see _build_and_evaluate)
                     node_static, node_agg, node_names = (
-                        self._table_builder.build_packed(node_infos)
+                        self._table_builder.build_packed(
+                            node_infos, agg_delta=agg_delta
+                        )
                     )
                     pod_table, _ = build_pod_table(
                         pods_, capacity=cap, device=False
@@ -444,7 +513,9 @@ class DeviceScheduler(Scheduler):
                         )
                         choice = jax.device_get(choice)
                     return node_names, choice.tolist()[: len(pods_)]
-                node_table, node_names = self._table_builder.build(node_infos)
+                node_table, node_names = self._table_builder.build(
+                    node_infos, agg_delta=agg_delta
+                )
                 pod_table, _ = build_pod_table(pods_, capacity=cap)
                 extra = self._build_constraints(
                     pods_, nodes, assigned,
@@ -481,22 +552,74 @@ class DeviceScheduler(Scheduler):
                 self._assume(qpi.pod, node_names[c])
                 winners.append((qpi, qpi.pod, node_names[c]))
             self._commit_winners(winners)
+            # _bind_batch re-closed the gate; this lane stays ungated (the
+            # next chunk's re-snapshot needs the bind events applied)
+            self.informer_factory.resume_dispatch()
             if losers:
                 self._handle_wave_losers(losers, node_infos, len(nodes))
+
+    # -- GC discipline ------------------------------------------------------
+    # At 100k-pod scale the process holds ~10⁶ tracked Python objects
+    # (pods, containers, label dicts, caches); CPython's automatic
+    # collections rescan them on allocation-heavy phases and cost more
+    # than the phases themselves (a 16k-pod batch bind: 130ms of work,
+    # ~340ms of GC).  The wave loop therefore freezes the stable heap,
+    # turns the automatic collector off, and collects explicitly at wave
+    # boundaries — young-gen every wave (bounds cyclic garbage), full
+    # periodically (bounds promoted-cycle leaks in long-running services).
+    FULL_GC_EVERY_WAVES = 64
+
+    def _loop(self) -> None:
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        was_enabled = gc.isenabled()
+        gc.disable()
+        self._waves_since_full_gc = 0
+        try:
+            super()._loop()
+        finally:
+            if was_enabled:
+                gc.enable()
+            gc.unfreeze()
+
+    def _wave_gc(self) -> None:
+        import gc
+
+        if gc.isenabled():
+            return  # not running under the loop's GC discipline
+        self._waves_since_full_gc = getattr(self, "_waves_since_full_gc", 0) + 1
+        if self._waves_since_full_gc >= self.FULL_GC_EVERY_WAVES:
+            self._waves_since_full_gc = 0
+            gc.collect()
+        else:
+            gc.collect(0)
 
     # the loop: one wave per iteration instead of one pod ------------------
     def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
         qpis = self.queue.pop_batch(self.max_wave, timeout=timeout)
         if not qpis:
+            # idle: the gate a bind may have closed (see _bind_batch) must
+            # not delay the events that will wake us; and with the
+            # automatic collector off, idle churn (informer handlers,
+            # exception cycles) still needs a periodic sweep
+            self.informer_factory.resume_dispatch()
+            self._wave_gc()
             return False
-        self.schedule_wave(qpis)
+        try:
+            self.schedule_wave(qpis)
+        finally:
+            # every exit path (incl. scan-only waves and early returns)
+            # collects; schedule_wave's own call was only on the main path
+            self._wave_gc()
         return True
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
         t_wave = time.monotonic()
         self.metrics.observe("wave_size", float(len(qpis)))
         with self.metrics.timed("wave_snapshot"):
-            node_infos = self.snapshot_nodes()
+            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
         if not node_infos:
             for qpi in qpis:
                 self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
@@ -517,26 +640,28 @@ class DeviceScheduler(Scheduler):
         )
         if constrained:
             plain = [qpi for qpi in qpis if not _is_cross_pod(qpi.pod)]
-            self._schedule_scan(constrained, node_infos)
+            self._schedule_scan(constrained, node_infos, agg_delta, assumed_pods)
             if not plain:
                 self.metrics.observe("wave", time.monotonic() - t_wave)
                 return
             qpis = plain
-            node_infos = self.snapshot_nodes()
+            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
 
         with self.metrics.timed("wave_assigned_list"):
             nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot
-            # with a live index the build never walks the population
+            # with a live index the build never walks the population; the
+            # index-less build must see the assumed pods explicitly now
+            # that the snapshot no longer folds them into NodeInfos
             assigned = (
                 ()
                 if self.constraint_index is not None
-                else [p for ni in node_infos for p in ni.pods]
+                else [p for ni in node_infos for p in ni.pods] + assumed_pods
             )
 
         def build_and_evaluate(qpis_):
             with self.metrics.timed("wave_evaluate"):
                 return self._build_and_evaluate(
-                    qpis_, node_infos, nodes, assigned
+                    qpis_, node_infos, nodes, assigned, agg_delta
                 )
 
         qpis, result = self._evaluate_or_park(qpis, build_and_evaluate)
@@ -558,7 +683,9 @@ class DeviceScheduler(Scheduler):
             self._handle_wave_losers(losers, node_infos, len(nodes))
         self.metrics.observe("wave", time.monotonic() - t_wave)
 
-    def _build_and_evaluate(self, qpis_, node_infos, nodes, assigned):
+    def _build_and_evaluate(
+        self, qpis_, node_infos, nodes, assigned, agg_delta=None
+    ):
         """One repair-wave evaluation: tables → fused repair evaluator →
         (node_names, placements, per-pod failing-plugin sets).
 
@@ -577,14 +704,18 @@ class DeviceScheduler(Scheduler):
         with self.metrics.timed("wave_build_tables"):
             if packed_mode:
                 node_static, node_agg, node_names = (
-                    self._table_builder.build_packed(node_infos)
+                    self._table_builder.build_packed(
+                        node_infos, agg_delta=agg_delta
+                    )
                 )
                 node_capacity = node_agg.capacity
                 pod_table, _ = build_pod_table(
                     pods_, capacity=pod_capacity, device=False
                 )
             else:
-                node_table, node_names = self._table_builder.build(node_infos)
+                node_table, node_names = self._table_builder.build(
+                    node_infos, agg_delta=agg_delta
+                )
                 node_capacity = node_table.capacity
                 pod_table, _ = build_pod_table(pods_, capacity=pod_capacity)
         extra = None
@@ -599,6 +730,9 @@ class DeviceScheduler(Scheduler):
                 )
         if self.result_store is not None:
             self._record_wave(pods_, pod_table, node_table, node_names, extra)
+        # the device call releases the GIL for the whole evaluation —
+        # let the event handlers for the previous wave's binds run there
+        self.informer_factory.resume_dispatch()
         with self.metrics.timed("wave_device"):
             if packed_mode:
                 _, choice, _, unsched = self._get_evaluator().call_packed(
@@ -880,6 +1014,15 @@ class DeviceScheduler(Scheduler):
             Binding(pod.metadata.name, pod.metadata.namespace, node_name)
             for _, pod, node_name, _ in ready
         ]
+        # close the dispatch gate BEFORE the events fan out: the informer
+        # threads then hold this wave's thousands of bind events through
+        # the next wave's host stretch (pop/snapshot/build) and process
+        # them inside its GIL-free device call — _build_and_evaluate
+        # reopens the gate, schedule_one reopens it when the queue idles.
+        # The handler work is identical either way (the assume-cache
+        # carries placements until the events land); only WHEN it contends
+        # for the GIL changes.
+        self.informer_factory.pause_dispatch()
         with self.metrics.timed("bind"):
             # return_objects=False: the engine only inspects failures —
             # cloning 8k bound pods back to a caller that drops them was

@@ -83,7 +83,17 @@ class NodeInfo:
         self.pods.append(pod)
         req = pod.resource_requests()
         self.requested.add(req)
-        self.non_zero_requested.add(non_zero_requests(pod))
+        # non_zero_requests(pod), inlined against the one walk above — the
+        # second resource_requests walk per event was a quarter of the
+        # cache's cost at wave scale (quantization identical: only cpu and
+        # memory get the non-zero defaults)
+        nz = self.non_zero_requested
+        nz.milli_cpu += req.milli_cpu or DEFAULT_POD_CPU_REQUEST
+        nz.memory += req.memory or DEFAULT_POD_MEMORY_REQUEST
+        nz.pods += req.pods
+        nz.ephemeral_storage += req.ephemeral_storage
+        for k, v in req.scalar.items():
+            nz.scalar[k] = nz.scalar.get(k, 0) + v
         self.req_mem_mib += req.memory // MIB
         self.req_eph_mib += req.ephemeral_storage // MIB
         self.nzreq_mem_mib += (req.memory // MIB) or (
@@ -102,7 +112,13 @@ class NodeInfo:
                 # copy may differ, e.g. an update refreshing the object)
                 req = p.resource_requests()
                 self.requested.sub(req)
-                self.non_zero_requested.sub(non_zero_requests(p))
+                nz = self.non_zero_requested
+                nz.milli_cpu -= req.milli_cpu or DEFAULT_POD_CPU_REQUEST
+                nz.memory -= req.memory or DEFAULT_POD_MEMORY_REQUEST
+                nz.pods -= req.pods
+                nz.ephemeral_storage -= req.ephemeral_storage
+                for k, v in req.scalar.items():
+                    nz.scalar[k] = nz.scalar.get(k, 0) - v
                 self.req_mem_mib -= req.memory // MIB
                 self.req_eph_mib -= req.ephemeral_storage // MIB
                 self.nzreq_mem_mib -= (req.memory // MIB) or (

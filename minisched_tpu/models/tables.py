@@ -776,6 +776,7 @@ class CachedNodeTableBuilder:
         #: every later wave)
         self._device_static = device_static
         self._names: List[str] = []
+        self._name_index: Dict[str, int] = {}
 
     def _ensure_static(self, node_infos: Sequence[Any], cap: int,
                        prof_capacity: int) -> None:
@@ -813,6 +814,7 @@ class CachedNodeTableBuilder:
         if self._device_static:
             self._static_dev = batched_device_put(self._host_static)
         self._names = names
+        self._name_index = {name: i for i, name in enumerate(names)}
         self._sig = sig
 
     def _patch_rows(self, node_infos: Sequence[Any], sig: Tuple) -> bool:
@@ -871,6 +873,34 @@ class CachedNodeTableBuilder:
             _fill_aggregate_row(t, i, ni)
         return t
 
+    def _apply_agg_delta(self, t: Dict[str, Any], agg_delta) -> None:
+        """Fold the wave engine's assume-cache deltas into the aggregate
+        columns numerically — the alternative (NodeInfo.add_pod per assumed
+        pod into cloned infos) cost ~250ms per 16k-pod wave and duplicated
+        work the cache's own event path does once the binds land.  A delta
+        row is ``[milli_cpu, mem_mib, eph_mib, pods, nz_milli_cpu,
+        nz_mem_mib, ports]`` with the exact NodeInfo.add_pod quantization
+        (sum-of-floors MiB — parity depends on it)."""
+        idx = self._name_index
+        for name, d in agg_delta.items():
+            i = idx.get(name)
+            if i is None:
+                continue  # node left the roster; the assumption prunes next
+            t["req_cpu"][i] += d[0]
+            t["req_mem"][i] += d[1]
+            t["req_eph"][i] += d[2]
+            t["req_pods"][i] += d[3]
+            t["nzreq_cpu"][i] += d[4]
+            t["nzreq_mem"][i] += d[5]
+            ports = d[6]
+            if ports:
+                n = int(t["num_used_ports"][i])
+                if n + len(ports) > MAX_PORTS:
+                    raise ValueError(f"node {name}: >{MAX_PORTS} used ports")
+                for j, port in enumerate(ports, start=n):
+                    t["used_port"][i, j] = port
+                t["num_used_ports"][i] = n + len(ports)
+
     @staticmethod
     def _cap_for(node_infos: Sequence[Any], capacity) -> int:
         n = len(node_infos)
@@ -880,10 +910,12 @@ class CachedNodeTableBuilder:
         return cap
 
     def build(self, node_infos: Sequence[Any], capacity: int = None,
-              prof_capacity: int = None):
+              prof_capacity: int = None, agg_delta=None):
         cap = self._cap_for(node_infos, capacity)
         self._ensure_static(node_infos, cap, prof_capacity)
         t = self._fill_aggregates(node_infos, cap)
+        if agg_delta:
+            self._apply_agg_delta(t, agg_delta)
         if self._device_static:
             cols = dict(self._static_dev)
             cols.update(batched_device_put(t))
@@ -894,7 +926,7 @@ class CachedNodeTableBuilder:
         return NodeTable(**cols), list(self._names)
 
     def build_packed(self, node_infos: Sequence[Any], capacity: int = None,
-                     prof_capacity: int = None):
+                     prof_capacity: int = None, agg_delta=None):
         """Single-program variant: (static device cols, PackedTable of the
         per-wave aggregate columns, names).  The consumer jit unpacks the
         aggregates and merges the device-resident statics inside its own
@@ -904,6 +936,8 @@ class CachedNodeTableBuilder:
         cap = self._cap_for(node_infos, capacity)
         self._ensure_static(node_infos, cap, prof_capacity)
         t = self._fill_aggregates(node_infos, cap)
+        if agg_delta:
+            self._apply_agg_delta(t, agg_delta)
         return self._static_dev, pack_table(t, (), cap), list(self._names)
 
 

@@ -1,0 +1,131 @@
+"""Profile the per-wave HOST costs of the config5 full-chain run, piece
+by piece, on the CPU backend: snapshot+assumed-fold, pod-table build,
+constraint build, batch bind.  The device step is excluded (see
+profile_device.py) — this isolates the 3.4s snapshot / 3.7s constraint /
+1.4s table / 3.8s bind split from the round-4 bench breakdown."""
+
+import cProfile
+import io
+import os
+import pstats
+import random
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.engine.cache import SchedulerCache
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_pod_table, pad_to
+
+N_NODES = int(os.environ.get("PN", 10_000))
+WAVE = int(os.environ.get("PW", 16_384))
+
+rng = random.Random(55)
+client = Client()
+t0 = time.monotonic()
+for i in range(N_NODES):
+    client.nodes().create(
+        make_node(
+            f"node{i:05d}",
+            capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            labels={"zone": f"z{i % 16}"},
+        )
+    )
+pods = [
+    client.pods().create(
+        make_pod(f"pod{i:06d}", requests={"cpu": "500m", "memory": "256Mi"})
+    )
+    for i in range(WAVE)
+]
+print(f"cluster: {time.monotonic()-t0:.1f}s")
+
+factory = SharedInformerFactory(client.store)
+cache = SchedulerCache()
+cache.wire(factory)
+factory.start()
+factory.wait_for_cache_sync()
+
+def timed(label, fn, n=1, profile=False):
+    if profile:
+        pr = cProfile.Profile()
+        pr.enable()
+    t = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    dt = (time.monotonic() - t) / n
+    print(f"{label}: {dt*1000:.1f}ms")
+    if profile:
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(18)
+        print(s.getvalue())
+    return out
+
+# 1. clean snapshot (no assumed)
+infos = timed("snapshot (clean)", cache.snapshot_with_assigned, n=3)[0]
+
+# 2. snapshot with a full wave of assumed pods to fold
+pod_informer = factory.informer_for("Pod")
+assumed = {}
+for i, p in enumerate(pods):
+    a = p.clone()
+    a.spec.node_name = f"node{i % N_NODES:05d}"
+    assumed[p.metadata.uid] = a
+
+def snap_fold():
+    infos, cache_assigned = cache.snapshot_with_assigned()
+    by_name = {ni.name: ni for ni in infos}
+    for uid in list(assumed):
+        a = assumed[uid]
+        current = pod_informer.get(a.metadata.key)
+        exists = current is not None and current.metadata.uid == uid
+        if uid in cache_assigned or not exists:
+            continue
+        ni = by_name.get(a.spec.node_name)
+        if ni is not None:
+            ni.add_pod(a)
+    return infos
+
+timed(f"snapshot + fold {WAVE} assumed", snap_fold, n=3, profile=True)
+
+# 3. pod table build (packed, host buffers)
+cap = pad_to(WAVE)
+timed(
+    f"build_pod_table packed cap={cap}",
+    lambda: build_pod_table(pods, capacity=cap, device=False),
+    n=3,
+    profile=True,
+)
+
+# 4. constraint build: plain pods, live index path approximated with
+#    assigned=() and index=None (zero-elided)
+nodes = [ni.node for ni in infos]
+timed(
+    "build_constraint_tables (plain wave)",
+    lambda: build_constraint_tables(
+        pods, nodes, [], pod_capacity=cap,
+        node_capacity=pad_to(N_NODES), scan_planes=False, device=False,
+    ),
+    n=3,
+    profile=True,
+)
+
+# 5. batch bind, then IMMEDIATELY the next wave's snapshot+fold, like the
+#    engine does — measures the dispatch-thread contention the isolated
+#    numbers above hide
+bindings = [
+    Binding(p.metadata.name, p.metadata.namespace, f"node{i % N_NODES:05d}")
+    for i, p in enumerate(pods)
+]
+timed(
+    f"bind_many {WAVE}",
+    lambda: client.pods().bind_many(bindings, return_objects=False),
+    profile=True,
+)
+timed("snapshot+fold right after bind (dispatch racing)", snap_fold)
+time.sleep(2.0)  # let dispatch drain
+timed("snapshot+fold after dispatch drained", snap_fold)
+

@@ -149,7 +149,7 @@ def test_watch_streams_events(api):
             line = raw.strip()
             if line:
                 events.append(json.loads(line))
-            if len(events) >= 2:
+            if len(events) >= 3:
                 break
 
     t = threading.Thread(target=reader, daemon=True)
@@ -158,8 +158,11 @@ def test_watch_streams_events(api):
     http.pods().create(make_pod("w1"))
     store_client.pods().bind(Binding("w1", "default", "x"))  # MODIFIED event
     t.join(timeout=5)
-    assert [e["type"] for e in events[:2]] == ["ADDED", "MODIFIED"]
-    assert events[0]["object"]["metadata"]["name"] == "w1"
+    # first line: the SYNC marker carrying the atomic snapshot count (the
+    # informer sync barrier's contract); then the live events
+    assert [e["type"] for e in events[:3]] == ["SYNC", "ADDED", "MODIFIED"]
+    assert events[0]["count"] == 0  # watch opened on an empty namespace
+    assert events[1]["object"]["metadata"]["name"] == "w1"
 
 
 def test_readme_scenario_over_http(api):
