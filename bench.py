@@ -415,7 +415,7 @@ def bench_config5_fullchain() -> dict:
                     f"queue={sched.queue.stats()} "
                     f"waves={int(snap.get('wave', {}).get('count', 0))}"
                 )
-            time.sleep(0.5)
+            time.sleep(0.05)  # fine-grained: the poll is part of the metric
         raise SystemExit(f"[config5/full-chain] timed out waiting for {what}")
 
     target_first = n_pods - n_special
@@ -436,12 +436,19 @@ def bench_config5_fullchain() -> dict:
     # slice must supply ample headroom: labeled nodes already carry ~12
     # normal pods (≈6000m of 8000m) so each offers ~3-4 cpu slots; one
     # labeled node per parked pod gives ~3× the needed capacity
+    t_label = time.monotonic()
     for name in rng.sample(normal_nodes, min(len(normal_nodes), n_special)):
         node = client.nodes().get(name)
         node.metadata.labels["special"] = "true"
         client.nodes().update(node)
+    label_loop_s = time.monotonic() - t_label
+    t_wait = time.monotonic()
     wait_until(
         lambda: bound_count() >= n_pods, timeout=600, what=f"all {n_pods} bound"
+    )
+    log(
+        f"[config5/full-chain] requeue tail: label loop {label_loop_s:.2f}s, "
+        f"bound-wait {time.monotonic()-t_wait:.2f}s"
     )
     elapsed = time.monotonic() - t0
     service.shutdown_scheduler()

@@ -1034,7 +1034,9 @@ class DeviceScheduler(Scheduler):
         # the dispatch thread later.  Record the move request so losers
         # whose attempts overlapped the commit re-queue through backoff
         # instead of parking past the event (the event-to-park race).
-        self.queue.note_move_request()
+        from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+
+        self.queue.note_move_request(ClusterEvent(GVK.POD, ActionType.UPDATE))
         for (qpi, pod, node_name, state), res in zip(ready, results):
             if isinstance(res, BaseException):
                 self.run_unreserve_plugins(state, pod, node_name)

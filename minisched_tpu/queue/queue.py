@@ -86,6 +86,24 @@ class SchedulingQueue:
         # PriorityQueue.AddUnschedulableIfNotPresent).
         self._scheduling_cycle = 0
         self._move_request_cycle = -1
+        # per-event move-request cycles: WHICH event fired at which cycle,
+        # so the event-to-park race check can stay event-GATED.  Upstream's
+        # single moveRequestCycle routes every concurrently-failing pod
+        # through backoff on ANY move request; at wave scale every wave's
+        # own binds are a move request, so genuinely-unschedulable pods
+        # never park — they replay through backoff for the whole run,
+        # doubling their backoff each lap (a 2k-pod replay wave per lap,
+        # and seconds of leftover backoff when the helping event finally
+        # arrives).  The None key is the conservative wildcard (a move
+        # request with no event attached helps everyone).
+        self._move_events: Dict[Optional[ClusterEvent], int] = {}
+        # event-storm tracking for pop_batch's debounce: the GVK whose
+        # event last re-activated parked pods, and the wall-clock time of
+        # the most recent same-GVK event while the storm lasts.  (Wall
+        # clock on purpose: the debounce interacts with real condition
+        # waits, not the injectable backoff clock.)
+        self._storm_gvk: Optional[GVK] = None
+        self._last_move_walltime = 0.0
 
     @staticmethod
     def _uid(pod) -> str:
@@ -175,12 +193,25 @@ class SchedulingQueue:
 
     def add_unschedulable(self, qpi: QueuedPodInfo) -> None:
         """Failed pod → unschedulableQ, stamped now (queue.go:95-107) —
-        unless a move request fired during its attempt, in which case it
-        goes through backoff (upstream AddUnschedulableIfNotPresent)."""
+        unless a move request that could HELP this pod fired during its
+        attempt, in which case it goes through backoff (upstream
+        AddUnschedulableIfNotPresent, with the event-gating refinement:
+        upstream's single moveRequestCycle would re-queue it on any
+        overlapping event, helping or not — see _move_events)."""
         with self._cond:
             qpi.timestamp = self._clock()
             self._queued_uids.add(self._uid(qpi.pod))
-            if self._move_request_cycle >= qpi.scheduling_cycle:
+            helped = any(
+                cycle >= qpi.scheduling_cycle
+                and (
+                    ev is None
+                    or event_helps_pod(
+                        ev, qpi.unschedulable_plugins, self._event_map
+                    )
+                )
+                for ev, cycle in self._move_events.items()
+            )
+            if helped:
                 if self._is_backing_off(qpi):
                     self._push_backoff(qpi)
                 else:
@@ -233,20 +264,24 @@ class SchedulingQueue:
             self._queued_uids.discard(uid)
 
     # -- event-driven requeue ---------------------------------------------
-    def note_move_request(self) -> None:
+    def note_move_request(self, event: Optional[ClusterEvent] = None) -> None:
         """Record a cluster state change as a move request WITHOUT a scan:
-        pods currently mid-attempt will re-queue through backoff on
-        failure.  The wave engine calls this synchronously after a batch
-        bind — the informer events arrive on the dispatch thread later,
-        after the wave's losers may already have parked."""
+        pods currently mid-attempt whose failures ``event`` could help will
+        re-queue through backoff on failure.  The wave engine calls this
+        synchronously after a batch bind (event = Pod/UPDATE, mirroring
+        what the dispatch thread will fire when the bind events land) —
+        those events arrive later, after the wave's losers may already
+        have parked.  ``event=None`` is the conservative wildcard."""
         with self._cond:
             self._move_request_cycle = self._scheduling_cycle
+            self._move_events[event] = self._scheduling_cycle
 
     def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
         """queue.go:54-82: on a cluster event, re-activate every
         unschedulable pod the event might help."""
         with self._cond:
             self._move_request_cycle = self._scheduling_cycle
+            self._move_events[event] = self._scheduling_cycle
             # the interest index narrows the scan to pods whose failed
             # plugins registered for this event's resource (or wildcard);
             # event_helps_pod then applies the precise action-type match
@@ -267,6 +302,21 @@ class SchedulingQueue:
                     self._push_backoff(qpi)
                 else:
                     self._push_active(qpi)
+            # storm tracking: a move that re-activated pods opens a storm
+            # for this GVK; further same-GVK events extend it while it
+            # lasts (a burst of node-label updates re-activates everything
+            # on the FIRST event — the follow-on events must still hold
+            # the wave boundary or it evaluates against half-updated
+            # state, fails half the burst, and pays a doubled backoff)
+            now_w = time.monotonic()
+            if moved:
+                self._storm_gvk = event.resource
+                self._last_move_walltime = now_w
+            elif (
+                self._storm_gvk == event.resource
+                and now_w - self._last_move_walltime < self.STORM_MAX_GATHER_S
+            ):
+                self._last_move_walltime = now_w
 
     def assigned_pod_added(self, pod) -> None:
         """A pod got bound somewhere — may unblock pods with (anti)affinity
@@ -344,6 +394,12 @@ class SchedulingQueue:
             self._queued_uids.discard(self._uid(qpi.pod))
             return qpi
 
+    #: pop_batch holds the wave boundary while an event storm that just
+    #: re-activated parked pods is still arriving (no same-GVK event for
+    #: this long = settled), bounded by the max gather
+    STORM_DEBOUNCE_S = 0.2
+    STORM_MAX_GATHER_S = 1.0
+
     def pop_batch(
         self,
         max_pods: int,
@@ -353,19 +409,27 @@ class SchedulingQueue:
         """Drain up to ``max_pods`` in FIFO order — the wave the TPU batch
         evaluator schedules in one fused kernel call.
 
+        Two bounded waits keep a requeue burst on ONE wave instead of
+        trickling through several (each its own full evaluation):
+
         ``gather_backoff_s``: after draining the activeQ, if the batch has
         room and more pods' backoff expires within this window, wait for
-        them and take them too.  A requeue burst (an event re-activating
-        thousands of parked pods through 1-2s of per-pod backoff,
-        queue.go:218-235 semantics) then rides ONE wave instead of
-        trickling through several — each its own full evaluation — which
-        made the tail of a run cost seconds for 2% of its pods.  Backoff
-        expiry times are unchanged (pods never leave early); only the
-        wave boundary waits for them."""
+        them and take them too.  Backoff expiry times are unchanged (pods
+        never leave early); only the wave boundary waits for them.
+
+        Storm debounce: when a cluster-event burst (say 2k node-label
+        updates) re-activates parked pods, the FIRST event moves them all
+        — a wave starting right then evaluates against the half-updated
+        cluster, fails half the burst, and pays a doubled per-pod backoff
+        (queue.go:218-235 semantics) before a second wave.  While same-GVK
+        events are still arriving (see move_all_to_active_or_backoff), the
+        wave boundary holds until STORM_DEBOUNCE_S passes without one,
+        capped at STORM_MAX_GATHER_S."""
         first = self.pop(timeout)
         if first is None:
             return []
         batch = [first]
+        t_start = time.monotonic()
         with self._cond:
             while True:
                 while self._active and len(batch) < max_pods:
@@ -375,16 +439,32 @@ class SchedulingQueue:
                     qpi.scheduling_cycle = self._scheduling_cycle
                     self._queued_uids.discard(self._uid(qpi.pod))
                     batch.append(qpi)
-                if len(batch) >= max_pods or not self._backoff:
+                if len(batch) >= max_pods:
                     break
-                wait = self._backoff[0][0] - self._clock()
-                if wait > gather_backoff_s:
+                now_w = time.monotonic()
+                storm_wait = None
+                if self._storm_gvk is not None:
+                    since = now_w - self._last_move_walltime
+                    if (
+                        since < self.STORM_DEBOUNCE_S
+                        and now_w - t_start < self.STORM_MAX_GATHER_S
+                    ):
+                        storm_wait = self.STORM_DEBOUNCE_S - since
+                    else:
+                        self._storm_gvk = None  # settled (or cap hit)
+                backoff_wait = None
+                if self._backoff:
+                    w = self._backoff[0][0] - self._clock()
+                    if w <= gather_backoff_s:
+                        backoff_wait = max(w, 0.0)
+                if storm_wait is None and backoff_wait is None:
                     break
+                wait = min(
+                    w for w in (storm_wait, backoff_wait) if w is not None
+                )
                 # releases the lock; producers/events can land meanwhile
-                self._cond.wait(max(wait, 0.0) + 0.001)
+                self._cond.wait(wait + 0.001)
                 self.flush_backoff_completed_locked()
-                if not self._active:
-                    break
         return batch
 
     def flush_backoff_completed_locked(self) -> None:

@@ -324,3 +324,89 @@ def test_pop_batch_gathers_imminent_backoff_burst():
     # ONE wave captured the whole burst once backoff expired
     assert len(batch) == 10, len(batch)
     assert now() - t0 < 1.0
+
+
+def test_pop_batch_debounces_event_storm():
+    """A burst of same-GVK events re-activating parked pods holds the wave
+    boundary until the storm settles: the whole burst rides one wave even
+    though only the FIRST event moved the pods (the rest would otherwise
+    find an empty unschedulableQ and a wave already mid-flight against
+    half-updated cluster state)."""
+    import threading
+    import time as _time
+
+    from minisched_tpu.framework.events import ActionType
+
+    event_map = {
+        ClusterEvent(GVK.NODE, ActionType.UPDATE): {"NodeAffinity"},
+    }
+    q = SchedulingQueue(event_map)
+    for i in range(20):
+        q.add(make_pod(f"s{i}"))
+    popped = q.pop_batch(100, timeout=1.0)
+    for qpi in popped:
+        qpi.unschedulable_plugins = {"NodeAffinity"}
+        q.add_unschedulable(qpi)
+        qpi.timestamp -= 60  # long past backoff: re-activation is instant
+        # (rewound AFTER add_unschedulable, which re-stamps internally)
+
+    stop = threading.Event()
+
+    def storm():
+        # first event moves all 20; the rest keep the storm open ~0.35s
+        deadline = _time.monotonic() + 0.35
+        while _time.monotonic() < deadline and not stop.is_set():
+            q.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.NODE, ActionType.UPDATE)
+            )
+            _time.sleep(0.02)
+
+    t = threading.Thread(target=storm, daemon=True)
+    t.start()
+    _time.sleep(0.05)  # storm underway before the consumer arrives
+    t0 = _time.monotonic()
+    batch = q.pop_batch(100, timeout=2.0)
+    took = _time.monotonic() - t0
+    stop.set()
+    t.join(timeout=1)
+    assert len(batch) == 20, len(batch)
+    # held past the storm (≳0.3s left when we popped) but under the cap
+    assert took < q.STORM_MAX_GATHER_S + 0.5, took
+
+
+def test_pop_batch_storm_cap_bounds_the_wait():
+    """An endless same-GVK event stream cannot hold waves forever — the
+    gather is capped at STORM_MAX_GATHER_S."""
+    import threading
+    import time as _time
+
+    from minisched_tpu.framework.events import ActionType
+
+    event_map = {
+        ClusterEvent(GVK.NODE, ActionType.UPDATE): {"NodeAffinity"},
+    }
+    q = SchedulingQueue(event_map)
+    q.add(make_pod("one"))
+    [qpi] = q.pop_batch(10, timeout=1.0)
+    qpi.unschedulable_plugins = {"NodeAffinity"}
+    q.add_unschedulable(qpi)
+    qpi.timestamp -= 60  # rewound after the re-stamp inside add_unschedulable
+
+    stop = threading.Event()
+
+    def endless_storm():
+        while not stop.is_set():
+            q.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.NODE, ActionType.UPDATE)
+            )
+            _time.sleep(0.02)
+
+    t = threading.Thread(target=endless_storm, daemon=True)
+    t.start()
+    t0 = _time.monotonic()
+    batch = q.pop_batch(10, timeout=5.0)
+    took = _time.monotonic() - t0
+    stop.set()
+    t.join(timeout=1)
+    assert len(batch) == 1
+    assert took < q.STORM_MAX_GATHER_S + 1.0, took
