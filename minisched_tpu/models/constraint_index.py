@@ -70,7 +70,9 @@ class _PodRecord:
     """What one assigned pod contributed — enough to subtract it again
     without re-matching (labels may have changed since)."""
 
-    __slots__ = ("node", "combo_ids", "ex_keys", "vols", "claims", "has_anti")
+    __slots__ = (
+        "node", "combo_ids", "ex_keys", "vols", "claims", "has_anti", "rev",
+    )
 
     def __init__(self, node: str):
         self.node = node
@@ -80,10 +82,14 @@ class _PodRecord:
         self.vols: List[Tuple[VolKey, int, bool]] = []
         #: referenced claim keys (for PVC/PV re-resolution)
         self.claims: List[str] = []
-        #: pod carries required anti-affinity — node label changes (or the
-        #: node's ADD arriving after the pod's, informers being separate
-        #: dispatch threads) change its ex-term owner domains
+        #: pod carries node-label-SENSITIVE terms (required anti-affinity
+        #: owner domains, symmetric preferred/hard-affinity contributions)
+        #: — node label changes (or the node's ADD arriving after the
+        #: pod's, informers being separate dispatch threads) change them
         self.has_anti = False
+        #: symmetric preferred contributions: (ComboKey, owner topo value,
+        #: signed weight) per scoring-relevant term of this assigned pod
+        self.rev: List[Tuple[ComboKey, str, int]] = []
 
 
 class ConstraintIndex:
@@ -106,6 +112,10 @@ class ConstraintIndex:
         # reverse anti-affinity: key → per-owner-node count
         self._ex_terms: Dict[ExKey, Dict[str, int]] = {}
         self._ex_sel: Dict[ExKey, LabelSelector] = {}
+        # symmetric preferred scoring: combo key → owner topo value →
+        # Σ signed weight of assigned pods' terms owning that domain
+        self._rev_pref: Dict[ComboKey, Dict[str, int]] = {}
+        self._rev_sel: Dict[ComboKey, LabelSelector] = {}
         # volume state: node → VolKey → [mounts, rw_mounts, family]
         self._node_vols: Dict[str, Dict[VolKey, List[int]]] = {}
         # claim key → uids of assigned pods mounting it (PVC/PV re-resolve)
@@ -290,6 +300,24 @@ class ConstraintIndex:
                        term.topology_key, owner_val)
                 self._ex_sel.setdefault(key, term.label_selector)
                 rec.ex_keys.append(key)
+        # symmetric preferred/hard-affinity contributions (the terms this
+        # ASSIGNED pod scores toward future incoming pods) — ONE term
+        # stream shared with the from-scratch walk
+        from minisched_tpu.models.constraints import rev_pref_terms_of
+
+        owner_labels = None
+        for nss, sel, topo, w in rev_pref_terms_of(pod):
+            # node-label-sensitive either way: a label change can grant or
+            # revoke the owner's topology key — re-resolve on node events
+            rec.has_anti = True
+            if owner_labels is None:
+                owner_labels = self._node_labels(pod.spec.node_name)
+            owner_val = owner_labels.get(topo)
+            if owner_val is None:
+                continue  # owner's node lacks the key: no domain to score
+            ck: ComboKey = (nss, _selector_sig(sel), topo)
+            self._rev_sel.setdefault(ck, sel)
+            rec.rev.append((ck, owner_val, w))
         uid = pod.metadata.uid
         for j, vol in enumerate(pod.spec.volumes):
             claim_key = f"{pod.metadata.namespace}/{vol}"
@@ -332,6 +360,9 @@ class ConstraintIndex:
         for key in rec.ex_keys:
             owners = self._ex_terms.setdefault(key, {})
             owners[node] = owners.get(node, 0) + 1
+        for ck, owner_val, w in rec.rev:
+            vals = self._rev_pref.setdefault(ck, {})
+            vals[owner_val] = vals.get(owner_val, 0) + w
         if rec.vols:
             nv = self._node_vols.setdefault(node, {})
             for vk, fam, rw in rec.vols:
@@ -370,6 +401,16 @@ class ConstraintIndex:
                     owners.pop(node, None)
                 else:
                     owners[node] = n
+        for ck, owner_val, w in rec.rev:
+            vals = self._rev_pref.get(ck)
+            if vals is not None:
+                left = vals.get(owner_val, 0) - w
+                if left == 0:
+                    vals.pop(owner_val, None)
+                    if not vals:
+                        self._rev_pref.pop(ck, None)
+                else:
+                    vals[owner_val] = left
         nv = self._node_vols.get(node)
         if nv is not None:
             for vk, _fam, rw in rec.vols:
@@ -466,6 +507,16 @@ class ConstraintIndex:
                 (key, self._ex_sel[key], set(owners))
                 for key, owners in self._ex_terms.items()
                 if owners
+            ]
+
+    def rev_pref_list(self) -> List[Tuple[ComboKey, LabelSelector, Dict[str, int]]]:
+        """Live symmetric preferred contributions: (combo key, selector,
+        owner-topo-value → Σ signed weight)."""
+        with self._mu:
+            return [
+                (ck, self._rev_sel[ck], dict(vals))
+                for ck, vals in self._rev_pref.items()
+                if vals
             ]
 
     def node_vol_state(self) -> Dict[str, Dict[VolKey, List[int]]]:

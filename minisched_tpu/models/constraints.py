@@ -20,10 +20,10 @@ Semantics follow upstream v1.22 ``interpodaffinity`` / ``podtopologyspread``
 (the reference's default roster enables both — scheduler_test.go:307-332),
 including the affinity bootstrap special case (a pod matching its own
 affinity term may land anywhere with the topology key when no pod matches
-cluster-wide) and spread's eligible-node gating.  Preferred-term scoring
-covers the incoming pod's terms (both signs); symmetric scoring of
-*existing* pods' preferred terms is intentionally out of scope for now and
-documented here so the scalar oracle and kernels agree on ONE semantic.
+cluster-wide), spread's eligible-node gating, and SYMMETRIC preferred-term
+scoring: assigned pods' preferred (and hard-weighted required) affinity
+terms score toward incoming pods that match them, via the ``rev_weight``
+plane (one ``pod_matches_combo @ rev_weight`` matmul on device).
 """
 
 from __future__ import annotations
@@ -94,6 +94,12 @@ class ConstraintTables:
     # reverse direction: assigned pods' required anti-affinity terms
     ex_domain: Any  # bool[T, N] nodes in the owning pod's topo domain
     pod_matches_ex: Any  # bool[P, T] pending pod matches term selector
+    # symmetric preferred scoring (upstream v1.22 interpodaffinity
+    # PreScore): assigned pods' preferred affinity (+w) / anti-affinity
+    # (−w) terms and required affinity terms (×HARD_POD_AFFINITY_WEIGHT),
+    # accumulated as signed weight over the owner's topology domain per
+    # combo.  Scored as pod_matches_combo @ rev_weight (one int matmul).
+    rev_weight: Any  # i32[C, N] Σ signed term weights whose domain holds n
     # sequential-scan support (ops/sequential.py): which pending pods match
     # each combo's selector — commits update the combo aggregates with it —
     # and the exclusion plane accumulated from committed pods' required
@@ -144,6 +150,7 @@ CONSTRAINT_AXES = {
     "topo_unique": ("rep", None),
     "ex_domain": ("last", "nodes"),
     "pod_matches_ex": ("first", "pods"),
+    "rev_weight": ("last", "nodes"),
     "pod_matches_combo": ("first", "pods"),
     "combo_excl": ("last", "nodes"),
     "claim_mask": ("last", "nodes"),
@@ -183,9 +190,46 @@ POD_AXIS_FIELDS = tuple(
 
 #: fields the sequential scan carries and updates as pods commit
 SCAN_CARRIED_FIELDS = (
-    "combo_dsum", "combo_here", "combo_global", "combo_excl",
+    "combo_dsum", "combo_here", "combo_global", "combo_excl", "rev_weight",
     "vol_any", "vol_rw", "node_vols_fam",
 )
+
+#: upstream HardPodAffinityWeight default (scheduler API defaulting): the
+#: weight at which EXISTING pods' required affinity terms score toward an
+#: incoming pod that matches them (symmetric hard-affinity scoring)
+HARD_POD_AFFINITY_WEIGHT = 1
+
+
+def rev_pref_terms_of(p: Any):
+    """The (namespaces, selector, topology-key, signed weight) stream of an
+    ASSIGNED pod's scoring-relevant terms toward future incoming pods —
+    upstream v1.22 interpodaffinity's symmetric PreScore set: preferred
+    affinity (+w), preferred anti-affinity (−w), required affinity
+    (×HARD_POD_AFFINITY_WEIGHT).  ONE definition shared by the from-scratch
+    walk, the incremental index, and the scalar plugin."""
+    aff = p.spec.affinity
+    if aff is None:
+        return
+    ns = p.metadata.namespace
+    pa = aff.pod_affinity
+    if pa is not None:
+        for term in pa.required:
+            yield (
+                _term_namespaces(term, ns), term.label_selector,
+                term.topology_key, HARD_POD_AFFINITY_WEIGHT,
+            )
+        for wt in pa.preferred:
+            yield (
+                _term_namespaces(wt.term, ns), wt.term.label_selector,
+                wt.term.topology_key, wt.weight,
+            )
+    pan = aff.pod_anti_affinity
+    if pan is not None:
+        for wt in pan.preferred:
+            yield (
+                _term_namespaces(wt.term, ns), wt.term.label_selector,
+                wt.term.topology_key, -wt.weight,
+            )
 
 
 def _selector_sig(sel: LabelSelector) -> Tuple:
@@ -374,6 +418,34 @@ def build_constraint_tables(
                 )
         pod_rows.append((pi, row))
 
+    # --- symmetric preferred contributions (assigned pods' terms) ----------
+    # cid → topology value → Σ signed weight; combos register here too, so
+    # C covers them before the matrices are allocated
+    rev_vals: Dict[int, Dict[str, int]] = {}
+
+    def _collect_rev(p: Any) -> None:
+        labels = nodes[node_idx[p.spec.node_name]].metadata.labels
+        for nss, sel, topo, w in rev_pref_terms_of(p):
+            val = labels.get(topo)
+            if val is None:
+                continue  # owner's node lacks the key: no domain to score
+            cid = reg.get(nss, sel, topo)
+            vals = rev_vals.setdefault(cid, {})
+            vals[val] = vals.get(val, 0) + w
+
+    if index is not None:
+        for key, sel_obj, vals in index.rev_pref_list():
+            nss_k, _sig, topo_k = key
+            cid = reg.get(nss_k, sel_obj, topo_k)
+            dst = rev_vals.setdefault(cid, {})
+            for val, w in vals.items():
+                dst[val] = dst.get(val, 0) + w
+        for p in extra_assigned:
+            _collect_rev(p)
+    else:
+        for p in assigned:
+            _collect_rev(p)
+
     # --- combo matrices ----------------------------------------------------
     # capacity quantum 32 (not 8): C/T/C2/Vd are EXECUTABLE shapes — a
     # wave whose combo count steps over a small quantum recompiles the
@@ -396,11 +468,20 @@ def build_constraint_tables(
     topo_onehot[:, :, : topo_onehot_.shape[2]] = topo_onehot_
     pod_matches_combo = np.zeros((P, C), bool)
     combo_excl = np.zeros((C, N), bool)
-    if scan_planes:
+    rev_weight = np.zeros((C, N), np.int32)
+    # scan mode matches every combo (commits update aggregates with it);
+    # wave mode matches only the rev-active combos — the symmetric score
+    # needs "does this pending pod match the assigned pod's term", and a
+    # wave over a cluster with no such terms pays nothing
+    match_combos = (
+        range(len(reg.combos)) if scan_planes else sorted(rev_vals)
+    )
+    if match_combos:
         # combos sharing (namespaces, selector) across topology keys match
         # identically — compute each distinct group once
         match_cache: Dict[Tuple, Any] = {}
-        for cid, (nss, sel, _topo) in enumerate(reg.combos):
+        for cid in match_combos:
+            nss, sel, _topo = reg.combos[cid]
             mkey = (nss, _selector_sig(sel))
             if mkey not in match_cache:
                 match_cache[mkey] = np.fromiter(
@@ -441,11 +522,14 @@ def build_constraint_tables(
                 val = nodes[i].metadata.labels.get(topo)
                 if val is not None:
                     domain_count[val] = domain_count.get(val, 0) + 1
+        rv = rev_vals.get(cid)
         for i, node in enumerate(nodes):
             val = node.metadata.labels.get(topo)
             if val is not None:
                 combo_haskey[cid, i] = True
                 combo_dsum[cid, i] = domain_count.get(val, 0)
+                if rv:
+                    rev_weight[cid, i] = rv.get(val, 0)
 
     # --- reverse anti-affinity terms (deduped: replicas sharing one term
     # and one topology domain collapse to a single row) --------------------
@@ -697,6 +781,7 @@ def build_constraint_tables(
             ppa_combo=ppa_combo, ppa_w=ppa_w, ppa_n=ppa_n,
             ex_domain=ex_domain, pod_matches_ex=pod_matches_ex,
             pod_matches_combo=pod_matches_combo, combo_excl=combo_excl,
+            rev_weight=rev_weight,
             claim_mask=claim_mask, pod_claims=pod_claims, vol_ok=vol_ok,
             pod_n_vols=pod_n_vols,
             claim_zone_ok=claim_zone_ok,

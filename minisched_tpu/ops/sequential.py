@@ -40,7 +40,10 @@ from typing import Any, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from minisched_tpu.models.constraints import POD_AXIS_FIELDS
+from minisched_tpu.models.constraints import (
+    HARD_POD_AFFINITY_WEIGHT,
+    POD_AXIS_FIELDS,
+)
 from minisched_tpu.models.tables import NodeTable, PodTable
 from minisched_tpu.ops.fused import BatchContext, evaluate
 from minisched_tpu.ops.state import apply_placements, mount_slot_planes
@@ -147,13 +150,13 @@ def scan_schedule(
     _z = jnp.zeros((1, 1), jnp.int32)  # placeholder for untracked carries
 
     def step(carry, i):
-        carry_nodes, dsum, here, glob, excl, va, vr, nvf = carry
+        carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf = carry
         pod_row = _slice_pod(pods, i)
         reps = {}
         if track_combos:
             reps.update(
                 combo_dsum=dsum, combo_here=here, combo_global=glob,
-                combo_excl=excl,
+                combo_excl=excl, rev_weight=revw,
             )
         if track_vols:
             reps.update(vol_any=va, vol_rw=vr, node_vols_fam=nvf)
@@ -178,6 +181,24 @@ def scan_schedule(
             pan_c = extra.pan_combo[i]  # (A,)
             pan_in = (jnp.arange(A) < extra.pan_n[i]) & committed
             excl = excl.at[pan_c].max(pan_in[:, None] & dom[pan_c])
+            # symmetric scoring: its preferred terms (signed weight) and
+            # required affinity terms (hard weight) now score toward later
+            # matching pods over its landing node's domain
+            ppa_c = extra.ppa_combo[i]  # (W,)
+            W = ppa_c.shape[0]
+            ppa_in = (jnp.arange(W) < extra.ppa_n[i]) & committed
+            revw = revw.at[ppa_c].add(
+                jnp.where(ppa_in, extra.ppa_w[i], 0)[:, None]
+                * dom[ppa_c].astype(revw.dtype)
+            )
+            pa_c = extra.pa_combo[i]  # (PA,)
+            pa_in = (
+                jnp.arange(pa_c.shape[0]) < extra.pa_n[i]
+            ) & committed
+            revw = revw.at[pa_c].add(
+                jnp.where(pa_in, HARD_POD_AFFINITY_WEIGHT, 0)[:, None]
+                * dom[pa_c].astype(revw.dtype)
+            )
 
         if track_vols:
             # -- volume planes: same commit update as the repair loop -----
@@ -197,7 +218,7 @@ def scan_schedule(
             rw_rows = jnp.where(committed & (sv >= 0) & ~sro, sv, dummy_row)
             vr = vr.at[rw_rows, n].set(True)
 
-        carry = (carry_nodes, dsum, here, glob, excl, va, vr, nvf)
+        carry = (carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf)
         return carry, (choice, result.best_score[0])
 
     carry0 = (
@@ -206,6 +227,7 @@ def scan_schedule(
         extra.combo_here if track_combos else _z,
         extra.combo_global if track_combos else _z,
         extra.combo_excl if track_combos else _z,
+        extra.rev_weight if track_combos else _z,
         extra.vol_any if track_vols else _z,
         extra.vol_rw if track_vols else _z,
         extra.node_vols_fam if track_vols else _z,

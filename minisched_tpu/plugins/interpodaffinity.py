@@ -14,14 +14,17 @@ follow upstream v1.22:
   and NO pod matches cluster-wide, in which case any node carrying the
   topology key qualifies.
 * Score sums weight × (matching pods in the node's domain) over the pod's
-  preferred terms (anti-affinity terms contribute negative weight), then
-  min-max normalizes to [0, 100].
+  preferred terms (anti-affinity terms contribute negative weight), PLUS
+  the symmetric direction: every *assigned* pod's preferred affinity
+  (+w) / anti-affinity (−w) terms and required affinity terms (at
+  ``HARD_POD_AFFINITY_WEIGHT``) score toward an incoming pod matching
+  them, over the assigned pod's topology domain.  The total then min-max
+  normalizes to [0, 100].
 
-Batch form (models/constraints.py): gathers of ``combo_dsum`` rows plus
-one bool matmul for the reverse direction — MXU-shaped at scale.
-Symmetric scoring of existing pods' *preferred* terms is out of scope (see
-constraints.py docstring); the scalar oracle implements the identical
-scope so parity holds.
+Batch form (models/constraints.py): gathers of ``combo_dsum`` rows, one
+bool matmul for the reverse required-anti direction, and one int matmul
+(``pod_matches_combo @ rev_weight``) for the symmetric scoring — all
+MXU-shaped at scale.
 """
 
 from __future__ import annotations
@@ -153,6 +156,8 @@ class InterPodAffinity(Plugin, BatchEvaluable):
         return Status.success()
 
     def pre_score(self, state: CycleState, pod: Any, nodes: List[Any]) -> Status:
+        from minisched_tpu.models.constraints import rev_pref_terms_of
+
         ns = pod.metadata.namespace
         node_infos = state.read("nodeinfos")
         aff = pod.spec.affinity
@@ -165,11 +170,23 @@ class InterPodAffinity(Plugin, BatchEvaluable):
             for wt in aff.pod_anti_affinity.preferred:
                 counts, _ = _domain_counts(wt.term, ns, node_infos)
                 weighted.append((wt.term.topology_key, counts, -wt.weight))
-        state.write(PRE_SCORE_KEY, weighted)
+        # symmetric direction: assigned pods' preferred/hard-affinity terms
+        # that match THIS pod score over the assigned pod's topology domain
+        sym: Dict[Tuple[str, str], int] = {}  # (topo_key, value) → Σ w
+        for ni in node_infos:
+            labels = ni.node.metadata.labels
+            for q in ni.pods:
+                for nss, sel, topo, w in rev_pref_terms_of(q):
+                    if not _matches(sel, nss, pod):
+                        continue
+                    val = labels.get(topo)
+                    if val is not None:
+                        sym[(topo, val)] = sym.get((topo, val), 0) + w
+        state.write(PRE_SCORE_KEY, (weighted, sym))
         return Status.success()
 
     def score(self, state: CycleState, pod: Any, node_name: str) -> Tuple[int, Status]:
-        weighted = state.read(PRE_SCORE_KEY)
+        weighted, sym = state.read(PRE_SCORE_KEY)
         ni: NodeInfo = state.read("nodeinfo/" + node_name)
         labels = ni.node.metadata.labels
         total = 0
@@ -177,6 +194,9 @@ class InterPodAffinity(Plugin, BatchEvaluable):
             val = labels.get(topo_key)
             if val is not None:
                 total += w * counts.get(val, 0)
+        for (topo_key, val), w in sym.items():
+            if labels.get(topo_key) == val:
+                total += w
         return total, Status.success()
 
     def score_extensions(self):
@@ -251,9 +271,19 @@ class InterPodAffinity(Plugin, BatchEvaluable):
         dsum = extra.combo_dsum[extra.ppa_combo]  # (P, W, N)
         haskey = extra.combo_haskey[extra.ppa_combo]
         contrib = extra.ppa_w[:, :, None] * jnp.where(haskey, dsum, 0)
-        return jnp.sum(
+        incoming = jnp.sum(
             jnp.where(in_range[:, :, None], contrib, 0), axis=1
-        ).astype(jnp.int32)
+        )
+        # symmetric direction: assigned (and scan-committed) pods' terms
+        # scoring toward matching incoming pods — one int matmul over the
+        # combo axis (rev_weight rows are zero for combos with no such
+        # terms, so plain clusters add nothing)
+        sym = jnp.einsum(
+            "pc,cn->pn",
+            extra.pod_matches_combo.astype(jnp.int32),
+            extra.rev_weight,
+        )
+        return (incoming + sym).astype(jnp.int32)
 
     def batch_normalize(self, ctx: Any, scores, mask):
         return minmax_normalize_batch(scores, mask, reverse=False, fill=0)

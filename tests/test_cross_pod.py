@@ -270,11 +270,38 @@ def _random_cross_pod_cluster(rng: random.Random, n_nodes: int, n_assigned: int,
         p = _assigned(
             f"asg{i}", rng.choice(nodes).metadata.name, {"app": rng.choice(apps)}
         )
-        if rng.random() < 0.2:
+        r = rng.random()
+        if r < 0.2:
             p.spec.affinity = Affinity(
                 pod_anti_affinity=PodAntiAffinity(
                     required=[_term({"app": rng.choice(apps)})]
                 )
+            )
+        elif r < 0.4:
+            # symmetric scoring inputs: preferred terms on ASSIGNED pods
+            p.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randrange(1, 100),
+                            term=_term({"app": rng.choice(apps)}),
+                        )
+                    ],
+                    # and required affinity scoring at the hard weight
+                    required=(
+                        [_term({"app": rng.choice(apps)})]
+                        if rng.random() < 0.5
+                        else []
+                    ),
+                ),
+                pod_anti_affinity=PodAntiAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randrange(1, 100),
+                            term=_term({"app": rng.choice(apps)}),
+                        )
+                    ]
+                ),
             )
         assigned.append(p)
     pods = []
@@ -332,3 +359,83 @@ def test_parity_config4_randomized():
                              assigned=assigned)
     assert oracle == batch
     assert any(p != "" for p in oracle)
+
+
+# -- symmetric preferred scoring (upstream v1.22 PreScore's existing-pod
+# terms — VERDICT r3 item 6) -----------------------------------------------
+
+
+def test_symmetric_preferred_affinity_attracts_plain_pod():
+    """An ASSIGNED pod's preferred affinity term scores toward a matching
+    incoming pod that carries NO affinity of its own: the incoming pod
+    lands in the assigned pod's topology domain.  Scalar and batch agree."""
+    nodes = _zone_nodes()
+    owner = _assigned("owner", "node-b0", {"app": "db"})
+    owner.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(
+            preferred=[
+                WeightedPodAffinityTerm(weight=50, term=_term({"app": "web"}))
+            ]
+        )
+    )
+    pod = make_pod("incoming", labels={"app": "web"})  # no affinity itself
+    ipa = InterPodAffinity()
+    args = ([NodeUnschedulable(), ipa], [ipa], [ipa])
+    oracle = oracle_placements([pod], nodes, *args, assigned=[owner])
+    batch = batch_placements([pod], nodes, *args, assigned=[owner])
+    assert oracle == batch
+    assert oracle[0].startswith("node-b"), oracle  # pulled into zone b
+
+
+def test_symmetric_preferred_anti_affinity_repels_plain_pod():
+    nodes = _zone_nodes(zones=("a", "b"))
+    owner = _assigned("owner", "node-a0", {"app": "db"})
+    owner.spec.affinity = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            preferred=[
+                WeightedPodAffinityTerm(weight=80, term=_term({"app": "web"}))
+            ]
+        )
+    )
+    pod = make_pod("incoming", labels={"app": "web"})
+    ipa = InterPodAffinity()
+    args = ([NodeUnschedulable(), ipa], [ipa], [ipa])
+    oracle = oracle_placements([pod], nodes, *args, assigned=[owner])
+    batch = batch_placements([pod], nodes, *args, assigned=[owner])
+    assert oracle == batch
+    assert oracle[0].startswith("node-b"), oracle  # pushed out of zone a
+
+
+def test_symmetric_hard_affinity_scores_at_hard_weight():
+    """An assigned pod's REQUIRED affinity term scores toward matching
+    incoming pods at HARD_POD_AFFINITY_WEIGHT (upstream default 1) — it
+    wins ties but loses to any heavier preferred signal."""
+    from minisched_tpu.models.constraints import HARD_POD_AFFINITY_WEIGHT
+
+    assert HARD_POD_AFFINITY_WEIGHT == 1
+    nodes = _zone_nodes(zones=("a", "b"))
+    hard_owner = _assigned("hard", "node-a0", {"app": "db"})
+    hard_owner.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(required=[_term({"app": "web"})])
+    )
+    pod = make_pod("incoming", labels={"app": "web"})
+    ipa = InterPodAffinity()
+    args = ([NodeUnschedulable(), ipa], [ipa], [ipa])
+    oracle = oracle_placements([pod], nodes, *args, assigned=[hard_owner])
+    batch = batch_placements([pod], nodes, *args, assigned=[hard_owner])
+    assert oracle == batch
+    assert oracle[0].startswith("node-a"), oracle  # hard weight attracts
+
+    # a heavier preferred anti signal in zone a overrides the hard weight
+    soft = _assigned("soft", "node-a1", {"app": "cache"})
+    soft.spec.affinity = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            preferred=[
+                WeightedPodAffinityTerm(weight=30, term=_term({"app": "web"}))
+            ]
+        )
+    )
+    oracle = oracle_placements([pod], nodes, *args, assigned=[hard_owner, soft])
+    batch = batch_placements([pod], nodes, *args, assigned=[hard_owner, soft])
+    assert oracle == batch
+    assert oracle[0].startswith("node-b"), oracle

@@ -141,6 +141,52 @@ def _mixed_cluster(rng, n_nodes, n_assigned, n_pods):
                     ]
                 )
             )
+        elif i % 5 == 1:
+            # symmetric preferred scoring: this ASSIGNED pod's preferred
+            # (anti-)affinity terms score toward matching pending pods
+            p.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randrange(1, 80),
+                            term=PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={"app": rng.choice(apps)}
+                                ),
+                                topology_key="zone",
+                            ),
+                        )
+                    ]
+                ),
+                pod_anti_affinity=PodAntiAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randrange(1, 80),
+                            term=PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={"app": rng.choice(apps)}
+                                ),
+                                topology_key="zone",
+                            ),
+                        )
+                    ]
+                ),
+            )
+        elif i % 5 == 2:
+            # symmetric HARD affinity: required terms score at the hard
+            # weight toward matching pending pods
+            p.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    required=[
+                        PodAffinityTerm(
+                            label_selector=LabelSelector(
+                                match_labels={"app": apps[i % 3]}
+                            ),
+                            topology_key="zone",
+                        )
+                    ]
+                )
+            )
         assigned.append(p)
 
     pvs, pvcs = [], []
@@ -361,3 +407,66 @@ def test_sequential_matches_wave_for_bind_independent_chain():
     scan = scan_sequential(pods, nodes, filters, [nn], [nn])
     wave = batch_placements(pods, nodes, filters, [nn], [nn])
     assert scan == wave
+
+
+def test_sequential_intra_scan_symmetric_preferred():
+    """A pod committed mid-scan with a preferred affinity term pulls a
+    later MATCHING pod (which carries no affinity of its own) into its
+    topology domain — the carried rev_weight plane.  The later pod's
+    required-affinity commit also scores at the hard weight."""
+    from minisched_tpu.api.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        WeightedPodAffinityTerm,
+    )
+    from minisched_tpu.models.constraints import build_constraint_tables
+    from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    nodes = [
+        make_node("a1", labels={"zone": "za"}),
+        make_node("a2", labels={"zone": "za"}),
+        make_node("b1", labels={"zone": "zb"}),
+        make_node("b2", labels={"zone": "zb"}),
+    ]
+    magnet = make_pod("a-magnet", labels={"app": "db"})
+    magnet.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(
+            preferred=[
+                WeightedPodAffinityTerm(
+                    weight=60,
+                    term=PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"app": "web"}
+                        ),
+                        topology_key="zone",
+                    ),
+                )
+            ]
+        )
+    )
+    follower = make_pod("b-follower", labels={"app": "web"})  # no affinity
+    pods = [magnet, follower]
+    ipa = InterPodAffinity()
+    filters = [NodeUnschedulable(), ipa]
+    node_infos = build_node_infos(nodes, [])
+    oracle = schedule_pods_sequentially(
+        filters, [ipa], [ipa], {}, pods, node_infos
+    )
+    node_table, node_names = build_node_table(nodes)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=pod_table.capacity,
+        node_capacity=node_table.capacity,
+    )
+    sched = SequentialScheduler(filters, [ipa], [ipa])
+    _, choice, _ = sched(pod_table, node_table, extra)
+    scan = [
+        node_names[c] if c >= 0 else "" for c in choice.tolist()[: len(pods)]
+    ]
+    assert scan == oracle
+    zone_of = {n.metadata.name: n.metadata.labels["zone"] for n in nodes}
+    assert scan[0] and scan[1]
+    assert zone_of[scan[0]] == zone_of[scan[1]]  # follower joined the magnet
