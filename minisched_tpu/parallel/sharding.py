@@ -237,6 +237,77 @@ def sharded_repair_step(
     return _CompiledShardedStep(mesh, step)
 
 
+def sharded_scan_step(
+    mesh: Mesh,
+    filter_plugins,
+    pre_score_plugins,
+    score_plugins,
+    ctx,
+):
+    """The bind-exact sequential scan (ops/sequential.scan_schedule) jitted
+    over ``mesh``.  The scan is sequential over PODS by construction, so
+    only the NODE axis parallelizes: the node table (and every node-axis
+    constraint plane) shards across devices and each step's evaluation
+    reduces over node shards via XLA collectives; pod-axis inputs stay
+    replicated — a pod-sharded layout would turn every step's dynamic
+    row slice into a cross-shard gather for no compute win."""
+    from dataclasses import fields as dc_fields
+    from functools import partial
+
+    from minisched_tpu.ops.sequential import scan_schedule
+
+    step = partial(
+        scan_schedule,
+        filter_plugins=tuple(filter_plugins),
+        pre_score_plugins=tuple(pre_score_plugins),
+        score_plugins=tuple(score_plugins),
+        ctx=ctx,
+    )
+
+    class _ScanStep(_CompiledShardedStep):
+        def __call__(self, nodes, pods, extra=None):
+            key = extra is not None
+            if key not in self._jitted:
+                node_sh = node_sharding(self._mesh, nodes)
+                pod_rep = jax.tree_util.tree_map(
+                    lambda _a: NamedSharding(self._mesh, P()), pods
+                )
+                shardings = [node_sh, pod_rep]
+                if extra is not None:
+                    # node-axis planes shard with the node table; pod-axis
+                    # rows replicate (see docstring)
+                    specs = {}
+                    for f in dc_fields(type(extra)):
+                        leaf = getattr(extra, f.name)
+                        kind, axis = _CONSTRAINT_AXES.get(
+                            f.name, ("first", POD_AXIS)
+                        )
+                        if kind == "last":
+                            spec = P(*((None,) * (leaf.ndim - 1)), axis)
+                        else:
+                            spec = P()
+                        specs[f.name] = NamedSharding(self._mesh, spec)
+                    shardings.append(type(extra)(**specs))
+
+                    def wrapped(nodes, pods, extra):
+                        return self._fn(nodes, pods, extra=extra)
+
+                else:
+                    def wrapped(nodes, pods):
+                        return self._fn(nodes, pods)
+
+                self._jitted[key] = jax.jit(
+                    wrapped, in_shardings=tuple(shardings)
+                )
+            if extra is not None:
+                # inputs re-placed per call (tables arrive host- or
+                # single-device-resident)
+                return self._jitted[key](nodes, pods, extra)
+            return self._jitted[key](nodes, pods)
+
+    return _ScanStep(mesh, step)
+
+
 def sharded_wave_step(
     mesh: Mesh,
     filter_plugins,

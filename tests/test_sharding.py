@@ -119,3 +119,166 @@ def test_default_pod_shards_factoring():
     assert default_pod_shards(32, n_processes=8) == 8
     # host count not dividing the device count: fall back to square-ish
     assert default_pod_shards(6, n_processes=4) == 2
+
+
+def _scale_cluster(n_nodes=2100, n_pods=4100, n_assigned=200, seed=9):
+    """Config3-like scale with cross-pod constraints, deliberately UNEVEN:
+    node/pod counts divide none of the mesh axis sizes — the padded
+    capacities (pad_to quantum 128) carry the sharding."""
+    import random
+
+    from minisched_tpu.api.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+        WeightedPodAffinityTerm,
+    )
+
+    rng = random.Random(seed)
+    zones = [f"z{i}" for i in range(12)]
+    nodes = sorted(
+        (
+            make_node(
+                f"node{i:04d}",
+                labels={"zone": zones[i % 12]},
+                unschedulable=rng.random() < 0.1,
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 24},
+            )
+            for i in range(n_nodes)
+        ),
+        key=lambda n: n.metadata.name,
+    )
+    assigned = []
+    for i in range(n_assigned):
+        p = make_pod(f"asg{i}", labels={"app": f"a{i % 4}"})
+        p.metadata.uid = f"asg{i}"
+        p.spec.node_name = rng.choice(nodes).metadata.name
+        assigned.append(p)
+    pods = []
+    for i in range(n_pods):
+        p = make_pod(
+            f"pod{i:05d}",
+            labels={"app": f"a{i % 4}"},
+            requests={"cpu": f"{rng.choice([250, 500])}m", "memory": "256Mi"},
+        )
+        if i % 16 == 0:
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=8,
+                    topology_key="zone",
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=LabelSelector(
+                        match_labels={"app": p.metadata.labels["app"]}
+                    ),
+                )
+            ]
+        elif i % 16 == 1:
+            p.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=20,
+                            term=PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={
+                                        "app": p.metadata.labels["app"]
+                                    }
+                                ),
+                                topology_key="zone",
+                            ),
+                        )
+                    ]
+                )
+            )
+        pods.append(p)
+    return nodes, assigned, pods
+
+
+def _crosspod_chain():
+    from minisched_tpu.ops.fused import BatchContext
+    from minisched_tpu.plugins.interpodaffinity import InterPodAffinity
+    from minisched_tpu.plugins.noderesources import (
+        NodeResourcesFit,
+        NodeResourcesLeastAllocated,
+    )
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+    from minisched_tpu.plugins.podtopologyspread import PodTopologySpread
+
+    ipa = InterPodAffinity()
+    ts = PodTopologySpread()
+    return (
+        (NodeUnschedulable(), NodeResourcesFit(), ipa, ts),
+        (ipa, ts),
+        (NodeResourcesLeastAllocated(), ipa, ts),
+        BatchContext(weights=()),
+    )
+
+
+def _scale_tables(nodes, assigned, pods):
+    from minisched_tpu.models.constraints import build_constraint_tables
+
+    by_node = {}
+    for p in assigned:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    node_table, names = build_node_table(nodes, by_node)
+    pod_table, _ = build_pod_table(pods)
+    extra = build_constraint_tables(
+        pods, nodes, assigned,
+        pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    )
+    return node_table, pod_table, extra, names
+
+
+def test_sharded_repair_config3_scale_uneven_bit_equal():
+    """VERDICT r3 item 5: config3-like scale (4100 pods x 2100 nodes,
+    neither divisible by a mesh axis) with cross-pod constraint tables,
+    through the conflict-repair loop on the 8-device mesh — placements
+    BIT-EQUAL to single-device."""
+    from minisched_tpu.ops.repair import RepairingEvaluator
+
+    nodes, assigned, pods = _scale_cluster()
+    filters, pres, scores, ctx = _crosspod_chain()
+
+    node_table, pod_table, extra, _ = _scale_tables(nodes, assigned, pods)
+    ev = RepairingEvaluator(filters, pres, scores)
+    _, want, _ = ev(pod_table, node_table, extra)
+    want = want.tolist()
+
+    node_table, pod_table, extra, _ = _scale_tables(nodes, assigned, pods)
+    mesh = sharding.make_mesh(8)
+    step = sharding.sharded_repair_step(mesh, filters, pres, scores, ctx)
+    pod_table, node_table = sharding.shard_tables(mesh, pod_table, node_table)
+    extra = jax.device_put(extra, sharding.constraint_sharding(mesh, extra))
+    _, got, _ = step(node_table, pod_table, extra)
+    got = got.tolist()
+
+    assert want == got
+    placed = sum(1 for c in got[: len(pods)] if c >= 0)
+    assert placed == len(pods), placed  # ample headroom: all place
+
+
+def test_sharded_scan_matches_single_device():
+    """The bind-exact sequential scan sharded on the NODE axis (the pod
+    axis is sequential by construction): placements bit-equal to the
+    single-device scan, cross-pod coupling state carried through."""
+    from minisched_tpu.ops.sequential import SequentialScheduler
+
+    nodes, assigned, pods = _scale_cluster(
+        n_nodes=130, n_pods=96, n_assigned=20, seed=3
+    )
+    filters, pres, scores, ctx = _crosspod_chain()
+
+    node_table, pod_table, extra, _ = _scale_tables(nodes, assigned, pods)
+    seq = SequentialScheduler(filters, pres, scores)
+    _, want, _ = seq(pod_table, node_table, extra)
+
+    node_table, pod_table, extra, _ = _scale_tables(nodes, assigned, pods)
+    mesh = sharding.make_mesh(8)
+    step = sharding.sharded_scan_step(mesh, filters, pres, scores, ctx)
+    _, got, _ = step(node_table, pod_table, extra)
+    jax.block_until_ready(got)
+
+    assert want.tolist() == got.tolist()
+    assert int((got >= 0).sum()) == len(pods)
