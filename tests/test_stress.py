@@ -1,0 +1,266 @@
+"""Concurrency stress harness (SURVEY.md §5.2's "race-detector-equivalent"
+demand; VERDICT r3 item 7).
+
+The reference's queue/waiting-pod machinery carries known data races
+(minisched/queue/queue.go:86-91 lock-free pop; the unlocked waitingPods
+map at minisched/minisched.go:230,241-245).  This build fixed them with
+condvars and locks — these tests HAMMER the fixed structures: concurrent
+pod creation / deletion / node churn / permit allow-reject storms against
+a LIVE engine (scalar and device), then assert global invariants:
+
+* no lost pods — after the storm settles, every still-pending pod is
+  accounted for by the queue (active + backoff + unschedulable), none
+  stranded outside it;
+* no double-booked capacity — every node's bound pod count within
+  allocatable (the store's AlreadyBound guard + assume-cache discipline);
+* the engine survives — its loop thread is alive throughout, and a final
+  wave of fresh pods still schedules (liveness).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.service.config import default_scheduler_config
+from minisched_tpu.service.service import SchedulerService
+
+
+def _wait(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.parametrize("device_mode", [False, True])
+def test_engine_survives_event_and_permit_storm(device_mode):
+    rng = random.Random(1234 + device_mode)
+    client = Client()
+    # ten schedulable nodes with digit suffixes (NodeNumber semantics) —
+    # the permit plugin parks every pod in Wait and allows it after
+    # suffix × time_scale seconds, so the waiting-pod registry stays
+    # populated for the meddler thread to storm
+    for i in range(10):
+        client.nodes().create(
+            make_node(f"node{i}", capacity={"cpu": "64", "pods": 200})
+        )
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_scheduler_config(time_scale=0.01),
+        device_mode=device_mode,
+    )
+
+    created: list = []
+    created_mu = threading.Lock()
+    deleted: set = set()
+    stop = threading.Event()
+    errors: list = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as err:  # pragma: no cover - the assert below
+                errors.append(err)
+
+        return run
+
+    seq = [0]
+
+    def creator():
+        with created_mu:
+            n = seq[0]
+            seq[0] += 1
+        if n >= 400:
+            time.sleep(0.01)
+            return
+        pod = client.pods().create(make_pod(f"pod{n}"))
+        with created_mu:
+            created.append(pod)
+        time.sleep(rng.random() * 0.004)
+
+    def deleter():
+        with created_mu:
+            if len(created) < 20:
+                victim = None
+            else:
+                victim = rng.choice(created)
+        if victim is not None and victim.metadata.name not in deleted:
+            try:
+                client.pods().delete(victim.metadata.name)
+                deleted.add(victim.metadata.name)
+            except KeyError:
+                pass  # already gone
+        time.sleep(rng.random() * 0.02)
+
+    def node_churner():
+        i = rng.randrange(10)
+        try:
+            node = client.nodes().get(f"node{i}")
+            if rng.random() < 0.3:
+                node.spec.unschedulable = not node.spec.unschedulable
+            else:
+                node.metadata.labels["flip"] = str(rng.randrange(3))
+            client.nodes().update(node)
+        except KeyError:
+            pass
+        time.sleep(rng.random() * 0.01)
+
+    def permit_meddler():
+        # racing allow/reject against the timer-driven permit machinery:
+        # double allows, allow-after-reject, reject-after-timeout — all
+        # must be absorbed (non-blocking sends, first signal wins)
+        with created_mu:
+            pods = list(created[-50:])
+        for p in pods:
+            wp = sched.get_waiting_pod(p.metadata.uid)
+            if wp is None:
+                continue
+            if rng.random() < 0.5:
+                wp.allow("NodeNumber")
+            else:
+                wp.reject("NodeNumber", "storm rejection")
+        time.sleep(rng.random() * 0.01)
+
+    threads = [
+        threading.Thread(target=guard(creator), daemon=True),
+        threading.Thread(target=guard(creator), daemon=True),
+        threading.Thread(target=guard(deleter), daemon=True),
+        threading.Thread(target=guard(node_churner), daemon=True),
+        threading.Thread(target=guard(permit_meddler), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+
+    # uncordon everything so the survivors can finish scheduling
+    for i in range(10):
+        node = client.nodes().get(f"node{i}")
+        if node.spec.unschedulable:
+            node.spec.unschedulable = False
+            client.nodes().update(node)
+
+    # settle: binds stop changing and the queue stops churning.  Storm
+    # rejections park pods in the unschedulableQ — the 60s leftover flush
+    # (or any helping event) replays them, so only require STABILITY here,
+    # then assert accounting.
+    def state():
+        pods = client.pods().list()
+        bound = sum(1 for p in pods if p.spec.node_name)
+        return bound, len(pods), sched.queue.stats()
+
+    last = [None]
+
+    def settled():
+        cur = state()
+        ok = cur == last[0] and cur[2]["active"] == 0 and cur[2]["backoff"] == 0
+        last[0] = cur
+        return ok
+
+    _wait(settled, 60, "storm aftermath to settle")
+    assert not errors, errors
+
+    # --- invariants ------------------------------------------------------
+    pods = client.pods().list()
+    pending = [p for p in pods if not p.spec.node_name]
+    stats = sched.queue.stats()
+    in_queue = stats["active"] + stats["backoff"] + stats["unschedulable"]
+    waiting = len(sched._waiting_pods)
+    # no lost pods: every pending pod is queued or mid-permit
+    assert len(pending) <= in_queue + waiting, (
+        f"{len(pending)} pending but only {in_queue} queued + "
+        f"{waiting} waiting — pods were lost\n{stats}"
+    )
+    # no double-booked capacity
+    from collections import Counter
+
+    per_node = Counter(p.spec.node_name for p in pods if p.spec.node_name)
+    for node in client.nodes().list():
+        assert per_node[node.metadata.name] <= 200, node.metadata.name
+    # deleted pods never hold a binding in the store
+    names = {p.metadata.name for p in pods}
+    assert not (deleted & names), "deleted pods still in the store"
+
+    # liveness: a fresh pod after the storm still schedules
+    client.pods().create(make_pod("post-storm-pod1"))
+    _wait(
+        lambda: client.pods().get("post-storm-pod1").spec.node_name != "",
+        30,
+        "post-storm pod to bind",
+    )
+    svc.shutdown_scheduler()
+
+
+def test_queue_concurrent_producers_consumers_and_moves():
+    """Raw queue soak: adds, deletes, updates, move-requests, and batch
+    pops race; every pod is either popped exactly once or still tracked —
+    none lost, none duplicated (the reference's NextPod races dropped or
+    double-delivered under exactly this load)."""
+    from minisched_tpu.queue.queue import SchedulingQueue
+
+    q = SchedulingQueue()
+    N = 3000
+    popped: list = []
+    popped_mu = threading.Lock()
+    stop = threading.Event()
+
+    def producer(base):
+        for i in range(N):
+            q.add(make_pod(f"p{base}-{i}"))
+
+    def consumer():
+        while not stop.is_set():
+            batch = q.pop_batch(64, timeout=0.05)
+            if batch:
+                with popped_mu:
+                    popped.extend(qpi.pod.metadata.name for qpi in batch)
+
+    def mover():
+        from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+
+        while not stop.is_set():
+            q.move_all_to_active_or_backoff(
+                ClusterEvent(GVK.NODE, ActionType.UPDATE)
+            )
+            q.note_move_request()
+            time.sleep(0.001)
+
+    producers = [
+        threading.Thread(target=producer, args=(b,), daemon=True)
+        for b in range(3)
+    ]
+    consumers = [threading.Thread(target=consumer, daemon=True) for _ in range(2)]
+    mv = threading.Thread(target=mover, daemon=True)
+    for t in (*producers, *consumers, mv):
+        t.start()
+    for t in producers:
+        t.join(timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with popped_mu:
+            if len(popped) >= 3 * N:
+                break
+        time.sleep(0.01)
+    stop.set()
+    for t in (*consumers, mv):
+        t.join(timeout=5)
+
+    with popped_mu:
+        names = popped
+    assert len(names) == 3 * N, f"popped {len(names)} of {3 * N}"
+    assert len(set(names)) == 3 * N, "a pod was delivered twice"
+    stats = q.stats()
+    assert stats == {"active": 0, "backoff": 0, "unschedulable": 0}, stats
