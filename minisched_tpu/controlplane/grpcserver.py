@@ -5,24 +5,23 @@ to external callers".  The reference has no analog (its only wire surface
 is the kube REST API); this makes the TPU wave evaluator callable from any
 language: send a cluster, get placements.
 
-Transport design mirrors the §2-row-4 decision to carry no generated
-schema code: gRPC *framing* (HTTP/2 streams, deadlines, status codes) with
-the language-neutral checkpoint JSON codec as the payload — the same
-encoding the WAL, checkpoint files, and REST façade speak — registered
-through ``grpc.method_handlers_generic_handler`` with bytes
-serializers.  A non-Python caller needs only a gRPC stack and JSON.
-
-Service ``minisched.Evaluator``:
-
-* ``Health``  — {} → {"ok": true}
-* ``Evaluate`` — {"nodes": [Node...], "pods": [Pod...],
-  "assigned": [Pod...], "pvcs": [...], "pvs": [...],
-  "mode": "wave"|"repair"} →
-  {"placements": {pod key: node name or null}, "rounds": n}
+The wire contract is ``proto/minisched_evaluator.proto`` — a real,
+protoc-compilable service definition any language can generate stubs
+from.  Each message wraps ONE ``bytes json = 1`` field holding the
+language-neutral checkpoint JSON codec (the same encoding the WAL,
+checkpoint files, and REST façade speak), so generated callers fill the
+payload with a plain JSON library.  Server-side the single-field message
+is framed with a hand-rolled protobuf codec (``_wrap_json`` /
+``_unwrap_json`` — byte-identical to what protoc-generated stubs emit
+for this shape) registered through
+``grpc.method_handlers_generic_handler``; no protobuf runtime needed.
+Raw-JSON request bodies (the pre-proto framing) are still accepted: the
+two framings are unambiguous on the first byte.
 
 Placements follow the same deterministic semantics as the in-process
 engine: full default roster, conflict-repairing commit (mode "repair",
-the default) or the stateless wave (mode "wave").
+the default) or the stateless wave (mode "wave").  Full request/response
+JSON schema: the .proto's comments.
 """
 
 from __future__ import annotations
@@ -35,6 +34,60 @@ from typing import Any, Callable, Optional, Tuple
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
 
 SERVICE = "minisched.Evaluator"
+
+
+# ---------------------------------------------------------------------------
+# proto framing: `message X { bytes json = 1; }` — field 1, wire type 2
+# (length-delimited).  Encoding/decoding this one shape by hand keeps the
+# wire byte-identical to protoc-generated stubs without a protobuf runtime.
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _wrap_json(payload: bytes) -> bytes:
+    """Serialize ``message { bytes json = 1; }`` (proto3 omits empty)."""
+    if not payload:
+        return b""
+    return b"\x0a" + _varint(len(payload)) + payload
+
+
+def _unwrap_json(data: bytes) -> bytes:
+    """Parse the message above; also accepts the legacy raw-JSON framing
+    (first byte ``{`` / ``[`` / whitespace — never a field-1 tag)."""
+    if not data:
+        return b"{}"
+    if data[0] != 0x0A:
+        return data  # raw JSON (pre-proto framing)
+    length, pos = _read_varint(data, 1)
+    if pos + length > len(data):
+        raise ValueError("truncated json field")
+    return data[pos : pos + length]
 
 
 # ---------------------------------------------------------------------------
@@ -142,12 +195,12 @@ def _handlers():
     import grpc
 
     def health(request_bytes: bytes, context) -> bytes:
-        return json.dumps({"ok": True}).encode()
+        return _wrap_json(json.dumps({"ok": True}).encode())
 
     def evaluate(request_bytes: bytes, context) -> bytes:
         try:
-            request = json.loads(request_bytes.decode("utf-8"))
-            return json.dumps(evaluate_cluster(request)).encode()
+            request = json.loads(_unwrap_json(request_bytes).decode("utf-8"))
+            return _wrap_json(json.dumps(evaluate_cluster(request)).encode())
         except (ValueError, KeyError) as err:
             # evaluate_cluster re-raises malformed-payload TypeErrors as
             # ValueError; evaluator bugs deliberately fall through as
@@ -203,8 +256,10 @@ class EvaluatorClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
-        raw = fn(json.dumps(payload).encode(), timeout=timeout)
-        return json.loads(raw.decode("utf-8"))
+        raw = fn(
+            _wrap_json(json.dumps(payload).encode()), timeout=timeout
+        )
+        return json.loads(_unwrap_json(raw).decode("utf-8"))
 
     def health(self) -> dict:
         return self._call("Health", {})

@@ -3,6 +3,8 @@ gRPC framing), get placements with the engine's exact semantics."""
 
 from __future__ import annotations
 
+import json
+import os
 import random
 
 import pytest
@@ -106,3 +108,92 @@ def test_bad_mode_is_invalid_argument(server):
         client._call("Evaluate", {"nodes": [], "pods": [], "mode": "bogus"})
     assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
     client.close()
+
+
+def test_proto_contract_compiles_with_protoc(tmp_path):
+    """proto/minisched_evaluator.proto IS the wire contract — a non-Python
+    caller must be able to codegen from it.  Gate: the system protoc
+    accepts it (descriptor set output)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("protoc") is None:
+        import pytest
+
+        pytest.skip("protoc not installed")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [
+            "protoc",
+            f"--proto_path={os.path.join(root, 'proto')}",
+            f"--descriptor_set_out={tmp_path / 'ev.desc'}",
+            "minisched_evaluator.proto",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0, res.stderr
+    assert (tmp_path / "ev.desc").stat().st_size > 0
+
+
+def test_json_framing_matches_protobuf_wire_format():
+    """The hand-rolled single-field codec must emit byte-identical wire
+    format to what protoc-generated stubs produce for
+    `message { bytes json = 1; }` — that equivalence IS the contract."""
+    from minisched_tpu.controlplane.grpcserver import _unwrap_json, _wrap_json
+
+    for payload in (b"{}", b'{"ok": true}', b"x" * 1, b"y" * 127, b"z" * 300):
+        wrapped = _wrap_json(payload)
+        # field 1, wire type 2, then a varint length
+        assert wrapped[0] == 0x0A
+        assert _unwrap_json(wrapped) == payload
+    assert _wrap_json(b"") == b""  # proto3 omits empty fields
+    assert _unwrap_json(b"") == b"{}"
+    # legacy raw-JSON framing still passes through
+    assert _unwrap_json(b'{"mode": "wave"}') == b'{"mode": "wave"}'
+
+    try:
+        from google.protobuf import descriptor_pb2  # noqa: F401
+        from google.protobuf.internal import encoder  # noqa: F401
+    except Exception:
+        return  # no protobuf runtime: the protoc gate above still holds
+    # cross-check against the real protobuf encoder when available
+    from google.protobuf.internal.encoder import _VarintBytes
+
+    for payload in (b'{"ok": true}', b"q" * 300):
+        want = b"\x0a" + _VarintBytes(len(payload)) + payload
+        assert _wrap_json(payload) == want
+
+
+def test_evaluator_accepts_legacy_raw_json_frames():
+    """Pre-proto clients sent bare JSON bodies; the server keeps accepting
+    them (the two framings are unambiguous on the first byte)."""
+    import grpc
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.checkpoint import _encode
+    from minisched_tpu.controlplane.grpcserver import (
+        SERVICE,
+        _unwrap_json,
+        start_grpc_server,
+    )
+
+    _server, address, shutdown = start_grpc_server()
+    try:
+        channel = grpc.insecure_channel(address)
+        fn = channel.unary_unary(
+            f"/{SERVICE}/Evaluate",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        payload = {
+            "nodes": [_encode(make_node("n1"))],
+            "pods": [_encode(make_pod("p1"))],
+            "mode": "wave",
+        }
+        raw = fn(json.dumps(payload).encode(), timeout=60.0)
+        out = json.loads(_unwrap_json(raw).decode())
+        assert out["placements"] == {"default/p1": "n1"}
+        channel.close()
+    finally:
+        shutdown()
