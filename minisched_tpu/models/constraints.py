@@ -336,6 +336,7 @@ def build_constraint_tables(
     index: Any = None,
     extra_assigned: Sequence[Any] = (),
     device: bool = True,
+    elide_zeros: bool = True,
 ):
     """Build the wave's coupling tables.
 
@@ -792,5 +793,12 @@ def build_constraint_tables(
             vol_any=vol_any, vol_rw=vol_rw,
         )
     if not device:
-        return pack_table(host_cols, (), P, elide_zeros=True)
+        # elide_zeros=False callers (the scan lane) trade wire bytes for
+        # ONE packed schema per capacity: with elision, every distinct
+        # zero-set is a fresh consumer executable, and the scan's planes
+        # flip zero/nonzero mid-run (combo counts appear after the first
+        # commits) — each flip cost a ~5-50s compile/cache-load on the
+        # tunnel.  Waves keep elision: plain waves elide everything and
+        # their schema is stable.
+        return pack_table(host_cols, (), P, elide_zeros=elide_zeros)
     return ConstraintTables(**batched_device_put(host_cols))

@@ -253,6 +253,33 @@ class PackedCaller:
         self._consumer = consumer
         self._fns: Dict[Tuple, Any] = {}
 
+    def _build_fn(self, key, pod_packed, node_agg_packed, extra_packed):
+        from minisched_tpu.models.constraints import ConstraintTables
+
+        ex_schema = extra_packed.schema if extra_packed is not None else None
+        pod_metas, pod_zeros = pod_packed.schema
+        agg_metas, agg_zeros = node_agg_packed.schema
+        consumer = self._consumer
+
+        def run(pod_flat, agg_flat, ex_flat, static_cols):
+            pods = PodTable(
+                **unpack_columns(pod_flat, pod_metas, pod_zeros)
+            )
+            nodes = NodeTable(
+                **static_cols,
+                **unpack_columns(agg_flat, agg_metas, agg_zeros),
+            )
+            extra = (
+                ConstraintTables(
+                    **unpack_columns(ex_flat, *ex_schema)
+                )
+                if ex_schema is not None
+                else None
+            )
+            return consumer(pods, nodes, extra)
+
+        return jax.jit(run)
+
     def __call__(self, pod_packed, node_static, node_agg_packed,
                  extra_packed=None):
         ex_schema = extra_packed.schema if extra_packed is not None else None
@@ -260,37 +287,37 @@ class PackedCaller:
                tuple(sorted(node_static)))
         fn = self._fns.get(key)
         if fn is None:
-            from minisched_tpu.models.constraints import ConstraintTables
-
-            pod_metas, pod_zeros = pod_packed.schema
-            agg_metas, agg_zeros = node_agg_packed.schema
-            consumer = self._consumer
-
-            def run(pod_flat, agg_flat, ex_flat, static_cols):
-                pods = PodTable(
-                    **unpack_columns(pod_flat, pod_metas, pod_zeros)
-                )
-                nodes = NodeTable(
-                    **static_cols,
-                    **unpack_columns(agg_flat, agg_metas, agg_zeros),
-                )
-                extra = (
-                    ConstraintTables(
-                        **unpack_columns(ex_flat, *ex_schema)
-                    )
-                    if ex_schema is not None
-                    else None
-                )
-                return consumer(pods, nodes, extra)
-
-            fn = jax.jit(run)
+            fn = self._build_fn(key, pod_packed, node_agg_packed, extra_packed)
             self._fns[key] = fn
         ex_flat = (
             extra_packed.flat
             if extra_packed is not None
             else np.zeros(0, np.int32)
         )
-        return fn(pod_packed.flat, node_agg_packed.flat, ex_flat, node_static)
+        try:
+            return fn(
+                pod_packed.flat, node_agg_packed.flat, ex_flat, node_static
+            )
+        except ValueError as err:
+            # jax 0.9's C++ dispatch can return a WRONG-ARITY executable
+            # for this call after unrelated large programs compiled in the
+            # same process ("Execution supplied N buffers but compiled
+            # program expected M buffers") — an upstream cache-dispatch
+            # bug, not a shape problem on our side: the same signature
+            # succeeded before.  Self-heal: drop the poisoned entry,
+            # clear that jit's caches, recompile once.
+            if "buffers but compiled program expected" not in str(err):
+                raise
+            self._fns.pop(key, None)
+            try:
+                fn.clear_cache()
+            except Exception:
+                pass
+            fn = self._build_fn(key, pod_packed, node_agg_packed, extra_packed)
+            self._fns[key] = fn
+            return fn(
+                pod_packed.flat, node_agg_packed.flat, ex_flat, node_static
+            )
 
 
 def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
@@ -986,7 +1013,8 @@ def _pod_is_simple(pod: Any) -> bool:
 
 
 def _build_pod_table_fast(pods: Sequence[Any], cap: int,
-                          device: bool = True):
+                          device: bool = True,
+                          invalid_rows: Sequence[Any] = ()):
     """Columnar fast path for simple pods: per-field list comprehensions +
     native batch string kernels (minisched_tpu.native) instead of the
     per-pod row-write loop — ~10× on the host build that feeds the device
@@ -1032,6 +1060,8 @@ def _build_pod_table_fast(pods: Sequence[Any], cap: int,
     # wire bytes, no second executable) — the table is ~50× wider than its
     # live fast-path columns and PCIe/tunnel bandwidth on the host build
     # was the wave pipeline's bottleneck.
+    if invalid_rows:
+        host["valid"][list(invalid_rows)] = False
     if not device:
         return pack_table(host, _zero_pod_metas(cap), cap), names
     cols = batched_device_put(host, zero_metas=_zero_pod_metas(cap))
@@ -1078,17 +1108,23 @@ def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
 
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None,
-                    force_packed: bool = False, device: bool = True):
+                    force_packed: bool = False, device: bool = True,
+                    invalid_rows: Sequence[int] = ()):
     """``device=False`` returns (PackedTable, names) instead of a
     device-resident PodTable — for consumers that unpack the flat
-    buffer inside their own jitted program (ops/repair packed mode)."""
+    buffer inside their own jitted program (ops/repair packed mode).
+    ``invalid_rows``: row indices marked valid=False — INTERIOR padding
+    for the blocked scan lane, whose block structure needs placeholder
+    rows between real pods (tail padding is automatic)."""
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
         raise ValueError(f"{p} pods exceed table capacity {cap}")
 
     if all(_pod_is_simple(pod) for pod in pods):
-        return _build_pod_table_fast(pods, cap, device=device)
+        return _build_pod_table_fast(
+            pods, cap, device=device, invalid_rows=invalid_rows
+        )
 
     def zeros(shape, dtype=np.int32):
         return np.zeros(shape, dtype)
@@ -1227,6 +1263,8 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
             for j, port in enumerate(ports):
                 t["port"][i, j] = port
             t["num_ports"][i] = len(ports)
+    if invalid_rows:
+        t["valid"][list(invalid_rows)] = False
     if not device:
         # NO zero-elision here (unlike the constraint tables): the slow
         # pod schema's zero-set varies with each wave's feature mix, and
