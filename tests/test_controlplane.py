@@ -163,3 +163,49 @@ class TestClient:
         [t.start() for t in ts]
         [t.join() for t in ts]
         assert sum(1 for o, _ in outcomes if o == "ok") == 1
+
+
+def test_informer_dispatch_gate_holds_and_releases_batches():
+    """The wave engine's dispatch gate: a gated batch is HELD (handlers
+    see nothing) until resume — and the safety timeout bounds a forgotten
+    gate so the stream can never stall permanently."""
+    import threading
+    import time
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.informer import (
+        ResourceEventHandlers,
+        SharedInformerFactory,
+    )
+    from minisched_tpu.controlplane.store import ObjectStore
+
+    store = ObjectStore()
+    factory = SharedInformerFactory(store)
+    inf = factory.informer_for("Pod")
+    seen = []
+    inf.add_event_handlers(
+        ResourceEventHandlers(on_add=lambda o: seen.append(o.metadata.name))
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync(5)
+
+    factory.pause_dispatch()
+    store.create("Pod", make_pod("held"))
+    time.sleep(0.5)
+    assert seen == [], seen  # held behind the gate
+
+    factory.resume_dispatch()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "held" not in seen:
+        time.sleep(0.02)
+    assert seen == ["held"]
+
+    # a forgotten gate self-releases within the safety timeout (2s)
+    factory.pause_dispatch()
+    store.create("Pod", make_pod("eventually"))
+    deadline = time.monotonic() + 6
+    while time.monotonic() < deadline and "eventually" not in seen:
+        time.sleep(0.05)
+    assert "eventually" in seen
+    factory.resume_dispatch()
+    factory.shutdown()
