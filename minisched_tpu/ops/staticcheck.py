@@ -125,6 +125,26 @@ def verify_static_classification(
     nodes_p, extra_p = _perturb(nodes, extra)
     cpu = jax.devices("cpu")[0]
 
+    # probes compile in-memory: XLA:CPU AOT cache LOADS warn (and can
+    # SIGILL) whenever the cached entry's machine features mismatch the
+    # host — including XLA's own pseudo-features that host detection never
+    # reports, so even same-host loads are unsafe.  A tiny per-plugin CPU
+    # compile costs less than one risky load.
+    import contextlib
+
+    @contextlib.contextmanager
+    def _no_compilation_cache():
+        try:
+            old = jax.config.jax_enable_compilation_cache
+        except AttributeError:  # option absent in this jax: nothing to gate
+            yield
+            return
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_compilation_cache", old)
+
     def run(pl, kind, n, e):
         needs = getattr(pl, "needs_extra", False)
         if kind == "filter":
@@ -138,7 +158,7 @@ def verify_static_classification(
             )
             fn = (lambda p, nn, ee: pl.batch_score(ctx, p, nn, aux, ee)) if needs \
                 else (lambda p, nn, ee: pl.batch_score(ctx, p, nn, aux))
-        with jax.default_device(cpu):
+        with _no_compilation_cache(), jax.default_device(cpu):
             return np.asarray(jax.jit(fn)(pods, n, e))
 
     for kind, chain in (("filter", static_filters), ("score", static_scores)):

@@ -67,4 +67,14 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     # cache everything: the tunnel RTT dominates even trivial compiles
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # keep the jax-level executable cache but NOT XLA's own AOT kernel
+    # caches: XLA:CPU AOT loads hard-check machine features — including
+    # XLA pseudo-features host detection never reports — so every load
+    # warns about a mismatch and is documented as able to SIGILL.  The
+    # executables this build actually needs cached (the tunnel-compiled
+    # wave/scan programs) live in the jax layer.
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except Exception:
+        pass  # older jax without the option: nothing to disable
     return cache_dir
