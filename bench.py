@@ -446,9 +446,10 @@ def bench_config5_fullchain() -> dict:
     wait_until(
         lambda: bound_count() >= n_pods, timeout=600, what=f"all {n_pods} bound"
     )
+    bound_wait_s = time.monotonic() - t_wait
     log(
         f"[config5/full-chain] requeue tail: label loop {label_loop_s:.2f}s, "
-        f"bound-wait {time.monotonic()-t_wait:.2f}s"
+        f"bound-wait {bound_wait_s:.2f}s"
     )
     elapsed = time.monotonic() - t0
     service.shutdown_scheduler()
@@ -540,6 +541,8 @@ def bench_config5_fullchain() -> dict:
         "requeued": n_special,
         "first_drain_s": round(t_drain, 1),
         "requeue_tail_s": round(elapsed - t_drain, 1),
+        "requeue_label_loop_s": round(label_loop_s, 2),
+        "requeue_bound_wait_s": round(bound_wait_s, 2),
         "total_s": round(elapsed, 1),
         "crosspod_pods": n_crosspod,
         "wave_evaluate_mean_s": phase("wave_evaluate", "mean_s"),

@@ -209,3 +209,70 @@ def test_informer_dispatch_gate_holds_and_releases_batches():
     assert "eventually" in seen
     factory.resume_dispatch()
     factory.shutdown()
+
+
+def test_packed_caller_self_heals_from_wrong_arity_executable():
+    """jax 0.9 can hand a cached jit a WRONG-ARITY executable after
+    unrelated large programs compile in-process; PackedCaller must drop
+    the poisoned entry and recompile instead of failing the wave."""
+    import numpy as np
+
+    from minisched_tpu.models.tables import (
+        PackedCaller,
+        build_node_table,
+        build_pod_table,
+        pack_table,
+    )
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.framework.nodeinfo import build_node_infos
+    from minisched_tpu.models.tables import CachedNodeTableBuilder
+
+    infos = build_node_infos([make_node("n1"), make_node("n2")], [])
+    builder = CachedNodeTableBuilder()
+    node_static, node_agg, _ = builder.build_packed(infos)
+    pod_packed, _ = build_pod_table([make_pod("p1")], device=False)
+
+    calls = []
+
+    def consumer(pods, nodes, extra):
+        calls.append(1)
+        return pods.valid.sum() + nodes.valid.sum()
+
+    caller = PackedCaller(consumer)
+    want = int(caller(pod_packed, node_static, node_agg))
+
+    # poison the cached fn with a stub that fails like the jax fault once
+    key, fn = next(iter(caller._fns.items()))
+    state = {"fired": False}
+
+    class _Poisoned:
+        def __call__(self, *a, **k):
+            if not state["fired"]:
+                state["fired"] = True
+                raise ValueError(
+                    "INVALID_ARGUMENT: Execution supplied 24 buffers but "
+                    "compiled program expected 31 buffers"
+                )
+            return fn(*a, **k)
+
+        def clear_cache(self):
+            state["cleared"] = True
+
+    caller._fns[key] = _Poisoned()
+    got = int(caller(pod_packed, node_static, node_agg))
+    assert got == want
+    assert state["fired"] and state.get("cleared")
+    # the poisoned entry was replaced with a fresh jit
+    assert not isinstance(caller._fns[key], _Poisoned)
+
+    # any OTHER ValueError must propagate untouched
+    class _Broken:
+        def __call__(self, *a, **k):
+            raise ValueError("genuinely broken")
+
+    caller._fns[key] = _Broken()
+    try:
+        caller(pod_packed, node_static, node_agg)
+        raise AssertionError("expected ValueError")
+    except ValueError as err:
+        assert "genuinely broken" in str(err)
