@@ -41,6 +41,12 @@ from minisched_tpu.models.tables import (
 from minisched_tpu.ops.repair import RepairingEvaluator
 
 
+import os as _os
+
+#: env-gated per-wave stderr trace (timeline debugging at bench scale)
+_WAVE_LOG = _os.environ.get("MINISCHED_WAVE_LOG", "") not in ("", "0")
+
+
 def _is_cross_pod(pod: Pod) -> bool:
     """Pods that read or write intra-wave cross-pod coupling state
     (topology spread / pod (anti-)affinity).  The repair wave evaluates
@@ -279,6 +285,18 @@ class DeviceScheduler(Scheduler):
     #: it sees chunk k's binds (sequential semantics across chunks)
     SCAN_MIN_CAP = 128
     SCAN_MAX_CHUNK = 1024
+    #: small-wave pod capacity: partial and requeue waves (a 2k-pod
+    #: backoff replay after a 16k-pod drain) evaluate at this capacity
+    #: instead of the full max_wave executable — the (P, N) planes scale
+    #: with capacity, so a 2k wave on a 16384-cap program paid ~8× its
+    #: share of device time.  Exactly TWO wave shapes ever run (both
+    #: prewarmed); engines with max_wave <= this keep one.
+    WAVE_SMALL_CAP = 2048
+
+    def _wave_cap(self, n_pods: int) -> int:
+        full = pad_to(max(self.max_wave, 128))
+        small = min(self.WAVE_SMALL_CAP, full)
+        return small if n_pods <= small else full
     #: blocked-scan lane (VERDICT r3 item 4): cross-pod pods pre-grouped
     #: into blocks of pairwise-disjoint interaction sets, each block one
     #: kernel step (ops/sequential.blocked_scan_schedule) — within-group
@@ -330,6 +348,9 @@ class DeviceScheduler(Scheduler):
         node_capacity = pad_to(max(len(live_nodes), 2))
         prof_capacity = node_profile_capacity(live_nodes)
         pod_capacity = pad_to(max(self.max_wave, 128))
+        # both wave tiers compile: the full max_wave shape and the small
+        # one partial/requeue waves take (identical when max_wave is small)
+        wave_caps = sorted({pod_capacity, self._wave_cap(1)})
         nodes = [make_node("warm0"), make_node("warm1")]
         pods = [make_pod("warmpod", requests={"cpu": "1"})]
         # pod tables have TWO packed-transfer schemas per capacity: the
@@ -348,7 +369,7 @@ class DeviceScheduler(Scheduler):
         if not packed_mode:
             # the unpacked path ships pod tables through per-capacity
             # splitter executables; packed mode never invokes them
-            warm_caps = {pod_capacity}
+            warm_caps = set(wave_caps)
             if self._has_cross_pod:
                 warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
             for cap in warm_caps:
@@ -362,36 +383,38 @@ class DeviceScheduler(Scheduler):
             node_static, node_agg, _ = CachedNodeTableBuilder().build_packed(
                 infos, capacity=node_capacity, prof_capacity=prof_capacity
             )
-            for warm_pods in (pods, pods + [complex_pod]):
-                pt, _ = build_pod_table(
-                    warm_pods, capacity=pod_capacity, device=False
+            for wave_cap in wave_caps:
+                for warm_pods in (pods, pods + [complex_pod]):
+                    pt, _ = build_pod_table(
+                        warm_pods, capacity=wave_cap, device=False
+                    )
+                    extra = None
+                    if self._needs_extra:
+                        extra = build_constraint_tables(
+                            warm_pods, nodes, [],
+                            pod_capacity=wave_cap,
+                            node_capacity=node_capacity,
+                            scan_planes=False, device=False,
+                        )
+                    out = self._get_evaluator().call_packed(
+                        pt, node_static, node_agg, extra
+                    )
+                    jax.block_until_ready(out[1])
+        else:
+            for wave_cap in wave_caps:
+                node_table, _ = CachedNodeTableBuilder().build(
+                    infos, capacity=node_capacity, prof_capacity=prof_capacity
                 )
+                pod_table, _ = build_pod_table(pods, capacity=wave_cap)
                 extra = None
                 if self._needs_extra:
                     extra = build_constraint_tables(
-                        warm_pods, nodes, [],
-                        pod_capacity=pod_capacity,
-                        node_capacity=node_capacity,
-                        scan_planes=False, device=False,
+                        pods, nodes, [],
+                        pod_capacity=wave_cap, node_capacity=node_capacity,
+                        scan_planes=False,
                     )
-                out = self._get_evaluator().call_packed(
-                    pt, node_static, node_agg, extra
-                )
+                out = self._get_evaluator()(pod_table, node_table, extra)
                 jax.block_until_ready(out[1])
-        else:
-            node_table, _ = CachedNodeTableBuilder().build(
-                infos, capacity=node_capacity, prof_capacity=prof_capacity
-            )
-            pod_table, _ = build_pod_table(pods, capacity=pod_capacity)
-            extra = None
-            if self._needs_extra:
-                extra = build_constraint_tables(
-                    pods, nodes, [],
-                    pod_capacity=pod_capacity, node_capacity=node_capacity,
-                    scan_planes=False,
-                )
-            out = self._get_evaluator()(pod_table, node_table, extra)
-            jax.block_until_ready(out[1])
         if self._has_cross_pod:
             # cross-pod-constrained pods ride the sequential scan — warm
             # BOTH chunk capacities (_schedule_scan uses exactly these
@@ -888,7 +911,17 @@ class DeviceScheduler(Scheduler):
         self._commit_winners(winners)
         if losers:
             self._handle_wave_losers(losers, node_infos, len(nodes))
-        self.metrics.observe("wave", time.monotonic() - t_wave)
+        dur = time.monotonic() - t_wave
+        self.metrics.observe("wave", dur)
+        if _WAVE_LOG:
+            import sys
+
+            print(
+                f"[wave t={time.monotonic():.2f}] size={len(qpis)} "
+                f"dur={dur:.2f}s winners={len(winners)} losers={len(losers)}",
+                file=sys.stderr,
+                flush=True,
+            )
 
     def _build_and_evaluate(
         self, qpis_, node_infos, nodes, assigned, agg_delta=None
@@ -907,7 +940,7 @@ class DeviceScheduler(Scheduler):
 
         pods_ = [qpi.pod for qpi in qpis_]
         packed_mode = self._packed_mode
-        pod_capacity = pad_to(max(len(pods_), self.max_wave))
+        pod_capacity = self._wave_cap(len(pods_))
         with self.metrics.timed("wave_build_tables"):
             if packed_mode:
                 node_static, node_agg, node_names = (

@@ -98,12 +98,16 @@ class SchedulingQueue:
         # request with no event attached helps everyone).
         self._move_events: Dict[Optional[ClusterEvent], int] = {}
         # event-storm tracking for pop_batch's debounce: the GVK whose
-        # event last re-activated parked pods, and the wall-clock time of
-        # the most recent same-GVK event while the storm lasts.  (Wall
-        # clock on purpose: the debounce interacts with real condition
-        # waits, not the injectable backoff clock.)
+        # event last re-activated parked pods, the wall-clock time of the
+        # most recent same-GVK event while the storm lasts, and when the
+        # storm OPENED — the gather cap counts from there, not from
+        # pop_batch entry (an engine idling in pop() for up to its poll
+        # timeout before the storm begins must not have the cap already
+        # spent).  (Wall clock on purpose: the debounce interacts with
+        # real condition waits, not the injectable backoff clock.)
         self._storm_gvk: Optional[GVK] = None
         self._last_move_walltime = 0.0
+        self._storm_open_walltime = 0.0
 
     @staticmethod
     def _uid(pod) -> str:
@@ -310,6 +314,12 @@ class SchedulingQueue:
             # state, fails half the burst, and pays a doubled backoff)
             now_w = time.monotonic()
             if moved:
+                if (
+                    self._storm_gvk != event.resource
+                    or now_w - self._last_move_walltime
+                    >= self.STORM_DEBOUNCE_S
+                ):
+                    self._storm_open_walltime = now_w  # fresh storm
                 self._storm_gvk = event.resource
                 self._last_move_walltime = now_w
             elif (
@@ -445,9 +455,10 @@ class SchedulingQueue:
                 storm_wait = None
                 if self._storm_gvk is not None:
                     since = now_w - self._last_move_walltime
+                    opened = max(self._storm_open_walltime, t_start)
                     if (
                         since < self.STORM_DEBOUNCE_S
-                        and now_w - t_start < self.STORM_MAX_GATHER_S
+                        and now_w - opened < self.STORM_MAX_GATHER_S
                     ):
                         storm_wait = self.STORM_DEBOUNCE_S - since
                     else:
