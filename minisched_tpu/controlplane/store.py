@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -175,6 +176,8 @@ class ObjectStore:
 
                 stored.metadata.uid = new_uid(kind.lower())
             stored.metadata.resource_version = self._bump()
+            if not stored.metadata.creation_timestamp:
+                stored.metadata.creation_timestamp = time.time()
             objs[key] = stored
             out = stored.clone()
             self._fanout(kind, WatchEvent(EventType.ADDED, stored))
@@ -201,6 +204,7 @@ class ObjectStore:
                 raise KeyError(f"{kind} {key!r} not found")
             stored = obj.clone()
             stored.metadata.uid = old.metadata.uid
+            stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             stored.metadata.resource_version = self._bump()
             objs[key] = stored
             out = stored.clone()
@@ -278,6 +282,9 @@ class ObjectStore:
                     else:
                         work = fn(old)
                     work.metadata.uid = old.metadata.uid
+                    work.metadata.creation_timestamp = (
+                        old.metadata.creation_timestamp
+                    )
                     work.metadata.resource_version = self._bump()
                     objs[key] = work
                     self._on_batch_commit(kind, work)

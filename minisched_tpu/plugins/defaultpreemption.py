@@ -12,14 +12,22 @@ Semantics (upstream v1.22 ``defaultpreemption``, simplified where noted):
   whose filter verdict was plain Unschedulable (UnschedulableAndUnresolvable
   nodes are skipped — no eviction can fix those), capped at
   ``max(min_candidate_nodes_absolute, pct% of nodes)`` dry-run candidates.
-* Victims on a candidate node are assigned pods with LOWER priority than
-  the incoming pod, evicted lowest-priority-first (ties broken by name)
-  until the pod passes the full filter chain against the trimmed node.
-  (Upstream removes all lower-priority pods then "reprieves" back; the
-  greedy form picks the same victims for resource-monotone filters and is
-  deterministic.)
-* The best candidate minimizes (victim count, highest victim priority,
-  node name).  Its victims are deleted through the API and the pod gets
+* Victims on a candidate node are selected exactly like upstream's
+  ``selectVictimsOnNode``: remove ALL assigned pods with lower priority
+  than the incoming pod; if the pod still cannot pass the full filter
+  chain, the node is not a candidate; otherwise "reprieve" the removed
+  pods back one at a time, most-important first (higher priority, then
+  earlier creation — the start-time analog, we don't track
+  ``status.startTime``), keeping each pod that leaves the incoming pod
+  feasible.  The pods that cannot be re-added are the victims.  (The
+  earlier greedy lowest-first form diverged when pod sizes vary: greedy
+  evicts the first small low-priority pod that suffices, reprieve keeps
+  every high-priority pod it can and evicts the blocking one.)
+* The best candidate follows upstream's ``pickOneNodeForPreemption``
+  order (sans PDBs, which don't exist here): minimum highest victim
+  priority, then minimum priority sum, then fewest victims, then the
+  latest earliest-creation among highest-priority victims (start-time
+  analog), then node name for determinism.  Its victims are deleted through the API and the pod gets
   the node as ``status.nominated_node_name``; the pod itself requeues and
   schedules once the informer sees the deletions (the Pod/DELETE cluster
   event gates its requeue, queue.go:167-190 semantics).
@@ -36,6 +44,7 @@ from typing import Any, List, Optional, Tuple
 from minisched_tpu.framework.nodeinfo import NodeInfo, build_node_infos
 from minisched_tpu.framework.plugin import Plugin
 from minisched_tpu.framework.types import CycleState, Status
+from minisched_tpu.plugins.noderesources import NodeResourcesFit
 
 NAME = "DefaultPreemption"
 
@@ -212,20 +221,62 @@ class DefaultPreemption(Plugin):
         node_infos: List[NodeInfo],
         shared_state: Optional[CycleState] = None,
     ) -> Optional[List[Any]]:
-        lower = sorted(
-            (p for p in ni.pods if p.spec.priority < pod.spec.priority),
-            key=lambda p: (p.spec.priority, p.metadata.name),
-        )
+        lower = [p for p in ni.pods if p.spec.priority < pod.spec.priority]
         if not lower:
             return None
-        remaining = list(ni.pods)
+        remaining = [p for p in ni.pods if p.spec.priority >= pod.spec.priority]
+        if not self._feasible_after(pod, ni, remaining, node_infos, shared_state):
+            return None  # even with every lower-priority pod gone, no fit
+        # reprieve most-important first: higher priority, then earlier
+        # creation (the status.startTime analog), then name
+        lower.sort(
+            key=lambda p: (
+                -p.spec.priority,
+                p.metadata.creation_timestamp,
+                p.metadata.name,
+            )
+        )
+        # Sound probe gate: a reprieve runs 1 + len(lower) full filter-chain
+        # probes per candidate (the greedy form's early exit is gone), and
+        # the exact (non-shared-state) probe path rebuilds cluster-wide
+        # pre-filter state each time.  When NodeResourcesFit is in the
+        # chain, a reprieve that over-commits the node MUST fail the full
+        # probe — run JUST that one filter against an incrementally
+        # maintained NodeInfo first, and mark the pod a victim without the
+        # chain (and without the pre-filter snapshot rebuild) when it
+        # rejects.  Calling the real filter keeps the gate exact by
+        # construction (no duplicated fit arithmetic to keep in sync).
+        from minisched_tpu.framework.types import is_success
+
+        fit = next(
+            (
+                f
+                for f in self.h.filter_plugins
+                if isinstance(f, NodeResourcesFit)
+            ),
+            None,
+        )
+        probe_ni = None
+        if fit is not None and ni.node is not None:
+            [probe_ni] = build_node_infos([ni.node], remaining)
+
         victims: List[Any] = []
         for v in lower:
-            remaining.remove(v)
-            victims.append(v)
-            if self._feasible_after(pod, ni, remaining, node_infos, shared_state):
-                return victims
-        return None
+            if probe_ni is not None:
+                probe_ni.add_pod(v)
+                if not is_success(fit.filter(CycleState(), pod, probe_ni)):
+                    probe_ni.remove_pod(v)
+                    victims.append(v)
+                    continue
+            remaining.append(v)
+            if not self._feasible_after(
+                pod, ni, remaining, node_infos, shared_state
+            ):
+                remaining.pop()  # v was just appended
+                victims.append(v)
+                if probe_ni is not None:
+                    probe_ni.remove_pod(v)
+        return victims  # possibly empty: the pod fits with no evictions
 
     # ------------------------------------------------------------------
     def post_filter(
@@ -250,6 +301,12 @@ class DefaultPreemption(Plugin):
                 continue  # eviction can't fix these (upstream skips them)
             victims = self._select_victims(pod, ni, node_infos, shared_state)
             if victims is not None:
+                if not victims:
+                    # every reprieve succeeded — the pod fits with no
+                    # evictions (snapshot drift after an earlier loser's
+                    # preemption); upstream's pickOneNodeForPreemption
+                    # returns a zero-victim node immediately
+                    return ni.name, Status.success()
                 candidates.append((ni, victims))
                 if len(candidates) >= cap:
                     break
@@ -257,14 +314,27 @@ class DefaultPreemption(Plugin):
             return None, Status.unschedulable(REASON_NO_CANDIDATES).with_plugin(
                 NAME
             )
-        best_ni, best_victims = min(
-            candidates,
-            key=lambda c: (
-                len(c[1]),
-                max(v.spec.priority for v in c[1]),
+        def _pick_key(c):
+            # pickOneNodeForPreemption order (no PDBs in this system):
+            # min highest victim priority → min priority sum → fewest
+            # victims → latest earliest-creation among the
+            # highest-priority victims (start-time analog; most recently
+            # started = least disruptive) → node name
+            victims = c[1]
+            top = max(v.spec.priority for v in victims)
+            return (
+                top,
+                sum(v.spec.priority for v in victims),
+                len(victims),
+                -min(
+                    v.metadata.creation_timestamp
+                    for v in victims
+                    if v.spec.priority == top
+                ),
                 c[0].name,
-            ),
-        )
+            )
+
+        best_ni, best_victims = min(candidates, key=_pick_key)
         for v in best_victims:
             try:
                 self.h.client.pods(v.metadata.namespace).delete(v.metadata.name)

@@ -120,6 +120,207 @@ def test_candidate_cap_math():
     assert dp._max_candidates(1) == 1  # capped at n
 
 
+def test_preemption_reprieve_keeps_high_priority_blockers():
+    """Upstream selectVictimsOnNode semantics: remove ALL lower-priority
+    pods, then reprieve most-important first.  With varied pod sizes the
+    greedy lowest-first form diverges: it would evict small `low` (1cpu
+    frees exactly the 1cpu needed... but here the blocker is mid-sized).
+    Cluster: n1 cap 4cpu holds hi(prio 8, 1cpu), mid(prio 3, 2cpu),
+    low(prio 1, 1cpu); incoming needs 2cpu.  Greedy lowest-first evicts
+    low (frees 1cpu, still short) then mid → victims {low, mid}.
+    Reprieve removes all three lower... (hi has prio 8 < 10, also
+    removable) → frees 4; re-adds hi (ok), mid (2cpu, leaves 1 < 2 →
+    victim), low (ok) → victims exactly {mid}."""
+    client = Client()
+    nodes = [make_node("n1", capacity={"cpu": "4", "memory": "8Gi", "pods": 10})]
+    client.nodes().create(nodes[0])
+    assigned = [
+        _assigned("hi", "n1", "1", priority=8),
+        _assigned("mid", "n1", "2", priority=3),
+        _assigned("low", "n1", "1", priority=1),
+    ]
+    for p in assigned:
+        client.pods().create(p)
+    infos = build_node_infos(nodes, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("wants-2cpu", requests={"cpu": "2"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert status.is_success() and nominated == "n1"
+    names = {p.metadata.name for p in client.pods().list()}
+    assert names == {"hi", "low"}  # only the blocking mid-priority pod
+
+
+def test_preemption_no_candidate_when_all_lower_removed_insufficient():
+    """Upstream's first check: if the pod is infeasible even with every
+    lower-priority pod evicted, the node is not a candidate and nothing
+    is probed further (no partial evictions)."""
+    client = Client()
+    nodes = [make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10})]
+    client.nodes().create(nodes[0])
+    assigned = [
+        _assigned("low", "n1", "1", priority=1),
+        _assigned("peer", "n1", "1", priority=10),
+    ]
+    for p in assigned:
+        client.pods().create(p)
+    infos = build_node_infos(nodes, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("wants-2cpu", requests={"cpu": "2"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert nominated is None and not status.is_success()
+    assert len(client.pods().list()) == 2
+
+
+def test_pick_one_node_upstream_order():
+    """pickOneNodeForPreemption: minimum highest victim priority
+    dominates victim COUNT — a node sacrificing two prio-1 pods beats a
+    node sacrificing one prio-5 pod."""
+    client = Client()
+    nodes = [
+        make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10}),
+        make_node("n2", capacity={"cpu": "2", "memory": "8Gi", "pods": 10}),
+    ]
+    for n in nodes:
+        client.nodes().create(n)
+    assigned = [
+        _assigned("tiny-a", "n1", "1", priority=1),
+        _assigned("tiny-b", "n1", "1", priority=1),
+        _assigned("mid", "n2", "2", priority=5),
+    ]
+    for p in assigned:
+        client.pods().create(p)
+    infos = build_node_infos(nodes, assigned)
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("wants-2cpu", requests={"cpu": "2"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert status.is_success() and nominated == "n1"
+    names = {p.metadata.name for p in client.pods().list()}
+    assert names == {"mid"}
+
+
+def test_preemption_zero_victim_candidate_nominates_without_eviction():
+    """Snapshot drift can leave a loser that now fits a node outright
+    (an earlier loser's big victim was evicted and replaced by a smaller
+    phantom).  Every reprieve then succeeds — upstream returns the
+    zero-victim node immediately; nothing must be deleted."""
+    client = Client()
+    node = make_node("n1", capacity={"cpu": "4", "memory": "8Gi", "pods": 10})
+    client.nodes().create(node)
+    occupant = _assigned("low", "n1", "1", priority=1)
+    client.pods().create(occupant)
+    infos = build_node_infos([node], [occupant])
+    dp = DefaultPreemption()
+    dp.h = _Handle(client, [NodeResourcesFit()])
+    pod = make_pod("fits", requests={"cpu": "1"}, priority=10)
+    nominated, status = dp.post_filter(CycleState(), pod, infos, Diagnosis())
+    assert status.is_success() and nominated == "n1"
+    assert dp.last_victims == []
+    assert {p.metadata.name for p in client.pods().list()} == {"low"}
+
+
+def test_store_stamps_creation_timestamp():
+    """The reprieve order and the pick-node start-time criterion read
+    metadata.creation_timestamp — the store must stamp it on create and
+    carry it through updates (like uid)."""
+    client = Client()
+    client.nodes().create(make_node("n1"))
+    p = make_pod("p1")
+    created = client.pods().create(p)
+    assert created.metadata.creation_timestamp > 0
+    created.metadata.labels["x"] = "y"
+    updated = client.pods().update(created)
+    assert (
+        updated.metadata.creation_timestamp
+        == created.metadata.creation_timestamp
+    )
+
+
+def test_resource_gate_matches_full_probes():
+    """The arithmetic probe gate (victims marked without running the
+    filter chain when NodeResourcesFit must reject) must select exactly
+    the victims full probing selects, across randomized clusters."""
+    import random
+
+    from minisched_tpu.framework.plugin import Plugin
+    from minisched_tpu.framework.types import Status
+
+    class _HiddenFit(Plugin):
+        """NodeResourcesFit behavior without the isinstance identity —
+        disables the gate so the comparison runs full probes."""
+
+        def __init__(self):
+            self._inner = NodeResourcesFit()
+
+        def name(self):
+            return self._inner.name()
+
+        def filter(self, state, pod, node_info):
+            return self._inner.filter(state, pod, node_info)
+
+    def _sized(name, cpu, mem_gi, prio):
+        p = make_pod(
+            name,
+            requests={"cpu": cpu, "memory": f"{mem_gi}Gi"},
+            priority=prio,
+        )
+        p.metadata.uid = name
+        p.spec.node_name = "n1"
+        return p
+
+    rng = random.Random(20260731)
+    for trial in range(40):
+        n_pods = rng.randint(1, 8)
+        nodes = [
+            make_node(
+                "n1",
+                capacity={
+                    # make every gate branch load-bearing across trials:
+                    # cpu, memory, and the pod-count headroom all bind
+                    "cpu": str(rng.randint(2, 8)),
+                    "memory": f"{rng.randint(2, 10)}Gi",
+                    "pods": rng.randint(1, 9),
+                },
+            )
+        ]
+        assigned = [
+            _sized(
+                f"p{i}",
+                str(rng.randint(1, 3)),
+                rng.randint(1, 3),
+                # priorities straddle the incoming pod's (3): `remaining`
+                # starts non-empty when higher-priority pods are assigned
+                rng.randint(0, 6),
+            )
+            for i in range(n_pods)
+        ]
+        pod = make_pod(
+            "incoming",
+            requests={
+                "cpu": str(rng.randint(1, 4)),
+                "memory": f"{rng.randint(1, 4)}Gi",
+            },
+            priority=3,
+        )
+        results = []
+        for chain in ([NodeResourcesFit()], [_HiddenFit()]):
+            client = Client()
+            client.nodes().create(nodes[0])
+            for p in assigned:
+                client.pods().create(p)
+            infos = build_node_infos(nodes, assigned)
+            dp = DefaultPreemption()
+            dp.h = _Handle(client, chain)
+            nominated, status = dp.post_filter(
+                CycleState(), pod, infos, Diagnosis()
+            )
+            survivors = sorted(p.metadata.name for p in client.pods().list())
+            results.append((nominated, status.is_success(), survivors))
+        assert results[0] == results[1], f"trial {trial}: {results}"
+
+
 def test_default_preemption_args_flow_through_config():
     """The reference's conversion carries DefaultPreemption plugin args
     (scheduler_test.go:164,205); ours must too — through customization,
