@@ -560,6 +560,10 @@ def bench_config5_fullchain() -> dict:
             ),
             "device_total_s": phase("wave_device", "total_s"),
             "device_mean_s": phase("wave_device", "mean_s"),
+            "scan_build_total_s": phase("scan_build", "total_s"),
+            "scan_grouping_total_s": phase("scan_grouping", "total_s"),
+            "losers_handle_total_s": phase("losers_handle", "total_s"),
+            "commit_total_s": phase("commit", "total_s"),
         },
     }
 

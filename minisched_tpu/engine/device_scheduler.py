@@ -285,6 +285,13 @@ class DeviceScheduler(Scheduler):
     #: it sees chunk k's binds (sequential semantics across chunks)
     SCAN_MIN_CAP = 128
     SCAN_MAX_CHUNK = 1024
+    #: blocked-lane chunk stride/top tier: per-call overhead on the
+    #: tunneled runtime (dispatch + the packed node/constraint transfer,
+    #: ~0.6-0.9s) dominates the blocked chunk's device compute, so the
+    #: blocked lane takes FEWER, BIGGER calls than the exact lane —
+    #: a 5k-pod cross-pod burst is 2 calls at this tier instead of 6 at
+    #: SCAN_MAX_CHUNK (measured ~9s → ~4s of scan-lane wall)
+    BLOCKED_MAX_CHUNK = 4096
     #: small-wave pod capacity: partial and requeue waves (a 2k-pod
     #: backoff replay after a 16k-pod drain) evaluate at this capacity
     #: instead of the full max_wave executable — the (P, N) planes scale
@@ -317,6 +324,18 @@ class DeviceScheduler(Scheduler):
         ~30s tunnel compile inside a wave costs more than masked no-op
         steps ever will.  tests/test_shape_discipline.py pins this."""
         return cls.SCAN_MIN_CAP if n_pods <= cls.SCAN_MIN_CAP else cls.SCAN_MAX_CHUNK
+
+    @classmethod
+    def _blocked_cap(cls, n_pods: int) -> int:
+        """Blocked-lane capacity tiers: {128, 1024, 4096}.  Same shape
+        discipline as _scan_cap, one more tier — the blocked kernel's
+        masked no-op steps are cheap relative to the per-call tunnel
+        overhead the big tier amortizes."""
+        if n_pods <= cls.SCAN_MIN_CAP:
+            return cls.SCAN_MIN_CAP
+        if n_pods <= cls.SCAN_MAX_CHUNK:
+            return cls.SCAN_MAX_CHUNK
+        return cls.BLOCKED_MAX_CHUNK
 
     def prewarm(self) -> None:
         """Compile (or cache-load) the wave evaluator executable for the
@@ -372,6 +391,8 @@ class DeviceScheduler(Scheduler):
             warm_caps = set(wave_caps)
             if self._has_cross_pod:
                 warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
+                if self.SCAN_BLOCK_SIZE > 1:
+                    warm_caps.add(self.BLOCKED_MAX_CHUNK)
             for cap in warm_caps:
                 build_pod_table([complex_pod], capacity=cap, force_packed=True)
         infos = build_node_infos(nodes, [])
@@ -421,11 +442,20 @@ class DeviceScheduler(Scheduler):
             # two; a partial chunk compiling the small one mid-run cost
             # ~13s).  Fresh node table: the mesh-mode repair warm above
             # donates its (re-sharded) argument and must not alias this.
+            # the blocked lane has one extra (bigger) tier than the exact
+            # lane — warm each executable only at the caps it runs
+            scan_caps = {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
+            blocked_caps = (
+                scan_caps | {self.BLOCKED_MAX_CHUNK}
+                if self.SCAN_BLOCK_SIZE > 1
+                else set()
+            )
+            all_caps = sorted(scan_caps | blocked_caps)
             if packed_mode:
                 # scan chunks carry cross-pod pods, which are never
                 # "simple" — the live schema is the SLOW pod table; warm
                 # exactly that packed entry per chunk capacity
-                for cap in (self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK):
+                for cap in all_caps:
                     scan_pods, _ = build_pod_table(
                         pods + [complex_pod], capacity=cap, device=False
                     )
@@ -436,11 +466,12 @@ class DeviceScheduler(Scheduler):
                         scan_planes=True, device=False,
                         elide_zeros=False,
                     )
-                    _, choice, _ = self._get_scan_scheduler().call_packed(
-                        scan_pods, node_static, node_agg, scan_extra
-                    )
-                    jax.block_until_ready(choice)
-                    if self.SCAN_BLOCK_SIZE > 1:
+                    if cap in scan_caps:
+                        _, choice, _ = self._get_scan_scheduler().call_packed(
+                            scan_pods, node_static, node_agg, scan_extra
+                        )
+                        jax.block_until_ready(choice)
+                    if cap in blocked_caps:
                         _, bc, _, _ = (
                             self._get_blocked_scheduler().call_packed(
                                 scan_pods, node_static, node_agg, scan_extra
@@ -451,7 +482,7 @@ class DeviceScheduler(Scheduler):
             node_table, _ = CachedNodeTableBuilder().build(
                 infos, capacity=node_capacity, prof_capacity=prof_capacity
             )
-            for cap in (self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK):
+            for cap in all_caps:
                 scan_pods, _ = build_pod_table(pods, capacity=cap)
                 scan_extra = build_constraint_tables(
                     pods, nodes, [],
@@ -459,11 +490,12 @@ class DeviceScheduler(Scheduler):
                     node_capacity=node_capacity,
                     scan_planes=True,
                 )
-                _, choice, _ = self._get_scan_scheduler()(
-                    scan_pods, node_table, scan_extra
-                )
-                jax.block_until_ready(choice)
-                if self.SCAN_BLOCK_SIZE > 1:
+                if cap in scan_caps:
+                    _, choice, _ = self._get_scan_scheduler()(
+                        scan_pods, node_table, scan_extra
+                    )
+                    jax.block_until_ready(choice)
+                if cap in blocked_caps:
                     _, bc, _, _ = self._get_blocked_scheduler()(
                         scan_pods, node_table, scan_extra
                     )
@@ -564,10 +596,10 @@ class DeviceScheduler(Scheduler):
                 blocks = order_into_blocks(pending, sets, B)
                 flat = [m for blk in blocks for m in blk]
             retry: List[QueuedPodInfo] = []
-            for start in range(0, len(flat), self.SCAN_MAX_CHUNK):
+            for start in range(0, len(flat), self.BLOCKED_MAX_CHUNK):
                 if fresh is None:
                     fresh = self._snapshot_for_wave()
-                part = flat[start : start + self.SCAN_MAX_CHUNK]
+                part = flat[start : start + self.BLOCKED_MAX_CHUNK]
                 retry += self._run_blocked_chunk(part, *fresh)
                 fresh = None
             if not retry:
@@ -599,7 +631,7 @@ class DeviceScheduler(Scheduler):
             + list(assumed_pods)
         )
         dummy = make_pod("scan-pad")
-        cap = self._scan_cap(len(part))
+        cap = self._blocked_cap(len(part))
 
         def build_and_scan(part_live):
             # the padded layout, restricted to the currently-live qpis —
@@ -614,25 +646,28 @@ class DeviceScheduler(Scheduler):
             pods_ = [m.pod if m is not None else dummy for m in cur]
             packed_mode = self._packed_mode
             if packed_mode:
-                node_static, node_agg, node_names = (
-                    self._table_builder.build_packed(
-                        node_infos, agg_delta=agg_delta
+                with self.metrics.timed("scan_build"):
+                    node_static, node_agg, node_names = (
+                        self._table_builder.build_packed(
+                            node_infos, agg_delta=agg_delta
+                        )
                     )
-                )
-                pod_table, _ = build_pod_table(
-                    pods_, capacity=cap, device=False, invalid_rows=pad_rows
-                )
-                extra = self._build_constraints(
-                    pods_, nodes, assigned,
-                    pod_capacity=cap,
-                    node_capacity=node_agg.capacity,
-                    scan_planes=True,
-                    device=False,
-                    # one packed schema per capacity: elision made every
-                    # zero-set flip (combo counts appearing mid-run) a
-                    # fresh executable compile/load on the tunnel
-                    elide_zeros=False,
-                )
+                    pod_table, _ = build_pod_table(
+                        pods_, capacity=cap, device=False,
+                        invalid_rows=pad_rows,
+                    )
+                    extra = self._build_constraints(
+                        pods_, nodes, assigned,
+                        pod_capacity=cap,
+                        node_capacity=node_agg.capacity,
+                        scan_planes=True,
+                        device=False,
+                        # one packed schema per capacity: elision made
+                        # every zero-set flip (combo counts appearing
+                        # mid-run) a fresh executable compile/load on
+                        # the tunnel
+                        elide_zeros=False,
+                    )
                 with self.metrics.timed("scan_evaluate"):
                     _, choice, _, accepted = (
                         self._get_blocked_scheduler().call_packed(
@@ -641,18 +676,19 @@ class DeviceScheduler(Scheduler):
                     )
                     choice, accepted = jax.device_get((choice, accepted))
             else:
-                node_table, node_names = self._table_builder.build(
-                    node_infos, agg_delta=agg_delta
-                )
-                pod_table, _ = build_pod_table(
-                    pods_, capacity=cap, invalid_rows=pad_rows
-                )
-                extra = self._build_constraints(
-                    pods_, nodes, assigned,
-                    pod_capacity=cap,
-                    node_capacity=node_table.capacity,
-                    scan_planes=True,
-                )
+                with self.metrics.timed("scan_build"):
+                    node_table, node_names = self._table_builder.build(
+                        node_infos, agg_delta=agg_delta
+                    )
+                    pod_table, _ = build_pod_table(
+                        pods_, capacity=cap, invalid_rows=pad_rows
+                    )
+                    extra = self._build_constraints(
+                        pods_, nodes, assigned,
+                        pod_capacity=cap,
+                        node_capacity=node_table.capacity,
+                        scan_planes=True,
+                    )
                 with self.metrics.timed("scan_evaluate"):
                     _, choice, _, accepted = self._get_blocked_scheduler()(
                         pod_table, node_table, extra
@@ -721,38 +757,40 @@ class DeviceScheduler(Scheduler):
                 if packed_mode:
                     # single-program chunk: flat host buffers unpacked
                     # inside the scan executable (see _build_and_evaluate)
-                    node_static, node_agg, node_names = (
-                        self._table_builder.build_packed(
-                            node_infos, agg_delta=agg_delta
+                    with self.metrics.timed("scan_build"):
+                        node_static, node_agg, node_names = (
+                            self._table_builder.build_packed(
+                                node_infos, agg_delta=agg_delta
+                            )
                         )
-                    )
-                    pod_table, _ = build_pod_table(
-                        pods_, capacity=cap, device=False
-                    )
-                    extra = self._build_constraints(
-                        pods_, nodes, assigned,
-                        pod_capacity=cap,
-                        node_capacity=node_agg.capacity,
-                        scan_planes=True,  # the scan's commits need it
-                        device=False,
-                        elide_zeros=False,  # one packed schema per cap
-                    )
+                        pod_table, _ = build_pod_table(
+                            pods_, capacity=cap, device=False
+                        )
+                        extra = self._build_constraints(
+                            pods_, nodes, assigned,
+                            pod_capacity=cap,
+                            node_capacity=node_agg.capacity,
+                            scan_planes=True,  # the scan's commits need it
+                            device=False,
+                            elide_zeros=False,  # one packed schema per cap
+                        )
                     with self.metrics.timed("scan_evaluate"):
                         _, choice, _ = self._get_scan_scheduler().call_packed(
                             pod_table, node_static, node_agg, extra
                         )
                         choice = jax.device_get(choice)
                     return node_names, choice.tolist()[: len(pods_)]
-                node_table, node_names = self._table_builder.build(
-                    node_infos, agg_delta=agg_delta
-                )
-                pod_table, _ = build_pod_table(pods_, capacity=cap)
-                extra = self._build_constraints(
-                    pods_, nodes, assigned,
-                    pod_capacity=cap,
-                    node_capacity=node_table.capacity,
-                    scan_planes=True,  # the scan's commit updates need it
-                )
+                with self.metrics.timed("scan_build"):
+                    node_table, node_names = self._table_builder.build(
+                        node_infos, agg_delta=agg_delta
+                    )
+                    pod_table, _ = build_pod_table(pods_, capacity=cap)
+                    extra = self._build_constraints(
+                        pods_, nodes, assigned,
+                        pod_capacity=cap,
+                        node_capacity=node_table.capacity,
+                        scan_planes=True,  # the scan's commits need it
+                    )
                 if self.result_store is not None:
                     # scan pods get the same per-plugin artifact as wave
                     # pods (diagnostics against the pre-decision snapshot)
@@ -1011,9 +1049,15 @@ class DeviceScheduler(Scheduler):
         will consume the capacity they freed) — otherwise several losers
         select the same victims and over-evict.
         """
+        self.metrics.observe("wave_losers", float(len(losers)))
+        with self.metrics.timed("losers_handle"):
+            self._handle_wave_losers_inner(losers, node_infos, n_nodes)
+
+    def _handle_wave_losers_inner(
+        self, losers: List[Any], node_infos: List[Any], n_nodes: int
+    ) -> None:
         from minisched_tpu.plugins.defaultpreemption import preemption_might_help
 
-        self.metrics.observe("wave_losers", float(len(losers)))
         diagnoses = {}
         for qpi, pod, fails in losers:
             diagnosis = Diagnosis()
@@ -1198,6 +1242,10 @@ class DeviceScheduler(Scheduler):
 
         ``winners``: (qpi, pod, node_name) triples, already assumed.
         """
+        with self.metrics.timed("commit"):
+            self._commit_winners_inner(winners)
+
+    def _commit_winners_inner(self, winners: List[Any]) -> None:
         from minisched_tpu.framework.types import CycleState
 
         ready: List[Any] = []

@@ -402,25 +402,62 @@ def blocked_scan_schedule(
             glob = glob + jnp.sum(pmc, axis=0).astype(glob.dtype)
 
             # -- per-pod scatter updates (anti-affinity exclusion, rev
-            # weights) — small row counts, unrolled over the block -------
-            for j in range(B):
-                dom_j = _combo_domain_masks(extra, n_b[j])  # (C, N)
-                committed_j = committed[j]
-                pan_c = extra_b.pan_combo[j]
-                pan_in = (jnp.arange(A) < extra_b.pan_n[j]) & committed_j
-                excl = excl.at[pan_c].max(pan_in[:, None] & dom_j[pan_c])
-                ppa_c = extra_b.ppa_combo[j]
-                ppa_in = (jnp.arange(W) < extra_b.ppa_n[j]) & committed_j
-                revw = revw.at[ppa_c].add(
-                    jnp.where(ppa_in, extra_b.ppa_w[j], 0)[:, None]
-                    * dom_j[ppa_c].astype(revw.dtype)
+            # weights), batched over the block: gather each pod's term
+            # combos' domain masks at its landing node — (B, A, N) — and
+            # commit them in ONE scatter per plane.  add/max scatters
+            # accumulate duplicate rows correctly, and block pods read
+            # the PRE-block planes (evaluate above), so the batch equals
+            # the member-by-member order.  The unrolled form emitted
+            # ~B×3 scatter kernels per step and dominated the step wall.
+            def _dom_at(combo_rows, nb):
+                # (B, K, N) domain masks of combo ``combo_rows[j, k]``
+                # at node ``nb[j]``
+                keys_r = extra.combo_key[combo_rows]  # (B, K)
+                D_ = extra.topo_onehot.shape[1]
+                d_r = extra.topo_domain[keys_r, nb[:, None]]  # (B, K)
+                has_r = d_r != D_
+                dom_r = extra.topo_onehot[
+                    keys_r, jnp.minimum(d_r, D_ - 1)
+                ]  # (B, K, N)
+                uniq_r = extra.topo_unique[keys_r]  # (B, K)
+                onehot_nb = (
+                    jnp.arange(dom_r.shape[-1])[None, :] == nb[:, None]
+                )  # (B, N)
+                return (
+                    jnp.where(
+                        uniq_r[..., None], onehot_nb[:, None, :], dom_r
+                    )
+                    & has_r[..., None]
                 )
-                pa_c = extra_b.pa_combo[j]
-                pa_in = (jnp.arange(PA) < extra_b.pa_n[j]) & committed_j
-                revw = revw.at[pa_c].add(
-                    jnp.where(pa_in, HARD_POD_AFFINITY_WEIGHT, 0)[:, None]
-                    * dom_j[pa_c].astype(revw.dtype)
-                )
+
+            N_ = dsum.shape[1]
+            pan_c = extra_b.pan_combo  # (B, A)
+            pan_in = (
+                jnp.arange(A)[None, :] < extra_b.pan_n[:, None]
+            ) & committed[:, None]
+            excl = excl.at[pan_c.reshape(-1)].max(
+                (pan_in[..., None] & _dom_at(pan_c, n_b)).reshape(-1, N_)
+            )
+            ppa_c = extra_b.ppa_combo  # (B, W)
+            ppa_in = (
+                jnp.arange(W)[None, :] < extra_b.ppa_n[:, None]
+            ) & committed[:, None]
+            revw = revw.at[ppa_c.reshape(-1)].add(
+                (
+                    jnp.where(ppa_in, extra_b.ppa_w, 0)[..., None]
+                    * _dom_at(ppa_c, n_b).astype(revw.dtype)
+                ).reshape(-1, N_)
+            )
+            pa_c = extra_b.pa_combo  # (B, PA)
+            pa_in = (
+                jnp.arange(PA)[None, :] < extra_b.pa_n[:, None]
+            ) & committed[:, None]
+            revw = revw.at[pa_c.reshape(-1)].add(
+                (
+                    jnp.where(pa_in, HARD_POD_AFFINITY_WEIGHT, 0)[..., None]
+                    * _dom_at(pa_c, n_b).astype(revw.dtype)
+                ).reshape(-1, N_)
+            )
 
         if track_vols:
             # batched volume-plane commit (same math as the repair round,

@@ -1,0 +1,108 @@
+"""Profile the blocked-scan lane's device cost on c5x-shaped data.
+
+Measures, on the real chip: (a) the full blocked kernel per chunk,
+(b) evaluate()-only on one block shape, (c) the scan host builds —
+to find where the 34ms/step goes.  Scratch tool, not part of the bench.
+"""
+import os
+import time
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import numpy as np
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.ops.sequential import BlockedSequentialScheduler, SequentialScheduler
+from minisched_tpu.plugins.registry import build_plugins
+from minisched_tpu.service.config import default_full_roster_config
+
+N_NODES = int(os.environ.get("P_NODES", 10_000))
+CAP = int(os.environ.get("P_CAP", 1024))
+N_PODS = CAP
+N_APPS = 32
+N_ZONES = 16
+B = int(os.environ.get("P_BLOCK", 32))
+
+rng = np.random.default_rng(0)
+nodes = []
+for i in range(N_NODES):
+    n = make_node(
+        f"node-{i:05d}",
+        capacity={"cpu": "8", "memory": "32Gi", "pods": "110"},
+        labels={
+            "zone": f"z{i % N_ZONES}",
+            "kubernetes.io/hostname": f"node-{i:05d}",
+        },
+    )
+    nodes.append(n)
+
+pods = []
+for i in range(N_PODS):
+    app = f"app{i % N_APPS}"
+    p = make_pod(
+        f"spread-{i:05d}",
+        requests={"cpu": "100m", "memory": "128Mi"},
+        labels={"app": app},
+    )
+    from minisched_tpu.api.objects import TopologySpreadConstraint, LabelSelector
+
+    p.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=4,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+    ]
+    pods.append(p)
+
+cfg = default_full_roster_config()
+chains = build_plugins(cfg)
+
+t0 = time.monotonic()
+node_table, names = build_node_table(nodes)
+pod_table, _ = build_pod_table(pods, capacity=CAP)
+extra = build_constraint_tables(
+    pods, nodes, [], pod_capacity=CAP, node_capacity=node_table.capacity,
+    scan_planes=True,
+)
+print(f"host build: {time.monotonic()-t0:.2f}s")
+
+blocked = BlockedSequentialScheduler(
+    chains.filter, chains.pre_score, chains.score,
+    weights=cfg.score_weights(), block_size=B,
+)
+t0 = time.monotonic()
+nt, choice, best, acc = blocked(pod_table, node_table, extra)
+jax.block_until_ready(choice)
+print(f"blocked compile+run: {time.monotonic()-t0:.1f}s")
+for _ in range(3):
+    t0 = time.monotonic()
+    nt, choice, best, acc = blocked(pod_table, node_table, extra)
+    jax.block_until_ready(choice)
+    dt = time.monotonic() - t0
+    n_steps = CAP // B
+    print(
+        f"blocked chunk: {dt*1000:.1f}ms = {dt/n_steps*1000:.2f}ms/step "
+        f"({n_steps} steps of {B})  placed={int((np.asarray(choice)>=0).sum())}"
+    )
+
+# per-pod exact scan for comparison
+seq = SequentialScheduler(
+    chains.filter, chains.pre_score, chains.score, weights=cfg.score_weights()
+)
+t0 = time.monotonic()
+_, c2, _ = seq(pod_table, node_table, extra)
+jax.block_until_ready(c2)
+print(f"exact scan compile+run: {time.monotonic()-t0:.1f}s")
+for _ in range(2):
+    t0 = time.monotonic()
+    _, c2, _ = seq(pod_table, node_table, extra)
+    jax.block_until_ready(c2)
+    dt = time.monotonic() - t0
+    print(f"exact scan chunk: {dt*1000:.1f}ms = {dt/CAP*1000:.3f}ms/pod")

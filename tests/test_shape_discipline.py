@@ -81,6 +81,20 @@ def test_constraint_capacities_stable_under_growth():
 def test_scan_chunks_use_exactly_two_capacities():
     caps = {DeviceScheduler._scan_cap(n) for n in (1, 64, 128, 129, 700, 1024)}
     assert caps == {DeviceScheduler.SCAN_MIN_CAP, DeviceScheduler.SCAN_MAX_CHUNK}
+    # the blocked lane adds exactly one bigger tier
+    bcaps = {
+        DeviceScheduler._blocked_cap(n)
+        for n in (1, 128, 129, 1024, 1025, 4096, 4097)
+    }
+    assert bcaps == {
+        DeviceScheduler.SCAN_MIN_CAP,
+        DeviceScheduler.SCAN_MAX_CHUNK,
+        DeviceScheduler.BLOCKED_MAX_CHUNK,
+    }
+    # chunks above the top tier never exceed it (the stride pins them)
+    assert DeviceScheduler._blocked_cap(
+        DeviceScheduler.BLOCKED_MAX_CHUNK
+    ) == DeviceScheduler.BLOCKED_MAX_CHUNK
 
 
 def test_pod_table_has_two_schemas_per_capacity():
