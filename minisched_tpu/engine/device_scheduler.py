@@ -586,7 +586,13 @@ class DeviceScheduler(Scheduler):
             order_into_blocks,
         )
 
-        self.informer_factory.resume_dispatch()
+        # wave-style dispatch gating (see _bind_batch): the previous
+        # wave's thousands of bind events drain inside this lane's
+        # GIL-free device calls, not against its host builds — ungated,
+        # the grouping/build stretches ran ~10× slower under dispatch
+        # GIL pressure.  Snapshots stay correct while gated: the
+        # assume-cache folds not-yet-dispatched binds as numeric deltas.
+        self.informer_factory.pause_dispatch()
         B = self.SCAN_BLOCK_SIZE
         pending = qpis
         fresh = (node_infos, agg_delta, assumed_pods)
@@ -603,8 +609,10 @@ class DeviceScheduler(Scheduler):
                 retry += self._run_blocked_chunk(part, *fresh)
                 fresh = None
             if not retry:
+                self.informer_factory.resume_dispatch()
                 return
             pending = retry
+        self.informer_factory.resume_dispatch()
         if pending:
             # capacity-race stragglers: the exact lane finishes them
             self._schedule_scan_exact(pending, *self._snapshot_for_wave())
@@ -647,27 +655,33 @@ class DeviceScheduler(Scheduler):
             packed_mode = self._packed_mode
             if packed_mode:
                 with self.metrics.timed("scan_build"):
-                    node_static, node_agg, node_names = (
-                        self._table_builder.build_packed(
-                            node_infos, agg_delta=agg_delta
+                    with self.metrics.timed("scan_build_nodes"):
+                        node_static, node_agg, node_names = (
+                            self._table_builder.build_packed(
+                                node_infos, agg_delta=agg_delta
+                            )
                         )
-                    )
-                    pod_table, _ = build_pod_table(
-                        pods_, capacity=cap, device=False,
-                        invalid_rows=pad_rows,
-                    )
-                    extra = self._build_constraints(
-                        pods_, nodes, assigned,
-                        pod_capacity=cap,
-                        node_capacity=node_agg.capacity,
-                        scan_planes=True,
-                        device=False,
-                        # one packed schema per capacity: elision made
-                        # every zero-set flip (combo counts appearing
-                        # mid-run) a fresh executable compile/load on
-                        # the tunnel
-                        elide_zeros=False,
-                    )
+                    with self.metrics.timed("scan_build_pods"):
+                        pod_table, _ = build_pod_table(
+                            pods_, capacity=cap, device=False,
+                            invalid_rows=pad_rows,
+                        )
+                    with self.metrics.timed("scan_build_constraints"):
+                        extra = self._build_constraints(
+                            pods_, nodes, assigned,
+                            pod_capacity=cap,
+                            node_capacity=node_agg.capacity,
+                            scan_planes=True,
+                            device=False,
+                            # one packed schema per capacity: elision made
+                            # every zero-set flip (combo counts appearing
+                            # mid-run) a fresh executable compile/load on
+                            # the tunnel
+                            elide_zeros=False,
+                        )
+                # gate opens for the device call: held event batches
+                # drain against GIL-free device compute
+                self.informer_factory.resume_dispatch()
                 with self.metrics.timed("scan_evaluate"):
                     _, choice, _, accepted = (
                         self._get_blocked_scheduler().call_packed(
@@ -689,6 +703,7 @@ class DeviceScheduler(Scheduler):
                         node_capacity=node_table.capacity,
                         scan_planes=True,
                     )
+                self.informer_factory.resume_dispatch()
                 with self.metrics.timed("scan_evaluate"):
                     _, choice, _, accepted = self._get_blocked_scheduler()(
                         pod_table, node_table, extra
@@ -718,7 +733,12 @@ class DeviceScheduler(Scheduler):
             else:
                 losers.append((qpi, qpi.pod, set()))
         self._commit_winners(winners)
-        self.informer_factory.resume_dispatch()
+        # keep the next chunk's grouping/build gated: _bind_batch closes
+        # the gate when it runs, but a chunk whose winners all parked in
+        # permit-wait (or that had none) never reaches it — re-close
+        # explicitly (idempotent Event) so victims' DELETE events from
+        # the loser handling below drain in the next device call too
+        self.informer_factory.pause_dispatch()
         if losers:
             self._handle_wave_losers(losers, node_infos, len(nodes))
         return retry

@@ -258,13 +258,19 @@ class _ComboRegistry:
         return self.ids[key]
 
 
-def _topo_key_axis(combos, nodes) -> Tuple[Dict[str, int], Any, Any, Any]:
+def _topo_key_axis(combos, nodes) -> Tuple[
+    Dict[str, int], Any, Any, Any, Any, List[Dict[str, int]]
+]:
     """Dense domain encoding per distinct topology key.
 
     Returns (key→index, topo_domain i32[K, N], topo_onehot bool[K, D, N],
-    topo_unique bool[K]).  Keys whose cardinality exceeds MAX_DOMAINS must
-    be unique-per-node (hostname-like) — their one-hot plane is unused (the
-    kernel short-circuits to per-node counts); anything in between raises.
+    topo_unique bool[K], val_id i32[K, N], value→id dicts per key).  Keys
+    whose cardinality exceeds MAX_DOMAINS must be unique-per-node
+    (hostname-like) — their one-hot plane is unused (the kernel
+    short-circuits to per-node counts); anything in between raises.
+    ``val_id[k, i]`` is node i's label-VALUE id under key k (−1 when the
+    node lacks the key) — the host-side gather axis that lets the combo
+    planes fill without a per-combo × per-node Python loop.
     """
     N = len(nodes)
     keys = sorted({topo for (_, _, topo) in combos})
@@ -296,20 +302,47 @@ def _topo_key_axis(combos, nodes) -> Tuple[Dict[str, int], Any, Any, Any]:
     Ncap = N  # caller re-pads below
     topo_domain = np.full((K, Ncap), D, np.int32)
     topo_onehot = np.zeros((K, D, Ncap), bool)
+    val_id = np.full((K, Ncap), -1, np.int32)
     for k in range(len(keys)):
         for i, dom in enumerate(vals_per_node[k]):
             if dom is None:
                 continue
+            val_id[k, i] = dom
             if unique[k]:
                 topo_domain[k, i] = 0  # unused by the unique path; != D marks haskey
             else:
                 topo_domain[k, i] = dom
                 topo_onehot[k, dom, i] = True
-    return key_ids, topo_domain, topo_onehot, unique
+    return key_ids, topo_domain, topo_onehot, unique, val_id, values
 
 
 def _matches(sel: LabelSelector, namespaces: Tuple[str, ...], pod: Any) -> bool:
     return pod.metadata.namespace in namespaces and sel.matches(pod.metadata.labels)
+
+
+def _sig_groups(pods: Sequence[Any]):
+    """Group pods by their (namespace, labels) signature.
+
+    Selector matching is a pure function of that signature, and real
+    populations are replica sets — thousands of pods collapse to a
+    handful of signatures, so selector × pod matching can run selector ×
+    GROUP (the per-combo fold over assumed/pending pods was ~0.5s per
+    scan chunk at 32 combos × 16k pods).  Returns (representative pods,
+    int32 group id per pod)."""
+    group_of: Dict[Tuple, int] = {}
+    reps: List[Any] = []
+    ids = np.empty(len(pods), np.int32)
+    for i, p in enumerate(pods):
+        sig = (
+            p.metadata.namespace,
+            tuple(sorted(p.metadata.labels.items())),
+        )
+        g = group_of.get(sig)
+        if g is None:
+            g = group_of[sig] = len(reps)
+            reps.append(p)
+        ids[i] = g
+    return reps, ids
 
 
 def _claim_zone_row(pvc: Any, pv_by_name: Dict, nodes: Sequence[Any], zone_ok) -> List[bool]:
@@ -458,8 +491,8 @@ def build_constraint_tables(
     combo_global = np.zeros(C, np.int32)
     combo_here = np.zeros((C, N), np.int32)
     combo_key = np.zeros(C, np.int32)
-    key_ids, topo_domain_, topo_onehot_, topo_unique = _topo_key_axis(
-        reg.combos, nodes
+    key_ids, topo_domain_, topo_onehot_, topo_unique, val_id_, key_vals = (
+        _topo_key_axis(reg.combos, nodes)
     )
     # pad the node axis of the key-domain planes to capacity N
     K, D = topo_onehot_.shape[0], topo_onehot_.shape[1]
@@ -479,30 +512,49 @@ def build_constraint_tables(
     )
     if match_combos:
         # combos sharing (namespaces, selector) across topology keys match
-        # identically — compute each distinct group once
+        # identically — compute each distinct group once, against pod
+        # SIGNATURES rather than pods (replicas share label sets)
+        p_reps, p_gid = _sig_groups(pending_pods)
         match_cache: Dict[Tuple, Any] = {}
         for cid in match_combos:
             nss, sel, _topo = reg.combos[cid]
             mkey = (nss, _selector_sig(sel))
-            if mkey not in match_cache:
-                match_cache[mkey] = np.fromiter(
-                    (_matches(sel, nss, pod) for pod in pending_pods),
+            row = match_cache.get(mkey)
+            if row is None:
+                grp = np.fromiter(
+                    (_matches(sel, nss, r) for r in p_reps),
                     dtype=bool,
-                    count=len(pending_pods),
+                    count=len(p_reps),
                 )
-            pod_matches_combo[: len(pending_pods), cid] = match_cache[mkey]
+                row = match_cache[mkey] = grp[p_gid]
+            pod_matches_combo[: len(pending_pods), cid] = row
+    n_real = len(nodes)
+    # assumed/assigned-pod fold by signature group: sig → {node: count} —
+    # each combo then matches the handful of signatures, not every pod.
+    # With an index the planes already hold the indexed population, so
+    # only the assume-cache extras fold here; without one, all assigned.
+    _fold_src = extra_assigned if index is not None else assigned
+    a_reps, a_nodes = [], []
+    if _fold_src:
+        a_reps, a_gid = _sig_groups(_fold_src)
+        a_nodes = [dict() for _ in a_reps]
+        for g, p in zip(a_gid, _fold_src):
+            d = a_nodes[g]
+            node = p.spec.node_name
+            d[node] = d.get(node, 0) + 1
     for cid, (nss, sel, topo) in enumerate(reg.combos):
-        combo_key[cid] = key_ids[topo]
+        k = key_ids[topo]
+        combo_key[cid] = k
         domain_count: Dict[str, int] = {}
         if index is not None:
             # O(nonzero): per-node counts from the index, assumed pods
             # folded through the same matcher; domain sums derive from the
             # CURRENT node labels so label churn self-heals
             here = index.combo_aggregate(nss, sel, topo)
-            for p in extra_assigned:
-                if _matches(sel, nss, p):
-                    node = p.spec.node_name
-                    here[node] = here.get(node, 0) + 1
+            for g, rep in enumerate(a_reps):
+                if _matches(sel, nss, rep):
+                    for node, cnt in a_nodes[g].items():
+                        here[node] = here.get(node, 0) + cnt
             total = 0
             for node_name, cnt in here.items():
                 i = node_idx.get(node_name)
@@ -515,22 +567,41 @@ def build_constraint_tables(
                     domain_count[val] = domain_count.get(val, 0) + cnt
             combo_global[cid] = total
         else:
-            matching = [p for p in assigned if _matches(sel, nss, p)]
-            combo_global[cid] = len(matching)
-            for p in matching:
-                i = node_idx[p.spec.node_name]
-                combo_here[cid, i] += 1
-                val = nodes[i].metadata.labels.get(topo)
-                if val is not None:
-                    domain_count[val] = domain_count.get(val, 0) + 1
+            total = 0
+            for g, rep in enumerate(a_reps):
+                if not _matches(sel, nss, rep):
+                    continue
+                for node, cnt in a_nodes[g].items():
+                    i = node_idx[node]
+                    total += cnt
+                    combo_here[cid, i] += cnt
+                    val = nodes[i].metadata.labels.get(topo)
+                    if val is not None:
+                        domain_count[val] = domain_count.get(val, 0) + cnt
+            combo_global[cid] = total
+        # haskey/dsum/rev rows as gathers through the node→value-id axis
+        # (a per-combo × per-node Python loop here cost ~1s per scan chunk
+        # at 32 combos × 10k nodes)
         rv = rev_vals.get(cid)
-        for i, node in enumerate(nodes):
-            val = node.metadata.labels.get(topo)
-            if val is not None:
-                combo_haskey[cid, i] = True
-                combo_dsum[cid, i] = domain_count.get(val, 0)
-                if rv:
-                    rev_weight[cid, i] = rv.get(val, 0)
+        vid = val_id_[k, :n_real]  # (n_real,) value id, -1 absent
+        has = vid >= 0
+        combo_haskey[cid, :n_real] = has
+        vals_k = key_vals[k]
+        safe_vid = np.where(has, vid, 0)
+        if domain_count:
+            cnt_by_vid = np.zeros(max(len(vals_k), 1), np.int32)
+            for val, c in domain_count.items():
+                vi = vals_k.get(val)
+                if vi is not None:
+                    cnt_by_vid[vi] = c
+            combo_dsum[cid, :n_real] = np.where(has, cnt_by_vid[safe_vid], 0)
+        if rv:
+            rw_by_vid = np.zeros(max(len(vals_k), 1), np.int32)
+            for val, w in rv.items():
+                vi = vals_k.get(val)
+                if vi is not None:
+                    rw_by_vid[vi] = w
+            rev_weight[cid, :n_real] = np.where(has, rw_by_vid[safe_vid], 0)
 
     # --- reverse anti-affinity terms (deduped: replicas sharing one term
     # and one topology domain collapse to a single row) --------------------

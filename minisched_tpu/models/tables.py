@@ -225,12 +225,7 @@ def pack_table(
         for k, v in host.items():
             arr = np.asarray(v)
             if not arr.any():
-                kind = (
-                    "bool"
-                    if arr.dtype == np.bool_
-                    else "uint32" if arr.dtype == np.uint32 else "int32"
-                )
-                zeros.append((k, kind, tuple(arr.shape)))
+                zeros.append((k, _wire_kind(arr.dtype), tuple(arr.shape)))
             else:
                 live[k] = arr
         host, zero_metas = live, tuple(zeros)
@@ -320,6 +315,14 @@ class PackedCaller:
             )
 
 
+def _wire_kind(dtype) -> str:
+    """Wire-format kind of a column dtype (the packed transfer's only
+    three legal dtypes)."""
+    if dtype == np.bool_:
+        return "bool"
+    return "uint32" if dtype == np.uint32 else "int32"
+
+
 def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
     for k, v in arrays.items():
         if v.dtype not in (np.bool_, np.uint32, np.int32):
@@ -328,14 +331,7 @@ def _col_metas(arrays: Dict[str, Any]) -> Tuple[Tuple[str, str, Tuple[int, ...]]
                 "bool/uint32/int32 ride the packed wire format"
             )
     return tuple(
-        (
-            k,
-            "bool"
-            if v.dtype == np.bool_
-            else "uint32" if v.dtype == np.uint32 else "int32",
-            tuple(v.shape),
-        )
-        for k, v in arrays.items()
+        (k, _wire_kind(v.dtype), tuple(v.shape)) for k, v in arrays.items()
     )
 
 
@@ -343,6 +339,7 @@ def batched_device_put(
     t: Dict[str, Any],
     zero_metas: Tuple[Tuple[str, str, Tuple[int, ...]], ...] = (),
     force_packed: bool = False,
+    elide_zeros: bool = False,
 ) -> Dict[str, Any]:
     """Move a dict of host numpy columns to device in ONE transfer.
 
@@ -356,8 +353,23 @@ def batched_device_put(
     ``zero_metas``: extra (name, kind, shape) columns known to be all-zero
     — created inside the SAME compiled splitter (zero wire bytes, and no
     second executable to load; one tunnel program-load costs ~0.4s).
+
+    ``elide_zeros``: auto-detect all-zero columns and move them into
+    zero_metas.  The zero-set keys the splitter executable, so this is
+    for ONE-SHOT big builds (a 100k-pod table whose wide affinity planes
+    are all zero pays seconds of tunnel transfer for nothing) — wave-loop
+    builds whose feature mix flips per wave must not use it.
     """
     arrays = {k: np.asarray(v) for k, v in t.items()}
+    if elide_zeros:
+        live: Dict[str, Any] = {}
+        zeros = list(zero_metas)
+        for k, v in arrays.items():
+            if v.size >= 4096 and not v.any():
+                zeros.append((k, _wire_kind(v.dtype), tuple(v.shape)))
+            else:
+                live[k] = v
+        arrays, zero_metas = live, tuple(zeros)
     metas = _col_metas(arrays)
     total = sum(v.size for v in arrays.values())
     _SCHEMA_SEEN[metas] = _SCHEMA_SEEN.get(metas, 0) + 1
@@ -1012,6 +1024,19 @@ def _pod_is_simple(pod: Any) -> bool:
     )
 
 
+#: shared all-zero request vector for container-less simple pods (read-only)
+_ZERO_REQS = None  # set lazily below to avoid import cycles
+
+
+def _get_zero_reqs():
+    global _ZERO_REQS
+    if _ZERO_REQS is None:
+        from minisched_tpu.api.objects import ResourceList
+
+        _ZERO_REQS = ResourceList()
+    return _ZERO_REQS
+
+
 def _build_pod_table_fast(pods: Sequence[Any], cap: int,
                           device: bool = True,
                           invalid_rows: Sequence[Any] = ()):
@@ -1024,7 +1049,17 @@ def _build_pod_table_fast(pods: Sequence[Any], cap: int,
 
     p = len(pods)
     names = [pod.metadata.name for pod in pods]
-    reqs = [pod.resource_requests() for pod in pods]
+    # simple pods have ≤1 container, so the request sum IS the container's
+    # already-parsed ResourceList — reading it directly skips the
+    # per-pod ResourceList allocation + memo write of resource_requests()
+    # (~60% of the cold fast build; the memo exists for the paths that DO
+    # aggregate per pod: assume-cache, NodeInfo).  req_pods is pinned to 1
+    # below, matching resource_requests' max(pods, 1) floor.
+    _zero = _get_zero_reqs()
+    reqs = [
+        pod.spec.containers[0].requests if pod.spec.containers else _zero
+        for pod in pods
+    ]
 
     def col(values, dtype=np.int32, fill=0):
         arr = np.full(cap, fill, dtype)
@@ -1109,13 +1144,17 @@ def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None,
                     force_packed: bool = False, device: bool = True,
-                    invalid_rows: Sequence[int] = ()):
+                    invalid_rows: Sequence[int] = (),
+                    elide_zeros: bool = False):
     """``device=False`` returns (PackedTable, names) instead of a
     device-resident PodTable — for consumers that unpack the flat
     buffer inside their own jitted program (ops/repair packed mode).
     ``invalid_rows``: row indices marked valid=False — INTERIOR padding
     for the blocked scan lane, whose block structure needs placeholder
-    rows between real pods (tail padding is automatic)."""
+    rows between real pods (tail padding is automatic).
+    ``elide_zeros`` (device=True slow path only): materialize all-zero
+    columns on device instead of shipping them — for one-shot big
+    builds (see batched_device_put); wave-loop builds must not set it."""
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
@@ -1272,4 +1311,6 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
         # ~50s of mid-run compiles at config5 scale.  The fast path's
         # FIXED _zero_pod_metas already covers the common all-simple wave.
         return pack_table(t, (), cap), names
-    return PodTable(**batched_device_put(t, force_packed=force_packed)), names
+    return PodTable(**batched_device_put(
+        t, force_packed=force_packed, elide_zeros=elide_zeros
+    )), names
