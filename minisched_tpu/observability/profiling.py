@@ -103,7 +103,12 @@ NULL_METRICS = NullMetrics()
 @contextlib.contextmanager
 def device_trace(log_dir: Optional[str]) -> Iterator[None]:
     """JAX profiler trace around device work (no-op when log_dir is None).
-    View with TensorBoard / xprof."""
+    View with TensorBoard / xprof.
+
+    Export happens on context exit and serializes every event of the
+    traced span — for a full engine run (compiles included) that takes
+    ~10-30s after shutdown; keep the process alive until the trace
+    directory is populated."""
     if not log_dir:
         yield
         return

@@ -1,0 +1,125 @@
+"""Unit tests for the packed-transfer helpers added for the host-build
+optimization pass: auto zero-elision in batched_device_put and the
+signature-grouped selector matching in the constraint build.  The broad
+parity suites cover behavior end to end; these pin the helpers' exact
+equivalences so a regression fails with a pointed message."""
+
+import numpy as np
+
+from minisched_tpu.api.objects import (
+    LabelSelector,
+    TopologySpreadConstraint,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.models.constraints import (
+    _matches,
+    _sig_groups,
+    build_constraint_tables,
+)
+from minisched_tpu.models.tables import batched_device_put, build_pod_table
+
+
+def test_batched_device_put_elision_is_bit_identical():
+    rng = np.random.default_rng(3)
+    t = {
+        "live_i32": rng.integers(0, 100, (300, 4)).astype(np.int32),
+        "zero_i32": np.zeros((300, 8, 4), np.int32),
+        "zero_bool": np.zeros((70, 80), bool),
+        "small_zero": np.zeros(8, np.int32),  # below the elision floor
+        "live_u32": rng.integers(0, 2**31, 300).astype(np.uint32),
+    }
+    full = batched_device_put({k: v.copy() for k, v in t.items()})
+    elided = batched_device_put(
+        {k: v.copy() for k, v in t.items()}, elide_zeros=True
+    )
+    assert set(full) == set(elided)
+    for k in t:
+        a, b = np.asarray(full[k]), np.asarray(elided[k])
+        assert a.dtype == b.dtype and a.shape == b.shape, k
+        assert (a == b).all(), k
+
+
+def test_build_pod_table_elision_matches_full():
+    pods = [
+        make_pod(f"p{i}", requests={"cpu": "250m", "memory": "1Gi"})
+        for i in range(20)
+    ]
+    # one complex pod forces the slow schema (where elision applies)
+    pods.append(
+        make_pod("sel", requests={"cpu": "1"}, node_selector={"a": "b"})
+    )
+    full, _ = build_pod_table(pods, capacity=128)
+    elided, _ = build_pod_table(pods, capacity=128, elide_zeros=True)
+    import dataclasses
+
+    for f in dataclasses.fields(full):
+        a = np.asarray(getattr(full, f.name))
+        b = np.asarray(getattr(elided, f.name))
+        assert a.dtype == b.dtype and (a == b).all(), f.name
+
+
+def test_sig_groups_partition_matches_selector_semantics():
+    pods = []
+    for i in range(60):
+        pods.append(
+            make_pod(
+                f"p{i}",
+                labels={"app": f"a{i % 3}"} if i % 4 else {"tier": "db"},
+            )
+        )
+    reps, gid = _sig_groups(pods)
+    assert len(reps) == 4  # 3 app values + the tier signature
+    sel = LabelSelector(match_labels={"app": "a1"})
+    nss = ("default",)
+    # group-level matching must equal per-pod matching for every pod
+    grp = [_matches(sel, nss, r) for r in reps]
+    for i, pod in enumerate(pods):
+        assert grp[gid[i]] == _matches(sel, nss, pod), pod.metadata.name
+
+
+def test_grouped_fold_equals_per_pod_fold_in_combo_planes():
+    """The index-less assigned fold (signature-grouped) must produce the
+    same combo_here/combo_dsum/combo_global planes as first principles."""
+    nodes = [
+        make_node(f"n{i}", labels={"zone": f"z{i % 3}"}) for i in range(9)
+    ]
+    assigned = []
+    for i in range(24):
+        p = make_pod(f"bound{i}", labels={"app": f"a{i % 2}"})
+        p.spec.node_name = f"n{i % 9}"
+        assigned.append(p)
+    pending = []
+    for i in range(4):
+        p = make_pod(f"pend{i}", labels={"app": f"a{i % 2}"})
+        p.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": f"a{i % 2}"}),
+            )
+        ]
+        pending.append(p)
+    extra = build_constraint_tables(
+        pending, nodes, assigned, pod_capacity=128, node_capacity=16,
+        scan_planes=True, device=False,
+    ).unpack()
+    here = np.asarray(extra["combo_here"])
+    dsum = np.asarray(extra["combo_dsum"])
+    glob = np.asarray(extra["combo_global"])
+    # first-principles per combo: app=a0 and app=a1 over zone
+    for cid, app in enumerate(("a0", "a1")):
+        members = [p for p in assigned if p.metadata.labels["app"] == app]
+        assert glob[cid] == len(members)
+        per_node = {}
+        for p in members:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        per_zone = {}
+        for name, cnt in per_node.items():
+            z = name[1:]
+            per_zone[f"z{int(z) % 3}"] = per_zone.get(f"z{int(z) % 3}", 0) + cnt
+        for i, node in enumerate(nodes):
+            assert here[cid, i] == per_node.get(node.metadata.name, 0)
+            zone = node.metadata.labels["zone"]
+            assert dsum[cid, i] == per_zone.get(zone, 0), (cid, i)
