@@ -858,18 +858,15 @@ class DeviceScheduler(Scheduler):
     FULL_GC_EVERY_WAVES = 64
 
     def stop(self) -> None:
-        import os
-
         super().stop()
         # profiling: the trace exports on loop exit (~10-30s for a full
         # run) — the base stop()'s 2s join would let process exit kill
         # the daemon thread mid-write and truncate the trace
-        if os.environ.get("MINISCHED_JAX_PROFILE") and self._thread is not None:
+        if _os.environ.get("MINISCHED_JAX_PROFILE") and self._thread is not None:
             self._thread.join(timeout=120.0)
 
     def _loop(self) -> None:
         import gc
-        import os
 
         from minisched_tpu.observability.profiling import device_trace
 
@@ -881,7 +878,7 @@ class DeviceScheduler(Scheduler):
         try:
             # MINISCHED_JAX_PROFILE=<dir>: JAX profiler trace of the whole
             # run loop (device kernels + host gaps) for TensorBoard/xprof
-            with device_trace(os.environ.get("MINISCHED_JAX_PROFILE")):
+            with device_trace(_os.environ.get("MINISCHED_JAX_PROFILE")):
                 super()._loop()
         finally:
             if was_enabled:
