@@ -569,6 +569,12 @@ def bench_config5_fullchain() -> dict:
             "scan_grouping_total_s": phase("scan_grouping", "total_s"),
             "losers_handle_total_s": phase("losers_handle", "total_s"),
             "commit_total_s": phase("commit", "total_s"),
+            "constraints_lock_wait_s": phase(
+                "constraints_lock_wait", "total_s"
+            ),
+            "constraints_store_list_s": phase(
+                "constraints_store_list", "total_s"
+            ),
         },
     }
 

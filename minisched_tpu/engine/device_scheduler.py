@@ -98,6 +98,14 @@ class DeviceScheduler(Scheduler):
         #: simulator feature, not for headline-scale waves)
         self.result_store: Any = None
         self._diag_evaluator: Any = None
+        # cross-pod pods deferred across waves (see schedule_wave): the
+        # scan lane's cost is per-CALL (packed transfer + dispatch on the
+        # tunneled runtime), so constrained pods accumulate here and the
+        # lane runs once per ~BLOCKED_MAX_CHUNK of them — or at queue
+        # drain, whichever comes first.  Pop order is preserved, so
+        # per-group FIFO (the lane's exactness contract) is unchanged.
+        self._scan_backlog: List[QueuedPodInfo] = []
+        self._scan_backlog_waves = 0  # full waves survived since first defer
         # assume-pod cache (upstream's scheduler cache AssumePod): a placed
         # pod counts against its node IMMEDIATELY, before the async bind
         # lands in the informer cache — without it, the next wave snapshots
@@ -138,7 +146,12 @@ class DeviceScheduler(Scheduler):
         import contextlib
 
         index = self.constraint_index
-        with index.lock() if index is not None else contextlib.nullcontext():
+        with self.metrics.timed("constraints_lock_wait"):
+            lock_cm = (
+                index.lock() if index is not None else contextlib.nullcontext()
+            )
+            lock_cm.__enter__()
+        try:
             extra: Any = ()
             if index is not None:
                 uids = index.assigned_uids()
@@ -147,14 +160,19 @@ class DeviceScheduler(Scheduler):
                         a for uid, a in self._assumed.items()
                         if uid not in uids
                     ]
+            with self.metrics.timed("constraints_store_list"):
+                pvcs = self.client.store.list("PersistentVolumeClaim")
+                pvs = self.client.store.list("PersistentVolume")
             return build_constraint_tables(
                 pods_, nodes, assigned,
-                pvcs=self.client.store.list("PersistentVolumeClaim"),
-                pvs=self.client.store.list("PersistentVolume"),
+                pvcs=pvcs,
+                pvs=pvs,
                 index=index,
                 extra_assigned=extra,
                 **kw,
             )
+        finally:
+            lock_cm.__exit__(None, None, None)
 
     # -- assume-pod cache ---------------------------------------------------
     def _assume(self, pod: Pod, node_name: str) -> None:
@@ -288,10 +306,11 @@ class DeviceScheduler(Scheduler):
     #: blocked-lane chunk stride/top tier: per-call overhead on the
     #: tunneled runtime (dispatch + the packed node/constraint transfer,
     #: ~0.6-0.9s) dominates the blocked chunk's device compute, so the
-    #: blocked lane takes FEWER, BIGGER calls than the exact lane —
-    #: a 5k-pod cross-pod burst is 2 calls at this tier instead of 6 at
-    #: SCAN_MAX_CHUNK (measured ~9s → ~4s of scan-lane wall)
-    BLOCKED_MAX_CHUNK = 4096
+    #: blocked lane takes FEWER, BIGGER calls than the exact lane — with
+    #: cross-wave deferral (schedule_wave) a 5k-pod cross-pod burst is
+    #: ONE call at this tier; fully-padded trailing blocks skip their
+    #: step via lax.cond, so the tier's padding costs ~nothing on device
+    BLOCKED_MAX_CHUNK = 8192
     #: small-wave pod capacity: partial and requeue waves (a 2k-pod
     #: backoff replay after a 16k-pod drain) evaluate at this capacity
     #: instead of the full max_wave executable — the (P, N) planes scale
@@ -313,6 +332,11 @@ class DeviceScheduler(Scheduler):
     #: blocked rounds before leftover capacity-race losers fall back to
     #: the exact per-pod scan
     SCAN_BLOCK_RETRIES = 3
+    #: deferral age bound: flush the cross-pod backlog after this many
+    #: consecutive FULL waves even if neither the size threshold nor a
+    #: queue drain arrives — a sustained stream of plain waves must not
+    #: starve constrained pods indefinitely
+    SCAN_DEFER_MAX_WAVES = 8
     #: cap on PostFilter (preemption) passes per wave — each is
     #: O(nodes × pods) host work (see _handle_wave_losers)
     MAX_PREEMPT_PER_WAVE = 256
@@ -327,10 +351,11 @@ class DeviceScheduler(Scheduler):
 
     @classmethod
     def _blocked_cap(cls, n_pods: int) -> int:
-        """Blocked-lane capacity tiers: {128, 1024, 4096}.  Same shape
+        """Blocked-lane capacity tiers: {128, 1024, 8192}.  Same shape
         discipline as _scan_cap, one more tier — the blocked kernel's
-        masked no-op steps are cheap relative to the per-call tunnel
-        overhead the big tier amortizes."""
+        padded blocks skip their whole step via lax.cond, so the big
+        tier costs (almost) only its live blocks while amortizing the
+        per-call tunnel overhead the lane is bound by."""
         if n_pods <= cls.SCAN_MIN_CAP:
             return cls.SCAN_MIN_CAP
         if n_pods <= cls.SCAN_MAX_CHUNK:
@@ -901,6 +926,15 @@ class DeviceScheduler(Scheduler):
     def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
         qpis = self.queue.pop_batch(self.max_wave, timeout=timeout)
         if not qpis:
+            if self._scan_backlog:
+                # queue drained with constrained pods still deferred:
+                # flush the lane now (the backlog, not the queue, holds
+                # the remaining work)
+                try:
+                    self._flush_scan_backlog()
+                finally:
+                    self._wave_gc()
+                return True
             # idle: the gate a bind may have closed (see _bind_batch) must
             # not delay the events that will wake us; and with the
             # automatic collector off, idle churn (informer handlers,
@@ -908,13 +942,68 @@ class DeviceScheduler(Scheduler):
             self.informer_factory.resume_dispatch()
             self._wave_gc()
             return False
+        partial = len(qpis) < self.max_wave
         try:
             self.schedule_wave(qpis)
+            # a partial pop means the queue is (momentarily) drained —
+            # don't sit on deferred constrained pods waiting for a burst
+            # that may never come; the wave-count bound keeps a sustained
+            # stream of full plain waves from starving them indefinitely
+            if self._scan_backlog:
+                self._scan_backlog_waves += 1
+                if (
+                    partial
+                    or len(self._scan_backlog) >= self.BLOCKED_MAX_CHUNK
+                    or self._scan_backlog_waves >= self.SCAN_DEFER_MAX_WAVES
+                ):
+                    self._flush_scan_backlog()
         finally:
             # every exit path (incl. scan-only waves and early returns)
             # collects; schedule_wave's own call was only on the main path
             self._wave_gc()
         return True
+
+    def _flush_scan_backlog(self) -> None:
+        """Run the deferred cross-pod lane over everything accumulated.
+        Snapshots fresh state — the backlog outlives the wave snapshots
+        it was deferred from."""
+        backlog, self._scan_backlog = self._scan_backlog, []
+        self._scan_backlog_waves = 0
+        # the deferral window is minutes, not milliseconds: a pod can be
+        # DELETED, RECREATED, or UPDATED while parked here, and the
+        # queue's own update/delete handling can no longer reach it (it
+        # was popped).  Re-validate every entry in ONE informer lock hold
+        # (get_many — no per-pod store round-trips/clones in front of the
+        # single device call the deferral exists to amortize): drop the
+        # gone and the renamed-uid recreations (the informer ADD already
+        # enqueued the new incarnation), refresh the spec of the changed.
+        pod_inf = self.informer_factory.informer_for("Pod")
+        keys = [
+            f"{qpi.pod.metadata.namespace}/{qpi.pod.metadata.name}"
+            for qpi in backlog
+        ]
+        live_backlog: List[QueuedPodInfo] = []
+        for qpi, cur in zip(backlog, pod_inf.get_many(keys)):
+            if cur is None:
+                continue  # deleted while deferred
+            if cur.metadata.uid != qpi.pod.metadata.uid:
+                continue  # recreated under the same name: not this entry
+            if cur.spec.node_name:
+                continue  # bound elsewhere while deferred
+            if (
+                cur.metadata.resource_version
+                != qpi.pod.metadata.resource_version
+            ):
+                qpi.pod_info.pod = cur
+            live_backlog.append(qpi)
+        if not live_backlog:
+            return
+        node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
+        if not node_infos:
+            for qpi in live_backlog:
+                self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
+            return
+        self._schedule_scan(live_backlog, node_infos, agg_delta, assumed_pods)
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
         t_wave = time.monotonic()
@@ -926,27 +1015,27 @@ class DeviceScheduler(Scheduler):
                 self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
             return
 
-        # cross-pod-constrained pods are scheduled FIRST, one at a time on
-        # device via the sequential scan (they see each other's commits in
-        # the carried combo planes — bind-exact semantics the repair wave
-        # cannot give them); the plain remainder then rides the repair
-        # wave against a re-snapshot that includes the scan's winners.
-        # The wave thus equals the sequential order [constrained…, plain…].
+        # cross-pod-constrained pods run on device via the sequential scan
+        # (they see each other's commits in the carried combo planes —
+        # bind-exact semantics the repair wave cannot give them).  They are
+        # DEFERRED rather than run per wave: the lane's cost on the
+        # tunneled runtime is per-call (packed transfer + dispatch), so
+        # constrained pods accumulate in pop order across waves and the
+        # lane runs once per ~BLOCKED_MAX_CHUNK — or when the queue drains
+        # (schedule_one).  The global order is thus [plain…×k, constrained…]
+        # — per-group FIFO (the exactness contract) is untouched, and the
+        # lane's acceptance/audit guarantees don't depend on WHEN it runs.
         # A chain WITHOUT cross-pod plugins never evaluates the constraints
         # at all (reference semantics with the plugin disabled) — no scan.
-        constrained = (
-            [qpi for qpi in qpis if _is_cross_pod(qpi.pod)]
-            if self._has_cross_pod
-            else []
-        )
-        if constrained:
-            plain = [qpi for qpi in qpis if not _is_cross_pod(qpi.pod)]
-            self._schedule_scan(constrained, node_infos, agg_delta, assumed_pods)
-            if not plain:
-                self.metrics.observe("wave", time.monotonic() - t_wave)
-                return
-            qpis = plain
-            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
+        if self._has_cross_pod:
+            constrained = [qpi for qpi in qpis if _is_cross_pod(qpi.pod)]
+            if constrained:
+                self._scan_backlog.extend(constrained)
+                plain = [qpi for qpi in qpis if not _is_cross_pod(qpi.pod)]
+                if not plain:
+                    self.metrics.observe("wave", time.monotonic() - t_wave)
+                    return
+                qpis = plain
 
         with self.metrics.timed("wave_assigned_list"):
             nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot

@@ -66,17 +66,42 @@ ExKey = Tuple[Tuple[str, ...], Tuple, str, str]
 VolKey = Tuple
 
 
+class _SigMeta:
+    __slots__ = ("namespace", "labels")
+
+    def __init__(self, namespace: str, labels: Dict[str, str]):
+        self.namespace = namespace
+        self.labels = labels
+
+
+class _SigRep:
+    """namespace/labels shim standing in for every pod sharing a label
+    signature in selector matching — ``_matches`` reads only
+    ``pod.metadata.namespace`` and ``.labels``, and retaining a real pod
+    object here would pin its whole spec/status past removal."""
+
+    __slots__ = ("metadata",)
+
+    def __init__(self, namespace: str, labels: Dict[str, str]):
+        self.metadata = _SigMeta(namespace, labels)
+
+
 class _PodRecord:
     """What one assigned pod contributed — enough to subtract it again
     without re-matching (labels may have changed since)."""
 
     __slots__ = (
-        "node", "combo_ids", "ex_keys", "vols", "claims", "has_anti", "rev",
+        "node", "sig", "ex_keys", "vols", "claims", "has_anti", "rev",
     )
 
     def __init__(self, node: str):
         self.node = node
-        self.combo_ids: List[int] = []
+        #: the pod's label-signature id — combo membership lives at the
+        #: SIGNATURE level (``_sig_combos``), not per record: replica
+        #: populations collapse to a handful of signatures, so selector
+        #: matching (per add and per new-combo backfill) runs against
+        #: signatures instead of pods
+        self.sig: int = -1
         self.ex_keys: List[ExKey] = []
         #: (VolKey, family, rw) per mount — one entry per spec.volumes slot
         self.vols: List[Tuple[VolKey, int, bool]] = []
@@ -107,8 +132,24 @@ class ConstraintIndex:
         self._combo_here: List[Dict[str, int]] = []
         # distinct (namespaces, selector-sig) match groups shared across
         # topology keys: group key → combo ids in the group (one match
-        # test per GROUP per pod, as the from-scratch builder does)
+        # test per GROUP per SIGNATURE, as the from-scratch builder does)
         self._group_ids: Dict[Tuple, List[int]] = {}
+        # label-signature tables: selector matching is a pure function of
+        # (namespace, labels), and real populations are replica sets —
+        # deferring combo registration to a late wave used to backfill
+        # each new combo over EVERY assigned pod (~1M matcher calls at
+        # 100k pods × 32 combos); against signatures it's 32 × #sigs.
+        # Signatures are REFCOUNTED and their ids recycled: populations
+        # with per-pod-unique labels (StatefulSets' pod-name label) would
+        # otherwise grow these tables one entry per pod ever assigned —
+        # and the rep is a namespace/labels shim, never the pod object
+        self._sig_ids: Dict[Tuple, int] = {}  # (ns, labels items) → sig id
+        self._sig_rep: List[Optional[Any]] = []  # sig id → _SigRep | None
+        self._sig_combos: List[List[int]] = []  # sig id → matching combo ids
+        self._sig_nodes: List[Dict[str, int]] = []  # sig id → node → count
+        self._sig_count: List[int] = []  # sig id → live records
+        self._sig_key: List[Optional[Tuple]] = []  # sig id → _sig_ids key
+        self._sig_free: List[int] = []  # recycled sig ids
         # reverse anti-affinity: key → per-owner-node count
         self._ex_terms: Dict[ExKey, Dict[str, int]] = {}
         self._ex_sel: Dict[ExKey, LabelSelector] = {}
@@ -277,11 +318,7 @@ class ConstraintIndex:
         from minisched_tpu.plugins.volumelimits import volume_family
 
         rec = _PodRecord(pod.spec.node_name)
-        for gkey, ids in self._group_ids.items():
-            nss, _sig = gkey
-            sel = self._combo_sel[ids[0]][1]
-            if _matches(sel, nss, pod):
-                rec.combo_ids.extend(ids)
+        rec.sig = self._sig_of(pod)
         aff = pod.spec.affinity
         if (
             aff is not None
@@ -338,6 +375,54 @@ class ConstraintIndex:
             rec.vols.append((vk, fam, rw))
         return rec
 
+    def _sig_of(self, pod: Any) -> int:
+        """The pod's label-signature id, creating (and combo-matching)
+        the signature on first sight — every later pod with the same
+        (namespace, labels) costs one dict lookup instead of a matcher
+        call per selector group.  The caller (_add) owns the refcount."""
+        key = (
+            pod.metadata.namespace,
+            tuple(sorted(pod.metadata.labels.items())),
+        )
+        sid = self._sig_ids.get(key)
+        if sid is None:
+            rep = _SigRep(pod.metadata.namespace, dict(pod.metadata.labels))
+            cids: List[int] = []
+            for gkey, ids in self._group_ids.items():
+                nss, _sig = gkey
+                sel = self._combo_sel[ids[0]][1]
+                if _matches(sel, nss, rep):
+                    cids.extend(ids)
+            if self._sig_free:
+                sid = self._sig_free.pop()
+                self._sig_rep[sid] = rep
+                self._sig_combos[sid] = cids
+                self._sig_nodes[sid] = {}
+                self._sig_count[sid] = 0
+                self._sig_key[sid] = key
+            else:
+                sid = len(self._sig_rep)
+                self._sig_rep.append(rep)
+                self._sig_combos.append(cids)
+                self._sig_nodes.append({})
+                self._sig_count.append(0)
+                self._sig_key.append(key)
+            self._sig_ids[key] = sid
+        return sid
+
+    def _sig_release(self, sid: int) -> None:
+        """Drop one reference; free and recycle the id at zero."""
+        self._sig_count[sid] -= 1
+        if self._sig_count[sid] <= 0:
+            key = self._sig_key[sid]
+            if key is not None:
+                self._sig_ids.pop(key, None)
+            self._sig_rep[sid] = None
+            self._sig_combos[sid] = []
+            self._sig_nodes[sid] = {}
+            self._sig_key[sid] = None
+            self._sig_free.append(sid)
+
     def _node_labels(self, node_name: str) -> Dict[str, str]:
         # set by wire(): the Node informer's get; absent in unit tests
         # that drive the index directly — they pass nodes via _node_get
@@ -354,9 +439,12 @@ class ConstraintIndex:
         self._pods[uid] = pod
         self._records[uid] = rec
         node = rec.node
-        for cid in rec.combo_ids:
+        for cid in self._sig_combos[rec.sig]:
             here = self._combo_here[cid]
             here[node] = here.get(node, 0) + 1
+        sn = self._sig_nodes[rec.sig]
+        sn[node] = sn.get(node, 0) + 1
+        self._sig_count[rec.sig] += 1
         for key in rec.ex_keys:
             owners = self._ex_terms.setdefault(key, {})
             owners[node] = owners.get(node, 0) + 1
@@ -386,13 +474,20 @@ class ConstraintIndex:
             return
         self._pods.pop(uid, None)
         node = rec.node
-        for cid in rec.combo_ids:
+        for cid in self._sig_combos[rec.sig]:
             here = self._combo_here[cid]
             n = here.get(node, 0) - 1
             if n <= 0:
                 here.pop(node, None)
             else:
                 here[node] = n
+        sn = self._sig_nodes[rec.sig]
+        left = sn.get(node, 0) - 1
+        if left <= 0:
+            sn.pop(node, None)
+        else:
+            sn[node] = left
+        self._sig_release(rec.sig)
         for key in rec.ex_keys:
             owners = self._ex_terms.get(key)
             if owners is not None:
@@ -469,19 +564,23 @@ class ConstraintIndex:
         group = self._group_ids.get(gkey)
         if group:
             # same (namespaces, selector) under another topology key:
-            # matches are identical — share the backfill, patch records
+            # matches are identical — share the backfill and the
+            # signature membership
             here.update(self._combo_here[group[0]])
-            for rec in self._records.values():
-                if group[0] in rec.combo_ids:
-                    rec.combo_ids.append(cid)
+            for cids in self._sig_combos:
+                if group[0] in cids:
+                    cids.append(cid)
             group.append(cid)
         else:
-            # one-time backfill over the current assigned population
-            for uid, pod in self._pods.items():
-                if _matches(sel, nss, pod):
-                    rec = self._records[uid]
-                    rec.combo_ids.append(cid)
-                    here[rec.node] = here.get(rec.node, 0) + 1
+            # one-time backfill against SIGNATURES (a handful), not the
+            # assigned population — a combo registered late (the deferred
+            # scan lane queries at drain end, 100k pods assigned) used to
+            # pay one matcher call per pod here
+            for sid, rep in enumerate(self._sig_rep):
+                if rep is not None and _matches(sel, nss, rep):
+                    self._sig_combos[sid].append(cid)
+                    for node, cnt in self._sig_nodes[sid].items():
+                        here[node] = here.get(node, 0) + cnt
             self._group_ids[gkey] = [cid]
         self._combo_here.append(here)
         return cid

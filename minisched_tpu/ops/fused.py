@@ -191,13 +191,26 @@ def precompute_static(
     score_plugins: Sequence[Any],
     ctx: BatchContext,
     extra: Any = None,
+    extra_dynamic: frozenset = frozenset(),
 ) -> StaticWavePlanes:
-    """Evaluate the round-invariant half of the chain once (traceable)."""
+    """Evaluate the round-invariant half of the chain once (traceable).
+
+    ``extra_dynamic``: plugin names to treat as round-varying on top of
+    the ``reads_committed_state`` flag — the sequential scans pass the
+    plugins whose carried coupling planes (combos/volumes) change mid-
+    scan, which the wave/repair split never has to care about."""
     valid = pods.valid[:, None] & nodes.valid[None, :]
+
+    def is_dynamic(pl) -> bool:
+        return (
+            getattr(pl, "reads_committed_state", False)
+            or pl.name() in extra_dynamic
+        )
+
     mask = valid
     names = []
     for pl in filter_plugins:
-        if getattr(pl, "reads_committed_state", False):
+        if is_dynamic(pl):
             continue
         names.append(pl.name())
         if getattr(pl, "needs_extra", False):
@@ -206,11 +219,11 @@ def precompute_static(
             mask = mask & pl.batch_filter(ctx, pods, nodes)
     aux: Dict[str, Dict[str, Any]] = {}
     for pl in pre_score_plugins:
-        if not getattr(pl, "reads_committed_state", False):
+        if not is_dynamic(pl):
             aux[pl.name()] = pl.batch_pre_score(ctx, pods, nodes)
     raw: Dict[str, Any] = {}
     for pl in score_plugins:
-        if getattr(pl, "reads_committed_state", False):
+        if is_dynamic(pl):
             continue
         if getattr(pl, "needs_extra", False):
             s = pl.batch_score(ctx, pods, nodes, aux.get(pl.name(), {}), extra)

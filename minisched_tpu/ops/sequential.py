@@ -45,7 +45,12 @@ from minisched_tpu.models.constraints import (
     POD_AXIS_FIELDS,
 )
 from minisched_tpu.models.tables import NodeTable, PodTable
-from minisched_tpu.ops.fused import BatchContext, evaluate
+from minisched_tpu.ops.fused import (
+    BatchContext,
+    StaticWavePlanes,
+    evaluate,
+    precompute_static,
+)
 from minisched_tpu.ops.state import apply_placements, mount_slot_planes
 
 
@@ -284,6 +289,14 @@ def blocked_scan_schedule(
     capacity race to an earlier-in-block pod — the caller retries it (a
     sequential order would never fail it); ``choice < 0`` means
     infeasible against the state its block observed.
+
+    The commit math routes through small matmul chains against the
+    hoisted topology one-hot planes — the earlier per-pod (B, A, N)
+    domain-mask materializations and the (C, D, N) one-hot einsum read
+    ~30MB/step and dominated the step wall, and TPU lowers the obvious
+    gather/scatter forms to scalar-core loops.  Fully-padded trailing
+    blocks (capacity tiers pad the pod axis) skip the whole step via
+    ``lax.cond``.
     """
     from minisched_tpu.ops.repair import accept_placements
 
@@ -321,173 +334,269 @@ def blocked_scan_schedule(
     PA = extra.pa_combo.shape[1]
     _z = jnp.zeros((1, 1), jnp.int32)
     B = block_size
+    # static/dynamic roster split (the repair waves' precompute_static,
+    # extended): plugins whose verdict can change mid-scan — committed
+    # node state or the carried coupling planes — re-evaluate per step;
+    # everything else evaluates ONCE over the whole chunk at batched
+    # throughput and enters each step as sliced mask/raw-score rows.
+    # HBM residency note: the cached planes are (P_cap, N) per static
+    # scorer plus the bool mask — ~1.1GB at the 8192×10k tier with the
+    # full roster's three static scorers.  Measured fine on a 16GB v5e
+    # next to the node tables; shrink BLOCKED_MAX_CHUNK before adding
+    # many static scorers on smaller parts.
+    # evaluate() re-normalizes cached raw scores against each step's full
+    # mask, so the split is bit-identical to the unsplit chain.  The
+    # full-roster step was ~5.5ms of evaluate at (32, 10k) — op-count
+    # bound, dominated by the ~14 static plugins this hoists.
+    scan_dynamic = frozenset(
+        pl.name()
+        for pl in (*filter_plugins, *pre_score_plugins, *score_plugins)
+        if getattr(pl, "needs_extra", False)
+        and set(getattr(pl, "scan_carried_planes", ("combos", "volumes")))
+        & tracked
+    )
+    static_planes = precompute_static(
+        pods, nodes, filter_plugins, pre_score_plugins, score_plugins,
+        ctx, extra=extra, extra_dynamic=scan_dynamic,
+    )
+    # per-pod pre-score aux re-derives from each step's sliced rows
+    # instead of slicing cached entries (none of the cacheable plugins'
+    # aux is worth the slicing machinery)
+    static_planes = StaticWavePlanes(
+        static_planes.static_mask, static_planes.static_names, {},
+        static_planes.raw_scores,
+    )
+
+    def _slice_static(start):
+        return StaticWavePlanes(
+            jax.lax.dynamic_slice_in_dim(
+                static_planes.static_mask, start, B, 0
+            ),
+            static_planes.static_names,
+            {},
+            {
+                k: jax.lax.dynamic_slice_in_dim(v, start, B, 0)
+                for k, v in static_planes.raw_scores.items()
+            },
+        )
+
+    if track_combos:
+        # hoisted per-call tensors: every step's zone-domain commit
+        # updates are expressed as small matmul chains through these —
+        # TPU lowers big gathers/scatters to slow per-element loops, so
+        # the step routes (combo, domain) increments through the MXU
+        # instead (counts/weights are small ints, exact in f32)
+        keys = extra.combo_key  # (C,) combo → topo key id
+        C = keys.shape[0]
+        K = extra.topo_onehot.shape[0]
+        D = extra.topo_onehot.shape[1]
+        uniq_c = extra.topo_unique[keys]  # (C,)
+        arange_c = jnp.arange(C)
+        onehot_f = extra.topo_onehot.astype(jnp.float32)  # (K, D, N)
+        key_oh = (keys[None, :] == jnp.arange(K)[:, None]).astype(
+            jnp.float32
+        )  # (K, C)
 
     def step(carry, b):
-        carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf = carry
         start = b * B
         pod_block = _slice_pods(pods, start, B)
-        reps = {}
-        if track_combos:
-            reps.update(
-                combo_dsum=dsum, combo_here=here, combo_global=glob,
-                combo_excl=excl, rev_weight=revw,
-            )
-        if track_vols:
-            reps.update(vol_any=va, vol_rw=vr, node_vols_fam=nvf)
-        extra_b = dataclasses.replace(
-            _slice_extra_rows(extra, start, B), **reps
-        )
-        result = evaluate(
-            pod_block, carry_nodes, filter_plugins, pre_score_plugins,
-            score_plugins, ctx, extra=extra_b,
-        )
-        choice = result.choice  # (B,)
-        accept = accept_placements(
-            carry_nodes, pod_block, choice, pod_block.valid,
-            check_resources=check_resources, check_ports=check_ports,
-            vol_state=(
-                [
-                    (extra_b.pod_vols_fam[:, f], nvf[f], mx)
-                    for f, mx in fam_limits
-                ]
-                if fam_limits
-                else None
-            ),
-            restr_state=(
-                (
-                    jax.lax.dynamic_slice_in_dim(slot_vol, start, B, 0),
-                    jax.lax.dynamic_slice_in_dim(slot_ro, start, B, 0),
-                    extra.vol_any.shape[0],
-                )
-                if check_restr
-                else None
-            ),
-        )
-        committed = accept & (choice >= 0)
-        n_b = jnp.maximum(choice, 0)  # (B,)
-        carry_nodes = apply_placements(
-            carry_nodes, pod_block, jnp.where(committed, choice, -1)
-        )
 
-        if track_combos:
-            # -- batched combo-count updates over the whole block ---------
-            keys = extra.combo_key  # (C,)
-            C = keys.shape[0]
-            D = extra.topo_onehot.shape[1]
-            # (B, C) matches of committed pods
-            pmc = extra_b.pod_matches_combo & committed[:, None]
-            d_cb = extra.topo_domain[keys[:, None], n_b[None, :]]  # (C, B)
-            has = d_cb != D
-            uniq = extra.topo_unique[keys]  # (C,)
-            # zone-like keys: accumulate per-domain counts then expand
-            # through the onehot planes (one einsum instead of B dense
-            # (C, N) domain masks)
-            zone_ok = has & ~uniq[:, None] & pmc.T  # (C, B)
-            w_cd = jnp.sum(
-                zone_ok[:, :, None]
-                & (
-                    jnp.arange(D)[None, None, :]
-                    == jnp.minimum(d_cb, D - 1)[:, :, None]
+        def skip_step(carry):
+            # fully-padded trailing block (capacity tier > pod count):
+            # the whole evaluate/commit body would be masked no-ops
+            return carry, (
+                jnp.full((B,), -1, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool),
+            )
+
+        def live_step(carry):
+            carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf = carry
+            reps = {}
+            if track_combos:
+                reps.update(
+                    combo_dsum=dsum, combo_here=here, combo_global=glob,
+                    combo_excl=excl, rev_weight=revw,
+                )
+            if track_vols:
+                reps.update(vol_any=va, vol_rw=vr, node_vols_fam=nvf)
+            extra_b = dataclasses.replace(
+                _slice_extra_rows(extra, start, B), **reps
+            )
+            result = evaluate(
+                pod_block, carry_nodes, filter_plugins, pre_score_plugins,
+                score_plugins, ctx, extra=extra_b,
+                static=_slice_static(start),
+            )
+            choice = result.choice  # (B,)
+            accept = accept_placements(
+                carry_nodes, pod_block, choice, pod_block.valid,
+                check_resources=check_resources, check_ports=check_ports,
+                vol_state=(
+                    [
+                        (extra_b.pod_vols_fam[:, f], nvf[f], mx)
+                        for f, mx in fam_limits
+                    ]
+                    if fam_limits
+                    else None
                 ),
-                axis=1,
-                dtype=dsum.dtype,
-            )  # (C, D)
-            dsum = dsum + jnp.einsum(
-                "cd,cdn->cn", w_cd, extra.topo_onehot[keys].astype(dsum.dtype)
-            )
-            # hostname-like (unique) keys: the domain is the node itself
-            uniq_add = (uniq[:, None] & has & pmc.T).astype(dsum.dtype)  # (C, B)
-            dsum = dsum.at[:, n_b].add(uniq_add)
-            here = here.at[:, n_b].add(pmc.T.astype(here.dtype))
-            glob = glob + jnp.sum(pmc, axis=0).astype(glob.dtype)
-
-            # -- per-pod scatter updates (anti-affinity exclusion, rev
-            # weights), batched over the block: gather each pod's term
-            # combos' domain masks at its landing node — (B, A, N) — and
-            # commit them in ONE scatter per plane.  add/max scatters
-            # accumulate duplicate rows correctly, and block pods read
-            # the PRE-block planes (evaluate above), so the batch equals
-            # the member-by-member order.  The unrolled form emitted
-            # ~B×3 scatter kernels per step and dominated the step wall.
-            def _dom_at(combo_rows, nb):
-                # (B, K, N) domain masks of combo ``combo_rows[j, k]``
-                # at node ``nb[j]``
-                keys_r = extra.combo_key[combo_rows]  # (B, K)
-                D_ = extra.topo_onehot.shape[1]
-                d_r = extra.topo_domain[keys_r, nb[:, None]]  # (B, K)
-                has_r = d_r != D_
-                dom_r = extra.topo_onehot[
-                    keys_r, jnp.minimum(d_r, D_ - 1)
-                ]  # (B, K, N)
-                uniq_r = extra.topo_unique[keys_r]  # (B, K)
-                onehot_nb = (
-                    jnp.arange(dom_r.shape[-1])[None, :] == nb[:, None]
-                )  # (B, N)
-                return (
-                    jnp.where(
-                        uniq_r[..., None], onehot_nb[:, None, :], dom_r
+                restr_state=(
+                    (
+                        jax.lax.dynamic_slice_in_dim(slot_vol, start, B, 0),
+                        jax.lax.dynamic_slice_in_dim(slot_ro, start, B, 0),
+                        extra.vol_any.shape[0],
                     )
-                    & has_r[..., None]
+                    if check_restr
+                    else None
+                ),
+            )
+            committed = accept & (choice >= 0)
+            n_b = jnp.maximum(choice, 0)  # (B,)
+            carry_nodes = apply_placements(
+                carry_nodes, pod_block, jnp.where(committed, choice, -1)
+            )
+
+            if track_combos:
+                # -- combo-count updates as matmul chains: each committed
+                # pod's landing node defines, per topology key, a one-hot
+                # domain row; (K, B, D) one-hots matmul through the
+                # hoisted (K, D, N) planes into per-pod domain masks, and
+                # a second matmul distributes them onto the (C, N)
+                # planes.  The former per-combo einsum read the full
+                # (C, D, N) one-hot (~21MB/step); this reads (K, D, N)
+                # once and rides the MXU (~5MB/step at K=4).
+                pmc = extra_b.pod_matches_combo & committed[:, None]  # (B, C)
+                d_kb = extra.topo_domain[:, n_b]  # (K, B)
+                has_kb = d_kb != D
+                oh_kbd = (
+                    (d_kb[..., None] == jnp.arange(D)) & has_kb[..., None]
+                ).astype(jnp.float32)  # (K, B, D)
+                dom_kbn = jnp.einsum(
+                    "kbd,kdn->kbn", oh_kbd, onehot_f
+                )  # (K, B, N) — pod j's domain mask under key k
+                has = jnp.einsum("kc,kb->cb", key_oh, has_kb.astype(
+                    jnp.float32)) > 0  # (C, B) — selects each combo's key
+                zone_ok = has & ~uniq_c[:, None] & pmc.T  # (C, B)
+                zkc = zone_ok.astype(jnp.float32)[None] * key_oh[
+                    :, :, None
+                ]  # (K, C, B)
+                dsum = dsum + jnp.einsum(
+                    "kcb,kbn->cn", zkc, dom_kbn
+                ).astype(dsum.dtype)
+                # hostname-like (unique) keys: the domain is the node itself
+                uniq_add = (uniq_c[:, None] & has & pmc.T).astype(dsum.dtype)
+                dsum = dsum.at[:, n_b].add(uniq_add)
+                here = here.at[:, n_b].add(pmc.T.astype(here.dtype))
+                glob = glob + jnp.sum(pmc, axis=0).astype(glob.dtype)
+
+                def _term_chain(combo_rows, weights_z, valid):
+                    # Σ over a pod's terms: weighted (C, B) membership by
+                    # combo, split zone-like vs unique, then the zone part
+                    # matmuls through the per-pod domain masks onto (C, N).
+                    # Precision.HIGHEST: summed weights exceed 256, and the
+                    # TPU default would feed them to the MXU as bf16
+                    row_oh = (
+                        combo_rows[..., None] == arange_c
+                    )  # (B, T, C) — tiny
+                    u_r = uniq_c[combo_rows]  # (B, T)
+                    wz = jnp.where(valid & ~u_r, weights_z, 0).astype(
+                        jnp.float32
+                    )
+                    m_cb = jnp.einsum(
+                        "btc,bt->cb", row_oh.astype(jnp.float32), wz,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )  # (C, B) zone-weight by combo
+                    mk = m_cb[None] * key_oh[:, :, None]  # (K, C, B)
+                    inc = jnp.einsum(
+                        "kcb,kbn->cn", mk, dom_kbn,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )  # (C, N)
+                    return inc, (valid & u_r)
+
+                # the committed pod's required anti-affinity terms ban
+                # matchers from its landing domain
+                pan_c = extra_b.pan_combo  # (B, A)
+                pan_in = (
+                    jnp.arange(A)[None, :] < extra_b.pan_n[:, None]
+                ) & committed[:, None]
+                pan_has = extra.topo_domain[keys[pan_c], n_b[:, None]] != D
+                inc, vu = _term_chain(
+                    pan_c, jnp.ones_like(pan_c), pan_in & pan_has
                 )
+                excl = excl | (inc > 0)
+                excl = excl.at[
+                    pan_c, jnp.broadcast_to(n_b[:, None], pan_c.shape)
+                ].max(vu)
 
-            N_ = dsum.shape[1]
-            pan_c = extra_b.pan_combo  # (B, A)
-            pan_in = (
-                jnp.arange(A)[None, :] < extra_b.pan_n[:, None]
-            ) & committed[:, None]
-            excl = excl.at[pan_c.reshape(-1)].max(
-                (pan_in[..., None] & _dom_at(pan_c, n_b)).reshape(-1, N_)
-            )
-            ppa_c = extra_b.ppa_combo  # (B, W)
-            ppa_in = (
-                jnp.arange(W)[None, :] < extra_b.ppa_n[:, None]
-            ) & committed[:, None]
-            revw = revw.at[ppa_c.reshape(-1)].add(
-                (
-                    jnp.where(ppa_in, extra_b.ppa_w, 0)[..., None]
-                    * _dom_at(ppa_c, n_b).astype(revw.dtype)
-                ).reshape(-1, N_)
-            )
-            pa_c = extra_b.pa_combo  # (B, PA)
-            pa_in = (
-                jnp.arange(PA)[None, :] < extra_b.pa_n[:, None]
-            ) & committed[:, None]
-            revw = revw.at[pa_c.reshape(-1)].add(
-                (
-                    jnp.where(pa_in, HARD_POD_AFFINITY_WEIGHT, 0)[..., None]
-                    * _dom_at(pa_c, n_b).astype(revw.dtype)
-                ).reshape(-1, N_)
-            )
-
-        if track_vols:
-            # batched volume-plane commit (same math as the repair round,
-            # over the block): disjointness guarantees no two block pods
-            # share a volume, so per-pod scatters never collide
-            sc = jax.lax.dynamic_slice_in_dim(slot_cnt, start, B, 0)
-            sv = jax.lax.dynamic_slice_in_dim(slot_vol, start, B, 0)
-            sro = jax.lax.dynamic_slice_in_dim(slot_ro, start, B, 0)
-            sfam = jax.lax.dynamic_slice_in_dim(slot_fam, start, B, 0)
-            sdup = jax.lax.dynamic_slice_in_dim(slot_dup, start, B, 0)
-            attached = va[jnp.maximum(sc, 0), n_b[:, None]]  # (B, V)
-            new_slot = committed[:, None] & (sc >= 0) & ~sdup & ~attached
-            for f in range(F):
-                counts_f = jnp.sum(
-                    new_slot & (sfam == f), axis=1, dtype=nvf.dtype
+                # symmetric scoring: preferred terms (signed weight) and
+                # required-affinity terms (hard weight) in one signed-add
+                # increment
+                rev_rows = jnp.concatenate(
+                    [extra_b.ppa_combo, extra_b.pa_combo], axis=1
+                )  # (B, W + PA)
+                ppa_in = (
+                    jnp.arange(W)[None, :] < extra_b.ppa_n[:, None]
+                ) & committed[:, None]
+                pa_in = (
+                    jnp.arange(PA)[None, :] < extra_b.pa_n[:, None]
+                ) & committed[:, None]
+                rev_in = jnp.concatenate([ppa_in, pa_in], axis=1)
+                rev_w = jnp.concatenate(
+                    [
+                        extra_b.ppa_w,
+                        jnp.full(
+                            (B, PA), HARD_POD_AFFINITY_WEIGHT,
+                            extra_b.ppa_w.dtype,
+                        ),
+                    ],
+                    axis=1,
                 )
-                nvf = nvf.at[f, n_b].add(counts_f)
-            nvf = nvf.at[0, n_b].add(
-                jnp.where(committed, extra_b.pod_missing, 0)
-            )
-            rows = jnp.where(committed[:, None] & (sc >= 0), sc, dummy_row)
-            cols = jnp.broadcast_to(n_b[:, None], rows.shape)
-            va = va.at[rows, cols].set(True)
-            rw_rows = jnp.where(
-                committed[:, None] & (sv >= 0) & ~sro, sv, dummy_row
-            )
-            vr = vr.at[rw_rows, cols].set(True)
+                rev_has = (
+                    extra.topo_domain[keys[rev_rows], n_b[:, None]] != D
+                )
+                inc, vu = _term_chain(rev_rows, rev_w, rev_in & rev_has)
+                revw = revw + inc.astype(revw.dtype)
+                revw = revw.at[
+                    rev_rows,
+                    jnp.broadcast_to(n_b[:, None], rev_rows.shape),
+                ].add(jnp.where(vu, rev_w, 0).astype(revw.dtype))
 
-        carry = (carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf)
-        return carry, (choice, result.best_score, accept)
+            if track_vols:
+                # batched volume-plane commit (same math as the repair
+                # round, over the block): disjointness guarantees no two
+                # block pods share a volume, so per-pod scatters never
+                # collide
+                sc = jax.lax.dynamic_slice_in_dim(slot_cnt, start, B, 0)
+                sv = jax.lax.dynamic_slice_in_dim(slot_vol, start, B, 0)
+                sro = jax.lax.dynamic_slice_in_dim(slot_ro, start, B, 0)
+                sfam = jax.lax.dynamic_slice_in_dim(slot_fam, start, B, 0)
+                sdup = jax.lax.dynamic_slice_in_dim(slot_dup, start, B, 0)
+                attached = va[jnp.maximum(sc, 0), n_b[:, None]]  # (B, V)
+                new_slot = committed[:, None] & (sc >= 0) & ~sdup & ~attached
+                for f in range(F):
+                    counts_f = jnp.sum(
+                        new_slot & (sfam == f), axis=1, dtype=nvf.dtype
+                    )
+                    nvf = nvf.at[f, n_b].add(counts_f)
+                nvf = nvf.at[0, n_b].add(
+                    jnp.where(committed, extra_b.pod_missing, 0)
+                )
+                rows = jnp.where(
+                    committed[:, None] & (sc >= 0), sc, dummy_row
+                )
+                cols = jnp.broadcast_to(n_b[:, None], rows.shape)
+                va = va.at[rows, cols].set(True)
+                rw_rows = jnp.where(
+                    committed[:, None] & (sv >= 0) & ~sro, sv, dummy_row
+                )
+                vr = vr.at[rw_rows, cols].set(True)
+
+            carry = (carry_nodes, dsum, here, glob, excl, revw, va, vr, nvf)
+            return carry, (choice, result.best_score, accept)
+
+        return jax.lax.cond(
+            jnp.any(pod_block.valid), live_step, skip_step, carry
+        )
 
     carry0 = (
         nodes,

@@ -239,18 +239,27 @@ class PodTopologySpread(Plugin, BatchEvaluable):
                 key = extra.combo_key[combo]  # (P,)
                 unique = extra.topo_unique[key]  # (P,)
                 # zone-like path: per-domain sums via the MXU, then select
-                # each pod's key row and gather per-node domain sums back
+                # each pod's key row and EXPAND per-node domain sums back
+                # through the same one-hot — a (P, N) take_along_axis here
+                # lowered to a per-element scalar-core gather that was 67%
+                # of the blocked scan's step wall; the matmul form stays
+                # on the MXU and is exact (counts < 2²⁴ in f32).  Keyless
+                # nodes get 0 instead of an arbitrary row — masked out by
+                # ``haskey`` either way.
                 a_all = dot(x.astype(jnp.float32), onehot_t).reshape(P, K, D)
-                A = jnp.take_along_axis(
-                    a_all, key[:, None, None], axis=1
-                )[:, 0].astype(jnp.int32)  # (P, D)
-                exists = jnp.take_along_axis(
-                    e_all, key[:, None, None], axis=1
-                )[:, 0]  # (P, D)
-                dom = extra.topo_domain[key]  # (P, N); == D when keyless
-                dsum_z = jnp.take_along_axis(
-                    A, jnp.minimum(dom, D - 1), axis=1
-                )  # (P, N)
+                key_oh = (
+                    key[:, None] == jnp.arange(K)[None, :]
+                ).astype(jnp.float32)  # (P, K)
+                A = jnp.einsum(
+                    "pkd,pk->pd", a_all, key_oh,
+                    precision=jax.lax.Precision.HIGHEST,
+                ).astype(jnp.int32)  # (P, D)
+                exists = jnp.einsum(
+                    "pkd,pk->pd", e_all.astype(jnp.float32), key_oh,
+                    precision=jax.lax.Precision.HIGHEST,
+                ) > 0  # (P, D)
+                a_key = (a_all * key_oh[:, :, None]).reshape(P, K * D)
+                dsum_z = dot(a_key, onehot_t.T).astype(jnp.int32)  # (P, N)
                 m_z = jnp.min(jnp.where(exists, A, _INF), axis=1)  # (P,)
                 # hostname-like path: every domain is one node
                 dsum_u = x

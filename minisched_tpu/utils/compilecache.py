@@ -31,6 +31,14 @@ def _machine_key() -> str:
     potential SIGILL.  Namespacing the cache directory by (arch, CPU
     flags) makes cross-machine loads impossible while same-type hosts
     still share everything.
+
+    Even with matching real features, XLA:CPU loads still log a
+    mismatch for the pseudo-features ``+prefer-no-gather`` /
+    ``+prefer-no-scatter`` — compile-side options the load-side CPUID
+    detection never reports.  Those lines are benign (the executable
+    loads and runs; the whole test suite passes off cached entries);
+    only *real* ISA flags can SIGILL, and those are covered by this
+    digest.
     """
     flags = ""
     try:

@@ -1,0 +1,87 @@
+"""Profile the scan lane's host-side constraint build on c5x shapes.
+
+10k nodes, one 4096-cap chunk of spread-constrained pods (32 apps x 16
+zones), packed mode (device=False, elide_zeros=False) — the exact call
+the blocked lane makes per chunk.  Scratch tool, not part of the bench.
+"""
+import cProfile
+import os
+import pstats
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from minisched_tpu.api.objects import (
+    LabelSelector,
+    TopologySpreadConstraint,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import pad_to
+
+N_NODES = int(os.environ.get("P_NODES", 10_000))
+CAP = int(os.environ.get("P_CAP", 4096))
+N_PODS = int(os.environ.get("P_PODS", 4096))
+N_APPS = 32
+N_ZONES = 16
+
+nodes = []
+for i in range(N_NODES):
+    nodes.append(
+        make_node(
+            f"node-{i:05d}",
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "110"},
+            labels={
+                "zone": f"z{i % N_ZONES}",
+                "kubernetes.io/hostname": f"node-{i:05d}",
+            },
+        )
+    )
+
+pods = []
+for i in range(N_PODS):
+    app = f"app{i % N_APPS}"
+    p = make_pod(
+        f"spread-{i:05d}",
+        requests={"cpu": "100m", "memory": "128Mi"},
+        labels={"app": app},
+    )
+    p.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=4,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+    ]
+    pods.append(p)
+
+NCAP = pad_to(len(nodes))
+
+t0 = time.monotonic()
+extra = build_constraint_tables(
+    pods, nodes, [], pod_capacity=CAP, node_capacity=NCAP,
+    scan_planes=True, device=False, elide_zeros=False,
+)
+print(f"cold build: {time.monotonic() - t0:.3f}s")
+
+for _ in range(2):
+    t0 = time.monotonic()
+    extra = build_constraint_tables(
+        pods, nodes, [], pod_capacity=CAP, node_capacity=NCAP,
+        scan_planes=True, device=False, elide_zeros=False,
+    )
+    print(f"warm build: {time.monotonic() - t0:.3f}s")
+
+prof = cProfile.Profile()
+prof.enable()
+extra = build_constraint_tables(
+    pods, nodes, [], pod_capacity=CAP, node_capacity=NCAP,
+    scan_planes=True, device=False, elide_zeros=False,
+)
+prof.disable()
+stats = pstats.Stats(prof)
+stats.sort_stats("cumulative").print_stats(25)

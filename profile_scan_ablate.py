@@ -1,0 +1,149 @@
+"""Ablate the blocked-scan step to locate the per-step wall.
+
+Variants, each a 32-step lax.scan over 32-pod blocks at 10k nodes:
+  eval      — evaluate() only, carry = nodes (no commits)
+  +apply    — evaluate + apply_placements
+  +accept   — evaluate + accept_placements + apply
+  full      — the real blocked_scan_schedule (spread-only flags)
+Scratch tool, not part of the bench.
+"""
+import os
+import time
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minisched_tpu.api.objects import (
+    LabelSelector,
+    TopologySpreadConstraint,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.models.constraints import (
+    POD_AXIS_FIELDS,
+    build_constraint_tables,
+)
+from minisched_tpu.ops.fused import BatchContext, evaluate
+from minisched_tpu.ops.repair import accept_placements
+from minisched_tpu.ops.sequential import (
+    BlockedSequentialScheduler,
+    _slice_extra_rows,
+    _slice_pods,
+)
+from minisched_tpu.ops.state import apply_placements
+from minisched_tpu.plugins.registry import build_plugins
+from minisched_tpu.service.config import default_full_roster_config
+
+N_NODES = int(os.environ.get("P_NODES", 10_000))
+CAP = int(os.environ.get("P_CAP", 1024))
+B = 32
+
+nodes = []
+for i in range(N_NODES):
+    nodes.append(
+        make_node(
+            f"node-{i:05d}",
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "110"},
+            labels={
+                "zone": f"z{i % 16}",
+                "kubernetes.io/hostname": f"node-{i:05d}",
+            },
+        )
+    )
+
+pods = []
+for i in range(CAP):
+    app = f"app{i % 32}"
+    p = make_pod(
+        f"spread-{i:05d}",
+        requests={"cpu": "100m", "memory": "128Mi"},
+        labels={"app": app},
+    )
+    p.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=4,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+    ]
+    pods.append(p)
+
+cfg = default_full_roster_config()
+chains = build_plugins(cfg)
+ctx = BatchContext(weights=tuple(sorted(cfg.score_weights().items())),
+                   in_scan=True)
+
+node_table, names = build_node_table(nodes)
+pod_table, _ = build_pod_table(pods, capacity=CAP)
+extra = build_constraint_tables(
+    pods, nodes, [], pod_capacity=CAP, node_capacity=node_table.capacity,
+    scan_planes=True,
+)
+
+filters, pres, scores = (
+    tuple(chains.filter), tuple(chains.pre_score), tuple(chains.score)
+)
+
+
+def make_variant(mode):
+    def step(carry_nodes, b):
+        start = b * B
+        pod_block = _slice_pods(pod_table, start, B)
+        extra_b = _slice_extra_rows(extra, start, B)
+        result = evaluate(
+            pod_block, carry_nodes, filters, pres, scores, ctx, extra=extra_b
+        )
+        choice = result.choice
+        if mode == "eval":
+            return carry_nodes, choice
+        if mode == "+accept":
+            acc = accept_placements(
+                carry_nodes, pod_block, choice, pod_block.valid,
+                check_resources=True, check_ports=True,
+            )
+            choice = jnp.where(acc, choice, -1)
+        carry_nodes = apply_placements(carry_nodes, pod_block, choice)
+        return carry_nodes, choice
+
+    @jax.jit
+    def run(nt):
+        _, ch = jax.lax.scan(step, nt, jnp.arange(CAP // B))
+        return ch
+
+    return run
+
+
+for mode in ("eval", "+apply", "+accept"):
+    fn = make_variant(mode)
+    ch = fn(node_table)
+    jax.block_until_ready(ch)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.monotonic()
+        ch = fn(node_table)
+        jax.block_until_ready(ch)
+        best = min(best, time.monotonic() - t0)
+    print(f"{mode:8s}: {best*1000:7.1f}ms = {best/(CAP//B)*1000:.2f}ms/step")
+
+blocked = BlockedSequentialScheduler(
+    filters, pres, scores, weights=cfg.score_weights(), block_size=B
+)
+nt, choice, _, acc = blocked(pod_table, node_table, extra)
+jax.block_until_ready(choice)
+best = 1e9
+for _ in range(3):
+    t0 = time.monotonic()
+    nt, choice, _, acc = blocked(pod_table, node_table, extra)
+    jax.block_until_ready(choice)
+    best = min(best, time.monotonic() - t0)
+print(f"full    : {best*1000:7.1f}ms = {best/(CAP//B)*1000:.2f}ms/step "
+      f"(placed={int((np.asarray(choice)>=0).sum())})")

@@ -1,0 +1,124 @@
+"""Capture a profiler trace of one blocked-scan chunk and print the top
+device ops.  Scratch tool, not part of the bench."""
+import glob
+import gzip
+import json
+import os
+import time
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import numpy as np
+
+from minisched_tpu.api.objects import (
+    LabelSelector,
+    TopologySpreadConstraint,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.models.tables import build_node_table, build_pod_table
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.ops.sequential import BlockedSequentialScheduler
+from minisched_tpu.plugins.registry import build_plugins
+from minisched_tpu.service.config import default_full_roster_config
+
+N_NODES = int(os.environ.get("P_NODES", 10_000))
+CAP = int(os.environ.get("P_CAP", 1024))
+B = 32
+
+nodes = []
+for i in range(N_NODES):
+    nodes.append(
+        make_node(
+            f"node-{i:05d}",
+            capacity={"cpu": "8", "memory": "32Gi", "pods": "110"},
+            labels={
+                "zone": f"z{i % 16}",
+                "kubernetes.io/hostname": f"node-{i:05d}",
+            },
+        )
+    )
+pods = []
+for i in range(CAP):
+    app = f"app{i % 32}"
+    p = make_pod(
+        f"spread-{i:05d}",
+        requests={"cpu": "100m", "memory": "128Mi"},
+        labels={"app": app},
+    )
+    p.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=4,
+            topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+    ]
+    pods.append(p)
+
+cfg = default_full_roster_config()
+chains = build_plugins(cfg)
+node_table, _ = build_node_table(nodes)
+pod_table, _ = build_pod_table(pods, capacity=CAP)
+extra = build_constraint_tables(
+    pods, nodes, [], pod_capacity=CAP, node_capacity=node_table.capacity,
+    scan_planes=True,
+)
+blocked = BlockedSequentialScheduler(
+    chains.filter, chains.pre_score, chains.score,
+    weights=cfg.score_weights(), block_size=B,
+)
+_, choice, _, _ = blocked(pod_table, node_table, extra)
+jax.block_until_ready(choice)
+
+logdir = "/tmp/scan_trace"
+os.system(f"rm -rf {logdir}")
+with jax.profiler.trace(logdir):
+    _, choice, _, _ = blocked(pod_table, node_table, extra)
+    jax.block_until_ready(choice)
+
+# parse the trace: top device ops by self time
+pb = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+print("xplane:", pb)
+from xprof.convert import raw_to_tool_data as rtd
+
+data, _ = rtd.xspace_to_tool_data(pb, "op_profile", {})
+prof = json.loads(data)
+
+
+def walk(node, depth=0, out=None):
+    m = node.get("metrics", {})
+    name = node.get("name", "")
+    t = m.get("rawTime", 0) or 0
+    out.append((t, name, depth))
+    for ch in node.get("children", []):
+        walk(ch, depth + 1, out)
+    return out
+
+
+root = prof.get("byProgram") or prof.get("byCategory")
+rows = walk(root, 0, [])
+rows.sort(reverse=True)
+total = rows[0][0] if rows else 1
+for t, name, depth in rows[:40]:
+    print(f"{t/1e9*1000:9.3f}ms  d{depth}  {name[:110]}")
+
+# dump optimized HLO and locate the hot fusions
+lowered = blocked._jit_fn(False, False).lower(node_table, pod_table, extra=extra)
+txt = lowered.compile().as_text()
+import re
+for fname in ("fusion.370", "reduce_max.71", "fusion.168", "fusion.78"):
+    m = re.search(rf"^\s*%?{re.escape(fname)} = .*$", txt, re.M)
+    print("\n===", fname, "===")
+    if m:
+        print(m.group(0)[:600])
+        # and the computation it calls
+        cm = re.search(r"calls=([%\w.\-]+)", m.group(0))
+        if cm:
+            comp = cm.group(1).lstrip("%")
+            cdef = re.search(rf"^%?{re.escape(comp)} [^\n]*\{{.*?^\}}", txt, re.M | re.S)
+            if cdef:
+                print(cdef.group(0)[:3000])

@@ -276,3 +276,58 @@ def test_new_combo_backfills_existing_population(live_index):
     ]
     inc, scratch = _build_both(client, index, [late])
     _assert_equal(inc, scratch)
+
+
+def test_signature_tables_recycle_under_unique_label_churn(live_index):
+    """StatefulSet-like populations (a unique label per pod) must not
+    grow the signature tables one entry per pod ever assigned: freed
+    signature ids are recycled, reps are namespace/labels shims (no pod
+    object retained), and a combo registered after heavy churn still
+    backfills correctly over whatever is live."""
+    client, factory, index = live_index
+    nodes = [
+        make_node(f"node{i:03d}", labels={"zone": f"z{i % 2}"})
+        for i in range(6)
+    ]
+    for n in nodes:
+        client.nodes().create(n)
+    # three generations of unique-labeled pods; each fully replaced
+    for gen in range(3):
+        for i in range(25):
+            p = make_pod(
+                f"ss-{gen}-{i:02d}",
+                labels={"pod-name": f"ss-{gen}-{i:02d}", "app": "ss"},
+            )
+            p.spec.node_name = nodes[i % len(nodes)].metadata.name
+            client.pods().create(p)
+        _wait(
+            lambda: len(index.assigned_uids()) == 25,
+            what=f"gen {gen} sync",
+        )
+        if gen < 2:
+            for i in range(25):
+                client.pods().delete(f"ss-{gen}-{i:02d}")
+            _wait(
+                lambda: len(index.assigned_uids()) == 0,
+                what=f"gen {gen} drain",
+            )
+    # live signatures ≤ live pods; freed ids were recycled, not appended
+    live_sigs = sum(1 for r in index._sig_rep if r is not None)
+    assert live_sigs <= 25
+    assert len(index._sig_rep) <= 50  # bounded by peak, not total churn
+    # reps are shims, not pods (no spec to pin)
+    assert all(
+        not hasattr(r, "spec") for r in index._sig_rep if r is not None
+    )
+    # a combo first queried NOW must backfill over the live generation
+    late = make_pod("late", labels={"team": "x"})
+    late.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="zone",
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": "ss"}),
+        )
+    ]
+    inc, scratch = _build_both(client, index, [late])
+    _assert_equal(inc, scratch)

@@ -413,3 +413,152 @@ def test_blocked_scan_lane_under_mesh():
         assert all(v <= 8000 for v in cpu.values())
     finally:
         svc.shutdown_scheduler()
+
+
+def test_scan_backlog_flushes_within_wave_bound():
+    """A sustained stream of FULL plain waves must not starve deferred
+    cross-pod pods: the backlog flushes after SCAN_DEFER_MAX_WAVES even
+    though neither a partial pop, a drain, nor the size threshold
+    arrives while plain pods keep coming."""
+    from minisched_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+
+    client = Client()
+    for i in range(16):
+        client.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                labels={"zone": f"z{i % 4}"},
+                capacity={"cpu": "64", "memory": "256Gi", "pods": 500},
+            )
+        )
+    cfg = default_full_roster_config()
+    svc = SchedulerService(client)
+    # max_wave=8: a couple hundred plain pods sustain full waves long
+    # enough that only the wave-count bound can flush the one spread pod
+    svc.start_scheduler(cfg, device_mode=True, max_wave=8)
+    try:
+        spread = make_pod(
+            "spread-first", labels={"app": "s"},
+            requests={"cpu": "100m", "memory": "64Mi"},
+        )
+        spread.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=2, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "s"}),
+            )
+        ]
+        client.pods().create(spread)
+        for i in range(240):
+            client.pods().create(
+                make_pod(
+                    f"plain{i:03d}",
+                    requests={"cpu": "100m", "memory": "64Mi"},
+                )
+            )
+        # the spread pod must bind while plain pods are STILL flowing —
+        # record how many remained unbound the moment it landed (a flush
+        # that only happened at drain would leave zero)
+        state = {}
+
+        def spread_bound():
+            if not client.pods().get("spread-first").spec.node_name:
+                return False
+            if "plain_left" not in state:
+                state["plain_left"] = sum(
+                    1
+                    for i in range(240)
+                    if not client.pods().get(f"plain{i:03d}").spec.node_name
+                )
+            return True
+
+        assert _wait(spread_bound, timeout=300.0), "deferred pod starved"
+        assert state["plain_left"] > 0, (
+            "spread pod only bound at drain — the wave-count bound did "
+            "not flush the backlog"
+        )
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_flush_drops_deleted_and_refreshes_updated_backlog_pods():
+    """The deferral window is wide enough for deletes/updates to land
+    while a constrained pod sits in _scan_backlog — flush must drop the
+    gone and schedule the changed from their CURRENT spec, not the
+    popped snapshot (the queue's own update/delete handling can't reach
+    popped pods)."""
+    from minisched_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+    from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+
+    client = Client()
+    for i in range(8):
+        client.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                labels={"zone": f"z{i % 2}", "tier": "a" if i == 7 else "b"},
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+        )
+    cfg = default_full_roster_config()
+    svc = SchedulerService(client)
+    svc.start_scheduler(cfg, device_mode=True, max_wave=8)
+    try:
+        sched = svc.scheduler
+
+        def spread(name):
+            p = make_pod(
+                name, labels={"app": "s"},
+                requests={"cpu": "100m", "memory": "64Mi"},
+            )
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=4, topology_key="zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": "s"}),
+                )
+            ]
+            return p
+
+        # "deleted while deferred": snapshot taken, then removed from the
+        # store before the flush
+        ghost = spread("ghost")
+        client.pods().create(ghost)
+        ghost_snap = client.pods().get("ghost").clone()
+        client.pods().delete("ghost")
+        # "updated while deferred": the live spec now pins to node007
+        upd = spread("upd")
+        client.pods().create(upd)
+        snap = client.pods().get("upd").clone()
+        live = client.pods().get("upd").clone()
+        live.spec.node_selector = {"tier": "a"}
+        client.pods().update(live)
+
+        # flush validates against the informer cache — wait for it to
+        # reflect the delete/update (dispatch thread), as it would have
+        # by any real flush point
+        pod_inf = sched.informer_factory.informer_for("Pod")
+        def informer_caught_up():
+            upd_cached = pod_inf.get("default/upd")
+            return (
+                pod_inf.get("default/ghost") is None
+                and upd_cached is not None
+                and upd_cached.metadata.resource_version
+                == client.pods().get("upd").metadata.resource_version
+            )
+
+        assert _wait(informer_caught_up)
+        sched._scan_backlog = [
+            QueuedPodInfo(pod_info=PodInfo(pod=ghost_snap)),
+            QueuedPodInfo(pod_info=PodInfo(pod=snap)),
+        ]
+        sched._flush_scan_backlog()
+        assert _wait(
+            lambda: client.pods().get("upd").spec.node_name, timeout=120.0
+        )
+        # the updated pod scheduled from its CURRENT spec (tier=a pins
+        # node007); the deleted one was dropped, not parked as a zombie
+        assert client.pods().get("upd").spec.node_name == "node007"
+        stats = sched.queue.stats()
+        assert stats.get("unschedulable", 0) == 0, stats
+    finally:
+        svc.shutdown_scheduler()
