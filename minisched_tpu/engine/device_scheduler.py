@@ -32,7 +32,10 @@ from minisched_tpu.framework.types import (
     QueuedPodInfo,
     Status,
 )
-from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.constraints import (
+    SCAN_ELIDE_GROUPS,
+    build_constraint_tables,
+)
 from minisched_tpu.models.tables import (
     CachedNodeTableBuilder,
     build_pod_table,
@@ -479,30 +482,106 @@ class DeviceScheduler(Scheduler):
             if packed_mode:
                 # scan chunks carry cross-pod pods, which are never
                 # "simple" — the live schema is the SLOW pod table; warm
-                # exactly that packed entry per chunk capacity
+                # exactly that packed entry per chunk capacity.  The
+                # blocked lane's schema also depends on which
+                # SCAN_ELIDE_GROUPS the chunk's workload leaves all-zero:
+                # warm its two common corners — a spread-only burst
+                # (affinity + volume groups elided) and the kitchen sink
+                # (nothing elided); a mixed burst in between compiles
+                # once mid-run and persists in the compile cache.
+                from minisched_tpu.api.objects import (
+                    Affinity,
+                    LabelSelector,
+                    PodAffinity,
+                    PodAffinityTerm,
+                    PodAntiAffinity,
+                    TopologySpreadConstraint,
+                    WeightedPodAffinityTerm,
+                )
+
+                def _spread(name):
+                    p = make_pod(
+                        name, requests={"cpu": "1"}, labels={"app": "warm"}
+                    )
+                    p.spec.topology_spread_constraints = [
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key="warmzone",
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector(
+                                match_labels={"app": "warm"}
+                            ),
+                        )
+                    ]
+                    return p
+
+                sink_pod = _spread("warmsink")
+                sel = LabelSelector(match_labels={"app": "warm"})
+                sink_pod.spec.affinity = Affinity(
+                    pod_affinity=PodAffinity(
+                        required=[
+                            PodAffinityTerm(
+                                label_selector=sel, topology_key="warmzone"
+                            )
+                        ],
+                        preferred=[
+                            WeightedPodAffinityTerm(
+                                weight=1,
+                                term=PodAffinityTerm(
+                                    label_selector=sel,
+                                    topology_key="warmzone",
+                                ),
+                            )
+                        ],
+                    ),
+                    pod_anti_affinity=PodAntiAffinity(
+                        required=[
+                            PodAffinityTerm(
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "other"}
+                                ),
+                                topology_key="warmzone",
+                            )
+                        ]
+                    ),
+                )
+                sink_pod.spec.volumes = ["warmclaim"]
+                blocked_sets = ([_spread("warmspread")], [sink_pod])
                 for cap in all_caps:
-                    scan_pods, _ = build_pod_table(
-                        pods + [complex_pod], capacity=cap, device=False
-                    )
-                    scan_extra = build_constraint_tables(
-                        pods + [complex_pod], nodes, [],
-                        pod_capacity=cap,
-                        node_capacity=node_capacity,
-                        scan_planes=True, device=False,
-                        elide_zeros=False,
-                    )
                     if cap in scan_caps:
+                        scan_pods, _ = build_pod_table(
+                            pods + [complex_pod], capacity=cap, device=False
+                        )
+                        scan_extra = build_constraint_tables(
+                            pods + [complex_pod], nodes, [],
+                            pod_capacity=cap,
+                            node_capacity=node_capacity,
+                            scan_planes=True, device=False,
+                            elide_zeros=False,
+                        )
                         _, choice, _ = self._get_scan_scheduler().call_packed(
                             scan_pods, node_static, node_agg, scan_extra
                         )
                         jax.block_until_ready(choice)
                     if cap in blocked_caps:
-                        _, bc, _, _ = (
-                            self._get_blocked_scheduler().call_packed(
-                                scan_pods, node_static, node_agg, scan_extra
+                        for warm_set in blocked_sets:
+                            bp, _ = build_pod_table(
+                                warm_set, capacity=cap, device=False
                             )
-                        )
-                        jax.block_until_ready(bc)
+                            bx = build_constraint_tables(
+                                warm_set, nodes, [],
+                                pod_capacity=cap,
+                                node_capacity=node_capacity,
+                                scan_planes=True, device=False,
+                                elide_zeros=False,
+                                elide_groups=SCAN_ELIDE_GROUPS,
+                            )
+                            _, bc, _, _ = (
+                                self._get_blocked_scheduler().call_packed(
+                                    bp, node_static, node_agg, bx
+                                )
+                            )
+                            jax.block_until_ready(bc)
                 return
             node_table, _ = CachedNodeTableBuilder().build(
                 infos, capacity=node_capacity, prof_capacity=prof_capacity
@@ -570,6 +649,12 @@ class DeviceScheduler(Scheduler):
                     self.error_func(qpi, err)
                 return qpis, None
         except Exception as err:
+            import os as _os
+            if _os.environ.get("MINISCHED_DEBUG_HEAL"):
+                import traceback as _tb
+                print("[wave] parked batch on:", type(err).__name__,
+                      str(err)[-220:], flush=True)
+                _tb.print_exc()
             for qpi in qpis:
                 self.error_func(qpi, err)
             return qpis, None
@@ -698,11 +783,16 @@ class DeviceScheduler(Scheduler):
                             node_capacity=node_agg.capacity,
                             scan_planes=True,
                             device=False,
-                            # one packed schema per capacity: elision made
-                            # every zero-set flip (combo counts appearing
-                            # mid-run) a fresh executable compile/load on
-                            # the tunnel
+                            # per-capacity schema discipline: full elision
+                            # made every STATE-driven zero-set flip (combo
+                            # counts appearing mid-run) a fresh executable
+                            # compile/load on the tunnel — but the
+                            # WORKLOAD-driven groups (affinity terms, pod
+                            # volumes, spread slots) elide as units, so a
+                            # spread-only burst's program folds the other
+                            # lanes entirely (~2× per-step)
                             elide_zeros=False,
+                            elide_groups=SCAN_ELIDE_GROUPS,
                         )
                 # gate opens for the device call: held event batches
                 # drain against GIL-free device compute

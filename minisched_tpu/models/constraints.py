@@ -52,6 +52,27 @@ MAX_DOMAINS = 64
 TS_DO_NOT_SCHEDULE = 0
 TS_SCHEDULE_ANYWAY = 1
 
+#: packed-schema elision groups for the scan lane (pack_table
+#: ``elide_groups``): columns whose zero-ness is a property of the
+#: chunk's WORKLOAD, not of cluster state — each group elides as a unit
+#: only when every member is all-zero, so e.g. a spread-only burst ships
+#: no affinity/volume columns and XLA folds those whole per-step lanes
+#: out of the blocked-scan program.  Gating counts (``*_n``) are members,
+#: so zero-materialized values always read as "no constraints"
+#: (TS_DO_NOT_SCHEDULE == 0 is safe: ``ts_n`` == 0 masks every slot).
+SCAN_ELIDE_GROUPS = (
+    (
+        "pan_combo", "pan_n", "ppa_combo", "ppa_w", "ppa_n",
+        "pa_combo", "pa_self", "pa_n",
+    ),
+    (
+        "pod_claims", "pod_claim_valid", "pod_n_vols", "pod_vols_fam",
+        "pod_missing", "claim_mask", "claim_zone_ok", "claim_cnt",
+        "claim_family", "claim_ro",
+    ),
+    ("ts_combo", "ts_skew", "ts_mode", "ts_n"),
+)
+
 #: capacity quantum for the combo/ex-term/claim/volume axes — every
 #: distinct padded size is a separate compiled executable (see the combo
 #: matrices comment in build_constraint_tables)
@@ -370,6 +391,7 @@ def build_constraint_tables(
     extra_assigned: Sequence[Any] = (),
     device: bool = True,
     elide_zeros: bool = True,
+    elide_groups: Tuple[Tuple[str, ...], ...] = (),
 ):
     """Build the wave's coupling tables.
 
@@ -870,6 +892,12 @@ def build_constraint_tables(
         # flip zero/nonzero mid-run (combo counts appear after the first
         # commits) — each flip cost a ~5-50s compile/cache-load on the
         # tunnel.  Waves keep elision: plain waves elide everything and
-        # their schema is stable.
-        return pack_table(host_cols, (), P, elide_zeros=elide_zeros)
+        # their schema is stable.  elide_groups (SCAN_ELIDE_GROUPS) is
+        # the scan lane's bounded middle ground: per-WORKLOAD zero
+        # groups (affinity terms, pod volumes) elide as units, folding
+        # their whole per-step compute lanes for e.g. spread-only bursts.
+        return pack_table(
+            host_cols, (), P,
+            elide_zeros=elide_zeros, elide_groups=elide_groups,
+        )
     return ConstraintTables(**batched_device_put(host_cols))

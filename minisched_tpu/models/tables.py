@@ -209,6 +209,7 @@ def pack_table(
     zero_metas: Tuple = (),
     capacity: int = 0,
     elide_zeros: bool = False,
+    elide_groups: Tuple[Tuple[str, ...], ...] = (),
 ) -> PackedTable:
     """``elide_zeros``: move columns that are entirely zero into
     ``zero_metas`` (materialized on device by the consumer's unpack, zero
@@ -218,7 +219,16 @@ def pack_table(
     almost entirely zero planes.  NOTE: the zero-set is part of the
     schema — a column flipping nonzero compiles a new consumer
     executable, so flips must be rare/one-way (combo planes go nonzero
-    once cross-pod pods land and stay there)."""
+    once cross-pod pods land and stay there).
+
+    ``elide_groups``: the selective middle ground — each GROUP of column
+    names elides as a unit, and only when every member is all-zero.
+    Consumers whose zero-sets must stay schema-stable against state
+    churn (the scan lane) use this for the columns whose zero-ness is a
+    property of the WORKLOAD (a spread-only burst carries no affinity
+    terms, no volumes): XLA then constant-folds those columns' whole
+    compute lanes out of the per-step program, while the schema space
+    stays bounded at one executable per group subset actually seen."""
     if elide_zeros:
         live: Dict[str, Any] = {}
         zeros = list(zero_metas)
@@ -228,6 +238,20 @@ def pack_table(
                 zeros.append((k, _wire_kind(arr.dtype), tuple(arr.shape)))
             else:
                 live[k] = arr
+        host, zero_metas = live, tuple(zeros)
+    elif elide_groups:
+        zeros = list(zero_metas)
+        live = dict(host)
+        for group in elide_groups:
+            members = [k for k in group if k in live]
+            if members and all(
+                not np.asarray(live[k]).any() for k in members
+            ):
+                for k in members:
+                    arr = np.asarray(live.pop(k))
+                    zeros.append(
+                        (k, _wire_kind(arr.dtype), tuple(arr.shape))
+                    )
         host, zero_metas = live, tuple(zeros)
     metas, flat = pack_columns(host)
     return PackedTable(metas, tuple(zero_metas), flat, capacity)

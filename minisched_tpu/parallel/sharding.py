@@ -168,8 +168,17 @@ def shard_tables(
 class _CompiledShardedStep:
     """One jitted executable per call signature (with/without the
     constraint tables) — waves may alternate between the two.  ``fn`` is
-    ``fn(nodes, pods, extra=None)``; the node table is donated so updates
-    are in-place across waves."""
+    ``fn(nodes, pods, extra=None)``.
+
+    The node table is deliberately NOT donated: table builds route
+    all-zero columns through a shared splitter executable whose outputs
+    can ALIAS (one broadcasted-zero buffer serving several columns), and
+    a donation-compiled program then rejects the call with "supplied N
+    buffers but compiled program expected M" — an order-dependent live
+    failure (a whole wave parked unschedulable) first seen when another
+    engine's builds warmed the splitter caches.  Donation only saved an
+    on-device copy on the virtual-mesh path; the single-chip hot path
+    never goes through here."""
 
     def __init__(self, mesh: Mesh, fn):
         self._mesh = mesh
@@ -177,7 +186,66 @@ class _CompiledShardedStep:
         self._jitted = {}
 
     def __call__(self, nodes, pods, extra=None):
-        key = extra is not None
+        try:
+            out = self._call(nodes, pods, extra)
+            # execution is async — the poisoned-dispatch fault below only
+            # surfaces when results are awaited, which would be outside
+            # this handler.  Blocking here costs pipelining only on the
+            # virtual-mesh path.
+            jax.block_until_ready(out)
+            return out
+        # the fault has surfaced as ValueError on this jaxlib, but PJRT
+        # execution errors are XlaRuntimeError (a RuntimeError) in other
+        # paths — catch both, gate on the message
+        except (ValueError, RuntimeError) as err:
+            # jit-cache poisoning self-heal: with other engines' builds
+            # in this process's jit caches, dispatch can land on an
+            # executable traced for a DIFFERENT argument set and fail
+            # with "Execution supplied N buffers but compiled program
+            # expected M" (constant delta, every wave — the whole wave
+            # would park unschedulable).  Dropping the entry recompiles
+            # against THIS call's actual structure; a second failure is
+            # a real bug and surfaces.
+            if "buffers but compiled program expected" not in str(err):
+                raise
+            import os as _os
+            if _os.environ.get("MINISCHED_DEBUG_HEAL"):
+                print("[sharded-step] poisoned dispatch; recompiling",
+                      flush=True)
+            # evict only the poisoned signature — other entries' compiled
+            # executables (warm shapes, the other extra variant) are fine
+            self._jitted.pop(self._sig_key(nodes, pods, extra), None)
+            try:
+                out = self._call(nodes, pods, extra)
+                jax.block_until_ready(out)
+            except Exception as err2:
+                if _os.environ.get("MINISCHED_DEBUG_HEAL"):
+                    print("[sharded-step] heal retry FAILED:",
+                          type(err2).__name__, str(err2)[-200:], flush=True)
+                raise
+            if _os.environ.get("MINISCHED_DEBUG_HEAL"):
+                print("[sharded-step] heal retry ok", flush=True)
+            return out
+
+    @staticmethod
+    def _sig_key(nodes, pods, extra):
+        return (
+            extra is not None,
+            tuple(
+                (l.shape, str(l.dtype))
+                for l in jax.tree_util.tree_leaves((nodes, pods, extra))
+            ),
+        )
+
+    def _call(self, nodes, pods, extra=None):
+        # one jax.jit OBJECT per full input signature — not just per
+        # with/without-extra: sharing one jit across signatures let the
+        # dispatch fast path land on the executable of ANOTHER signature
+        # (the prewarm's warm tables vs live waves) once enough other
+        # programs populated this process's jit caches — the
+        # buffers-count fault handled in __call__.  jax would retrace per
+        # signature anyway; distinct jit objects only pin the dispatch.
+        key = self._sig_key(nodes, pods, extra)
         if key not in self._jitted:
             mesh, fn = self._mesh, self._fn
             shardings = [node_sharding(mesh, nodes), pod_sharding(mesh, pods)]
@@ -192,10 +260,18 @@ class _CompiledShardedStep:
                 def wrapped(nodes, pods):
                     return fn(nodes, pods)
 
+            # keep_unused: argument PRUNING is the second half of the
+            # order-dependent failure this class documents above — the
+            # compiled program and the dispatch fast path can disagree on
+            # the pruned argument set ("supplied 102 buffers but compiled
+            # program expected 109") once other engines' builds populated
+            # the jit caches.  Keeping every argument makes both sides
+            # count the same buffers; the cost is shipping a few unused
+            # columns to a virtual mesh.
             self._jitted[key] = jax.jit(
                 wrapped,
                 in_shardings=tuple(shardings),
-                donate_argnums=(0,),
+                keep_unused=True,
             )
         if extra is not None:
             return self._jitted[key](nodes, pods, extra)

@@ -7,6 +7,7 @@ passes."""
 
 from __future__ import annotations
 
+import os
 import time
 
 from minisched_tpu.api.objects import make_node, make_pod
@@ -203,7 +204,37 @@ def test_wave_loser_diagnosis_matches_scalar_engine():
 def test_live_engine_sharded_over_mesh():
     """device_mesh: the live wave engine evaluates SHARDED over the 8-dev
     virtual mesh (pods data-parallel x nodes model-parallel) and still
-    binds everything correctly with per-pod diagnosis intact."""
+    binds everything correctly with per-pod diagnosis intact.
+
+    Runs in a SUBPROCESS: compiling the blocked-scan kernel earlier in
+    the same process corrupts jaxlib state for the SPMD mesh executable
+    (wave 2+ dispatches fail with "Execution supplied N buffers but
+    compiled program expected M", and the interpreter SIGABRTs at exit
+    — reproducible on jax 0.9.0 with a fresh compilation cache, with
+    donation disabled, and with keep_unused; see
+    parallel/sharding._CompiledShardedStep's hardening).  One engine per
+    process is the deployed topology (bench children, dryrun_multichip),
+    so process isolation here matches reality rather than hiding a
+    product defect."""
+    import subprocess
+    import sys
+
+    if os.environ.get("MINISCHED_MESH_TEST_SUBPROC") != "1":
+        env = dict(os.environ, MINISCHED_MESH_TEST_SUBPROC="1")
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "pytest", "-q", "-x",
+                f"{__file__}::test_live_engine_sharded_over_mesh",
+                "--no-header", "-p", "no:cacheprovider",
+            ],
+            env=env,
+            capture_output=True,
+            timeout=580,
+        )
+        assert proc.returncode == 0, (
+            proc.stdout.decode()[-2000:] + proc.stderr.decode()[-500:]
+        )
+        return
     import time
 
     from minisched_tpu.api.objects import make_node, make_pod
