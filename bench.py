@@ -947,6 +947,9 @@ def bench_wire() -> dict:
         client = RemoteClient(base)
         rng = random.Random(55)
         t0 = time.monotonic()
+        # serial on purpose: creation is GIL-bound JSON either way, and
+        # concurrent urllib churn overruns ThreadingHTTPServer's listen
+        # backlog (connection resets); setup is not part of measured e2e
         for i in range(n_nodes):
             client.nodes().create(
                 make_node(
@@ -983,6 +986,9 @@ def bench_wire() -> dict:
         sched = svc.start_scheduler(
             default_full_roster_config(), device_mode=True, max_wave=4096,
             on_decision=counting, prewarm=True,
+            # the wire workload carries no cross-pod-constrained pods —
+            # skip the scan-lane warms (they were most of the ~4min wall)
+            prewarm_scan=False,
         )
         t0 = time.monotonic()
         log(f"[wire] engine warmup+start: {t0 - t_warm:.1f}s")

@@ -365,13 +365,20 @@ class DeviceScheduler(Scheduler):
             return cls.SCAN_MAX_CHUNK
         return cls.BLOCKED_MAX_CHUNK
 
-    def prewarm(self) -> None:
+    def prewarm(self, scan: bool = True) -> None:
         """Compile (or cache-load) the wave evaluator executable for the
         shapes this engine will use, before the run loop starts.  The
         full-roster repair graph costs 30-50s to compile (~15s to load
         from the persistent cache over the tunnel); paying that inside the
         first wave stalls the whole first drain.  Called by the service
         when ``prewarm=True`` — between informer sync and run().
+
+        ``scan=False`` skips the sequential/blocked scan-lane warms (the
+        biggest share of the wall for cross-pod-capable rosters: two
+        schedulers × capacity tiers × schema corners): callers that KNOW
+        their workload carries no cross-pod-constrained pods never run
+        those lanes, and a workload that surprises them merely pays the
+        compile at first use.
 
         Shapes must match the live waves exactly or the warm executable is
         wasted: pod capacity is the wave capacity (_build_and_evaluate
@@ -417,7 +424,7 @@ class DeviceScheduler(Scheduler):
             # the unpacked path ships pod tables through per-capacity
             # splitter executables; packed mode never invokes them
             warm_caps = set(wave_caps)
-            if self._has_cross_pod:
+            if self._has_cross_pod and scan:
                 warm_caps |= {self.SCAN_MIN_CAP, self.SCAN_MAX_CHUNK}
                 if self.SCAN_BLOCK_SIZE > 1:
                     warm_caps.add(self.BLOCKED_MAX_CHUNK)
@@ -464,7 +471,7 @@ class DeviceScheduler(Scheduler):
                     )
                 out = self._get_evaluator()(pod_table, node_table, extra)
                 jax.block_until_ready(out[1])
-        if self._has_cross_pod:
+        if self._has_cross_pod and scan:
             # cross-pod-constrained pods ride the sequential scan — warm
             # BOTH chunk capacities (_schedule_scan uses exactly these
             # two; a partial chunk compiling the small one mid-run cost

@@ -46,6 +46,7 @@ class SchedulerService:
         on_decision=None,
         metrics=None,
         prewarm: bool = False,
+        prewarm_scan: bool = True,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
@@ -131,8 +132,10 @@ class SchedulerService:
             sched.on_decision = emit
         if prewarm and device_mode:
             # compile/load the wave executable for the live shapes BEFORE
-            # the engine thread starts — otherwise the first wave pays it
-            sched.prewarm()
+            # the engine thread starts — otherwise the first wave pays it.
+            # prewarm_scan=False skips the scan-lane warms for callers
+            # whose workload carries no cross-pod-constrained pods.
+            sched.prewarm(scan=prewarm_scan)
         sched.run()
         self._scheduler = sched
         self._current_cfg = orig_cfg

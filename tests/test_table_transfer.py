@@ -17,7 +17,11 @@ from minisched_tpu.models.constraints import (
     _sig_groups,
     build_constraint_tables,
 )
-from minisched_tpu.models.tables import batched_device_put, build_pod_table
+from minisched_tpu.models.tables import (
+    batched_device_put,
+    build_pod_table,
+    pack_table,
+)
 
 
 def test_batched_device_put_elision_is_bit_identical():
@@ -123,3 +127,39 @@ def test_grouped_fold_equals_per_pod_fold_in_combo_planes():
             assert here[cid, i] == per_node.get(node.metadata.name, 0)
             zone = node.metadata.labels["zone"]
             assert dsum[cid, i] == per_zone.get(zone, 0), (cid, i)
+
+
+def test_pack_table_elide_groups_are_all_or_nothing():
+    """elide_groups: a group ships nothing only when EVERY member is
+    all-zero; one nonzero member keeps the whole group on the wire; and
+    unpack rebuilds elided columns as zeros of the right shape/dtype."""
+    host = {
+        "a1": np.zeros((4, 3), np.int32),
+        "a2": np.zeros(4, bool),
+        "b1": np.zeros((4, 2), np.int32),
+        "b2": np.zeros(4, np.int32),
+        "live": np.arange(4, dtype=np.int32),
+    }
+    groups = (("a1", "a2"), ("b1", "b2"))
+
+    # both groups fully zero → both elided
+    t = pack_table(dict(host), (), 4, elide_groups=groups)
+    zero_names = {m[0] for m in t.zero_metas}
+    assert zero_names == {"a1", "a2", "b1", "b2"}
+    cols = t.unpack()
+    assert cols["a1"].shape == (4, 3) and not cols["a1"].any()
+    assert cols["a2"].dtype == bool and not cols["a2"].any()
+    assert list(np.asarray(cols["live"])) == [0, 1, 2, 3]
+
+    # one nonzero member keeps ITS group live; the other still elides
+    host2 = dict(host)
+    host2["b2"] = np.array([0, 0, 1, 0], np.int32)
+    t2 = pack_table(dict(host2), (), 4, elide_groups=groups)
+    zero_names2 = {m[0] for m in t2.zero_metas}
+    assert zero_names2 == {"a1", "a2"}
+    cols2 = t2.unpack()
+    assert np.asarray(cols2["b2"]).tolist() == [0, 0, 1, 0]
+    assert np.asarray(cols2["b1"]).shape == (4, 2)
+
+    # schema difference is visible (distinct consumer executables)
+    assert t.schema != t2.schema
