@@ -713,23 +713,27 @@ class DeviceScheduler(Scheduler):
         B = self.SCAN_BLOCK_SIZE
         pending = qpis
         fresh = (node_infos, agg_delta, assumed_pods)
-        for _attempt in range(self.SCAN_BLOCK_RETRIES):
-            with self.metrics.timed("scan_grouping"):
-                sets = interaction_sets([q.pod for q in pending])
-                blocks = order_into_blocks(pending, sets, B)
-                flat = [m for blk in blocks for m in blk]
-            retry: List[QueuedPodInfo] = []
-            for start in range(0, len(flat), self.BLOCKED_MAX_CHUNK):
-                if fresh is None:
-                    fresh = self._snapshot_for_wave()
-                part = flat[start : start + self.BLOCKED_MAX_CHUNK]
-                retry += self._run_blocked_chunk(part, *fresh)
-                fresh = None
-            if not retry:
-                self.informer_factory.resume_dispatch()
-                return
-            pending = retry
-        self.informer_factory.resume_dispatch()
+        try:
+            for _attempt in range(self.SCAN_BLOCK_RETRIES):
+                with self.metrics.timed("scan_grouping"):
+                    sets = interaction_sets([q.pod for q in pending])
+                    blocks = order_into_blocks(pending, sets, B)
+                    flat = [m for blk in blocks for m in blk]
+                retry: List[QueuedPodInfo] = []
+                for start in range(0, len(flat), self.BLOCKED_MAX_CHUNK):
+                    if fresh is None:
+                        fresh = self._snapshot_for_wave()
+                    part = flat[start : start + self.BLOCKED_MAX_CHUNK]
+                    retry += self._run_blocked_chunk(part, *fresh)
+                    fresh = None
+                if not retry:
+                    return
+                pending = retry
+        finally:
+            # a raise anywhere above must not leave the dispatch gate
+            # closed for good (events would stall until the next bind);
+            # resume is idempotent, the success paths share this exit
+            self.informer_factory.resume_dispatch()
         if pending:
             # capacity-race stragglers: the exact lane finishes them
             self._schedule_scan_exact(pending, *self._snapshot_for_wave())
@@ -1006,6 +1010,21 @@ class DeviceScheduler(Scheduler):
             if was_enabled:
                 gc.enable()
             gc.unfreeze()
+            # a stop with constrained pods still deferred must not drop
+            # them silently (advisor r4): park them through error_func so
+            # the queue reflects their Pending state.  This runs ON the
+            # loop thread — the backlog's owner — so it cannot race a
+            # wave that would re-populate it (stop()'s 2s join can time
+            # out mid-wave and a park from there could be overwritten).
+            backlog, self._scan_backlog = self._scan_backlog, []
+            if backlog:
+                try:
+                    self._park_scan_failures(
+                        backlog,
+                        RuntimeError("scheduler stopped with deferred pods"),
+                    )
+                except Exception:
+                    pass  # shutdown path: queue/informers may be gone
 
     def _wave_gc(self) -> None:
         import gc
@@ -1069,24 +1088,11 @@ class DeviceScheduler(Scheduler):
         # the deferral window is minutes, not milliseconds: a pod can be
         # DELETED, RECREATED, or UPDATED while parked here, and the
         # queue's own update/delete handling can no longer reach it (it
-        # was popped).  Re-validate every entry in ONE informer lock hold
-        # (get_many — no per-pod store round-trips/clones in front of the
-        # single device call the deferral exists to amortize): drop the
-        # gone and the renamed-uid recreations (the informer ADD already
-        # enqueued the new incarnation), refresh the spec of the changed.
-        pod_inf = self.informer_factory.informer_for("Pod")
-        keys = [
-            f"{qpi.pod.metadata.namespace}/{qpi.pod.metadata.name}"
-            for qpi in backlog
-        ]
+        # was popped).  Re-validate every entry: drop the gone and the
+        # renamed-uid recreations (the informer ADD already enqueued the
+        # new incarnation), refresh the spec of the changed.
         live_backlog: List[QueuedPodInfo] = []
-        for qpi, cur in zip(backlog, pod_inf.get_many(keys)):
-            if cur is None:
-                continue  # deleted while deferred
-            if cur.metadata.uid != qpi.pod.metadata.uid:
-                continue  # recreated under the same name: not this entry
-            if cur.spec.node_name:
-                continue  # bound elsewhere while deferred
+        for qpi, cur in self._revalidate_backlog(backlog):
             if (
                 cur.metadata.resource_version
                 != qpi.pod.metadata.resource_version
@@ -1095,22 +1101,62 @@ class DeviceScheduler(Scheduler):
             live_backlog.append(qpi)
         if not live_backlog:
             return
-        node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
-        if not node_infos:
-            for qpi in live_backlog:
-                self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
-            return
-        self._schedule_scan(live_backlog, node_infos, agg_delta, assumed_pods)
+        try:
+            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
+            if not node_infos:
+                for qpi in live_backlog:
+                    self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
+                return
+            self._schedule_scan(
+                live_backlog, node_infos, agg_delta, assumed_pods
+            )
+        except Exception as err:
+            # advisor r4: the run loop's catch-all would swallow this and
+            # the (already-swapped-out) backlog pods would sit Pending
+            # until an unrelated event — the wave path parks its batch
+            # via error_func on exception, this lane must too
+            self._park_scan_failures(live_backlog, err)
+
+    def _revalidate_backlog(self, qpis: List[QueuedPodInfo]):
+        """The shared liveness rule for backlog entries: (qpi, current
+        pod) pairs for those still present, same-uid, and unbound — one
+        informer lock hold (get_many; no per-pod store round-trips in
+        front of the single device call the deferral amortizes).  Flush
+        schedules the survivors; park error_funcs them."""
+        pod_inf = self.informer_factory.informer_for("Pod")
+        keys = [
+            f"{q.pod.metadata.namespace}/{q.pod.metadata.name}" for q in qpis
+        ]
+        out = []
+        for qpi, cur in zip(qpis, pod_inf.get_many(keys)):
+            if cur is None:
+                continue  # deleted while deferred
+            if cur.metadata.uid != qpi.pod.metadata.uid:
+                continue  # recreated under the same name: not this entry
+            if cur.spec.node_name:
+                continue  # bound elsewhere while deferred
+            out.append((qpi, cur))
+        return out
+
+    def _park_scan_failures(self, qpis: List[QueuedPodInfo], err) -> None:
+        """Route the still-unplaced pods of a failed scan through
+        error_func → unschedulableQ.  Pods the lane already committed
+        before the raise (assumed and/or bound — chunks commit as they
+        go) are skipped: error_func would forget a live assumption and
+        requeue a pod that was in fact placed.  The assume snapshot is
+        taken BEFORE the informer read: a pod leaves _assumed only after
+        the informer reflects its bind, so this order can't miss a
+        commit that confirms between the two reads (the reverse could)."""
+        with self._assumed_lock:
+            assumed = set(self._assumed)
+        for qpi, _cur in self._revalidate_backlog(qpis):
+            if qpi.pod.metadata.uid in assumed:
+                continue  # committed by an earlier chunk
+            self.error_func(qpi, err)
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
         t_wave = time.monotonic()
         self.metrics.observe("wave_size", float(len(qpis)))
-        with self.metrics.timed("wave_snapshot"):
-            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
-        if not node_infos:
-            for qpi in qpis:
-                self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
-            return
 
         # cross-pod-constrained pods run on device via the sequential scan
         # (they see each other's commits in the carried combo planes —
@@ -1124,6 +1170,9 @@ class DeviceScheduler(Scheduler):
         # lane's acceptance/audit guarantees don't depend on WHEN it runs.
         # A chain WITHOUT cross-pod plugins never evaluates the constraints
         # at all (reference semantics with the plugin disabled) — no scan.
+        # The split runs BEFORE the snapshot: the priority bypass below
+        # may flush (and commit) the backlog, which a snapshot already in
+        # hand would not see — capacity double-booking.
         if self._has_cross_pod:
             constrained = [qpi for qpi in qpis if _is_cross_pod(qpi.pod)]
             if constrained:
@@ -1133,6 +1182,29 @@ class DeviceScheduler(Scheduler):
                     self.metrics.observe("wave", time.monotonic() - t_wave)
                     return
                 qpis = plain
+            # priority-inversion bypass (advisor r4): deferral reorders
+            # constrained pods behind up to SCAN_DEFER_MAX_WAVES full
+            # waves of later-arriving plain pods.  Near capacity a plain
+            # wave could consume resources that priority/FIFO pop order
+            # had given an earlier, HIGHER-priority constrained pod — so
+            # when any deferred pod outranks any plain pod about to run,
+            # the backlog flushes first (restoring the order the queue
+            # popped them in).  Same-priority workloads (the common case)
+            # never trigger this and keep the amortized single-call lane.
+            # The max is derived at the read site — the backlog is
+            # bounded by ~BLOCKED_MAX_CHUNK, and cached state would need
+            # resets at every site that mutates the backlog.
+            if self._scan_backlog:
+                hi = max(q.pod.spec.priority for q in self._scan_backlog)
+                if hi > min(q.pod.spec.priority for q in qpis):
+                    self._flush_scan_backlog()
+
+        with self.metrics.timed("wave_snapshot"):
+            node_infos, agg_delta, assumed_pods = self._snapshot_for_wave()
+        if not node_infos:
+            for qpi in qpis:
+                self.error_func(qpi, FitError(qpi.pod, 0, Diagnosis()))
+            return
 
         with self.metrics.timed("wave_assigned_list"):
             nodes = [ni.node for ni in node_infos]  # name-sorted by snapshot

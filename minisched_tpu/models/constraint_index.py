@@ -318,7 +318,6 @@ class ConstraintIndex:
         from minisched_tpu.plugins.volumelimits import volume_family
 
         rec = _PodRecord(pod.spec.node_name)
-        rec.sig = self._sig_of(pod)
         aff = pod.spec.affinity
         if (
             aff is not None
@@ -373,6 +372,14 @@ class ConstraintIndex:
                 vk = ("pvc", claim_key)
                 rw = False  # unbound: no PV identity to conflict on
             rec.vols.append((vk, fam, rw))
+        # signature LAST (advisor r4): _sig_of creates a refcount-0
+        # registry entry on first sight, and apply_events swallows
+        # per-event exceptions — a raise in any step above would strand
+        # the entry in _sig_ids/_sig_rep forever (only _remove releases).
+        # Nothing above reads rec.sig, so creating it after every
+        # fallible step means a failed _contribution mutates no
+        # signature state.
+        rec.sig = self._sig_of(pod)
         return rec
 
     def _sig_of(self, pod: Any) -> int:

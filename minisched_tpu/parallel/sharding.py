@@ -180,6 +180,11 @@ class _CompiledShardedStep:
     on-device copy on the virtual-mesh path; the single-chip hot path
     never goes through here."""
 
+    #: process-wide count of poisoned-dispatch self-heals (see __call__)
+    #: — repeated poisoning is a real bug and must be visible, not masked
+    #: by silent recompiles
+    heal_count = 0
+
     def __init__(self, mesh: Mesh, fn):
         self._mesh = mesh
         self._fn = fn
@@ -209,9 +214,19 @@ class _CompiledShardedStep:
             if "buffers but compiled program expected" not in str(err):
                 raise
             import os as _os
-            if _os.environ.get("MINISCHED_DEBUG_HEAL"):
-                print("[sharded-step] poisoned dispatch; recompiling",
-                      flush=True)
+            import sys as _sys
+            # heals are ALWAYS visible (advisor r4): a genuine argument-
+            # mismatch bug in a new caller would otherwise be silently
+            # masked by its first recompile and only surface if it
+            # repeats.  The counter lets harnesses assert no-heal runs.
+            _CompiledShardedStep.heal_count += 1
+            print(
+                f"[sharded-step] poisoned dispatch #"
+                f"{_CompiledShardedStep.heal_count}; recompiling "
+                f"({str(err)[-120:]})",
+                file=_sys.stderr,
+                flush=True,
+            )
             # evict only the poisoned signature — other entries' compiled
             # executables (warm shapes, the other extra variant) are fine
             self._jitted.pop(self._sig_key(nodes, pods, extra), None)

@@ -203,8 +203,16 @@ class SchedulingQueue:
         upstream's single moveRequestCycle would re-queue it on any
         overlapping event, helping or not — see _move_events)."""
         with self._cond:
+            uid = self._uid(qpi.pod)
+            if uid in self._queued_uids:
+                # upstream's IfNotPresent: the pod is already in some
+                # queue segment — a second routing (e.g. a failed scan
+                # lane re-parking a chunk loser it already error_func'd)
+                # must not insert a duplicate entry that would be popped
+                # and scheduled twice
+                return
             qpi.timestamp = self._clock()
-            self._queued_uids.add(self._uid(qpi.pod))
+            self._queued_uids.add(uid)
             helped = any(
                 cycle >= qpi.scheduling_cycle
                 and (

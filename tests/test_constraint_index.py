@@ -331,3 +331,30 @@ def test_signature_tables_recycle_under_unique_label_churn(live_index):
     ]
     inc, scratch = _build_both(client, index, [late])
     _assert_equal(inc, scratch)
+
+
+def test_failed_contribution_strands_no_signature():
+    """A raise mid-_contribution (advisor r4: e.g. a PVC lookup blowing
+    up) must not strand a refcount-0 signature in the registry —
+    apply_events swallows per-event exceptions, so a stranded entry
+    would leak forever and keep paying matcher calls on every
+    register_combo backfill."""
+    index = ConstraintIndex()
+
+    def boom(_key):
+        raise RuntimeError("pvc cache exploded")
+
+    index._pvc_lister = boom
+    pod = make_pod("vol-pod", labels={"leak": "check"})
+    pod.spec.node_name = "node0"
+    pod.spec.volumes = ["claim-a"]
+    try:
+        index._add(pod)
+    except RuntimeError:
+        pass
+    key = (
+        pod.metadata.namespace,
+        tuple(sorted(pod.metadata.labels.items())),
+    )
+    assert key not in index._sig_ids, "refcount-0 signature stranded"
+    assert pod.metadata.uid not in index._records

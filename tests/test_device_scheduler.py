@@ -593,3 +593,128 @@ def test_flush_drops_deleted_and_refreshes_updated_backlog_pods():
         assert stats.get("unschedulable", 0) == 0, stats
     finally:
         svc.shutdown_scheduler()
+
+
+def test_scan_backlog_priority_bypass_flushes_before_plain_wave():
+    """Deferral must not invert priorities (advisor r4): when a deferred
+    cross-pod pod outranks the plain pods about to run, the backlog
+    flushes FIRST — the wave-count bound is disabled here, so only the
+    bypass (not age, size, or drain) can bind the spread pod while
+    lower-priority plain pods are still flowing."""
+    from minisched_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+
+    client = Client()
+    for i in range(16):
+        client.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                labels={"zone": f"z{i % 4}"},
+                capacity={"cpu": "64", "memory": "256Gi", "pods": 500},
+            )
+        )
+    cfg = default_full_roster_config()
+    svc = SchedulerService(client)
+    svc.start_scheduler(cfg, device_mode=True, max_wave=8)
+    try:
+        sched = svc.scheduler
+        # age/size bounds out of the picture: only the priority bypass
+        # (or the eventual queue drain) can flush
+        sched.SCAN_DEFER_MAX_WAVES = 10**6
+        spread = make_pod(
+            "spread-hi", labels={"app": "s"},
+            requests={"cpu": "100m", "memory": "64Mi"},
+            priority=100,
+        )
+        spread.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=2, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "s"}),
+            )
+        ]
+        client.pods().create(spread)
+        for i in range(240):
+            client.pods().create(
+                make_pod(
+                    f"plain{i:03d}",
+                    requests={"cpu": "100m", "memory": "64Mi"},
+                    priority=0,
+                )
+            )
+        state = {}
+
+        def spread_bound():
+            if not client.pods().get("spread-hi").spec.node_name:
+                return False
+            if "plain_left" not in state:
+                state["plain_left"] = sum(
+                    1
+                    for i in range(240)
+                    if not client.pods().get(f"plain{i:03d}").spec.node_name
+                )
+            return True
+
+        assert _wait(spread_bound, timeout=300.0), "high-prio pod starved"
+        assert state["plain_left"] > 0, (
+            "spread pod only bound at drain — the priority bypass did "
+            "not flush ahead of the lower-priority plain waves"
+        )
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_failed_scan_flush_parks_backlog_not_drops_it():
+    """A raise inside the scan lane must route the (already swapped-out)
+    backlog through error_func → unschedulableQ, not drop it (advisor
+    r4): the run loop's catch-all would otherwise leave the pods
+    Pending with no requeue path until an unrelated event."""
+    from minisched_tpu.api.objects import LabelSelector, TopologySpreadConstraint
+
+    client = Client()
+    for i in range(4):
+        client.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                labels={"zone": f"z{i % 2}"},
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+        )
+    cfg = default_full_roster_config()
+    svc = SchedulerService(client)
+    svc.start_scheduler(cfg, device_mode=True, max_wave=8)
+    try:
+        sched = svc.scheduler
+        victim = make_pod(
+            "victim", labels={"app": "s"},
+            requests={"cpu": "100m", "memory": "64Mi"},
+        )
+        victim.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=2, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "s"}),
+            )
+        ]
+        # the lane blows up BEFORE the pod exists — the live loop then
+        # pops it, defers it, drain-flushes, hits the raise, and must
+        # park it (installing the boom later races the loop, which can
+        # bind the pod first)
+        def boom(*a, **kw):
+            raise RuntimeError("scan lane exploded")
+
+        sched._schedule_scan = boom
+        client.pods().create(victim)
+
+        def parked():
+            stats = sched.queue.stats()
+            return (
+                stats.get("unschedulable", 0) + stats.get("backoff", 0) >= 1
+            )
+
+        assert _wait(parked, timeout=120.0), (
+            f"backlog pod dropped on scan failure: {sched.queue.stats()}"
+        )
+        assert not client.pods().get("victim").spec.node_name
+        assert sched._scan_backlog == []
+    finally:
+        svc.shutdown_scheduler()
