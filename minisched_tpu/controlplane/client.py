@@ -105,6 +105,13 @@ class _NodeAPI:
         node.metadata.namespace = ""
         return self._store.create(KIND_NODE, node)
 
+    def create_many(self, nodes: List[Node]) -> List[Node]:
+        """Batch create, aligned with ``nodes`` — the remote client turns
+        this into ONE collection POST (k8sapiserver setup at bench scale
+        was ~380 obj/s with a round-trip per object); in-process it's a
+        plain loop."""
+        return [self.create(n) for n in nodes]
+
     def get(self, name: str) -> Node:
         return self._store.get(KIND_NODE, "", name)
 
@@ -127,6 +134,10 @@ class _PodAPI:
         if not pod.metadata.namespace:
             pod.metadata.namespace = self._ns
         return self._store.create(KIND_POD, pod)
+
+    def create_many(self, pods: List[Pod]) -> List[Pod]:
+        """Batch create, aligned with ``pods`` — see _NodeAPI.create_many."""
+        return [self.create(p) for p in pods]
 
     def get(self, name: str, namespace: Optional[str] = None) -> Pod:
         return self._store.get(KIND_POD, namespace or self._ns, name)

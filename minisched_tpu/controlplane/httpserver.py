@@ -60,6 +60,18 @@ REST_KINDS = {**KIND_TYPES, "Event": _objects.Event}
 _CLUSTER_SCOPED = {"Node", "PersistentVolume"}
 
 
+def _fixup_namespace(kind: str, ns: str, obj: Any) -> None:
+    """The one namespace rule for creates (single and batch): cluster-
+    scoped kinds normalize to ""; otherwise the URL namespace wins (kube
+    semantics), else the body's, else "default"."""
+    if kind in _CLUSTER_SCOPED:
+        obj.metadata.namespace = ""
+    elif ns:
+        obj.metadata.namespace = ns
+    elif not obj.metadata.namespace:
+        obj.metadata.namespace = "default"
+
+
 def _route(path: str):
     """→ (kind, namespace, name, subresource) — name/sub may be ''."""
     parts = [p for p in path.split("/") if p]
@@ -209,20 +221,45 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, str(e))
             return
         try:
-            obj = _decode(REST_KINDS[kind], self._body())
+            body = self._body()
         except Exception as e:
             self._error(400, f"malformed body: {e}")
             return
-        if kind in _CLUSTER_SCOPED:
-            obj.metadata.namespace = ""
-        elif ns:
-            obj.metadata.namespace = ns  # the URL namespace wins (kube semantics)
-        elif not obj.metadata.namespace:
-            obj.metadata.namespace = "default"
+        # collection POST with an "items" list = batch create (one
+        # round-trip for a whole cluster's setup; single objects never
+        # encode with a top-level "items" key).  Per-item errors are
+        # returned per entry, like the batch bindings endpoint.
+        if isinstance(body, dict) and isinstance(body.get("items"), list):
+            self._create_many(kind, ns, body["items"])
+            return
+        try:
+            obj = _decode(REST_KINDS[kind], body)
+        except Exception as e:
+            self._error(400, f"malformed body: {e}")
+            return
+        _fixup_namespace(kind, ns, obj)
         try:
             self._send(201, _encode(self.store.create(kind, obj)))
         except KeyError as e:
             self._error(409, str(e))
+
+    def _create_many(self, kind: str, ns: str, items: list) -> None:
+        """Batch create: decode + create each item, same namespace fixup
+        as the single-object POST; one response entry per item ({"object"}
+        on success, {"error", "type"} on conflict/bad input)."""
+        out = []
+        for raw in items:
+            try:
+                obj = _decode(REST_KINDS[kind], raw)
+            except Exception as e:
+                out.append({"error": f"malformed item: {e}", "type": "BadRequest"})
+                continue
+            _fixup_namespace(kind, ns, obj)
+            try:
+                out.append({"object": _encode(self.store.create(kind, obj))})
+            except KeyError as e:
+                out.append({"error": str(e), "type": "Conflict"})
+        self._send(200, {"items": out})
 
     def _bind_many(self) -> None:
         """Batch binding subresource: a wave's placements in ONE request

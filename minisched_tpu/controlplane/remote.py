@@ -233,6 +233,35 @@ class RemoteStore:
             ),
         )
 
+    def create_many(self, kind: str, objs: List[Any]) -> List[Any]:
+        """Batch create: one collection POST per distinct namespace
+        (cluster setup at one request per object ran ~380 obj/s — 29s of
+        wall around a 1.7s measurement).  Per-namespace batching matters:
+        the server rewrites every item's namespace to the URL's, so a
+        mixed batch on one URL would silently move objects across
+        namespaces.  Returns objects aligned with ``objs``; a per-item
+        failure comes back as the exception."""
+        if not objs:
+            return []
+        typ = _kind_types()[kind]
+        by_ns: dict = {}
+        for i, o in enumerate(objs):
+            by_ns.setdefault(o.metadata.namespace, []).append(i)
+        results: List[Any] = [None] * len(objs)
+        for ns, idxs in by_ns.items():
+            out = self._req(
+                "POST",
+                self._path(kind, ns),
+                {"items": [_encode(objs[i]) for i in idxs]},
+            )
+            for i, item in zip(idxs, out["items"]):
+                err = item.get("error")
+                if err is not None:
+                    results[i] = KeyError(err)
+                else:
+                    results[i] = _decode(typ, item["object"])
+        return results
+
     def update(self, kind: str, obj: Any) -> Any:
         typ = _kind_types()[kind]
         return _decode(
@@ -285,7 +314,8 @@ class RemoteStore:
 
 class _RemotePodAPI(_PodAPI):
     """The Pod facade over the wire: everything rides the RemoteStore's
-    REST calls; binds take the batch endpoint (one request per wave)."""
+    REST calls; binds take the batch endpoint (one request per wave),
+    batch creates one collection POST."""
 
     def bind_many(
         self, bindings: List[Binding], return_objects: bool = True
@@ -293,6 +323,31 @@ class _RemotePodAPI(_PodAPI):
         return self._store.bind_many_remote(
             bindings, return_objects=return_objects
         )
+
+    def create_many(self, pods: List[Any]) -> List[Any]:
+        for p in pods:
+            if not p.metadata.namespace:
+                p.metadata.namespace = self._ns
+        out = []
+        for res in self._store.create_many("Pod", pods):
+            if isinstance(res, BaseException):
+                raise res
+            out.append(res)
+        return out
+
+
+class _RemoteNodeAPI(_NodeAPI):
+    """Node facade over the wire with the batch-create collection POST."""
+
+    def create_many(self, nodes: List[Any]) -> List[Any]:
+        for n in nodes:
+            n.metadata.namespace = ""
+        out = []
+        for res in self._store.create_many("Node", nodes):
+            if isinstance(res, BaseException):
+                raise res
+            out.append(res)
+        return out
 
 
 class RemoteClient:
@@ -303,8 +358,8 @@ class RemoteClient:
     def __init__(self, base_url: str):
         self.store = RemoteStore(base_url)
 
-    def nodes(self) -> _NodeAPI:
-        return _NodeAPI(self.store)
+    def nodes(self) -> _RemoteNodeAPI:
+        return _RemoteNodeAPI(self.store)
 
     def pods(self, namespace: str = "default") -> _RemotePodAPI:
         return _RemotePodAPI(self.store, namespace)

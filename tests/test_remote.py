@@ -218,3 +218,44 @@ def test_remote_watch_reconnects_and_resyncs():
         factory.shutdown()
     finally:
         shutdown()
+
+
+def test_batch_create_collection_post():
+    """Collection POST with an items list creates the whole batch in one
+    round-trip — per-item conflict errors come back per entry and never
+    abort the rest (same shape as the batch bindings endpoint)."""
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        created = client.nodes().create_many(
+            [make_node(f"bn{i}") for i in range(5)]
+        )
+        assert [n.metadata.name for n in created] == [
+            f"bn{i}" for i in range(5)
+        ]
+        assert {n.metadata.name for n in client.nodes().list()} == {
+            f"bn{i}" for i in range(5)
+        }
+        pods = client.pods().create_many(
+            [make_pod(f"bp{i}", requests={"cpu": "100m"}) for i in range(7)]
+        )
+        assert len(pods) == 7
+        assert all(p.metadata.resource_version for p in pods)
+        assert len(client.pods().list()) == 7
+        # duplicate in the batch: that entry errors, the rest land
+        results = client.store.create_many(
+            "Pod", [make_pod("bp0"), make_pod("bp-new")]
+        )
+        assert isinstance(results[0], KeyError)
+        assert results[1].metadata.name == "bp-new"
+        assert client.pods().get("bp-new") is not None
+        # the in-process client exposes the same surface
+        from minisched_tpu.controlplane.client import Client
+
+        local = Client()
+        out = local.nodes().create_many([make_node("ln0"), make_node("ln1")])
+        assert [n.metadata.name for n in out] == ["ln0", "ln1"]
+        out = local.pods().create_many([make_pod("lp0")])
+        assert out[0].metadata.namespace == "default"
+    finally:
+        shutdown()
