@@ -152,7 +152,11 @@ class FullRosterScanOracle:
     """
 
     def __init__(self, nodes: Sequence[Any], default_nz_cpu: int,
-                 default_nz_mem_mib: int):
+                 default_nz_mem_mib: int, with_balanced: bool = True):
+        #: with_balanced: include BalancedAllocation in the score (the
+        #: full default roster).  False models the config-3 chain
+        #: (Fit + LeastAllocated only, scheduler_test.go config shapes).
+        self._with_balanced = with_balanced
         n = len(nodes)
         self.n = n
         MIB = 1 << 20
@@ -257,19 +261,26 @@ class FullRosterScanOracle:
 
         la = (least(r_cpu, a_cpu) + least(r_mem, a_mem)) // 2
 
-        # BalancedAllocation (plugins/noderesources.py:196-221)
-        def frac(requested, alloc):
-            clamped = np.minimum(requested, 2 * alloc)
-            return np.where(
-                alloc > 0,
-                clamped * FRAC_SCALE // np.maximum(alloc, 1),
-                FRAC_SCALE,
-            )
+        if self._with_balanced:
+            # BalancedAllocation (plugins/noderesources.py:196-221)
+            def frac(requested, alloc):
+                clamped = np.minimum(requested, 2 * alloc)
+                return np.where(
+                    alloc > 0,
+                    clamped * FRAC_SCALE // np.maximum(alloc, 1),
+                    FRAC_SCALE,
+                )
 
-        cpu_f, mem_f = frac(r_cpu, a_cpu), frac(r_mem, a_mem)
-        ba = (FRAC_SCALE - np.abs(cpu_f - mem_f)) * MAX_NODE_SCORE // FRAC_SCALE
-        ba = np.where((cpu_f >= FRAC_SCALE) | (mem_f >= FRAC_SCALE), 0, ba)
-        g["score"][rows] = la + ba  # both weight 1 in the default roster
+            cpu_f, mem_f = frac(r_cpu, a_cpu), frac(r_mem, a_mem)
+            ba = (
+                (FRAC_SCALE - np.abs(cpu_f - mem_f))
+                * MAX_NODE_SCORE // FRAC_SCALE
+            )
+            ba = np.where(
+                (cpu_f >= FRAC_SCALE) | (mem_f >= FRAC_SCALE), 0, ba
+            )
+            la = la + ba  # both weight 1 in the default roster
+        g["score"][rows] = la
         g["seen"][rows] = self._node_version[rows]
 
     def place(self, pod: Any) -> int:

@@ -1,0 +1,191 @@
+"""Ablate the repair step's phases on the real device at config5 wave
+shapes: evaluate / accept / apply / select, timed separately — the
+round-4 op profile was FLAT (largest fusion 21%), so the lever must be
+found empirically, not assumed (VERDICT r4 item 8).
+
+PN/PW env: node/pod counts (default 10_000 × 16_384).
+"""
+
+import os
+import sys
+import time
+
+from minisched_tpu.utils.compilecache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import random
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.models.constraints import build_constraint_tables
+from minisched_tpu.models.tables import build_node_table, build_pod_table, pad_to
+from minisched_tpu.ops.fused import BatchContext, evaluate, precompute_static, select_hosts
+from minisched_tpu.ops.repair import accept_placements, repair_wave_step
+from minisched_tpu.ops.state import apply_placements
+from minisched_tpu.plugins.registry import build_plugins
+from minisched_tpu.service.config import default_full_roster_config
+
+print("backend:", jax.default_backend(), file=sys.stderr)
+
+N_NODES = int(os.environ.get("PN", 10_000))
+WAVE = int(os.environ.get("PW", 16_384))
+
+rng = random.Random(55)
+nodes = sorted(
+    (
+        make_node(
+            f"node{i:05d}",
+            unschedulable=rng.random() < 0.2,
+            capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            labels={"zone": f"z{i % 16}"},
+        )
+        for i in range(N_NODES)
+    ),
+    key=lambda n: n.metadata.name,
+)
+pods = [
+    make_pod(f"pod{i:06d}", requests={"cpu": "500m", "memory": "256Mi"})
+    for i in range(WAVE)
+]
+
+cfg = default_full_roster_config()
+chains = build_plugins(cfg)
+ctx = BatchContext(weights=tuple(sorted(cfg.score_weights().items())))
+
+node_table, names = build_node_table(nodes)
+pod_table, _ = build_pod_table(pods, capacity=pad_to(WAVE))
+extra = build_constraint_tables(
+    pods, nodes, [],
+    pod_capacity=pod_table.capacity, node_capacity=node_table.capacity,
+    scan_planes=False,
+)
+
+
+def timed(label, fn, *args, reps=4, **kw):
+    out = None
+    best = float("inf")
+    for rep in range(reps):
+        t0 = time.monotonic()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        dt = time.monotonic() - t0
+        if rep:  # rep 0 is compile
+            best = min(best, dt)
+    print(f"{label:<28s} {best*1e3:8.1f} ms", file=sys.stderr)
+    return out
+
+
+# 1. the full repair step (diagnostics OFF — the live packed path has
+# them ON; compare both)
+for diag in (False, True):
+    step = jax.jit(partial(
+        repair_wave_step,
+        filter_plugins=tuple(chains.filter),
+        pre_score_plugins=tuple(chains.pre_score),
+        score_plugins=tuple(chains.score),
+        ctx=ctx, max_rounds=16, with_diagnostics=diag,
+    ))
+    timed(f"full repair (diag={diag})", step, node_table, pod_table,
+          extra=extra)
+
+# 2. static precompute (once per wave) — StaticWavePlanes is not a
+# pytree (it only ever lives inside one jitted program), so the probe
+# returns its leaves
+def _static_only(pods, nodes, extra):
+    s = precompute_static(
+        pods, nodes, tuple(chains.filter), tuple(chains.pre_score),
+        tuple(chains.score), ctx, extra=extra,
+    )
+    return (s.static_mask, s.aux, s.raw_scores)
+
+timed("precompute_static", jax.jit(_static_only), pod_table, node_table,
+      extra)
+
+
+# 3. static + one round's evaluate (loop-body shape); evaluate alone ≈
+# this minus the static probe above
+def _static_plus_round(pods, nodes, extra):
+    s = precompute_static(
+        pods, nodes, tuple(chains.filter), tuple(chains.pre_score),
+        tuple(chains.score), ctx, extra=extra,
+    )
+    return evaluate(
+        pods, nodes, tuple(chains.filter), tuple(chains.pre_score),
+        tuple(chains.score), ctx, extra=extra, static=s,
+    )
+
+result = timed("static + 1 evaluate round", jax.jit(_static_plus_round),
+               pod_table, node_table, extra)
+
+# 4. accept_placements on the round's choice
+fam_limits = tuple(
+    (pl.volume_family_index, pl.max_volumes)
+    for pl in chains.filter
+    if getattr(pl, "volume_family_index", None) is not None
+)
+acc_fn = jax.jit(partial(accept_placements, check_resources=True,
+                         check_ports=True))
+accept = timed("accept_placements", acc_fn, node_table, pod_table,
+               result.choice, pod_table.valid)
+
+# 5. apply_placements scatter
+app_fn = jax.jit(apply_placements)
+timed("apply_placements", app_fn, node_table, pod_table,
+      jnp.where(accept, result.choice, -1))
+
+# 6. select_hosts alone at this shape (inside evaluate already, but
+# isolate its share)
+P = pod_table.valid.shape[0]
+N = node_table.valid.shape[0]
+scores = jnp.zeros((P, N), jnp.int32)
+mask = pod_table.valid[:, None] & node_table.valid[None, :]
+sel = jax.jit(select_hosts)
+timed("select_hosts (current)", sel, scores, mask, pod_table.seed)
+
+
+# 7. per-plugin ablation of the static half — which kernel owns
+# precompute_static's share?
+def _one_filter(pl):
+    if getattr(pl, "needs_extra", False):
+        return jax.jit(lambda p, n, e: pl.batch_filter(ctx, p, n, e))
+    return jax.jit(lambda p, n, e: pl.batch_filter(ctx, p, n))
+
+
+def _one_score(pl):
+    def fn(p, n, e):
+        aux = {}
+        for pre in chains.pre_score:
+            if pre.name() == pl.name():
+                aux = pre.batch_pre_score(ctx, p, n)
+        if getattr(pl, "needs_extra", False):
+            return pl.batch_score(ctx, p, n, aux, e)
+        return pl.batch_score(ctx, p, n, aux)
+
+    return jax.jit(fn)
+
+
+print("-- static filters --", file=sys.stderr)
+for pl in chains.filter:
+    if getattr(pl, "reads_committed_state", False):
+        continue
+    timed(f"  filter {pl.name()}", _one_filter(pl), pod_table, node_table,
+          extra)
+print("-- static scores --", file=sys.stderr)
+for pl in chains.score:
+    if getattr(pl, "reads_committed_state", False):
+        continue
+    timed(f"  score {pl.name()}", _one_score(pl), pod_table, node_table,
+          extra)
+print("-- dynamic (per round) --", file=sys.stderr)
+for pl in chains.filter:
+    if getattr(pl, "reads_committed_state", False):
+        timed(f"  filter {pl.name()}", _one_filter(pl), pod_table,
+              node_table, extra)
+for pl in chains.score:
+    if getattr(pl, "reads_committed_state", False):
+        timed(f"  score {pl.name()}", _one_score(pl), pod_table, node_table,
+              extra)
