@@ -4,10 +4,18 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench docker clean
+.PHONY: test native start serve bench chaos docker clean
 
 test: native
 	python -m pytest tests/ -q
+
+# chaos soak under a FIXED fault-schedule seed: the fabric's injection
+# decisions are a pure function of (seed, point, key, ordinal), so a
+# failure here reproduces byte-for-byte — override the seed with
+# MINISCHED_CHAOS_SEED=<n> to explore other schedules
+chaos: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_chaos_soak.py tests/test_faults.py -q
 
 # native host-table kernels (auto-built on first import too; this target
 # is for explicit/offline builds)

@@ -1223,11 +1223,135 @@ def bench_wire() -> dict:
         shutdown()
 
 
+def bench_chaos() -> dict:
+    """Chaos soak at bench scale: the device wave engine over a WAL store
+    while the fault fabric injects store/bind/watch/WAL failures on a
+    seeded schedule (BENCH_CHAOS_SEED reproduces the exact injections).
+    Reports convergence + the injected/recovered counts — the product
+    claim is 'survives a lossy control plane without leaking capacity',
+    so the record carries the leak/double-bind audit results, not just a
+    throughput number."""
+    import tempfile
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.faults import FaultFabric
+    from minisched_tpu.observability import counters
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    n_nodes = int(os.environ.get("BENCH_CHAOS_NODES", "128"))
+    n_pods = int(os.environ.get("BENCH_CHAOS_PODS", "2000"))
+    wal = os.path.join(tempfile.mkdtemp(prefix="minisched-chaos-"), "c.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+    for i in range(n_nodes):
+        client.nodes().create(
+            make_node(
+                f"node{i:04d}",
+                unschedulable=i % 16 == 0,
+                capacity={"cpu": "64", "memory": "128Gi", "pods": 256},
+            )
+        )
+    client.pods().create_many(
+        [
+            make_pod(f"cp{i:05d}", requests={"cpu": "500m", "memory": "64Mi"})
+            for i in range(n_pods)
+        ]
+    )
+    fabric = (
+        FaultFabric(seed)
+        .on("store.update", rate=0.10)
+        .on("store.get", rate=0.05)
+        .on("watch.drop", rate=0.02, max_fires=16, keys={"Pod", "Node"})
+        .on("wal.append", rate=0.03, max_fires=16)
+        .on("engine.bind", rate=0.05, max_fires=16)
+    )
+    counters.reset()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True,
+        max_wave=int(os.environ.get("BENCH_CHAOS_WAVE", "512")),
+    )
+    sched.faults = fabric
+    sched.assume_ttl_s = 3.0
+    store.fault_injector = fabric.as_store_injector()
+    store.faults = fabric
+    t0 = time.monotonic()
+    deadline = t0 + float(os.environ.get("BENCH_CHAOS_DEADLINE_S", "300"))
+    bound = 0
+    try:
+        while time.monotonic() < deadline:
+            try:
+                bound = sum(
+                    1 for p in client.pods().list() if p.spec.node_name
+                )
+            except Exception:
+                continue  # injected list fault on our own poll
+            if bound >= n_pods:
+                break
+            if sched.queue.stats()["unschedulable"]:
+                sched.queue.flush_unschedulable_leftover()
+                sched.queue.flush_backoff_completed()
+            time.sleep(0.25)
+        elapsed = time.monotonic() - t0
+        # quiesce: the assume ledger must drain (lease confirm path)
+        drain_deadline = time.monotonic() + 10 * sched.assume_ttl_s
+        leaked = True
+        while time.monotonic() < drain_deadline:
+            with sched._assumed_lock:
+                leaked = bool(sched._assumed)
+            if not leaked:
+                break
+            time.sleep(0.25)
+        store.fault_injector = None
+        store.faults = None
+        if bound < n_pods:
+            raise SystemExit(
+                f"[chaos] DID NOT CONVERGE: {bound}/{n_pods} bound; "
+                f"faults={fabric.stats()} counters={counters.snapshot()}"
+            )
+        if leaked:
+            raise SystemExit("[chaos] ASSUMED-CAPACITY LEAK at quiesce")
+    finally:
+        svc.shutdown_scheduler()
+        store.close()
+    # WAL history audit: no pod ever bound to two different nodes
+    from minisched_tpu.faults import wal_double_binds
+
+    violations = wal_double_binds(wal)
+    if violations:
+        raise SystemExit(f"[chaos] DOUBLE BIND: {violations[:5]}")
+    stats = fabric.stats()
+    log(
+        f"[chaos] {n_pods} pods converged under "
+        f"{sum(stats['fires'].values())} injected faults in {elapsed:.1f}s "
+        f"(seed={seed}; no leak, no double-bind)"
+    )
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "total_s": round(elapsed, 1),
+        "seed": seed,
+        "injected": stats["fires"],
+        "recovered": {
+            k: v
+            for k, v in counters.snapshot().items()
+            if v and not k.startswith("assume.lease_renewed")
+        },
+        "leak": False,
+        "double_bind": False,
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
     "fullchain_parity": bench_fullchain_parity,
     "wire": bench_wire,
+    "chaos": bench_chaos,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -1305,6 +1429,10 @@ def main() -> None:
                 "wire-crosspod",
             )
         )
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        # degraded-mode soak: convergence + leak/double-bind audits under
+        # a seeded fault schedule (BENCH_CHAOS_SEED reproduces it)
+        optional.append(("chaos_soak", "chaos", None, "chaos"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

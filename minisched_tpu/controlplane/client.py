@@ -95,6 +95,26 @@ class AlreadyBound(Exception):
     pass
 
 
+def _create_all_then_raise(create_one, objs: List[Any]) -> List[Any]:
+    """The shared batch-create contract of BOTH facades: each item is
+    independent — per-item KeyErrors (conflicts) are collected while the
+    rest keep creating, then the FIRST one raises.  This is what
+    RemoteStore.create_many already does server-side; the in-process loop
+    must not predict different cluster state."""
+    out: List[Any] = []
+    first_err: Optional[KeyError] = None
+    for obj in objs:
+        try:
+            out.append(create_one(obj))
+        except KeyError as err:
+            out.append(None)
+            if first_err is None:
+                first_err = err
+    if first_err is not None:
+        raise first_err
+    return out
+
+
 class _NodeAPI:
     def __init__(self, store: ObjectStore):
         self._store = store
@@ -109,8 +129,11 @@ class _NodeAPI:
         """Batch create, aligned with ``nodes`` — the remote client turns
         this into ONE collection POST (k8sapiserver setup at bench scale
         was ~380 obj/s with a round-trip per object); in-process it's a
-        plain loop."""
-        return [self.create(n) for n in nodes]
+        plain loop.  Partial-failure semantics MATCH the remote facade:
+        every non-conflicting item is created, then the first per-item
+        KeyError raises — aborting at the first conflict (the old
+        behavior) made cluster state facade-dependent."""
+        return _create_all_then_raise(self.create, nodes)
 
     def get(self, name: str) -> Node:
         return self._store.get(KIND_NODE, "", name)
@@ -136,8 +159,9 @@ class _PodAPI:
         return self._store.create(KIND_POD, pod)
 
     def create_many(self, pods: List[Pod]) -> List[Pod]:
-        """Batch create, aligned with ``pods`` — see _NodeAPI.create_many."""
-        return [self.create(p) for p in pods]
+        """Batch create, aligned with ``pods`` — see _NodeAPI.create_many
+        (all independent items, first KeyError raised at the end)."""
+        return _create_all_then_raise(self.create, pods)
 
     def get(self, name: str, namespace: Optional[str] = None) -> Pod:
         return self._store.get(KIND_POD, namespace or self._ns, name)

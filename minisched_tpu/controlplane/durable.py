@@ -61,6 +61,17 @@ class DurableObjectStore(ObjectStore):
                 f"durable store {self._path!r} is closed; mutation refused"
             )
 
+    def _check_wal_writable(self, kind: str) -> None:
+        """``wal.append`` injection point (faults.FaultFabric): a WAL
+        write failure surfaces as a failed API call BEFORE the in-memory
+        commit — same reason as _check_open: failing AFTER the mutation
+        would leave watchers and the reopened WAL divergent.  (A real
+        mid-append crash is the other failure mode; the torn-tail
+        truncation in _replay covers that one.)"""
+        faults = self.faults
+        if faults is not None and self._loggable(kind):
+            faults.check("wal.append", kind)
+
     def _append(self, rec: dict) -> None:
         if self._log is None:
             return  # replay: the record being applied is already in the log
@@ -79,6 +90,7 @@ class DurableObjectStore(ObjectStore):
         batch instead of per bind."""
         with self._lock:
             self._check_open()
+            self._check_wal_writable(kind)
             self._defer_flush = True
             try:
                 return super().mutate_many(
@@ -100,6 +112,7 @@ class DurableObjectStore(ObjectStore):
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:
             self._check_open()
+            self._check_wal_writable(kind)
             out = super().create(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(out)})
@@ -108,6 +121,7 @@ class DurableObjectStore(ObjectStore):
     def update(self, kind: str, obj: Any) -> Any:
         with self._lock:
             self._check_open()
+            self._check_wal_writable(kind)
             out = super().update(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(out)})
@@ -116,6 +130,7 @@ class DurableObjectStore(ObjectStore):
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             self._check_open()
+            self._check_wal_writable(kind)
             super().delete(kind, namespace, name)
             if self._loggable(kind):
                 self._append(
@@ -130,6 +145,7 @@ class DurableObjectStore(ObjectStore):
     def restore_object(self, kind: str, obj: Any) -> None:
         with self._lock:
             self._check_open()
+            self._check_wal_writable(kind)
             super().restore_object(kind, obj)
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(obj)})

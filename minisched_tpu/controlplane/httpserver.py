@@ -95,10 +95,41 @@ class _Handler(BaseHTTPRequestHandler):
     store: ObjectStore = None  # set by start_api_server
     active_watches = None  # set by start_api_server (set + lock)
     watch_lock = None
+    faults = None  # optional faults.FaultFabric, set by start_api_server
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:  # quiet
         pass
+
+    def _inject_fault(self) -> bool:
+        """Consult the fabric before routing: ``http.reset`` closes the
+        connection without a single response byte (the client sees a
+        transport error — retries must assume the request MAY have been
+        processed, which is why only pre-commit injection and idempotent
+        verbs are safe to replay blindly; see remote.py); ``http.500``
+        answers 503.  Both fire BEFORE the store is touched, so a retried
+        request never finds half-applied state.  /healthz is exempt —
+        readiness polling is the one probe chaos must not lie to."""
+        f = self.faults
+        if f is None:
+            return False
+        path = self.path.partition("?")[0]
+        if path == "/healthz":
+            return False
+        if f.should_fire("http.reset", path):
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            self.close_connection = True
+            return True
+        if f.should_fire("http.500", path):
+            # the body may be unread; keep-alive reuse would misparse it
+            # as the next request's start line
+            self.close_connection = True
+            self._error(503, "injected: control plane unavailable")
+            return True
+        return False
 
     def _send(self, code: int, payload: Any) -> None:
         body = json.dumps(payload).encode()
@@ -116,6 +147,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, {"error": msg})
 
     def do_GET(self) -> None:
+        if self._inject_fault():
+            return
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._send(200, "ok")
@@ -196,6 +229,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.active_watches.discard(watch)
 
     def do_POST(self) -> None:
+        if self._inject_fault():
+            return
         if self.path.partition("?")[0] == "/api/v1/bindings":
             self._bind_many()
             return
@@ -292,9 +327,20 @@ class _Handler(BaseHTTPRequestHandler):
             bindings, return_objects=return_objects
         )
         out = []
-        for res in results:
+        for b, res in zip(bindings, results):
             if isinstance(res, AlreadyBound):
-                out.append({"error": str(res), "type": "AlreadyBound"})
+                # carry the CURRENT bound node as a structured field: the
+                # remote client's idempotent-retry dedup compares it to
+                # the node it asked for — string-matching the prose
+                # message would couple the wire contract to an f-string
+                entry = {"error": str(res), "type": "AlreadyBound"}
+                try:
+                    entry["node"] = self.store.get(
+                        "Pod", b.pod_namespace, b.pod_name
+                    ).spec.node_name
+                except Exception:
+                    pass  # pod vanished between bind and lookup
+                out.append(entry)
             elif isinstance(res, BaseException):
                 out.append({"error": str(res), "type": "NotFound"})
             elif res is not None:
@@ -304,6 +350,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, {"items": out})
 
     def do_PUT(self) -> None:
+        if self._inject_fault():
+            return
         try:
             kind, ns, name, _ = _route(self.path)
         except (KeyError, ValueError):
@@ -328,6 +376,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, str(e))
 
     def do_DELETE(self) -> None:
+        if self._inject_fault():
+            return
         try:
             kind, ns, name, _ = _route(self.path)
             self.store.delete(kind, ns, name)
@@ -337,16 +387,19 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def start_api_server(
-    store: Optional[ObjectStore] = None, port: int = 0
+    store: Optional[ObjectStore] = None, port: int = 0, faults: Any = None
 ) -> Tuple[ThreadingHTTPServer, str, Callable[[], None]]:
     """Boot the REST façade on an ephemeral port and poll /healthz until it
     answers (k8sapiserver.go:231-249's readiness loop).  Returns
-    (server, base_url, shutdown_fn)."""
+    (server, base_url, shutdown_fn).  ``faults``: a faults.FaultFabric
+    armed with http.500 / http.reset makes this server lossy on purpose
+    (see _Handler._inject_fault)."""
     store = store or ObjectStore()
     handler = type(
         "BoundHandler",
         (_Handler,),
-        {"store": store, "active_watches": set(), "watch_lock": threading.Lock()},
+        {"store": store, "active_watches": set(),
+         "watch_lock": threading.Lock(), "faults": faults},
     )
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)

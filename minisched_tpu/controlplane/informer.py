@@ -15,10 +15,12 @@ and always observe events in cache order.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from minisched_tpu.controlplane.store import EventType, ObjectStore, WatchEvent
+from minisched_tpu.observability import counters
 
 Handler = Callable[[Any], None]
 UpdateHandler = Callable[[Any, Any], None]
@@ -70,6 +72,12 @@ class Informer:
         # a forgotten gate can stall the stream.
         self._gate = threading.Event()
         self._gate.set()
+        #: degraded-mode gauges: how many times the watch died and was
+        #: re-opened, and when this informer last made progress (either a
+        #: delivered batch or a verified-quiet live stream) — consumers
+        #: read ``staleness_s()`` to decide how much to trust the cache
+        self.reconnects = 0
+        self._last_progress_t = time.monotonic()
 
     def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
         with self._lock:
@@ -94,12 +102,52 @@ class Informer:
             return
         self._stop.clear()
         self._synced.clear()
-        self._watch, snapshot = self._store.watch(self._kind, send_initial=True)
-        self._initial = len(snapshot)
+        # the initial watch opens ON the dispatch thread (see _open_initial)
+        # so a control plane that is lossy AT BOOT delays sync instead of
+        # crashing the service — the same degraded mode as a mid-run drop
+        self._watch = None
+        self._initial = 0
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self._kind}", daemon=True
         )
         self._thread.start()
+
+    def _open_watch(self, backoff: float) -> Optional[List[Any]]:
+        """Open a watch (initial or reconnect) with bounded backoff — a
+        watch open is one HTTP request on the remote store, exactly as
+        droppable as the stream it starts.  Assigns ``self._watch`` and
+        returns the snapshot, or None only on shutdown."""
+        while not self._stop.is_set():
+            try:
+                watch, snapshot = self._store.watch(
+                    self._kind, send_initial=True
+                )
+            except Exception as err:
+                print(
+                    f"informer-{self._kind}: watch open failed ({err!r});"
+                    f" retrying in {backoff:.1f}s"
+                )
+                counters.inc("informer.open_retry")
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            self._watch = watch
+            if self._stop.is_set():
+                # stop() raced the open: it sets _stop BEFORE reading
+                # _watch, so either it saw this watch (and stopped it) or
+                # we see _stop here — stop it ourselves (stop is
+                # idempotent) so no orphan registration accretes events
+                watch.stop()
+                return None
+            return snapshot
+        return None
+
+    def _open_initial(self) -> bool:
+        snapshot = self._open_watch(backoff=0.1)
+        if snapshot is None:
+            return False
+        self._initial = len(snapshot)
+        return True
 
     def _drain_replays(self) -> None:
         while True:
@@ -110,6 +158,8 @@ class Informer:
             self._invoke(handlers, events)
 
     def _run(self) -> None:
+        if not self._open_initial():
+            return  # stopped before the control plane ever answered
         seen = 0
         if self._initial == 0:
             self._synced.set()
@@ -122,6 +172,12 @@ class Informer:
         while not self._stop.is_set():
             self._drain_replays()
             batch = self._watch.next_batch(timeout=0.1)
+            if batch or not self._watch.stopped:
+                # a delivered batch, or a live-but-quiet stream: either way
+                # the cache is current as of now.  The stamp freezes while
+                # the watch is down (reconnect backoff) — that widening gap
+                # is exactly what staleness_s() reports.
+                self._last_progress_t = time.monotonic()
             if batch and not self._gate.is_set():
                 # a gated batch is HELD, not dropped: the engine closes the
                 # gate just before delivering a wave's bind events and
@@ -199,33 +255,23 @@ class Informer:
         against the cache by the _run loop so consumers converge on the
         post-outage state without replaying what they already saw.
         Returns False only when the informer is shutting down."""
-        backoff = 0.5
-        while not self._stop.is_set():
-            try:
-                self._watch, snapshot = self._store.watch(
-                    self._kind, send_initial=True
-                )
-            except Exception as err:
-                print(
-                    f"informer-{self._kind}: re-watch failed ({err!r}); "
-                    f"retrying in {backoff:.1f}s"
-                )
-                self._stop.wait(backoff)
-                backoff = min(backoff * 2, 10.0)
-                continue
-            stale: List[WatchEvent] = []
-            with self._lock:
-                self._replay_pending = len(snapshot)
-                self._replay_seen = set()
-                if self._replay_pending == 0:
-                    # empty server: everything we cached is gone
-                    stale = self._finish_replay_locked()
-                handlers = list(self._handlers)
-            if stale:
-                for h in handlers:
-                    self._invoke(h, stale)
-            return True
-        return False
+        snapshot = self._open_watch(backoff=0.5)
+        if snapshot is None:
+            return False
+        self.reconnects += 1
+        counters.inc("informer.reconnect")
+        stale: List[WatchEvent] = []
+        with self._lock:
+            self._replay_pending = len(snapshot)
+            self._replay_seen = set()
+            if self._replay_pending == 0:
+                # empty server: everything we cached is gone
+                stale = self._finish_replay_locked()
+            handlers = list(self._handlers)
+        if stale:
+            for h in handlers:
+                self._invoke(h, stale)
+        return True
 
     def _invoke(self, h: ResourceEventHandlers, events: List[WatchEvent]) -> None:
         """One handler over a batch: a registered ``on_batch`` takes the
@@ -262,6 +308,12 @@ class Informer:
 
     def wait_for_cache_sync(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
+
+    def staleness_s(self) -> float:
+        """Seconds since this informer last KNEW it was current (live
+        stream observed).  Grows while the watch is down; snaps back to ~0
+        once the reconnect's replay lands."""
+        return time.monotonic() - self._last_progress_t
 
     def lister(self) -> List[Any]:
         with self._lock:
@@ -327,6 +379,17 @@ class SharedInformerFactory:
             if remaining <= 0 or not inf.wait_for_cache_sync(remaining):
                 return False
         return True
+
+    def staleness(self) -> Dict[str, Dict[str, float]]:
+        """Per-kind staleness gauge (see Informer.staleness_s) plus
+        reconnect counts — the degraded-mode dashboard line."""
+        return {
+            kind: {
+                "staleness_s": round(inf.staleness_s(), 3),
+                "reconnects": inf.reconnects,
+            }
+            for kind, inf in self._informers.items()
+        }
 
     def pause_dispatch(self) -> None:
         """Hold event dispatch for every informer (see Informer._gate)."""

@@ -42,7 +42,13 @@ class PVController:
 
     def start(self) -> "PVController":
         self._factory.start()
-        self._factory.wait_for_cache_sync()
+        # the informers now retry a failed watch open in the background
+        # (lossy-at-boot control plane) instead of raising here — so the
+        # sync result must be CHECKED, or a plane that stays down hands
+        # back a "started" controller with an empty PV cache that binds
+        # nothing and says nothing.  Same idiom as SchedulerService.
+        if not self._factory.wait_for_cache_sync(timeout=300.0):
+            raise RuntimeError("PV controller informer caches failed to sync")
         return self
 
     def stop(self) -> None:

@@ -21,8 +21,11 @@ never aborting the rest.
 
 from __future__ import annotations
 
+import http.client
 import json
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, List, Optional, Tuple
@@ -35,6 +38,9 @@ from minisched_tpu.controlplane.client import (
     _PodAPI,
 )
 from minisched_tpu.controlplane.store import EventType, WatchEvent
+from minisched_tpu.faults import InjectedFault
+from minisched_tpu.observability import counters
+from minisched_tpu.utils.retry import backoff_delays
 
 _COLLECTIONS = {
     "Node": "nodes",
@@ -166,11 +172,60 @@ class RemoteWatch:
         return self._stopped
 
 
-class RemoteStore:
-    """The ObjectStore surface the informers + engine consume, over REST."""
+#: transport-level failures worth a retry: the request may never have
+#: reached the server (connection refused/reset, DNS) or the response was
+#: lost (timeout, dropped stream).  HTTPError is NOT here — it means the
+#: server answered; only its 5xx family is retried, inside _req_ex.
+_TRANSIENT_ERRORS = (
+    urllib.error.URLError,
+    ConnectionError,
+    TimeoutError,
+    http.client.HTTPException,
+    InjectedFault,
+    OSError,
+)
 
-    def __init__(self, base_url: str):
+
+class RemoteStore:
+    """The ObjectStore surface the informers + engine consume, over REST.
+
+    Every call carries a per-call timeout and retries transient failures
+    (connection resets, timeouts, HTTP 5xx) with jittered exponential
+    backoff — a scheduler facing a lossy control plane must degrade into
+    waiting, not crash or silently drop state.  Semantic errors (404/409:
+    AlreadyBound, missing object, conflict) never retry.
+
+    Retry safety: GET/PUT/DELETE are idempotent and replay blindly.  The
+    batch-bind POST is made idempotent by the bind subresource's own
+    precondition (spec.node_name must be unset — the store-side analog of
+    a resource_version precondition): a retried bind whose first attempt
+    actually landed comes back AlreadyBound *to the node we asked for*,
+    which bind_many_remote converts to success.  Create POSTs are replayed
+    too; a retry whose first attempt landed surfaces as a per-item
+    conflict, which callers already handle per entry.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout_s: float = 30.0,
+        retries: int = 4,
+        backoff_initial_s: float = 0.05,
+        backoff_factor: float = 2.0,
+        backoff_jitter: float = 0.2,
+        retry_seed: Optional[int] = None,
+        faults: Any = None,
+    ):
         self._base = base_url.rstrip("/")
+        self._timeout_s = timeout_s
+        self._retries = max(int(retries), 0)
+        self._backoff_initial_s = backoff_initial_s
+        self._backoff_factor = backoff_factor
+        self._backoff_jitter = backoff_jitter
+        self._rng = random.Random(retry_seed)
+        #: faults.FaultFabric consulted at ``remote.request`` before each
+        #: attempt leaves the process (client-side connection reset)
+        self._faults = faults
 
     # -- plumbing -----------------------------------------------------------
     def _path(self, kind: str, namespace: str = "", name: str = "") -> str:
@@ -182,21 +237,51 @@ class RemoteStore:
         return f"{p}/{name}" if name else p
 
     def _req(self, method: str, path: str, payload: Any = None) -> Any:
+        return self._req_ex(method, path, payload)[0]
+
+    def _req_ex(
+        self, method: str, path: str, payload: Any = None
+    ) -> Tuple[Any, int]:
+        """(decoded response, attempts used beyond the first) — callers
+        that must reason about idempotency (bind_many_remote) need to know
+        whether a retry happened."""
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            self._base + path, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+        delays = backoff_delays(
+            self._backoff_initial_s,
+            self._backoff_factor,
+            self._retries + 1,
+            self._backoff_jitter,
+            self._rng,
         )
-        try:
-            with urllib.request.urlopen(req, timeout=30.0) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            body = e.read().decode(errors="replace")
-            if e.code == 409 and "already bound" in body:
-                raise AlreadyBound(body)
-            if e.code in (404, 409):
-                raise KeyError(body)
-            raise RuntimeError(f"HTTP {e.code}: {body}")
+        last_err: Optional[BaseException] = None
+        for attempt in range(self._retries + 1):
+            try:
+                if self._faults is not None:
+                    self._faults.check("remote.request", path)
+                req = urllib.request.Request(
+                    self._base + path, data=data, method=method,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
+                    return json.loads(r.read()), attempt
+            except urllib.error.HTTPError as e:
+                body = e.read().decode(errors="replace")
+                if e.code == 409 and "already bound" in body:
+                    raise AlreadyBound(body)
+                if e.code in (404, 409):
+                    raise KeyError(body)
+                if e.code < 500:
+                    raise RuntimeError(f"HTTP {e.code}: {body}")
+                last_err = RuntimeError(f"HTTP {e.code}: {body}")
+            except _TRANSIENT_ERRORS as e:
+                last_err = e
+            if attempt < self._retries:
+                counters.inc("remote.retry")
+                time.sleep(next(delays))
+        raise RuntimeError(
+            f"remote {method} {path} failed after {self._retries + 1} "
+            f"attempts: {last_err}"
+        )
 
     # -- store surface ------------------------------------------------------
     def watch(self, kind: str, send_initial: bool = True) -> Tuple[RemoteWatch, List[Any]]:
@@ -279,7 +364,7 @@ class RemoteStore:
     def bind_many_remote(
         self, bindings: List[Binding], return_objects: bool = True
     ) -> List[Any]:
-        out = self._req(
+        out, attempts = self._req_ex(
             "POST",
             "/api/v1/bindings",
             {
@@ -297,14 +382,33 @@ class RemoteStore:
         from minisched_tpu.api.objects import Pod
 
         results: List[Any] = []
-        for item in out["items"]:
+        for b, item in zip(bindings, out["items"]):
             err = item.get("error")
             if err is not None:
-                results.append(
-                    AlreadyBound(err)
-                    if item.get("type") == "AlreadyBound"
-                    else KeyError(err)
-                )
+                if item.get("type") == "AlreadyBound":
+                    # idempotent-retry guard: a retried request whose FIRST
+                    # attempt committed before its response was lost comes
+                    # back AlreadyBound to the node we asked for — that is
+                    # OUR bind landing, not a conflict.  The bind
+                    # subresource's unset-node_name precondition is what
+                    # makes this conversion safe (a genuine conflict names
+                    # a different node, or fires on the un-retried first
+                    # attempt and stays an error).  The server reports the
+                    # bound node as a structured field; the message-suffix
+                    # check is the fallback for servers predating it.
+                    bound_node = item.get("node") or ""
+                    ours = (
+                        bound_node == b.node_name
+                        if bound_node
+                        else err.endswith(f"already bound to {b.node_name}")
+                    )
+                    if attempts > 0 and ours:
+                        counters.inc("remote.bind_retry_dedup")
+                        results.append(None)
+                        continue
+                    results.append(AlreadyBound(err))
+                else:
+                    results.append(KeyError(err))
             elif item.get("object") is not None:
                 results.append(_decode(Pod, item["object"]))
             else:
@@ -353,10 +457,12 @@ class _RemoteNodeAPI(_NodeAPI):
 class RemoteClient:
     """Client facade whose every operation crosses the HTTP boundary —
     hand it to SchedulerService to run the whole scheduling path
-    over the wire (scheduler.go:54,72-73 against k8sapiserver.go:45-48)."""
+    over the wire (scheduler.go:54,72-73 against k8sapiserver.go:45-48).
+    Keyword arguments (timeouts, retry policy, fault fabric) pass through
+    to RemoteStore."""
 
-    def __init__(self, base_url: str):
-        self.store = RemoteStore(base_url)
+    def __init__(self, base_url: str, **kwargs: Any):
+        self.store = RemoteStore(base_url, **kwargs)
 
     def nodes(self) -> _RemoteNodeAPI:
         return _RemoteNodeAPI(self.store)

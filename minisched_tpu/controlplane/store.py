@@ -112,10 +112,18 @@ class Watch:
             out, self._events = self._events, []
             return out
 
-    def stop(self) -> None:
+    def kill(self) -> None:
+        """Die as a dropped stream would: stop delivering, wake consumers
+        with end-of-stream, but WITHOUT deregistering (the store's fanout
+        prunes dead watches lazily).  Only the fault fabric calls this —
+        the consumer sees exactly what a lost network stream looks like
+        and must reconnect."""
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
+
+    def stop(self) -> None:
+        self.kill()
         self._store._remove_watch(self._kind, self)
 
     @property
@@ -132,9 +140,17 @@ class ObjectStore:
         self._watches: Dict[str, List[Watch]] = {}
         self._rv = 0
         #: fault-injection hook (SURVEY.md §5.3 — the reference has none):
-        #: called as (op, kind, key) before every mutation; raising makes
-        #: the mutation fail exactly as a flaky apiserver/etcd would.
+        #: called as (op, kind, key) before every mutation AND read;
+        #: raising makes the call fail exactly as a flaky apiserver/etcd
+        #: would.  Wire a fabric with
+        #: ``store.fault_injector = fabric.as_store_injector()``.
         self.fault_injector: Optional[Callable[[str, str, str], None]] = None
+        #: optional faults.FaultFabric for non-raising failure modes —
+        #: today only ``watch.drop``: at fanout time a scheduled drop
+        #: KILLS the watch (stream death) instead of delivering, and the
+        #: triggering event is lost with it — the informer's reconnect +
+        #: snapshot-replay diff is what recovers the gap.
+        self.faults: Any = None
 
     # -- helpers -----------------------------------------------------------
     def _maybe_fault(self, op: str, kind: str, key: str) -> None:
@@ -159,7 +175,16 @@ class ObjectStore:
         # as immutable; only clones returned from get()/list()/update()
         # are theirs to mutate.)  At wave scale the per-event clones were
         # a third of the batch-bind cost.
+        faults = self.faults
         for w in list(self._watches.get(kind, ())):
+            if w.stopped:
+                # killed by a prior drop (kill() leaves registration to
+                # the fanout): prune here so dropped streams don't accrete
+                self._remove_watch(kind, w)
+                continue
+            if faults is not None and faults.should_fire("watch.drop", kind):
+                w.kill()
+                continue
             w._deliver(event)
 
     # -- CRUD --------------------------------------------------------------
@@ -185,6 +210,7 @@ class ObjectStore:
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         with self._lock:
+            self._maybe_fault("get", kind, f"{namespace}/{name}")
             obj = self._objects.get(kind, {}).get(f"{namespace}/{name}")
             if obj is None:
                 raise KeyError(f"{kind} {namespace}/{name} not found")
@@ -192,6 +218,7 @@ class ObjectStore:
 
     def list(self, kind: str) -> List[Any]:
         with self._lock:
+            self._maybe_fault("list", kind, "")
             return [o.clone() for o in self._objects.get(kind, {}).values()]
 
     def update(self, kind: str, obj: Any) -> Any:
@@ -294,7 +321,16 @@ class ObjectStore:
                     out.append(err)
             # ONE batched fanout per watcher, still under the store lock so
             # queue order equals mutation order across concurrent mutators
+            faults = self.faults
             for w in list(self._watches.get(kind, ())):
+                if w.stopped:
+                    self._remove_watch(kind, w)  # see _fanout
+                    continue
+                if faults is not None and faults.should_fire(
+                    "watch.drop", kind
+                ):
+                    w.kill()  # the whole batch is lost to this stream
+                    continue
                 w._deliver_many(events)
         return out
 

@@ -67,9 +67,29 @@ def _is_cross_pod(pod: Pod) -> bool:
 class DeviceScheduler(Scheduler):
     """Scheduler whose evaluation step runs on device, a wave at a time."""
 
-    def __init__(self, *args, max_wave: int = 1024, mesh: Any = None, **kwargs):
+    def __init__(
+        self,
+        *args,
+        max_wave: int = 1024,
+        mesh: Any = None,
+        assume_ttl_s: float = 30.0,
+        faults: Any = None,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.max_wave = max_wave
+        #: assume-lease TTL: every assumption expires after this many
+        #: seconds unless the informer confirms the bind first.  A pod
+        #: whose bind was LOST to a fault (transport failure whose error
+        #: path itself failed, a crashed bind thread) would otherwise
+        #: double-book its node forever — at expiry the AUTHORITATIVE
+        #: store decides: bound → renew (informer merely lagging);
+        #: unbound → release the capacity and requeue the pod; store
+        #: unreachable → renew and retry next check.  None disables.
+        self.assume_ttl_s: Optional[float] = assume_ttl_s
+        #: optional faults.FaultFabric for the engine-side injection
+        #: points (``engine.bind``) — tests/chaos soak arm it
+        self.faults = faults
         #: optional jax.sharding.Mesh — waves then evaluate SHARDED over
         #: the (pods × nodes) device mesh (parallel/sharding.py): pod rows
         #: data-parallel, node columns model-parallel, XLA collectives
@@ -120,6 +140,8 @@ class DeviceScheduler(Scheduler):
         #: numeric aggregate deltas instead of per-pod add_pod calls
         #: (~250ms/16k-pod wave of duplicated host work)
         self._assumed_agg: dict = {}
+        #: uid → monotonic deadline; see assume_ttl_s
+        self._assumed_expiry: dict = {}
         self._assumed_lock = threading.Lock()
 
     def _wire_pre_cache(self, informer_factory: Any) -> None:
@@ -202,11 +224,114 @@ class DeviceScheduler(Scheduler):
         with self._assumed_lock:
             self._assumed[pod.metadata.uid] = assumed
             self._assumed_agg[pod.metadata.uid] = agg
+            if self.assume_ttl_s is not None:
+                self._assumed_expiry[pod.metadata.uid] = (
+                    time.monotonic() + self.assume_ttl_s
+                )
 
     def _forget(self, uid: str) -> None:
         with self._assumed_lock:
             self._assumed.pop(uid, None)
             self._assumed_agg.pop(uid, None)
+            self._assumed_expiry.pop(uid, None)
+
+    def _expire_assume_leases(self) -> None:
+        """Release (or renew) assumptions whose lease ran out — the
+        backstop that keeps a lost bind from double-booking a node for
+        the life of the process.  Runs at every snapshot AND on the idle
+        path: with the queue drained there is no wave left to notice the
+        leak.  The authoritative-store read happens OUTSIDE the assume
+        lock (it may be a network call)."""
+        if self.assume_ttl_s is None:
+            return
+        now = time.monotonic()
+        # pods re-deferred to the scan backlog keep their assumption ON
+        # PURPOSE (_park_scan_failures: commit unverifiable, a later flush
+        # arbitrates) — expiring them here would put the same pod live in
+        # two lanes at once (queue.add dedupes against queues, not the
+        # backlog), and whichever lane ran second would overwrite the
+        # first's assumption.  The backlog and this method both run on
+        # the loop thread, so the read is unsynchronized but safe.
+        backlog_uids = {q.pod.metadata.uid for q in self._scan_backlog}
+        with self._assumed_lock:
+            expired = [
+                (uid, self._assumed[uid])
+                for uid, deadline in self._assumed_expiry.items()
+                if deadline <= now
+                and uid in self._assumed
+                and uid not in backlog_uids
+            ]
+        if not expired:
+            return
+        from minisched_tpu.observability import counters
+
+        # bound the authoritative probes per round: each is a store
+        # round-trip ON the scheduling-loop thread, and a lost big wave
+        # can expire hundreds of leases at once — probe a slice now,
+        # leave the rest expired for the next round (snapshot or idle,
+        # both frequent) instead of stalling the loop for N × RTT
+        probe, deferred = (
+            expired[: self.MAX_LEASE_PROBES_PER_ROUND],
+            expired[self.MAX_LEASE_PROBES_PER_ROUND :],
+        )
+        if deferred:
+            counters.inc("assume.lease_probe_deferred", len(deferred))
+        expired = probe
+        for i, (uid, assumed) in enumerate(expired):
+            try:
+                cur = self.client.pods().get(
+                    assumed.metadata.name, assumed.metadata.namespace
+                )
+            except KeyError:
+                # pod deleted while assumed: just release the capacity
+                self._forget(uid)
+                counters.inc("assume.lease_expired")
+                continue
+            except Exception:
+                # store unreachable: keep the capacity reserved (the bind
+                # may have landed), re-arm the lease — and for EVERY
+                # remaining expired lease too, without probing: each get
+                # pays the remote client's whole retry budget while the
+                # plane is down, and N sequential probes would stall the
+                # scheduling loop for N × that budget to learn the same
+                # answer N times
+                with self._assumed_lock:
+                    for uid2, _ in expired[i:]:
+                        if uid2 in self._assumed_expiry:
+                            self._assumed_expiry[uid2] = (
+                                now + self.assume_ttl_s
+                            )
+                counters.inc(
+                    "assume.lease_renewed_unreachable", len(expired) - i
+                )
+                return
+            if cur.metadata.uid != uid:
+                self._forget(uid)  # recreated under the same name
+                counters.inc("assume.lease_expired")
+            elif cur.spec.node_name:
+                # bound per the authority.  If the informer cache has
+                # caught up, the assumption is redundant — forget it (this
+                # is how the assume counter reaches zero at quiesce: the
+                # wave-snapshot prune only runs while waves run).  Cache
+                # still behind: renew so capacity stays booked until it is.
+                cached = self.informer_factory.informer_for("Pod").get(
+                    assumed.metadata.key
+                )
+                if cached is not None and cached.spec.node_name:
+                    self._forget(uid)
+                    counters.inc("assume.lease_confirmed")
+                else:
+                    with self._assumed_lock:
+                        if uid in self._assumed_expiry:
+                            self._assumed_expiry[uid] = now + self.assume_ttl_s
+                    counters.inc("assume.lease_renewed_bound")
+            else:
+                # the bind never landed anywhere: release the capacity and
+                # put the pod back through the queue (deduped by uid, so a
+                # pod that somehow also sits in a queue segment is safe)
+                self._forget(uid)
+                self.queue.add(cur)
+                counters.inc("assume.lease_requeued")
 
     def snapshot_nodes(self):
         """Object-level snapshot (scalar cycles, tests): the surviving
@@ -233,6 +358,7 @@ class DeviceScheduler(Scheduler):
         _merged_infos, the index-less constraint build) use the returned
         list or the live assume-cache — both disjoint from the snapshot's
         pod population by this prune."""
+        self._expire_assume_leases()
         infos, cache_assigned = self.cache.snapshot_with_assigned()
         delta: dict = {}
         with self._assumed_lock:
@@ -254,6 +380,7 @@ class DeviceScheduler(Scheduler):
                 if uid in cache_assigned or not exists:
                     del self._assumed[uid]
                     self._assumed_agg.pop(uid, None)
+                    self._assumed_expiry.pop(uid, None)
                     continue
                 agg = self._assumed_agg[uid]
                 leftover.append(assumed)
@@ -343,6 +470,10 @@ class DeviceScheduler(Scheduler):
     #: cap on PostFilter (preemption) passes per wave — each is
     #: O(nodes × pods) host work (see _handle_wave_losers)
     MAX_PREEMPT_PER_WAVE = 256
+    #: cap on authoritative-store probes per lease-expiry round (see
+    #: _expire_assume_leases) — bounds loop-thread stall after a lost
+    #: wave expires many leases at once
+    MAX_LEASE_PROBES_PER_ROUND = 64
 
     @classmethod
     def _scan_cap(cls, n_pods: int) -> int:
@@ -1060,8 +1191,11 @@ class DeviceScheduler(Scheduler):
             # idle: the gate a bind may have closed (see _bind_batch) must
             # not delay the events that will wake us; and with the
             # automatic collector off, idle churn (informer handlers,
-            # exception cycles) still needs a periodic sweep
+            # exception cycles) still needs a periodic sweep.  Assume
+            # leases must expire HERE too — with the queue drained, no
+            # wave snapshot is coming to notice a lost bind's leak.
             self.informer_factory.resume_dispatch()
+            self._expire_assume_leases()
             with self.metrics.timed("loop_gc"):
                 self._wave_gc()
             return False
@@ -1163,10 +1297,14 @@ class DeviceScheduler(Scheduler):
         decides.  Bound there: a real commit whose event just hasn't
         dispatched — skip.  Unbound there: the bind never landed — park
         (error_func also forgets the assumption, releasing the capacity
-        that would otherwise stay double-booked for the process life)."""
+        that would otherwise stay double-booked for the process life).
+        Store UNREACHABLE: keep the assumption (the bind may be real) but
+        re-defer the qpi instead of dropping it — a later flush retries
+        the park decision; dropping it here left the pod Pending forever
+        while its assumption double-booked the node (advisor r5)."""
         with self._assumed_lock:
             assumed = set(self._assumed)
-        for qpi, _cur in self._revalidate_backlog(qpis):
+        for qpi, cur_cache in self._revalidate_backlog(qpis):
             if qpi.pod.metadata.uid in assumed:
                 try:
                     cur = self.client.pods().get(
@@ -1175,14 +1313,35 @@ class DeviceScheduler(Scheduler):
                 except KeyError:
                     continue  # deleted meanwhile: nothing to requeue
                 except Exception:
-                    continue  # store unreachable: keep the assumption
+                    self._scan_backlog.append(qpi)
+                    continue
                 if cur.spec.node_name:
                     continue  # committed by an earlier chunk
+            # mirror _flush_scan_backlog: a pod updated while deferred must
+            # be requeued with its REFRESHED spec — the update event
+            # already fired and can't reach this popped copy (advisor r5)
+            if (
+                cur_cache.metadata.resource_version
+                != qpi.pod.metadata.resource_version
+            ):
+                qpi.pod_info.pod = cur_cache
             self.error_func(qpi, err)
 
     def schedule_wave(self, qpis: List[QueuedPodInfo]) -> None:
+        # the 'wave' metric must observe EVERY exit path (empty-node
+        # return, parked batch, scan-only wave, a raise) — the bench's
+        # e2e accounting asserts pop+wave+scan_flush+gc sums to the loop
+        # wall, and an invisible exit breaks the invariant (advisor r5)
         t_wave = time.monotonic()
         self.metrics.observe("wave_size", float(len(qpis)))
+        try:
+            self._schedule_wave_inner(qpis, t_wave)
+        finally:
+            self.metrics.observe("wave", time.monotonic() - t_wave)
+
+    def _schedule_wave_inner(
+        self, qpis: List[QueuedPodInfo], t_wave: float
+    ) -> None:
 
         # cross-pod-constrained pods run on device via the sequential scan
         # (they see each other's commits in the carried combo planes —
@@ -1205,8 +1364,7 @@ class DeviceScheduler(Scheduler):
                 self._scan_backlog.extend(constrained)
                 plain = [qpi for qpi in qpis if not _is_cross_pod(qpi.pod)]
                 if not plain:
-                    self.metrics.observe("wave", time.monotonic() - t_wave)
-                    return
+                    return  # schedule_wave's finally observes the metric
                 qpis = plain
             # priority-inversion bypass (advisor r4): deferral reorders
             # constrained pods behind up to SCAN_DEFER_MAX_WAVES full
@@ -1268,7 +1426,6 @@ class DeviceScheduler(Scheduler):
         if losers:
             self._handle_wave_losers(losers, node_infos, len(nodes))
         dur = time.monotonic() - t_wave
-        self.metrics.observe("wave", dur)
         if _WAVE_LOG:
             import sys
 
@@ -1631,12 +1788,31 @@ class DeviceScheduler(Scheduler):
         # for the GIL changes.
         self.informer_factory.pause_dispatch()
         with self.metrics.timed("bind"):
-            # return_objects=False: the engine only inspects failures —
-            # cloning 8k bound pods back to a caller that drops them was
-            # a third of the bind's copy cost
-            results = self.client.pods().bind_many(
-                bindings, return_objects=False
-            )
+            try:
+                if self.faults is not None:
+                    self.faults.check("engine.bind", str(len(ready)))
+                # return_objects=False: the engine only inspects failures —
+                # cloning 8k bound pods back to a caller that drops them
+                # was a third of the bind's copy cost
+                results = self.client.pods().bind_many(
+                    bindings, return_objects=False
+                )
+            except Exception as err:
+                # the TRANSACTION failed (store unreachable after the
+                # remote client's own retries, WAL refusal, injected
+                # fault) — before this catch the raise escaped through
+                # schedule_one to the loop's catch-all and the whole
+                # wave's winners were stranded: popped, assumed, in no
+                # queue.  Fail every item instead: error_func forgets the
+                # assumption and requeues; if the commit actually landed
+                # server-side (response lost), the retried pod's next
+                # bind returns AlreadyBound and the informer's bind event
+                # settles it — converges either way, and the assume-lease
+                # TTL backstops anything this path itself loses.
+                from minisched_tpu.observability import counters
+
+                counters.inc("engine.bind_batch_failed")
+                results = [err] * len(ready)
         # the binds changed cluster state NOW; the informer events land on
         # the dispatch thread later.  Record the move request so losers
         # whose attempts overlapped the commit re-queue through backoff

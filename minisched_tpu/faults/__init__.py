@@ -1,0 +1,185 @@
+"""Seedable, deterministic fault-injection fabric.
+
+The reference stack assumes an in-process, never-failing control plane
+(sched.go boots apiserver+etcd in the same process and no caller checks an
+error twice).  The production north star is the opposite: every layer of
+this scheduler talks to a control plane that can time out, reset
+connections, serve 5xx, lose a watch stream, or fail a WAL append — and
+the engine must converge anyway without leaking assumed capacity.
+
+This module is the one switchboard for making those failures HAPPEN on
+demand.  Components take an optional ``FaultFabric`` and consult it at
+*named injection points*; unconfigured points cost one attribute read.
+
+Named points wired through the tree (grep for the literal string):
+
+    store.get / store.list / store.create / store.update / store.delete
+        — ObjectStore API calls raise InjectedFault (a flaky apiserver /
+          etcd; ``store.update`` covers the bind subresource, which is a
+          mutate under the hood)
+    watch.drop
+        — a store watch stream dies instead of delivering an event (the
+          informer must reconnect + replay-diff); key = kind
+    wal.append
+        — DurableObjectStore refuses the mutation before touching memory
+          (disk full / IO error surfaced as a failed API call)
+    http.500 / http.reset
+        — the REST façade answers 503, or closes the connection without
+          any response bytes (the client sees a transport error and must
+          retry); key = request path
+    remote.request
+        — the RemoteStore client fails an attempt before it leaves the
+          process (connection reset on connect); key = request path
+    engine.bind
+        — the device engine's batch-bind transaction raises before the
+          store call (exercises the wave's failed-commit requeue path)
+
+Determinism: whether call *n* at (point, key) fires is a pure function of
+``(seed, point, key, n)`` — a blake2s hash, not a shared RNG — so the
+fault schedule reproduces byte-for-byte for a fixed seed regardless of
+thread interleaving, and two points never steal entropy from each other.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from hashlib import blake2s
+from typing import Dict, FrozenSet, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """An error manufactured by the fabric (never raised by real code)."""
+
+
+@dataclass
+class FaultRule:
+    """Per-point firing policy.
+
+    ``rate``: probability each eligible call fires.  ``after``: skip the
+    first N calls at the point (let a scenario boot cleanly).
+    ``max_fires``: stop injecting after this many fires (bounds the worst
+    case so a soak always converges).  ``keys``: restrict to these call
+    keys (e.g. only the Pod/Node watch streams).
+    """
+
+    rate: float
+    after: int = 0
+    max_fires: Optional[int] = None
+    keys: Optional[FrozenSet[str]] = None
+
+
+class FaultFabric:
+    def __init__(self, seed: int):
+        self._seed = int(seed)
+        self._rules: Dict[str, FaultRule] = {}
+        self._mu = threading.Lock()
+        self._calls: Dict[Tuple[str, str], int] = {}
+        self._fires: Dict[str, int] = {}
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def on(
+        self,
+        point: str,
+        rate: float,
+        after: int = 0,
+        max_fires: Optional[int] = None,
+        keys=None,
+    ) -> "FaultFabric":
+        """Arm a point (chainable)."""
+        self._rules[point] = FaultRule(
+            rate=float(rate),
+            after=after,
+            max_fires=max_fires,
+            keys=frozenset(keys) if keys is not None else None,
+        )
+        return self
+
+    def _decision(self, point: str, key: str, n: int) -> float:
+        h = blake2s(
+            f"{self._seed}:{point}:{key}:{n}".encode(), digest_size=4
+        ).digest()
+        return int.from_bytes(h, "big") / 2**32
+
+    def should_fire(self, point: str, key: str = "") -> bool:
+        """True when this call at (point, key) is scheduled to fail.
+        Counts the call either way — the decision depends on the per-key
+        call ordinal, which is what makes the schedule deterministic."""
+        rule = self._rules.get(point)
+        if rule is None:
+            return False
+        with self._mu:
+            n = self._calls.get((point, key), 0)
+            self._calls[(point, key)] = n + 1
+            if rule.keys is not None and key not in rule.keys:
+                return False
+            if n < rule.after:
+                return False
+            if (
+                rule.max_fires is not None
+                and self._fires.get(point, 0) >= rule.max_fires
+            ):
+                return False
+            fire = self._decision(point, key, n) < rule.rate
+            if fire:
+                self._fires[point] = self._fires.get(point, 0) + 1
+            return fire
+
+    def check(self, point: str, key: str = "") -> None:
+        """Raise InjectedFault when the schedule says this call fails."""
+        if self.should_fire(point, key):
+            raise InjectedFault(f"injected fault at {point} ({key})")
+
+    def fires(self, point: str) -> int:
+        with self._mu:
+            return self._fires.get(point, 0)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """{'fires': per-point fire counts, 'calls': per-point call counts}
+        — the chaos soak's injection evidence."""
+        with self._mu:
+            calls: Dict[str, int] = {}
+            for (point, _key), n in self._calls.items():
+                calls[point] = calls.get(point, 0) + n
+            return {"fires": dict(self._fires), "calls": calls}
+
+    def as_store_injector(self):
+        """Adapter for ``ObjectStore.fault_injector`` (op, kind, key):
+        routes mutations to the ``store.{op}`` points."""
+
+        def injector(op: str, kind: str, key: str) -> None:
+            self.check(f"store.{op}", f"{kind}/{key}")
+
+        return injector
+
+
+def wal_double_binds(wal_path: str):
+    """Audit a DurableObjectStore WAL's FULL history for double binds:
+    returns [(uid, first_node, other_node), ...] for every pod that ever
+    appeared bound to two different nodes — the capacity bug the assume/
+    requeue machinery must make impossible.  Shared by the chaos soak and
+    the bench chaos role (one audit, one definition of 'double bind')."""
+    import json
+
+    bound_to: dict = {}
+    violations = []
+    with open(wal_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("op") != "put" or rec.get("kind") != "Pod":
+                continue
+            obj = rec["obj"]
+            node = (obj.get("spec") or {}).get("node_name")
+            uid = (obj.get("metadata") or {}).get("uid")
+            if not node:
+                continue
+            prev = bound_to.setdefault(uid, node)
+            if prev != node:
+                violations.append((uid, prev, node))
+    return violations

@@ -1,0 +1,58 @@
+"""Named monotonic counters: the recovery-side ledger of the fault story.
+
+The fault fabric (minisched_tpu.faults) counts what was INJECTED; these
+counters record what the system DID about it — remote retries, informer
+reconnects, assume-lease expiries, failed bind batches.  A chaos soak
+asserts both sides: faults fired, and every recovery path that should
+have answered them actually ran.
+
+One process-global registry (``GLOBAL``) keeps call sites one-liners —
+``counters.inc("remote.retry")`` — without threading a handle through
+every constructor; tests snapshot/reset around their scenario.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Counters:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._mu:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._mu:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._counts.clear()
+
+
+GLOBAL = Counters()
+
+
+def inc(name: str, n: int = 1) -> None:
+    GLOBAL.inc(name, n)
+
+
+def get(name: str) -> int:
+    return GLOBAL.get(name)
+
+
+def snapshot() -> Dict[str, int]:
+    return GLOBAL.snapshot()
+
+
+def reset() -> None:
+    GLOBAL.reset()

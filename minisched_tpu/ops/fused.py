@@ -82,10 +82,31 @@ import os as _os
 #: constant, so toggle before building evaluators.
 _USE_PALLAS = _os.environ.get("MINISCHED_TPU_PALLAS", "1") != "0"
 
+#: test hook: route select_hosts through the Pallas dispatch logic even
+#: off-TPU (interpret mode), so the SHAPE fallback below is exercisable
+#: on CPU CI — the round-5 regression (P=1 crashing every scan-lane
+#: consumer) was invisible to `make test` precisely because the route
+#: was dead code off-TPU.
+_FORCE_PALLAS_ROUTE = False
+
 
 def set_pallas(enabled: bool) -> None:
     global _USE_PALLAS
     _USE_PALLAS = enabled
+
+
+def set_force_pallas_route(enabled: bool) -> None:
+    global _FORCE_PALLAS_ROUTE
+    _FORCE_PALLAS_ROUTE = enabled
+
+
+def _pallas_shape_ok(P: int, N: int) -> bool:
+    """Whether select_hosts_pallas can tile (P, N) — the kernel's
+    smallest tiles are 8 (pods) × 128 (nodes) (pallas_kernels._tiling).
+    The bind-exact sequential scan evaluates ONE pod per step (P=1), so
+    routing unconditionally on TPU crashed every scan-lane consumer
+    (VERDICT r5 headline); non-tiling shapes take the XLA tail instead."""
+    return P % 8 == 0 and N % 128 == 0
 
 
 def select_hosts(scores, mask, seeds):
@@ -105,11 +126,19 @@ def select_hosts(scores, mask, seeds):
 
         # only route to Pallas where it compiles natively — interpreter
         # mode off-TPU would be far slower than the XLA path below (tests
-        # exercise the kernel directly with interpret=True)
-        if _jax.default_backend() == "tpu":
+        # exercise the kernel directly with interpret=True) — and only
+        # for shapes the kernel can tile: P=1 scan steps and other
+        # non-divisible shapes fall through to the XLA tail (bit-exact
+        # either way; the Pallas kernel is a perf route, not a semantic)
+        P, N = scores.shape
+        if (
+            _FORCE_PALLAS_ROUTE or _jax.default_backend() == "tpu"
+        ) and _pallas_shape_ok(P, N):
             from minisched_tpu.ops.pallas_kernels import select_hosts_pallas
 
-            return select_hosts_pallas(scores, mask, seeds)
+            return select_hosts_pallas(
+                scores, mask, seeds, interpret=_FORCE_PALLAS_ROUTE
+            )
     P, N = scores.shape
     masked = jnp.where(mask, scores, NEG_INF_SCORE)
     best = masked.max(axis=1)  # i32[P]

@@ -1,0 +1,249 @@
+"""Chaos soak: a seeded fault schedule against the whole stack.
+
+The ISSUE-1 acceptance scenario: run a multi-wave workload while the
+fault fabric (minisched_tpu.faults) injects store errors, bind failures,
+WAL refusals, watch-stream drops, and (over the wire) HTTP 5xx +
+connection resets — then assert CONVERGENCE, not survival: every pod
+bound at quiesce, the assume-capacity ledger drained to zero (no leak),
+no pod ever bound to two nodes (WAL history audit), no node over
+allocatable, and every armed injection point actually fired.
+
+The fault schedule is a pure function of the seed (see FaultFabric):
+``MINISCHED_CHAOS_SEED`` reproduces the exact same injection decisions —
+`make chaos` pins it so failures replay byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.faults import FaultFabric, InjectedFault
+from minisched_tpu.observability import counters
+from minisched_tpu.service.config import default_full_roster_config
+from minisched_tpu.service.service import SchedulerService
+
+SEED = int(os.environ.get("MINISCHED_CHAOS_SEED", "1234"))
+
+
+def _drive_to_convergence(client, sched, want: int, deadline_s: float):
+    """The degraded-mode driver loop: poll for full placement, replaying
+    parked pods (any injected failure parks through error_func), and
+    tolerate the control plane failing our own polling reads."""
+    deadline = time.monotonic() + deadline_s
+    bound = []
+    while time.monotonic() < deadline:
+        try:
+            bound = [p for p in client.pods().list() if p.spec.node_name]
+        except Exception:
+            time.sleep(0.1)  # injected list fault: poll again
+            continue
+        if len(bound) >= want:
+            return bound
+        try:
+            if sched.queue.stats()["unschedulable"]:
+                sched.queue.flush_unschedulable_leftover()
+                sched.queue.flush_backoff_completed()
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return bound
+
+
+def _audit_capacity(client, bound, cpu_milli_per_pod: int, alloc_milli: int):
+    """No cordoned placements, no node over allocatable at quiesce."""
+    per_node: dict = {}
+    for p in bound:
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    for name, cnt in per_node.items():
+        node = client.nodes().get(name)
+        assert not node.spec.unschedulable, f"pod on cordoned {name}"
+        assert cnt * cpu_milli_per_pod <= alloc_milli, (name, cnt)
+
+
+def _audit_no_double_bind(wal_path: str):
+    """The WAL is the full mutation history: a pod uid appearing with two
+    DIFFERENT non-empty node_names was bound twice — the exact capacity
+    bug the assume/requeue machinery must make impossible."""
+    from minisched_tpu.faults import wal_double_binds
+
+    assert wal_double_binds(wal_path) == []
+
+
+def _wait_assume_drain(sched, timeout_s: float) -> None:
+    """At quiesce the assume ledger must return to zero — the lease
+    machinery confirms informer-acknowledged binds and releases the rest;
+    anything left after several TTLs is leaked capacity."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        with sched._assumed_lock:
+            if not sched._assumed and not sched._assumed_agg:
+                assert not sched._assumed_expiry
+                return
+        time.sleep(0.2)
+    with sched._assumed_lock:
+        raise AssertionError(
+            f"assumed-capacity leak at quiesce: {list(sched._assumed)}"
+        )
+
+
+def test_chaos_soak_inprocess_device_engine(tmp_path):
+    """WAL-durable store + device wave engine under a seeded schedule of
+    store get/create/bind errors, WAL refusals, watch drops, and whole-
+    batch bind-transaction failures, across two pod bursts."""
+    wal = str(tmp_path / "soak.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+
+    n_nodes, n_pods = 24, 240
+    for i in range(n_nodes):
+        client.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                unschedulable=i % 8 == 0,
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+        )
+    pods = [
+        make_pod(f"pod{i:04d}", requests={"cpu": "500m", "memory": "64Mi"})
+        for i in range(n_pods)
+    ]
+    for p in pods[:150]:
+        client.pods().create(p)
+
+    fabric = (
+        FaultFabric(SEED)
+        .on("store.update", rate=0.12)  # every bind is an update item
+        .on("store.get", rate=0.08)
+        .on("store.create", rate=0.10, max_fires=8)
+        .on("watch.drop", rate=0.04, max_fires=12, keys={"Pod", "Node"})
+        .on("wal.append", rate=0.04, max_fires=10)
+        .on("engine.bind", rate=0.08, max_fires=10)
+    )
+    counters.reset()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=32
+    )
+    sched.faults = fabric
+    sched.assume_ttl_s = 2.5
+    # arm AFTER boot: the scenario's own setup is not the system under test
+    store.fault_injector = fabric.as_store_injector()
+    store.faults = fabric
+    try:
+        # second burst lands mid-run, through the now-lossy control plane
+        # (the degraded-mode client retries its own creates)
+        def create_with_retry(p):
+            for _ in range(20):
+                try:
+                    client.pods().create(p)
+                    return
+                except InjectedFault:
+                    time.sleep(0.01)
+            raise AssertionError("create retry budget exhausted")
+
+        bound = _drive_to_convergence(client, sched, 40, 120.0)
+        assert len(bound) >= 40, "first waves never landed"
+        for p in pods[150:]:
+            create_with_retry(p)
+
+        bound = _drive_to_convergence(client, sched, n_pods, 240.0)
+        assert len(bound) == n_pods, (
+            f"only {len(bound)}/{n_pods} bound; queue={sched.queue.stats()} "
+            f"faults={fabric.stats()} counters={counters.snapshot()}"
+        )
+        _wait_assume_drain(sched, timeout_s=8 * sched.assume_ttl_s)
+        # quiesce reached: disarm before auditing — the audit reads are
+        # the test's own bookkeeping, not the system under test
+        store.fault_injector = None
+        store.faults = None
+        _audit_capacity(client, bound, 500, 8000)
+        # the guaranteed-volume points must have actually injected
+        # (≥10%-rate armed on the bind/store paths per the acceptance
+        # criteria: every bind is a store.update draw, every fanout a
+        # watch.drop draw).  store.get / engine.bind stay ARMED but
+        # unasserted — their call volume is timing-dependent (gets come
+        # from lease expiries and park verification, engine.bind draws
+        # once per wave), and their wiring is pinned deterministically in
+        # test_faults.py / test_device_scheduler.py.
+        fires = fabric.stats()["fires"]
+        for point in (
+            "store.update", "store.create", "watch.drop", "wal.append",
+        ):
+            assert fires.get(point, 0) > 0, (point, fires)
+        assert counters.get("informer.reconnect") >= 1, counters.snapshot()
+    finally:
+        store.fault_injector = None
+        store.faults = None
+        svc.shutdown_scheduler()
+        store.close()
+
+    _audit_no_double_bind(wal)
+    # crash-recovery cross-check: the reopened WAL agrees on placements
+    store2 = DurableObjectStore(wal)
+    recovered = [p for p in store2.list("Pod") if p.spec.node_name]
+    assert len(recovered) == n_pods
+    store2.close()
+
+
+def test_chaos_soak_over_the_wire():
+    """The whole scheduling path over REST — informers, waves, batch
+    binds — against a server injecting 5xx and connection resets, with
+    the hardened remote client's timeouts + jittered retries carrying
+    every hop, plus store-level watch drops killing live streams."""
+    store = ObjectStore()
+    setup = Client(store)
+    n_nodes, n_pods = 10, 60
+    for i in range(n_nodes):
+        setup.nodes().create(
+            make_node(
+                f"node{i:03d}",
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+        )
+    for i in range(n_pods):
+        setup.pods().create(
+            make_pod(f"wp{i:03d}", requests={"cpu": "500m", "memory": "64Mi"})
+        )
+
+    fabric = (
+        FaultFabric(SEED + 1)
+        .on("http.500", rate=0.10, max_fires=40)
+        .on("http.reset", rate=0.06, max_fires=25)
+        .on("watch.drop", rate=0.03, max_fires=6, keys={"Pod", "Node"})
+    )
+    counters.reset()
+    _server, base, shutdown = start_api_server(store, faults=fabric)
+    client = RemoteClient(
+        base, retries=8, backoff_initial_s=0.02, retry_seed=SEED
+    )
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=16
+    )
+    sched.assume_ttl_s = 2.5
+    store.faults = fabric  # arm stream drops only once informers are up
+    try:
+        bound = _drive_to_convergence(client, sched, n_pods, 240.0)
+        assert len(bound) == n_pods, (
+            f"only {len(bound)}/{n_pods} bound over the wire; "
+            f"queue={sched.queue.stats()} faults={fabric.stats()} "
+            f"counters={counters.snapshot()}"
+        )
+        _wait_assume_drain(sched, timeout_s=8 * sched.assume_ttl_s)
+        # audit straight off the authoritative store, not the lossy wire
+        _audit_capacity(setup, bound, 500, 8000)
+        fires = fabric.stats()["fires"]
+        assert fires.get("http.500", 0) > 0, fires
+        assert fires.get("http.reset", 0) > 0, fires
+        assert counters.get("remote.retry") > 0, counters.snapshot()
+    finally:
+        store.faults = None
+        svc.shutdown_scheduler()
+        shutdown()

@@ -718,3 +718,161 @@ def test_failed_scan_flush_parks_backlog_not_drops_it():
         assert sched._scan_backlog == []
     finally:
         svc.shutdown_scheduler()
+
+
+def test_park_scan_failures_redefers_assumed_pod_when_store_unreachable():
+    """ADVICE r5 #1/#2: a pod that was assumed but whose commit can't be
+    verified (authoritative store unreachable) must be RE-DEFERRED for a
+    later flush, not silently dropped while its assumption double-books
+    the node; and an un-assumed parked pod whose spec changed while
+    deferred must requeue with the REFRESHED spec."""
+    from minisched_tpu.faults import InjectedFault
+    from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+
+    client = Client()
+    client.nodes().create(
+        make_node("node000", capacity={"cpu": "8", "memory": "16Gi", "pods": 110})
+    )
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=8
+    )
+    sched = svc.scheduler
+    try:
+        # stop the loop from racing the hand-driven park below
+        sched.stop()
+        assumed_pod = make_pod("assumed1", requests={"cpu": "100m"})
+        stale_pod = make_pod("stale1", requests={"cpu": "100m"})
+        client.pods().create(assumed_pod)
+        client.pods().create(stale_pod)
+        snap_assumed = client.pods().get("assumed1").clone()
+        snap_stale = client.pods().get("stale1").clone()
+        # the stale pod's live spec moves on while it sits deferred
+        live = client.pods().get("stale1")
+        live.metadata.labels = {"v": "2"}
+        client.pods().update(live)
+        pod_inf = sched.informer_factory.informer_for("Pod")
+        assert _wait(
+            lambda: (
+                pod_inf.get("default/assumed1") is not None
+                and (pod_inf.get("default/stale1") or snap_stale)
+                .metadata.resource_version
+                != snap_stale.metadata.resource_version
+            )
+        )
+        sched._assume(snap_assumed, "node000")
+
+        def unreachable(op, kind, key):
+            if op == "get" and kind == "Pod":
+                raise InjectedFault("injected: store unreachable")
+
+        client.store.fault_injector = unreachable
+        qpi_assumed = QueuedPodInfo(pod_info=PodInfo(pod=snap_assumed))
+        qpi_stale = QueuedPodInfo(pod_info=PodInfo(pod=snap_stale))
+        sched._park_scan_failures(
+            [qpi_assumed, qpi_stale], RuntimeError("scan failed")
+        )
+        client.store.fault_injector = None
+        # the assumed pod re-deferred (assumption intact), NOT dropped
+        assert sched._scan_backlog == [qpi_assumed]
+        with sched._assumed_lock:
+            assert snap_assumed.metadata.uid in sched._assumed
+        # the stale pod went through error_func with its REFRESHED spec
+        # (it stays queued — here the informer ADD had already queued it,
+        # so the park deduped by uid; the refresh is the point)
+        assert qpi_stale.pod.metadata.labels == {"v": "2"}
+        stats = sched.queue.stats()
+        assert (
+            stats.get("unschedulable", 0)
+            + stats.get("backoff", 0)
+            + stats.get("active", 0)
+        ) >= 1
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_wave_metric_observed_on_every_exit_path():
+    """ADVICE r5 #3: schedule_wave must observe the 'wave' metric on the
+    empty-node and scan-only exits too — the bench asserts the loop's
+    phases sum to its wall clock, and invisible exits break that."""
+    from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+    from minisched_tpu.observability.profiling import CycleMetrics
+
+    client = Client()  # NO nodes: the empty-node early return
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=8
+    )
+    sched = svc.scheduler
+    try:
+        sched.stop()
+        sched.metrics = CycleMetrics()
+        pod = make_pod("p1", requests={"cpu": "100m"})
+        client.pods().create(pod)
+        qpi = QueuedPodInfo(pod_info=PodInfo(pod=client.pods().get("p1")))
+        sched.schedule_wave([qpi])
+        snap = sched.metrics.snapshot()
+        assert snap.get("wave", {}).get("count", 0) == 1, snap
+
+        # scan-only wave (every pod constrained → deferred): same rule
+        from minisched_tpu.api.objects import (
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        spread = make_pod("p2", requests={"cpu": "100m"}, labels={"app": "s"})
+        spread.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "s"}),
+            )
+        ]
+        client.pods().create(spread)
+        qpi2 = QueuedPodInfo(pod_info=PodInfo(pod=client.pods().get("p2")))
+        sched.schedule_wave([qpi2])
+        snap = sched.metrics.snapshot()
+        assert snap.get("wave", {}).get("count", 0) == 2, snap
+        assert sched._scan_backlog == [qpi2]
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_bind_batch_transaction_failure_fails_items_individually():
+    """A raised bind TRANSACTION (engine.bind injection = transport
+    failure after the remote client's own retries) must fail every item
+    through error_func — releasing the assumptions — instead of escaping
+    to the loop catch-all and stranding the wave's winners."""
+    from minisched_tpu.faults import FaultFabric
+    from minisched_tpu.framework.types import CycleState, PodInfo, QueuedPodInfo
+    from minisched_tpu.observability import counters
+
+    client = Client()
+    client.nodes().create(
+        make_node("node000", capacity={"cpu": "8", "memory": "16Gi", "pods": 110})
+    )
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=8
+    )
+    sched = svc.scheduler
+    try:
+        sched.stop()
+        counters.reset()
+        client.pods().create(make_pod("b1", requests={"cpu": "100m"}))
+        pod = client.pods().get("b1")
+        sched._assume(pod, "node000")
+        sched.faults = FaultFabric(1).on("engine.bind", rate=1.0, max_fires=1)
+        qpi = QueuedPodInfo(pod_info=PodInfo(pod=pod))
+        sched._bind_batch([(qpi, pod, "node000", CycleState())])
+        # transaction failed: nothing bound, assumption RELEASED
+        assert not client.pods().get("b1").spec.node_name
+        with sched._assumed_lock:
+            assert pod.metadata.uid not in sched._assumed
+        assert counters.get("engine.bind_batch_failed") == 1
+        # the injected budget is spent: the retried bind lands
+        sched._assume(pod, "node000")
+        sched._bind_batch([(qpi, pod, "node000", CycleState())])
+        assert client.pods().get("b1").spec.node_name == "node000"
+    finally:
+        svc.shutdown_scheduler()

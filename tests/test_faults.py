@@ -1,0 +1,269 @@
+"""The fault-injection fabric and the per-layer hardening against it.
+
+Unit-level: fabric determinism and firing policy, retry jitter, store/
+WAL/HTTP/remote injection points, the remote client's retry + idempotent-
+bind behavior, informer reconnect after a dropped watch, and the
+cross-facade create_many parity.  The full-stack composition lives in
+tests/test_chaos_soak.py.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.controlplane.remote import RemoteClient, RemoteStore
+from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.faults import FaultFabric, InjectedFault
+from minisched_tpu.observability import counters
+
+
+# -- fabric ----------------------------------------------------------------
+
+
+def _fire_pattern(seed: int, calls):
+    fab = FaultFabric(seed).on("p", rate=0.3)
+    return [fab.should_fire("p", key) for key in calls]
+
+
+def test_fabric_schedule_is_deterministic_for_a_seed():
+    calls = [f"k{i % 7}" for i in range(500)]
+    a = _fire_pattern(42, calls)
+    b = _fire_pattern(42, calls)
+    assert a == b, "same seed + same call sequence must fire identically"
+    assert any(a), "rate 0.3 over 500 calls must fire"
+    assert not all(a)
+    c = _fire_pattern(43, calls)
+    assert a != c, "a different seed must produce a different schedule"
+
+
+def test_fabric_decisions_are_per_key_ordinal_not_global():
+    """Thread-interleaving independence: the decision for call n at
+    (point, key) must not depend on calls at OTHER keys in between."""
+    fab1 = FaultFabric(7).on("p", rate=0.5)
+    seq1 = [fab1.should_fire("p", "a") for _ in range(50)]
+    fab2 = FaultFabric(7).on("p", rate=0.5)
+    seq2 = []
+    for _ in range(50):
+        fab2.should_fire("p", "b")  # interleaved traffic at another key
+        seq2.append(fab2.should_fire("p", "a"))
+    assert seq1 == seq2
+
+
+def test_fabric_after_max_fires_and_keys():
+    fab = FaultFabric(1).on("p", rate=1.0, after=2, max_fires=3)
+    fires = [fab.should_fire("p", "k") for _ in range(10)]
+    assert fires == [False, False, True, True, True] + [False] * 5
+    assert fab.fires("p") == 3
+
+    fab = FaultFabric(1).on("w", rate=1.0, keys={"Pod"})
+    assert not fab.should_fire("w", "Node")
+    assert fab.should_fire("w", "Pod")
+    assert fab.stats()["calls"]["w"] == 2
+
+    # unarmed points never fire and raise nothing
+    fab.check("unarmed", "x")
+
+
+def test_fabric_check_raises_injected_fault():
+    fab = FaultFabric(1).on("p", rate=1.0)
+    with pytest.raises(InjectedFault):
+        fab.check("p", "k")
+
+
+# -- retry jitter ----------------------------------------------------------
+
+
+def test_backoff_delays_jitter_bounds_and_reproducibility():
+    import random
+
+    from minisched_tpu.utils.retry import (
+        backoff_delays,
+        retry_with_exponential_backoff,
+    )
+
+    base = list(backoff_delays(0.1, 3.0, 6, jitter=0.0))
+    assert base == pytest.approx([0.1, 0.3, 0.9, 2.7, 8.1])  # legacy schedule
+    j1 = list(backoff_delays(0.1, 3.0, 6, jitter=0.5, rng=random.Random(9)))
+    j2 = list(backoff_delays(0.1, 3.0, 6, jitter=0.5, rng=random.Random(9)))
+    assert j1 == j2, "seeded rng makes the jittered schedule reproducible"
+    for b, j in zip(base, j1):
+        assert b <= j <= b * 1.5, "wait.Jitter semantics: [d, d*(1+jitter)]"
+
+    # the default call shape is byte-exact with the pre-jitter behavior
+    slept = []
+    attempts = [0]
+
+    def fn():
+        attempts[0] += 1
+        return attempts[0] >= 3
+
+    retry_with_exponential_backoff(fn, sleep=slept.append)
+    assert slept == [0.1, 0.30000000000000004]
+
+
+# -- store-level injection -------------------------------------------------
+
+
+def test_store_get_and_list_consult_the_injector():
+    store = ObjectStore()
+    store.create("Node", make_node("n1"))
+    fab = FaultFabric(3).on("store.get", rate=1.0, max_fires=1).on(
+        "store.list", rate=1.0, max_fires=1
+    )
+    store.fault_injector = fab.as_store_injector()
+    with pytest.raises(InjectedFault):
+        store.get("Node", "", "n1")
+    assert store.get("Node", "", "n1").metadata.name == "n1"  # recovered
+    with pytest.raises(InjectedFault):
+        store.list("Node")
+    assert len(store.list("Node")) == 1
+
+
+def test_wal_append_fault_fails_before_the_inmemory_commit(tmp_path):
+    wal = str(tmp_path / "t.wal")
+    store = DurableObjectStore(wal)
+    fab = FaultFabric(5).on("wal.append", rate=1.0, max_fires=1)
+    store.faults = fab
+    with pytest.raises(InjectedFault):
+        store.create("Node", make_node("n1"))
+    # the refused mutation touched NOTHING: no object, no watch event
+    assert store.list("Node") == []
+    store.create("Node", make_node("n1"))  # next attempt lands
+    store.close()
+    store2 = DurableObjectStore(wal)
+    assert [n.metadata.name for n in store2.list("Node")] == ["n1"]
+    store2.close()
+
+
+def test_watch_drop_kills_stream_and_informer_reconnects_with_diff():
+    store = ObjectStore()
+    fab = FaultFabric(11).on("watch.drop", rate=1.0, max_fires=1, keys={"Node"})
+    factory = SharedInformerFactory(store)
+    inf = factory.informer_for("Node")
+    factory.start()
+    assert factory.wait_for_cache_sync(5.0)
+    store.faults = fab
+    # this event's fanout kills the watch AND is lost with it; the
+    # reconnect's snapshot replay-diff must still deliver the node
+    store.create("Node", make_node("n1"))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if [n.metadata.name for n in inf.lister()] == ["n1"]:
+            break
+        time.sleep(0.05)
+    assert [n.metadata.name for n in inf.lister()] == ["n1"]
+    assert inf.reconnects >= 1
+    assert fab.fires("watch.drop") == 1
+    assert inf.staleness_s() < 5.0  # live again after the replay
+    factory.shutdown()
+
+
+# -- HTTP façade + remote client ------------------------------------------
+
+
+def test_remote_client_retries_through_500s_and_resets():
+    store = ObjectStore()
+    fab = (
+        FaultFabric(21)
+        .on("http.500", rate=1.0, max_fires=2)
+        .on("http.reset", rate=1.0, max_fires=2)
+    )
+    _server, base, shutdown = start_api_server(store, faults=fab)
+    try:
+        counters.reset()
+        client = RemoteClient(
+            base, retries=6, backoff_initial_s=0.01, retry_seed=1
+        )
+        node = client.nodes().create(make_node("n1"))
+        assert node.metadata.name == "n1"
+        got = client.store.get("Node", "", "n1")
+        assert got.metadata.name == "n1"
+        assert fab.fires("http.500") + fab.fires("http.reset") >= 2
+        assert counters.get("remote.retry") >= 2
+    finally:
+        shutdown()
+
+
+def test_remote_client_semantic_errors_do_not_retry():
+    store = ObjectStore()
+    _server, base, shutdown = start_api_server(store)
+    try:
+        counters.reset()
+        rstore = RemoteStore(base, retries=3, backoff_initial_s=0.01)
+        with pytest.raises(KeyError):
+            rstore.get("Node", "", "missing")
+        assert counters.get("remote.retry") == 0
+    finally:
+        shutdown()
+
+
+def test_remote_bind_retry_is_idempotent_same_node_only():
+    """A retried bind whose first attempt landed comes back AlreadyBound
+    to the SAME node → success; AlreadyBound to a DIFFERENT node stays a
+    conflict error."""
+    from minisched_tpu.controlplane.client import AlreadyBound
+
+    store = ObjectStore()
+    _server, base, shutdown = start_api_server(store)
+    try:
+        inproc = Client(store)
+        inproc.nodes().create(make_node("n1"))
+        inproc.pods().create(make_pod("p1"))
+        inproc.pods().create(make_pod("p2"))
+        # simulate "first attempt committed, response lost": the pod is
+        # already bound server-side, and the client-side fabric forces
+        # attempt 0 to fail so the visible request is a RETRY
+        inproc.pods().bind(Binding("p1", "default", "n1"))
+        inproc.pods().bind(Binding("p2", "default", "n1"))
+        fab = FaultFabric(31).on("remote.request", rate=1.0, max_fires=1)
+        rstore = RemoteStore(
+            base, retries=3, backoff_initial_s=0.01, faults=fab
+        )
+        [res] = rstore.bind_many_remote([Binding("p1", "default", "n1")])
+        assert res is None, "same-node AlreadyBound after a retry is OUR bind"
+        # different node → genuine conflict, even after a retry
+        fab2 = FaultFabric(32).on("remote.request", rate=1.0, max_fires=1)
+        rstore2 = RemoteStore(
+            base, retries=3, backoff_initial_s=0.01, faults=fab2
+        )
+        [res2] = rstore2.bind_many_remote([Binding("p2", "default", "nOTHER")])
+        assert isinstance(res2, AlreadyBound)
+    finally:
+        shutdown()
+
+
+# -- cross-facade create_many parity --------------------------------------
+
+
+def _seed_conflict_batch(pods_api):
+    pods = [make_pod("a"), make_pod("a"), make_pod("b")]
+    with pytest.raises(KeyError):
+        pods_api.create_many(pods)
+
+
+def test_create_many_partial_failure_parity_across_facades():
+    """ADVICE r5 #4: both facades must create every independent item and
+    raise the FIRST per-item conflict — code written against one surface
+    must predict cluster state on the other."""
+    inproc_store = ObjectStore()
+    _seed_conflict_batch(Client(inproc_store).pods())
+    inproc_names = sorted(
+        p.metadata.name for p in inproc_store.list("Pod")
+    )
+
+    remote_store = ObjectStore()
+    _server, base, shutdown = start_api_server(remote_store)
+    try:
+        _seed_conflict_batch(RemoteClient(base).pods())
+    finally:
+        shutdown()
+    remote_names = sorted(p.metadata.name for p in remote_store.list("Pod"))
+
+    assert inproc_names == remote_names == ["a", "b"]

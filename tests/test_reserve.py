@@ -46,6 +46,19 @@ class RejectingPermit:
         return Status.unschedulable("permit says no"), 0.0
 
 
+def _wait_node_in_cache(sched, n: int = 1, timeout: float = 10.0) -> bool:
+    """The Node and Pod informers dispatch on independent threads, so a
+    pod can be popped before the node's ADD lands in the cache — a cycle
+    then fails on an empty snapshot BEFORE the reserve/permit chain under
+    test ever runs (and any() short-circuits on that failed cycle)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(sched.snapshot_nodes()) >= n:
+            return True
+        time.sleep(0.02)
+    return False
+
+
 def _sched(client, **kwargs):
     factory = SharedInformerFactory(client.store)
     sched = Scheduler(
@@ -69,6 +82,7 @@ def test_reserve_runs_before_bind_and_sticks_on_success():
     try:
         client.nodes().create(make_node("n1"))
         client.pods().create(make_pod("p1"))
+        assert _wait_node_in_cache(sched)
         # the informer dispatch thread feeds the queue; under full-suite
         # load one 2s pop window can elapse before the ADD lands - retry
         assert any(sched.schedule_one(timeout=2.0) for _ in range(5))
@@ -92,6 +106,7 @@ def test_reserve_failure_rolls_back_in_reverse():
     try:
         client.nodes().create(make_node("n1"))
         client.pods().create(make_pod("p1"))
+        assert _wait_node_in_cache(sched)
         # the informer dispatch thread feeds the queue; under full-suite
         # load one 2s pop window can elapse before the ADD lands - retry
         assert any(sched.schedule_one(timeout=2.0) for _ in range(5))
@@ -113,6 +128,7 @@ def test_permit_rejection_unreserves():
     try:
         client.nodes().create(make_node("n1"))
         client.pods().create(make_pod("p1"))
+        assert _wait_node_in_cache(sched)
         # the informer dispatch thread feeds the queue; under full-suite
         # load one 2s pop window can elapse before the ADD lands - retry
         assert any(sched.schedule_one(timeout=2.0) for _ in range(5))
