@@ -4,10 +4,10 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench chaos docker clean
+.PHONY: test native start serve bench chaos chaos-proc docker clean
 
 test: native
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 
 # chaos soak under a FIXED fault-schedule seed: the fabric's injection
 # decisions are a pure function of (seed, point, key, ordinal), so a
@@ -16,6 +16,14 @@ test: native
 chaos: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_chaos_soak.py tests/test_faults.py -q
+
+# process-level chaos: SIGKILL/restart the control-plane child process
+# mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.
+# Runs BOTH the tier-1 smoke (1 kill) and the slow soak (≥3 scheduled
+# kills + checkpoint compaction under fire)
+chaos-proc: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_proc_chaos.py -q
 
 # native host-table kernels (auto-built on first import too; this target
 # is for explicit/offline builds)

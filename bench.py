@@ -1308,6 +1308,14 @@ def bench_chaos() -> dict:
             time.sleep(0.25)
         store.fault_injector = None
         store.faults = None
+        # the degraded-mode dashboard line: per-kind cache staleness +
+        # reconnect/resume counts AT QUIESCE.  A cache still stale past
+        # the threshold means an informer never re-verified itself after
+        # the injected outages — fail the run, don't just log it.
+        staleness = svc.informer_factory.staleness()
+        max_staleness = float(
+            os.environ.get("BENCH_CHAOS_MAX_STALENESS_S", "30")
+        )
         if bound < n_pods:
             raise SystemExit(
                 f"[chaos] DID NOT CONVERGE: {bound}/{n_pods} bound; "
@@ -1315,6 +1323,13 @@ def bench_chaos() -> dict:
             )
         if leaked:
             raise SystemExit("[chaos] ASSUMED-CAPACITY LEAK at quiesce")
+        for kind, rec in staleness.items():
+            if rec["staleness_s"] > max_staleness:
+                raise SystemExit(
+                    f"[chaos] STALE INFORMER at quiesce: {kind} unverified "
+                    f"for {rec['staleness_s']}s (> {max_staleness}s); "
+                    f"staleness={staleness}"
+                )
     finally:
         svc.shutdown_scheduler()
         store.close()
@@ -1341,6 +1356,10 @@ def bench_chaos() -> dict:
             for k, v in counters.snapshot().items()
             if v and not k.startswith("assume.lease_renewed")
         },
+        # per-kind staleness gauge + reconnect/resume counts at quiesce
+        # (ROADMAP open item: surface SharedInformerFactory.staleness()
+        # in the bench records and alert past a threshold)
+        "staleness": staleness,
         "leak": False,
         "double_bind": False,
     }

@@ -605,11 +605,20 @@ class PersistentVolumeClaim:
 
 @dataclass
 class Binding:
-    """v1.Binding equivalent (POSTed by minisched/minisched.go:267-273)."""
+    """v1.Binding equivalent (POSTed by minisched/minisched.go:267-273).
+
+    ``expected_rv``: optional optimistic-concurrency precondition — the
+    pod resource_version the placement decision was computed against.
+    When set, the bind commits only if the pod is still at that version
+    (Conflict otherwise): a pod whose spec changed between evaluation and
+    commit must be re-evaluated, not bound on stale requirements.  The
+    unset-node_name guard stays as the double-bind backstop either way.
+    """
 
     pod_name: str
     pod_namespace: str
     node_name: str
+    expected_rv: Optional[int] = None
 
 
 @dataclass

@@ -73,21 +73,32 @@ def _decode(tp: Any, data: Any) -> Any:
     return data
 
 
+def build_snapshot_doc(
+    objects_by_kind: Dict[str, Dict[str, Any]], resource_version: int
+) -> Dict[str, Any]:
+    """Assemble a checkpoint document from raw kind→key→object maps.
+    Shared by ``snapshot_store`` (the public, lock-taking path) and
+    ``DurableObjectStore.compact`` (already inside the store lock, and
+    deliberately NOT via ``store.list`` — compaction is internal
+    bookkeeping and must neither clone every object nor draw entropy
+    from the fault fabric's ``store.list`` schedule)."""
+    return {
+        "version": CHECKPOINT_VERSION,
+        "resource_version": resource_version,
+        "objects": {
+            kind: [_encode(o) for o in objs.values()]
+            for kind in KIND_TYPES
+            if (objs := objects_by_kind.get(kind))
+        },
+    }
+
+
 def snapshot_store(store: ObjectStore) -> Dict[str, Any]:
     """Serialize every object (all kinds) + the resource version, under ONE
     lock hold — a torn snapshot (pod bound to a node the snapshot missed)
     would silently lose resource accounting after restore."""
     with store.locked():
-        doc: Dict[str, Any] = {
-            "version": CHECKPOINT_VERSION,
-            "resource_version": store.resource_version,
-            "objects": {},
-        }
-        for kind in KIND_TYPES:
-            objs = store.list(kind)
-            if objs:
-                doc["objects"][kind] = [_encode(o) for o in objs]
-    return doc
+        return build_snapshot_doc(store._objects, store.resource_version)
 
 
 def save_checkpoint(store: ObjectStore, path: str) -> None:

@@ -15,7 +15,7 @@ import time
 from typing import Any, List, Optional
 
 from minisched_tpu.api.objects import Binding, Node, Pod, PodStatus
-from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.controlplane.store import Conflict, ObjectStore
 
 #: the reference's client limits (k8sapiserver.go:60-61)
 DEFAULT_QPS = 5000.0
@@ -215,9 +215,23 @@ class _PodAPI:
                 # resource_version on it.
                 spec = pod.spec
                 if spec.node_name:
+                    # checked BEFORE the rv precondition: a retried bind
+                    # whose first attempt landed must surface as
+                    # AlreadyBound-to-our-node (the idempotency signal the
+                    # remote dedup converts to success), not as a Conflict
+                    # from the rv bump our own commit caused
                     raise AlreadyBound(
                         f"pod {pod.metadata.key} already bound to "
                         f"{spec.node_name}"
+                    )
+                if (
+                    binding.expected_rv is not None
+                    and pod.metadata.resource_version != binding.expected_rv
+                ):
+                    raise Conflict(
+                        f"stale resource_version for Pod {pod.metadata.key}: "
+                        f"expected {binding.expected_rv}, have "
+                        f"{pod.metadata.resource_version}"
                     )
                 new_spec = object.__new__(type(spec))
                 new_spec.__dict__.update(spec.__dict__)

@@ -7,8 +7,16 @@ recovers the cluster state.  This backend closes that layer for the
 in-process control plane (SURVEY.md §7 stage 9's optional store): a
 ``DurableObjectStore`` appends one JSON line per mutation to a WAL before
 the call returns, and re-opening the same path replays the log.
-``compact()`` collapses the log to the current state with an atomic
-replace — etcd's snapshot+compaction cycle in miniature.
+``compact()`` is etcd's snapshot+compaction cycle in miniature: the live
+state lands in ``<path>.ckpt`` (atomic replace) and the WAL truncates, so
+recovery = checkpoint ⊕ WAL tail and replay cost is bounded by the write
+volume since the last compaction, not by process lifetime.
+
+Replay also rebuilds the watch-resume history ring from the WAL tail
+(ADDED/MODIFIED inferred from key presence, DELETED from the popped
+object), so a restarted server can answer ``?resource_version=N`` resumes
+for everything after the checkpoint — and sets the history floor at the
+checkpoint's rv, so resumes from before it get HistoryCompacted (410).
 
 The record encoding reuses the checkpoint codec (controlplane/checkpoint)
 so WAL, checkpoint files, and the HTTP façade all speak the same
@@ -21,8 +29,19 @@ import json
 import os
 from typing import Any, Optional
 
-from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
-from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.controlplane.checkpoint import (
+    CHECKPOINT_VERSION,
+    KIND_TYPES,
+    _decode,
+    _encode,
+    build_snapshot_doc,
+)
+from minisched_tpu.controlplane.store import (
+    DEFAULT_HISTORY_EVENTS,
+    EventType,
+    ObjectStore,
+    WatchEvent,
+)
 
 
 class DurableObjectStore(ObjectStore):
@@ -31,15 +50,30 @@ class DurableObjectStore(ObjectStore):
     ``fsync=True`` makes every append an fsync (etcd-grade durability at
     file-IO cost); the default flushes to the OS, surviving process death
     but not host power loss — the right trade for the simulator.
+
+    ``checkpoint_path`` (default ``<path>.ckpt``) holds the compaction
+    snapshot; ``archive_compacted=True`` appends every truncated WAL
+    segment to ``<path>.history`` first, so the FULL mutation history
+    stays auditable (faults.wal_double_binds) across compactions.
     """
 
-    def __init__(self, path: str, fsync: bool = False):
-        super().__init__()
+    def __init__(
+        self,
+        path: str,
+        fsync: bool = False,
+        checkpoint_path: Optional[str] = None,
+        archive_compacted: bool = False,
+        history_events: int = DEFAULT_HISTORY_EVENTS,
+    ):
+        super().__init__(history_events=history_events)
         self._path = path
+        self._ckpt_path = checkpoint_path or path + ".ckpt"
+        self._archive = archive_compacted
         self._fsync = fsync
         self._closed = False
         self._defer_flush = False  # batch mutations share one flush
         self._log = None  # replay must not re-log
+        self._ckpt_rv = 0  # WAL records at/below this are pre-snapshot
         self._replay()
         self._log = open(self._path, "a", encoding="utf-8")
 
@@ -103,52 +137,79 @@ class DurableObjectStore(ObjectStore):
                     if self._fsync:
                         os.fsync(self._log.fileno())
 
+    def _append_rv_watermark(self, rv: int) -> None:
+        """Persist a bare version-counter record for a mutation whose kind
+        is volatile (no put/del record).  Without it the replayed counter
+        is merely monotone, not EXACT: an Event create/delete bumps the
+        global rv with nothing in the WAL carrying it, and a reopened
+        store would re-issue resource_versions that watchers and
+        optimistic-concurrency clients already observed — breaking both
+        the ``expected_rv`` precondition and watch resume."""
+        self._append({"op": "rv", "rv": rv})
+
     def _on_batch_commit(self, kind: str, obj: Any) -> None:
         # the inlined batch path commits without calling update() — log
         # each stored object here, inside the same lock hold and order
         if self._loggable(kind):
             self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
+        else:
+            self._append_rv_watermark(obj.metadata.resource_version)
+
+    def _commit_record(self, kind: str, op: str, obj: Any, rv: int) -> None:
+        # the base store calls this AFTER the in-memory commit and BEFORE
+        # the watch fanout — so the record (flushed by _append) is on
+        # disk before any observer can see the resource_version.  A crash
+        # after fanout can then never roll back an observed rv, which is
+        # what keeps ``?resource_version=N`` resumes honest.
+        if op == "put":
+            if self._loggable(kind):
+                self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
+            else:
+                self._append_rv_watermark(rv)
+        elif op == "del":
+            if self._loggable(kind):
+                self._append(
+                    {
+                        "op": "del",
+                        "kind": kind,
+                        "key": obj.metadata.key,
+                        "rv": rv,
+                    }
+                )
+            else:
+                self._append_rv_watermark(rv)
+
+    def _flush_log(self) -> None:
+        # mutate_many's pre-fanout barrier: records were appended under
+        # _defer_flush — force them out before the batch's events go live
+        if self._log is not None:
+            self._log.flush()
+            if self._fsync:
+                os.fsync(self._log.fileno())
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
-            out = super().create(kind, obj)
-            if self._loggable(kind):
-                self._append({"op": "put", "kind": kind, "obj": _encode(out)})
-            return out
+            return super().create(kind, obj)
 
-    def update(self, kind: str, obj: Any) -> Any:
+    def update(self, kind: str, obj: Any, expected_rv: Optional[int] = None) -> Any:
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
-            out = super().update(kind, obj)
-            if self._loggable(kind):
-                self._append({"op": "put", "kind": kind, "obj": _encode(out)})
-            return out
+            return super().update(kind, obj, expected_rv=expected_rv)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
             super().delete(kind, namespace, name)
-            if self._loggable(kind):
-                self._append(
-                    {
-                        "op": "del",
-                        "kind": kind,
-                        "key": f"{namespace}/{name}",
-                        "rv": self.resource_version,
-                    }
-                )
 
     def restore_object(self, kind: str, obj: Any) -> None:
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
             super().restore_object(kind, obj)
-            if self._loggable(kind):
-                self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
 
     def set_resource_version(self, rv: int) -> None:
         with self._lock:
@@ -159,7 +220,76 @@ class DurableObjectStore(ObjectStore):
             self._append({"op": "rv", "rv": self.resource_version})
 
     # -- recovery ----------------------------------------------------------
+    def _load_checkpoint(self) -> int:
+        """Restore the compaction snapshot (if any) directly into the
+        object maps — no WAL re-log, no watch fanout (a fresh store has no
+        watchers; the ring starts at the tail).  Returns the snapshot's
+        resource_version: the skip watermark for tail replay and the
+        history floor for watch resume."""
+        if not os.path.exists(self._ckpt_path):
+            return 0
+        with open(self._ckpt_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("version") != CHECKPOINT_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint version {doc.get('version')!r} "
+                f"in {self._ckpt_path!r}"
+            )
+        for kind, items in (doc.get("objects") or {}).items():
+            tp = KIND_TYPES.get(kind)
+            if tp is None:
+                continue  # newer schema: skip rather than fail open
+            objs = self._objects.setdefault(kind, {})
+            for data in items:
+                obj = _decode(tp, data)
+                objs[obj.metadata.key] = obj
+                self._rv = max(self._rv, obj.metadata.resource_version)
+        rv = int(doc.get("resource_version", 0))
+        self._rv = max(self._rv, rv)
+        return rv
+
+    def _drain_pending_archive(self) -> None:
+        """Finish an interrupted archive: compact() atomically RENAMES the
+        retired WAL segment to ``<path>.pending-archive`` before copying
+        it into ``<path>.history`` — if a SIGKILL lands between the two,
+        the segment is still sitting there, claimed but uncopied.  Append
+        it exactly once and delete it.  (A copy-then-truncate scheme has
+        no such claim step: a kill between the copy and the truncate
+        makes the next compaction re-archive the same records.)
+
+        Exactly-once includes the kill window between the history fsync
+        and the unlink: a segment can only have been copied as history's
+        final bytes, so if the history tail already EQUALS the pending
+        content the copy happened and only the unlink is owed."""
+        pending = self._path + ".pending-archive"
+        if not os.path.exists(pending):
+            return
+        hist = self._path + ".history"
+        with open(pending, "rb") as src:
+            seg = src.read()
+        already = False
+        if seg and os.path.exists(hist) and os.path.getsize(hist) >= len(seg):
+            with open(hist, "rb") as f:
+                f.seek(-len(seg), os.SEEK_END)
+                already = f.read() == seg
+        if seg and not already:
+            with open(hist, "ab") as dst:
+                dst.write(seg)
+                dst.flush()
+                os.fsync(dst.fileno())
+        os.unlink(pending)
+
     def _replay(self) -> None:
+        if self._archive:
+            # a crash mid-archive leaves a claimed segment; fold it into
+            # the history file before anything else (its records are all
+            # at/below the checkpoint that retired it — replay skips them)
+            self._drain_pending_archive()
+        self._ckpt_rv = self._load_checkpoint()
+        if self._ckpt_rv:
+            # events at/below the snapshot's rv are not reconstructable —
+            # a watch resuming from before it must get 410 and relist
+            self.set_history_floor(self._ckpt_rv)
         if not os.path.exists(self._path):
             return
         good_end = 0  # byte offset past the last decodable record
@@ -187,6 +317,13 @@ class DurableObjectStore(ObjectStore):
                 f.truncate(good_end)
 
     def _apply(self, rec: dict) -> None:
+        """Apply one WAL record; also rebuilds the watch-resume history
+        ring (replay = the tail of the live event stream).  Records at or
+        below the checkpoint's rv are SKIPPED: they are already folded
+        into the snapshot, and re-applying a pre-snapshot put would
+        resurrect an object a later (also pre-snapshot) delete removed —
+        the crash-between-checkpoint-and-truncate window makes such
+        overlap possible."""
         op = rec["op"]
         if op == "rv":
             self._rv = max(self._rv, rec["rv"])
@@ -196,34 +333,85 @@ class DurableObjectStore(ObjectStore):
             return  # written by a newer schema; skip rather than fail open
         if op == "put":
             obj = _decode(KIND_TYPES[kind], rec["obj"])
-            self._objects.setdefault(kind, {})[obj.metadata.key] = obj
-            self._rv = max(self._rv, obj.metadata.resource_version)
+            rv = obj.metadata.resource_version
+            if rv <= self._ckpt_rv:
+                return
+            objs = self._objects.setdefault(kind, {})
+            key = obj.metadata.key
+            old = objs.get(key)
+            objs[key] = obj
+            self._rv = max(self._rv, rv)
+            self._record_history(
+                kind,
+                WatchEvent(
+                    EventType.MODIFIED if old is not None else EventType.ADDED,
+                    obj, old, rv=rv,
+                ),
+            )
         elif op == "del":
-            self._objects.get(kind, {}).pop(rec["key"], None)
-            self._rv = max(self._rv, rec.get("rv", 0))
+            rv = rec.get("rv", 0)
+            if rv and rv <= self._ckpt_rv:
+                return
+            old = self._objects.get(kind, {}).pop(rec["key"], None)
+            self._rv = max(self._rv, rv)
+            if old is not None:
+                self._record_history(
+                    kind, WatchEvent(EventType.DELETED, old, rv=rv)
+                )
 
     # -- compaction --------------------------------------------------------
     def compact(self) -> None:
-        """Collapse the log to one put per live object (atomic replace);
-        the previous log stays intact until the rename lands."""
+        """Checkpoint compaction: snapshot the live state to
+        ``checkpoint_path`` (temp file + fsync + atomic replace), then
+        truncate the WAL — recovery is snapshot ⊕ WAL tail.  Crash-safe at
+        every step: until the rename lands, the old checkpoint + full WAL
+        recover; between the rename and the truncate, replay's rv-skip
+        ignores the now-redundant WAL prefix.  ``archive_compacted``
+        appends the truncated records to ``<path>.history`` first so the
+        full mutation history stays auditable."""
         with self._lock:
-            tmp = self._path + ".tmp"
+            if self._log is not None:
+                self._log.flush()
+            doc = build_snapshot_doc(self._objects, self._rv)
+            tmp = self._ckpt_path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                for kind in KIND_TYPES:
-                    for obj in self._objects.get(kind, {}).values():
-                        f.write(
-                            json.dumps(
-                                {"op": "put", "kind": kind, "obj": _encode(obj)}
-                            )
-                            + "\n"
-                        )
-                f.write(json.dumps({"op": "rv", "rv": self._rv}) + "\n")
+                json.dump(doc, f)
                 f.flush()
                 os.fsync(f.fileno())
+            os.replace(tmp, self._ckpt_path)
+            self._ckpt_rv = self._rv
             if self._log is not None:
                 self._log.close()
-            os.replace(tmp, self._path)
-            self._log = open(self._path, "a", encoding="utf-8")
+                self._log = None
+            try:
+                if self._archive:
+                    # retire the segment by ATOMIC RENAME (the claim),
+                    # then fold it into .history; a kill in between is
+                    # finished by _drain_pending_archive at the next
+                    # compact or reopen
+                    self._drain_pending_archive()  # leftover from a crash
+                    if os.path.exists(self._path):
+                        os.replace(
+                            self._path, self._path + ".pending-archive"
+                        )
+                with open(self._path, "w", encoding="utf-8"):
+                    pass  # fresh WAL: the checkpoint holds the rest
+                if self._archive:
+                    self._drain_pending_archive()
+            finally:
+                # the log is reopened NO MATTER what raised above (ENOSPC
+                # mid-archive is exactly compaction's weather): with
+                # _log=None and _closed=False every later mutation would
+                # commit in memory, fan out, and silently skip the WAL —
+                # the one divergence this store exists to prevent.  If
+                # even the reopen fails, close the store so mutations are
+                # refused loudly instead of acknowledged and lost.
+                if not self._closed:
+                    try:
+                        self._log = open(self._path, "a", encoding="utf-8")
+                    except OSError:
+                        self._closed = True
+                        raise
 
     def close(self) -> None:
         with self._lock:

@@ -33,11 +33,16 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional, Tuple
+from urllib.parse import parse_qs
 
 from minisched_tpu.api.objects import Binding, Node, Pod
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
 from minisched_tpu.controlplane.client import AlreadyBound, Client
-from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.controlplane.store import (
+    Conflict,
+    HistoryCompacted,
+    ObjectStore,
+)
 
 
 def _kind_for(collection: str) -> str:
@@ -91,11 +96,20 @@ def _route(path: str):
     return _kind_for(collection), ns, name, sub
 
 
+#: bound on the per-server binding ack registry (entries, FIFO): big
+#: enough that every in-flight wave's retries land inside it, small
+#: enough that a soak never grows without bound
+_ACK_REGISTRY_CAP = 65536
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: ObjectStore = None  # set by start_api_server
     active_watches = None  # set by start_api_server (set + lock)
     watch_lock = None
     faults = None  # optional faults.FaultFabric, set by start_api_server
+    ack_registry = None  # set by start_api_server: ack id → response entry
+    ack_order = None  # FIFO of ack ids for eviction
+    ack_lock = None
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:  # quiet
@@ -146,6 +160,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, msg: str) -> None:
         self._send(code, {"error": msg})
 
+    def _int_param(self, query: str, name: str) -> Optional[int]:
+        """One integer query parameter (None when absent).  A non-integer
+        value answers the 400 itself and re-raises ValueError so the verb
+        handler just returns — the parse/error behavior cannot drift
+        between GET's resource_version and PUT's expected_rv."""
+        if not query:
+            return None
+        params = parse_qs(query)
+        if name not in params:
+            return None
+        try:
+            return int(params[name][0])
+        except ValueError:
+            self._error(400, f"{name} must be an integer")
+            raise
+
     def do_GET(self) -> None:
         if self._inject_fault():
             return
@@ -159,7 +189,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {path}")
             return
         if "watch=true" in query:
-            self._watch(kind, ns)
+            try:
+                resume_rv = self._int_param(query, "resource_version")
+            except ValueError:
+                return  # 400 already sent
+            self._watch(kind, ns, resume_rv)
             return
         try:
             if name:
@@ -173,11 +207,23 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError as e:
             self._error(404, str(e))
 
-    def _watch(self, kind: str, ns: str) -> None:
+    def _watch(self, kind: str, ns: str, resume_rv: Optional[int] = None) -> None:
         """JSON-lines event stream (chunked) until the client hangs up or
         the server shuts down — the apiserver watch verb the informer
-        machinery rides.  A namespaced path filters to that namespace."""
-        watch, snapshot = self.store.watch(kind, send_initial=True)
+        machinery rides.  A namespaced path filters to that namespace.
+
+        ``resume_rv`` (the ``?resource_version=N`` query) resumes instead
+        of relisting: the stream replays retained history with rv > N and
+        goes live, SYNC count 0 (the consumer's cache is already current
+        through N).  History compacted past N → 410 Gone, and the
+        consumer must relist."""
+        try:
+            watch, snapshot = self.store.watch(
+                kind, send_initial=resume_rv is None, resume_rv=resume_rv
+            )
+        except HistoryCompacted as e:
+            self._error(410, str(e))
+            return
         with self.watch_lock:
             self.active_watches.add(watch)
         self.send_response(200)
@@ -193,14 +239,26 @@ class _Handler(BaseHTTPRequestHandler):
             # first line: how many snapshot events this stream will replay
             # (ns-filtered), taken ATOMICALLY with the watch registration —
             # a client-side LIST-then-watch can't get this count right (a
-            # delete in the gap strands its sync barrier forever)
+            # delete in the gap strands its sync barrier forever).  A
+            # resumed stream replays history, not the snapshot: count 0.
             n_initial = sum(
                 1
                 for o in snapshot
                 if not ns or o.metadata.namespace == ns
             )
             chunk(
-                json.dumps({"type": "SYNC", "count": n_initial}).encode()
+                json.dumps(
+                    {
+                        "type": "SYNC",
+                        "count": n_initial,
+                        # the rv this stream's snapshot reflects, taken
+                        # atomically with the watch registration — the
+                        # consumer's resume cursor once it has consumed
+                        # the snapshot (a max over object rvs under-counts
+                        # deletes and replays already-folded history)
+                        "rv": watch.start_rv,
+                    }
+                ).encode()
                 + b"\n"
             )
             while True:
@@ -213,7 +271,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if ns and ev.obj.metadata.namespace != ns:
                     continue
                 line = json.dumps(
-                    {"type": ev.type.value, "object": _encode(ev.obj)}
+                    {
+                        "type": ev.type.value,
+                        "object": _encode(ev.obj),
+                        "rv": ev.rv,
+                    }
                 ).encode() + b"\n"
                 chunk(line)
             # orderly end-of-stream: terminal chunk, then drop keep-alive so
@@ -245,12 +307,16 @@ class _Handler(BaseHTTPRequestHandler):
             if not node_name:
                 self._error(400, "binding body requires node_name")
                 return
+            expected_rv = data.get("expected_rv")
             try:
                 pod = Client(self.store).pods(ns or "default").bind(
-                    Binding(name, ns or "default", node_name)
+                    Binding(name, ns or "default", node_name,
+                            expected_rv=expected_rv)
                 )
                 self._send(201, _encode(pod))
             except AlreadyBound as e:
+                self._error(409, str(e))
+            except Conflict as e:
                 self._error(409, str(e))
             except KeyError as e:
                 self._error(404, str(e))
@@ -301,11 +367,24 @@ class _Handler(BaseHTTPRequestHandler):
         (one HTTP round-trip per bind would serialize the TPU wave; the
         store transaction below is the same bind_many the in-process
         client uses).  Per-item errors are returned per entry —
-        AlreadyBound / missing pod never abort the rest of the batch."""
+        AlreadyBound / missing pod / stale-rv Conflict never abort the
+        rest of the batch.
+
+        Partial-batch acks: a request carrying ``batch_id`` gets each
+        entry recorded under the ack id ``{batch_id}/{index}``.  A RETRIED
+        batch (same batch_id — the response to the first attempt was lost)
+        answers already-acked entries straight from the registry, marked
+        ``"acked": true``, instead of re-running them through the store —
+        so a retry after a partially-processed wave re-posts only the
+        entries whose outcome is genuinely unknown.  The registry is
+        in-memory (bounded FIFO) and does NOT survive a server restart;
+        after one, the bind subresource's own preconditions take over
+        (AlreadyBound-to-the-requested-node ⇒ the retried entry landed)."""
         try:
             data = self._body()
             items = data.get("items", [])
             return_objects = data.get("return_objects", True)
+            batch_id = str(data.get("batch_id") or "")
             bindings = []
             for it in items:
                 if not it.get("name") or not it.get("node_name"):
@@ -315,6 +394,7 @@ class _Handler(BaseHTTPRequestHandler):
                     Binding(
                         it["name"], it.get("namespace") or "default",
                         it["node_name"],
+                        expected_rv=it.get("expected_rv"),
                     )
                 )
         except Exception as e:
@@ -323,11 +403,21 @@ class _Handler(BaseHTTPRequestHandler):
             # dropped connection
             self._error(400, f"malformed body: {e}")
             return
+        replayed: dict = {}
+        if batch_id:
+            with self.ack_lock:
+                for i in range(len(bindings)):
+                    entry = self.ack_registry.get(f"{batch_id}/{i}")
+                    if entry is not None:
+                        replayed[i] = entry
+        todo = [i for i in range(len(bindings)) if i not in replayed]
         results = Client(self.store).pods().bind_many(
-            bindings, return_objects=return_objects
+            [bindings[i] for i in todo], return_objects=return_objects
         )
-        out = []
-        for b, res in zip(bindings, results):
+        out: list = [None] * len(bindings)
+        fresh: dict = {}
+        for i, res in zip(todo, results):
+            b = bindings[i]
             if isinstance(res, AlreadyBound):
                 # carry the CURRENT bound node as a structured field: the
                 # remote client's idempotent-retry dedup compares it to
@@ -340,23 +430,57 @@ class _Handler(BaseHTTPRequestHandler):
                     ).spec.node_name
                 except Exception:
                     pass  # pod vanished between bind and lookup
-                out.append(entry)
+            elif isinstance(res, Conflict):
+                entry = {"error": str(res), "type": "Conflict"}
             elif isinstance(res, BaseException):
-                out.append({"error": str(res), "type": "NotFound"})
+                entry = {"error": str(res), "type": "NotFound"}
             elif res is not None:
-                out.append({"object": _encode(res)})
+                entry = {"object": _encode(res)}
             else:
-                out.append({})
+                entry = {}
+            out[i] = entry
+            # the registry keeps the OUTCOME, never the encoded pod: a
+            # success pins one tiny dict, not a multi-KB document, at
+            # 65536 entries (the replay re-reads the live object below)
+            fresh[i] = entry if "error" in entry else {"committed": True}
+        for i, entry in replayed.items():
+            if entry.get("committed"):
+                ack: dict = {"acked": True}
+                if return_objects:
+                    b = bindings[i]
+                    try:
+                        ack["object"] = _encode(
+                            self.store.get("Pod", b.pod_namespace, b.pod_name)
+                        )
+                    except Exception:
+                        pass  # pod since deleted: ack alone says it landed
+                out[i] = ack
+            else:
+                out[i] = dict(entry, acked=True)
+        if batch_id and fresh:
+            with self.ack_lock:
+                for i, entry in fresh.items():
+                    ack_id = f"{batch_id}/{i}"
+                    if ack_id not in self.ack_registry:
+                        self.ack_order.append(ack_id)
+                    self.ack_registry[ack_id] = entry
+                while len(self.ack_order) > _ACK_REGISTRY_CAP:
+                    self.ack_registry.pop(self.ack_order.popleft(), None)
         self._send(200, {"items": out})
 
     def do_PUT(self) -> None:
         if self._inject_fault():
             return
+        path, _, query = self.path.partition("?")
         try:
-            kind, ns, name, _ = _route(self.path)
+            kind, ns, name, _ = _route(path)
         except (KeyError, ValueError):
-            self._error(404, f"no route {self.path}")
+            self._error(404, f"no route {path}")
             return
+        try:
+            expected_rv = self._int_param(query, "expected_rv")
+        except ValueError:
+            return  # 400 already sent
         try:
             obj = _decode(REST_KINDS[kind], self._body())
         except Exception as e:
@@ -371,7 +495,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"body namespace {obj.metadata.namespace!r} != {ns!r}")
             return
         try:
-            self._send(200, _encode(self.store.update(kind, obj)))
+            self._send(
+                200,
+                _encode(self.store.update(kind, obj, expected_rv=expected_rv)),
+            )
+        except Conflict as e:
+            # 409 with the stale-rv marker: the remote client maps it to
+            # store.Conflict and retries get→re-apply→PUT, never blindly
+            self._error(409, str(e))
         except KeyError as e:
             self._error(404, str(e))
 
@@ -395,11 +526,15 @@ def start_api_server(
     armed with http.500 / http.reset makes this server lossy on purpose
     (see _Handler._inject_fault)."""
     store = store or ObjectStore()
+    from collections import deque as _deque
+
     handler = type(
         "BoundHandler",
         (_Handler,),
         {"store": store, "active_watches": set(),
-         "watch_lock": threading.Lock(), "faults": faults},
+         "watch_lock": threading.Lock(), "faults": faults,
+         "ack_registry": {}, "ack_order": _deque(),
+         "ack_lock": threading.Lock()},
     )
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -451,6 +586,8 @@ class HTTPClient:
             body = e.read().decode(errors="replace")
             if e.code == 409 and "already bound" in body:
                 raise AlreadyBound(body)
+            if e.code == 409 and "stale resource_version" in body:
+                raise Conflict(body)  # == in-process update(expected_rv)
             if e.code == 409 and "already exists" in body:
                 raise KeyError(body)  # == in-process store.create semantics
             if e.code == 404:

@@ -19,7 +19,12 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from minisched_tpu.controlplane.store import EventType, ObjectStore, WatchEvent
+from minisched_tpu.controlplane.store import (
+    EventType,
+    HistoryCompacted,
+    ObjectStore,
+    WatchEvent,
+)
 from minisched_tpu.observability import counters
 
 Handler = Callable[[Any], None]
@@ -77,7 +82,20 @@ class Informer:
         #: delivered batch or a verified-quiet live stream) — consumers
         #: read ``staleness_s()`` to decide how much to trust the cache
         self.reconnects = 0
+        #: of those, how many re-opened as a RESUME (history replay from
+        #: the last seen resource_version) vs. a full relist
+        self.resumes = 0
         self._last_progress_t = time.monotonic()
+        # highest mutation resource_version this dispatch thread has seen
+        # (only it writes); what a reconnect resumes from
+        self._last_rv = 0
+        #: callbacks invoked (on the dispatch thread) after every
+        #: successful reconnect, resume or relist — consumers whose
+        #: derived state assumes an unbroken stream re-arbitrate here
+        #: (the engine revalidates its assume ledger against the
+        #: authoritative store: a control-plane restart may have lost or
+        #: landed binds its pre-crash memory is wrong about)
+        self.on_reconnect: List[Callable[[], None]] = []
 
     def add_event_handlers(self, handlers: ResourceEventHandlers) -> None:
         with self._lock:
@@ -112,16 +130,37 @@ class Informer:
         )
         self._thread.start()
 
-    def _open_watch(self, backoff: float) -> Optional[List[Any]]:
+    def _open_watch(
+        self, backoff: float, resume_rv: Optional[int] = None
+    ) -> Optional[Tuple[List[Any], bool]]:
         """Open a watch (initial or reconnect) with bounded backoff — a
         watch open is one HTTP request on the remote store, exactly as
         droppable as the stream it starts.  Assigns ``self._watch`` and
-        returns the snapshot, or None only on shutdown."""
+        returns (snapshot, resumed), or None only on shutdown.
+
+        ``resume_rv``: try to RESUME from that resource_version first —
+        the server replays only the missed tail and the cache needs no
+        replay-diff.  When the history is compacted away (410 /
+        HistoryCompacted) fall back to the full list+watch, once, without
+        burning a backoff interval — the server is demonstrably up."""
         while not self._stop.is_set():
             try:
-                watch, snapshot = self._store.watch(
-                    self._kind, send_initial=True
-                )
+                if resume_rv is not None:
+                    try:
+                        watch, snapshot = self._store.watch(
+                            self._kind, send_initial=False,
+                            resume_rv=resume_rv,
+                        )
+                        resumed = True
+                    except HistoryCompacted:
+                        counters.inc("informer.relist_on_410")
+                        resume_rv = None
+                        continue
+                else:
+                    watch, snapshot = self._store.watch(
+                        self._kind, send_initial=True
+                    )
+                    resumed = False
             except Exception as err:
                 print(
                     f"informer-{self._kind}: watch open failed ({err!r});"
@@ -139,15 +178,30 @@ class Informer:
                 # idempotent) so no orphan registration accretes events
                 watch.stop()
                 return None
-            return snapshot
+            return snapshot, resumed
         return None
 
     def _open_initial(self) -> bool:
-        snapshot = self._open_watch(backoff=0.1)
-        if snapshot is None:
+        opened = self._open_watch(backoff=0.1)
+        if opened is None:
             return False
+        snapshot, _ = opened
         self._initial = len(snapshot)
+        self._advance_cursor_to_snapshot()
         return True
+
+    def _advance_cursor_to_snapshot(self) -> None:
+        """After a full-snapshot open, the resume cursor is the rv the
+        snapshot REFLECTS (Watch.start_rv, taken atomically with the
+        registration) — not the max event rv seen: object rvs undercount
+        deletes, and a cursor left low would make a later resume replay
+        history this snapshot already folded in (double-dispatched
+        DELETEDs, older objects clobbering newer cache entries).  Safe
+        even if the stream dies mid-replay: _reconnect's mid_replay guard
+        forces a relist then."""
+        self._last_rv = max(
+            self._last_rv, getattr(self._watch, "start_rv", 0)
+        )
 
     def _drain_replays(self) -> None:
         while True:
@@ -195,6 +249,9 @@ class Informer:
             normalized: List[WatchEvent] = []
             with self._lock:
                 for ev in batch:
+                    if ev.rv > self._last_rv:
+                        # the resume cursor: what a reconnect replays from
+                        self._last_rv = ev.rv
                     key = ev.obj.metadata.key
                     if self._replay_pending > 0:
                         self._replay_pending -= 1
@@ -250,17 +307,44 @@ class Informer:
     def _reconnect(self) -> bool:
         """The watch died underneath us (remote stream failure — the
         in-process store's watch only stops via Informer.stop): re-open
-        it with a snapshot replay, client-go-reflector style, retrying
-        with backoff until stopped.  The replayed snapshot is diffed
-        against the cache by the _run loop so consumers converge on the
-        post-outage state without replaying what they already saw.
-        Returns False only when the informer is shutting down."""
-        snapshot = self._open_watch(backoff=0.5)
-        if snapshot is None:
+        it, retrying with backoff until stopped.  RESUME first — the
+        server replays exactly the events after the last seen
+        resource_version (missed deletes included), so the cache needs no
+        diffing and consumers never re-see what they already processed.
+        Only when that history is compacted away (server restarted past
+        the tail, ring overflow → 410) fall back to the full snapshot
+        replay, client-go-reflector style: the replayed snapshot is
+        diffed against the cache by the _run loop so consumers converge
+        on the post-outage state.  Returns False only when the informer
+        is shutting down."""
+        with self._lock:
+            mid_replay = self._replay_pending > 0
+        # a reconnect DURING an unfinished relist must relist again, not
+        # resume: the aborted replay-diff never ran _finish_replay_locked,
+        # so deletes that happened in the original outage are still only
+        # detectable by a full snapshot diff — and the partial replay has
+        # already advanced _last_rv past their events, so a resume would
+        # never see them and the cache would retain deleted objects
+        # until some future 410 forced a relist.
+        resume_rv = (
+            None if mid_replay or not self._last_rv else self._last_rv
+        )
+        opened = self._open_watch(backoff=0.5, resume_rv=resume_rv)
+        if opened is None:
             return False
+        snapshot, resumed = opened
         self.reconnects += 1
         counters.inc("informer.reconnect")
+        if resumed:
+            self.resumes += 1
+            counters.inc("informer.resume")
+            with self._lock:
+                self._replay_pending = 0
+                self._replay_seen = set()
+            self._notify_reconnect()
+            return True
         stale: List[WatchEvent] = []
+        self._advance_cursor_to_snapshot()
         with self._lock:
             self._replay_pending = len(snapshot)
             self._replay_seen = set()
@@ -271,7 +355,17 @@ class Informer:
         if stale:
             for h in handlers:
                 self._invoke(h, stale)
+        self._notify_reconnect()
         return True
+
+    def _notify_reconnect(self) -> None:
+        for cb in list(self.on_reconnect):
+            try:
+                cb()
+            except Exception:  # a consumer hook must not kill the stream
+                import traceback
+
+                traceback.print_exc()
 
     def _invoke(self, h: ResourceEventHandlers, events: List[WatchEvent]) -> None:
         """One handler over a batch: a registered ``on_batch`` takes the
@@ -387,6 +481,7 @@ class SharedInformerFactory:
             kind: {
                 "staleness_s": round(inf.staleness_s(), 3),
                 "reconnects": inf.reconnects,
+                "resumes": inf.resumes,
             }
             for kind, inf in self._informers.items()
         }

@@ -37,7 +37,12 @@ from minisched_tpu.controlplane.client import (
     _NodeAPI,
     _PodAPI,
 )
-from minisched_tpu.controlplane.store import EventType, WatchEvent
+from minisched_tpu.controlplane.store import (
+    Conflict,
+    EventType,
+    HistoryCompacted,
+    WatchEvent,
+)
 from minisched_tpu.faults import InjectedFault
 from minisched_tpu.observability import counters
 from minisched_tpu.utils.retry import backoff_delays
@@ -75,7 +80,18 @@ class RemoteWatch:
         #: what makes the informer's sync barrier exact (a LIST taken
         #: before/after opening the stream can't be atomic with it)
         self._sync_count: Optional[int] = None
-        self._resp = urllib.request.urlopen(url, timeout=3600.0)
+        #: the store rv this stream's snapshot reflects (SYNC line) —
+        #: same role as the in-process Watch.start_rv
+        self.start_rv = 0
+        try:
+            self._resp = urllib.request.urlopen(url, timeout=3600.0)
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            if e.code == 410:
+                # resume asked for compacted history: the caller must
+                # relist (HistoryCompacted == the in-process store's)
+                raise HistoryCompacted(body)
+            raise
         self._thread = threading.Thread(
             target=self._read, name=f"remote-watch-{kind}", daemon=True
         )
@@ -92,11 +108,14 @@ class RemoteWatch:
                 msg = json.loads(line)
                 if msg["type"] == "SYNC":
                     with self._cond:
+                        self.start_rv = int(msg.get("rv", 0))
                         self._sync_count = int(msg["count"])
                         self._cond.notify_all()
                     continue
                 ev = WatchEvent(
-                    EventType(msg["type"]), _decode(self._typ, msg["object"])
+                    EventType(msg["type"]),
+                    _decode(self._typ, msg["object"]),
+                    rv=int(msg.get("rv", 0)),
                 )
                 with self._cond:
                     if self._stopped:
@@ -268,6 +287,10 @@ class RemoteStore:
                 body = e.read().decode(errors="replace")
                 if e.code == 409 and "already bound" in body:
                     raise AlreadyBound(body)
+                if e.code == 409 and "stale resource_version" in body:
+                    # semantic, never blindly retried: the caller must
+                    # re-read before re-applying (see mutate)
+                    raise Conflict(body)
                 if e.code in (404, 409):
                     raise KeyError(body)
                 if e.code < 500:
@@ -284,7 +307,12 @@ class RemoteStore:
         )
 
     # -- store surface ------------------------------------------------------
-    def watch(self, kind: str, send_initial: bool = True) -> Tuple[RemoteWatch, List[Any]]:
+    def watch(
+        self,
+        kind: str,
+        send_initial: bool = True,
+        resume_rv: Optional[int] = None,
+    ) -> Tuple[RemoteWatch, List[Any]]:
         """(watch, snapshot placeholder): the stream replays the
         server-side snapshot as ADDED events and announces its exact
         count in a SYNC first line (atomic with the watch registration —
@@ -292,10 +320,16 @@ class RemoteStore:
         and strand the informer's sync barrier).  The returned snapshot
         list is sized to that count; its entries are None — the informer
         only measures ``len``, and the objects themselves arrive through
-        the stream."""
-        w = RemoteWatch(
-            f"{self._base}{self._path(kind)}?watch=true", kind
-        )
+        the stream.
+
+        ``resume_rv``: resume from that resource_version instead of a
+        full snapshot replay (``?resource_version=N`` on the wire) —
+        SYNC count 0, history events stream in as live events.  Raises
+        HistoryCompacted (the server's 410) when the tail is gone."""
+        url = f"{self._base}{self._path(kind)}?watch=true"
+        if resume_rv is not None:
+            url += f"&resource_version={int(resume_rv)}"
+        w = RemoteWatch(url, kind)
         return w, [None] * w.initial_count()
 
     def list(self, kind: str) -> List[Any]:
@@ -347,15 +381,44 @@ class RemoteStore:
                     results[i] = _decode(typ, item["object"])
         return results
 
-    def update(self, kind: str, obj: Any) -> Any:
+    def update(
+        self, kind: str, obj: Any, expected_rv: Optional[int] = None
+    ) -> Any:
         typ = _kind_types()[kind]
-        return _decode(
-            typ,
-            self._req(
-                "PUT",
-                self._path(kind, obj.metadata.namespace, obj.metadata.name),
-                _encode(obj),
-            ),
+        path = self._path(kind, obj.metadata.namespace, obj.metadata.name)
+        if expected_rv is not None:
+            path += f"?expected_rv={int(expected_rv)}"
+        return _decode(typ, self._req("PUT", path, _encode(obj)))
+
+    def mutate(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        fn: Any,
+        max_conflict_retries: int = 16,
+    ) -> Any:
+        """Read-modify-write over the wire: GET, apply ``fn``, PUT with
+        the read's resource_version as the ``expected_rv`` precondition —
+        and on 409 Conflict, RE-READ and re-apply (get–mutate–retry).
+        This is the store.mutate surface the in-process client gets from
+        the lock-holding store, rebuilt on optimistic concurrency: two
+        remote writers can no longer silently last-write-wins each other,
+        and a bind/annotation racing this path surfaces as a retried
+        merge instead of a lost update."""
+        last: Optional[BaseException] = None
+        for _ in range(max_conflict_retries + 1):
+            obj = self.get(kind, namespace, name)
+            rv = obj.metadata.resource_version
+            updated = fn(obj) or obj
+            try:
+                return self.update(kind, updated, expected_rv=rv)
+            except Conflict as err:
+                counters.inc("remote.conflict_retry")
+                last = err
+        raise RuntimeError(
+            f"remote mutate {kind} {namespace}/{name} still conflicting "
+            f"after {max_conflict_retries + 1} attempts: {last}"
         )
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -364,27 +427,44 @@ class RemoteStore:
     def bind_many_remote(
         self, bindings: List[Binding], return_objects: bool = True
     ) -> List[Any]:
+        import uuid
+
+        # one ack identity per LOGICAL batch: _req_ex serializes the
+        # payload once before its retry loop, so every transport retry
+        # carries the same batch_id and the server answers already-acked
+        # entries from its registry instead of re-running them
+        items = []
+        for b in bindings:
+            it: dict = {
+                "namespace": b.pod_namespace,
+                "name": b.pod_name,
+                "node_name": b.node_name,
+            }
+            if b.expected_rv is not None:
+                it["expected_rv"] = b.expected_rv
+            items.append(it)
         out, attempts = self._req_ex(
             "POST",
             "/api/v1/bindings",
             {
-                "items": [
-                    {
-                        "namespace": b.pod_namespace,
-                        "name": b.pod_name,
-                        "node_name": b.node_name,
-                    }
-                    for b in bindings
-                ],
+                "items": items,
                 "return_objects": return_objects,
+                "batch_id": uuid.uuid4().hex,
             },
         )
         from minisched_tpu.api.objects import Pod
 
         results: List[Any] = []
         for b, item in zip(bindings, out["items"]):
+            if item.get("acked"):
+                # answered from the server's ack registry: the FIRST
+                # attempt's recorded outcome, not a re-execution
+                counters.inc("remote.bind_ack_replayed")
             err = item.get("error")
             if err is not None:
+                if item.get("type") == "Conflict":
+                    results.append(Conflict(err))
+                    continue
                 if item.get("type") == "AlreadyBound":
                     # idempotent-retry guard: a retried request whose FIRST
                     # attempt committed before its response was lost comes

@@ -27,6 +27,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -37,11 +38,29 @@ class EventType(enum.Enum):
     DELETED = "DELETED"
 
 
+class Conflict(Exception):
+    """Optimistic-concurrency failure: the caller's ``expected_rv``
+    precondition did not match the stored object's resource_version (the
+    apiserver's 409 on a stale PUT).  Never retried blindly — the right
+    recovery is get→re-apply→retry (see RemoteStore.mutate)."""
+
+
+class HistoryCompacted(Exception):
+    """A watch resume asked for history older than the store retains
+    (ring overflow, or a restart whose checkpoint compacted it away) —
+    the apiserver's 410 Gone.  The consumer must relist."""
+
+
 @dataclass
 class WatchEvent:
     type: EventType
     obj: Any
     old_obj: Any = None
+    #: the global resource_version of the mutation that produced this
+    #: event (0 = unknown/legacy producer).  Watch resume is keyed on it:
+    #: a consumer that saw rv N resumes with ``resume_rv=N`` and receives
+    #: exactly the events with rv > N.
+    rv: int = 0
 
 
 class Watch:
@@ -53,6 +72,11 @@ class Watch:
         self._cond = threading.Condition()
         self._events: List[WatchEvent] = []
         self._stopped = False
+        #: the store's resource_version at registration (for a full
+        #: snapshot open: the version the snapshot reflects — the exact
+        #: resume cursor once that snapshot is consumed; every queued
+        #: event has a higher rv).  A resumed watch carries its resume_rv.
+        self.start_rv = 0
 
     # called by the store while it holds its lock; only touches this
     # watch's own condition/queue, so it cannot block on user code
@@ -131,14 +155,36 @@ class Watch:
         return self._stopped
 
 
+#: events retained for watch resume, PER KIND.  Sized so a short
+#: reconnect (the informer's 0.5–10s backoff) replays from history
+#: instead of relisting even at wave scale; overflow advances that
+#: kind's floor and a too-old resume gets HistoryCompacted (410) —
+#: correct, just costlier for the consumer.  Per-kind isolation is the
+#: point: the EventRecorder's volatile Event churn (one create+expiry
+#: per scheduling decision) must not evict the Pod/Node tail a resuming
+#: informer actually needs.
+DEFAULT_HISTORY_EVENTS = 65536
+
+
 class ObjectStore:
     """Versioned multi-kind object store + watch hub."""
 
-    def __init__(self) -> None:
+    def __init__(self, history_events: int = DEFAULT_HISTORY_EVENTS) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, Any]] = {}  # kind -> key -> obj
         self._watches: Dict[str, List[Watch]] = {}
         self._rv = 0
+        # watch-resume history: per-kind event rings in mutation order.
+        # A kind's floor is the highest rv NO LONGER retained for it —
+        # resume_rv below the floor means the gap cannot be replayed
+        # (HistoryCompacted).  ``_history_floor_min`` is the baseline for
+        # every kind regardless of ring state (a durable reopen sets it
+        # to the checkpoint rv: nothing before the snapshot is
+        # reconstructable for ANY kind).
+        self._history: Dict[str, deque] = {}
+        self._history_cap = max(int(history_events), 0)
+        self._history_floors: Dict[str, int] = {}
+        self._history_floor_min = 0
         #: fault-injection hook (SURVEY.md §5.3 — the reference has none):
         #: called as (op, kind, key) before every mutation AND read;
         #: raising makes the call fail exactly as a flaky apiserver/etcd
@@ -166,6 +212,47 @@ class ObjectStore:
         self._rv += 1
         return self._rv
 
+    def _record_history(self, kind: str, event: WatchEvent) -> None:
+        """Append one event to the kind's resume ring (caller holds the
+        lock).  Overflow advances that kind's floor to the dropped
+        event's rv — resumes below the floor must relist
+        (HistoryCompacted)."""
+        if self._history_cap <= 0:
+            return
+        ring = self._history.get(kind)
+        if ring is None:
+            ring = self._history[kind] = deque()
+        if len(ring) >= self._history_cap:
+            dropped = ring.popleft()
+            if dropped.rv > self._history_floors.get(kind, 0):
+                self._history_floors[kind] = dropped.rv
+        if event.old_obj is not None:
+            # retain WITHOUT old_obj: the replaced version is garbage the
+            # moment a newer event lands, and pinning it doubles the
+            # ring's footprint at wave scale.  Resume consumers re-derive
+            # 'old' from their own caches (the informer's normalization
+            # does exactly that), and the wire encoding never carried it.
+            event = WatchEvent(event.type, event.obj, rv=event.rv)
+        ring.append(event)
+
+    def _floor_for(self, kind: str) -> int:
+        return max(self._history_floor_min, self._history_floors.get(kind, 0))
+
+    def set_history_floor(self, rv: int) -> None:
+        """Raise the resume floor for EVERY kind (never lowers).  The
+        durable store calls this at replay: events at or before the
+        checkpoint's rv are not reconstructable, so resumes from them
+        must get 410."""
+        with self._lock:
+            self._history_floor_min = max(self._history_floor_min, rv)
+
+    @property
+    def history_floor(self) -> int:
+        """The all-kinds baseline floor (per-kind ring overflow can sit
+        higher — ``watch`` checks both)."""
+        with self._lock:
+            return self._history_floor_min
+
     def _fanout(self, kind: str, event: WatchEvent) -> None:
         # events carry the STORED objects directly — no defensive clones.
         # Safe because the store never mutates an object after it lands in
@@ -175,6 +262,7 @@ class ObjectStore:
         # as immutable; only clones returned from get()/list()/update()
         # are theirs to mutate.)  At wave scale the per-event clones were
         # a third of the batch-bind cost.
+        self._record_history(kind, event)
         faults = self.faults
         for w in list(self._watches.get(kind, ())):
             if w.stopped:
@@ -205,7 +293,22 @@ class ObjectStore:
                 stored.metadata.creation_timestamp = time.time()
             objs[key] = stored
             out = stored.clone()
-            self._fanout(kind, WatchEvent(EventType.ADDED, stored))
+            # durability BEFORE visibility: the WAL record lands (and
+            # flushes) before any watcher can observe the event — a crash
+            # in between must never let a remote informer hold a
+            # resource_version the recovered server rolls back (and later
+            # re-issues), or its resume would silently skip the re-issued
+            # events.  Base store: no-op.
+            self._commit_record(
+                kind, "put", stored, stored.metadata.resource_version
+            )
+            self._fanout(
+                kind,
+                WatchEvent(
+                    EventType.ADDED, stored,
+                    rv=stored.metadata.resource_version,
+                ),
+            )
         return out
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -221,7 +324,13 @@ class ObjectStore:
             self._maybe_fault("list", kind, "")
             return [o.clone() for o in self._objects.get(kind, {}).values()]
 
-    def update(self, kind: str, obj: Any) -> Any:
+    def update(
+        self, kind: str, obj: Any, expected_rv: Optional[int] = None
+    ) -> Any:
+        """``expected_rv`` is the optimistic-concurrency precondition (the
+        apiserver's resourceVersion check on PUT): when set, the write
+        commits only if the STORED object still carries that version —
+        otherwise Conflict, and the caller must re-read and re-apply."""
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = self._key(obj)
@@ -229,13 +338,30 @@ class ObjectStore:
             old = objs.get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
+            if (
+                expected_rv is not None
+                and old.metadata.resource_version != expected_rv
+            ):
+                raise Conflict(
+                    f"stale resource_version for {kind} {key}: expected "
+                    f"{expected_rv}, have {old.metadata.resource_version}"
+                )
             stored = obj.clone()
             stored.metadata.uid = old.metadata.uid
             stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             stored.metadata.resource_version = self._bump()
             objs[key] = stored
             out = stored.clone()
-            self._fanout(kind, WatchEvent(EventType.MODIFIED, stored, old))
+            self._commit_record(
+                kind, "put", stored, stored.metadata.resource_version
+            )
+            self._fanout(
+                kind,
+                WatchEvent(
+                    EventType.MODIFIED, stored, old,
+                    rv=stored.metadata.resource_version,
+                ),
+            )
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -246,8 +372,9 @@ class ObjectStore:
             old = objs.pop(key, None)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
-            self._bump()
-            self._fanout(kind, WatchEvent(EventType.DELETED, old))
+            rv = self._bump()
+            self._commit_record(kind, "del", old, rv)
+            self._fanout(kind, WatchEvent(EventType.DELETED, old, rv=rv))
 
     def mutate(
         self, kind: str, namespace: str, name: str, fn: Callable[[Any], Any]
@@ -316,9 +443,20 @@ class ObjectStore:
                     objs[key] = work
                     self._on_batch_commit(kind, work)
                     out.append(work.clone() if return_objects else None)
-                    events.append(WatchEvent(EventType.MODIFIED, work, old))
+                    events.append(
+                        WatchEvent(
+                            EventType.MODIFIED, work, old,
+                            rv=work.metadata.resource_version,
+                        )
+                    )
                 except Exception as err:  # noqa: BLE001 — returned, not lost
                     out.append(err)
+            # durability before visibility for the batch too: every item's
+            # record was appended by _on_batch_commit; force it to disk
+            # BEFORE the events fan out (base store: no-op)
+            self._flush_log()
+            for ev in events:
+                self._record_history(kind, ev)
             # ONE batched fanout per watcher, still under the store lock so
             # queue order equals mutation order across concurrent mutators
             faults = self.faults
@@ -338,6 +476,18 @@ class ObjectStore:
         """Per-item durability hook for the inlined mutate_many path (which
         bypasses update()); DurableObjectStore overrides this to append the
         WAL record."""
+
+    def _commit_record(self, kind: str, op: str, obj: Any, rv: int) -> None:
+        """Single-op durability hook, called with the store lock held,
+        AFTER the in-memory commit and BEFORE the watch fanout — the
+        DurableObjectStore appends (and flushes) the WAL record here so
+        no observer ever sees a resource_version that a crash could roll
+        back.  ``op`` is "put" or "del"; ``obj`` is the stored object
+        (put) or the removed one (del)."""
+
+    def _flush_log(self) -> None:
+        """Batch-path durability barrier (see mutate_many): force pending
+        WAL records to disk before their events become visible."""
 
     @property
     def resource_version(self) -> int:
@@ -361,7 +511,16 @@ class ObjectStore:
             stored = obj.clone()
             objs[key] = stored
             self._rv = max(self._rv, stored.metadata.resource_version)
-            self._fanout(kind, WatchEvent(EventType.ADDED, stored))
+            self._commit_record(
+                kind, "put", stored, stored.metadata.resource_version
+            )
+            self._fanout(
+                kind,
+                WatchEvent(
+                    EventType.ADDED, stored,
+                    rv=stored.metadata.resource_version,
+                ),
+            )
 
     def set_resource_version(self, rv: int) -> None:
         """Fast-forward the version counter (checkpoint restore) — never
@@ -370,19 +529,65 @@ class ObjectStore:
             self._rv = max(self._rv, rv)
 
     # -- watch -------------------------------------------------------------
-    def watch(self, kind: str, send_initial: bool = True) -> Tuple[Watch, List[Any]]:
+    def watch(
+        self,
+        kind: str,
+        send_initial: bool = True,
+        resume_rv: Optional[int] = None,
+    ) -> Tuple[Watch, List[Any]]:
         """Open a watch; returns (watch, current snapshot).
 
         ``send_initial`` replays the snapshot as ADDED events into the watch
         (list+watch, what client-go's reflector does on start).
+
+        ``resume_rv`` resumes instead: the consumer saw everything through
+        that resource_version, so the watch pre-delivers ONLY the retained
+        history events with rv > resume_rv (no snapshot), then goes live —
+        atomically with registration, so nothing falls in a gap.  Raises
+        HistoryCompacted when the tail from resume_rv is no longer
+        retained (ring overflow / checkpoint compaction): the consumer
+        must fall back to a full list+watch.
         """
         with self._lock:
+            if resume_rv is not None:
+                floor = self._floor_for(kind)
+                if resume_rv < floor:
+                    raise HistoryCompacted(
+                        f"resource_version {resume_rv} compacted away "
+                        f"for {kind} (floor {floor})"
+                    )
+                if resume_rv > self._rv:
+                    # the consumer is AHEAD of this server: it observed
+                    # versions a crash rolled back (fanout raced the WAL
+                    # flush, or fsync=False lost the tail).  Honoring the
+                    # resume would silently skip every re-issued version —
+                    # force the relist instead.
+                    raise HistoryCompacted(
+                        f"resource_version {resume_rv} is ahead of this "
+                        f"server (at {self._rv}): recovered from older "
+                        f"state; relist required"
+                    )
+                w = Watch(self, kind)
+                w.start_rv = resume_rv
+                w._deliver_many(
+                    [
+                        ev
+                        for ev in self._history.get(kind, ())
+                        if ev.rv > resume_rv
+                    ]
+                )
+                self._watches.setdefault(kind, []).append(w)
+                return w, []
             w = Watch(self, kind)
+            w.start_rv = self._rv
             snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
             if send_initial:
                 w._deliver_many(
                     [
-                        WatchEvent(EventType.ADDED, obj.clone())
+                        WatchEvent(
+                            EventType.ADDED, obj.clone(),
+                            rv=obj.metadata.resource_version,
+                        )
                         for obj in snapshot
                     ]
                 )

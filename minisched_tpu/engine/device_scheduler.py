@@ -143,6 +143,27 @@ class DeviceScheduler(Scheduler):
         #: uid → monotonic deadline; see assume_ttl_s
         self._assumed_expiry: dict = {}
         self._assumed_lock = threading.Lock()
+        # control-plane reconnect (watch resumed OR relisted — either way
+        # the stream broke, and a server RESTART may sit behind it):
+        # every assumption's lease is marked due immediately, so the next
+        # snapshot/idle check re-arbitrates each against the AUTHORITATIVE
+        # store instead of trusting pre-crash memory — a bind the dead
+        # server never committed is released+requeued, one that committed
+        # without an event is confirmed (see _expire_assume_leases)
+        self.informer_factory.informer_for("Pod").on_reconnect.append(
+            self._revalidate_assume_ledger
+        )
+
+    def _revalidate_assume_ledger(self) -> None:
+        from minisched_tpu.observability import counters
+
+        now = time.monotonic()
+        with self._assumed_lock:
+            n = len(self._assumed_expiry)
+            for uid in self._assumed_expiry:
+                self._assumed_expiry[uid] = now
+        if n:
+            counters.inc("assume.revalidate_on_reconnect", n)
 
     def _wire_pre_cache(self, informer_factory: Any) -> None:
         """Create + wire the incremental constraint index when the chains
@@ -1774,8 +1795,18 @@ class DeviceScheduler(Scheduler):
     def _bind_batch(self, ready: List[Any]) -> None:
         from minisched_tpu.api.objects import Binding
 
+        # expected_rv: the optimistic-concurrency precondition — bind only
+        # if the pod is STILL at the version this wave evaluated (a spec
+        # changed under us must re-evaluate, not land on stale
+        # requirements).  The unset-node_name guard remains the wire-level
+        # double-bind backstop; a Conflict comes back per-item and rides
+        # the normal error_func → requeue path, where the refreshed pod
+        # re-enters a later wave.
         bindings = [
-            Binding(pod.metadata.name, pod.metadata.namespace, node_name)
+            Binding(
+                pod.metadata.name, pod.metadata.namespace, node_name,
+                expected_rv=pod.metadata.resource_version or None,
+            )
             for _, pod, node_name, _ in ready
         ]
         # close the dispatch gate BEFORE the events fan out: the informer

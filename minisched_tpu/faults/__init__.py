@@ -161,25 +161,44 @@ def wal_double_binds(wal_path: str):
     returns [(uid, first_node, other_node), ...] for every pod that ever
     appeared bound to two different nodes — the capacity bug the assume/
     requeue machinery must make impossible.  Shared by the chaos soak and
-    the bench chaos role (one audit, one definition of 'double bind')."""
+    the bench chaos role (one audit, one definition of 'double bind').
+
+    When the store compacts with ``archive_compacted=True`` the truncated
+    segments live in ``<path>.history``; the audit reads them first (in
+    append order, i.e. mutation order) so compaction never shrinks the
+    evidence."""
     import json
+    import os
 
     bound_to: dict = {}
     violations = []
-    with open(wal_path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            if rec.get("op") != "put" or rec.get("kind") != "Pod":
-                continue
-            obj = rec["obj"]
-            node = (obj.get("spec") or {}).get("node_name")
-            uid = (obj.get("metadata") or {}).get("uid")
-            if not node:
-                continue
-            prev = bound_to.setdefault(uid, node)
-            if prev != node:
-                violations.append((uid, prev, node))
+    paths = [
+        p
+        for p in (
+            wal_path + ".history",
+            wal_path + ".pending-archive",  # claimed by a compaction a
+            wal_path,                       # crash interrupted mid-copy
+        )
+        if os.path.exists(p)
+    ]
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail from a SIGKILL mid-append
+                if rec.get("op") != "put" or rec.get("kind") != "Pod":
+                    continue
+                obj = rec["obj"]
+                node = (obj.get("spec") or {}).get("node_name")
+                uid = (obj.get("metadata") or {}).get("uid")
+                if not node:
+                    continue
+                prev = bound_to.setdefault(uid, node)
+                if prev != node:
+                    violations.append((uid, prev, node))
     return violations

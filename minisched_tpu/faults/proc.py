@@ -1,0 +1,272 @@
+"""Process-level chaos: kill the control plane, not just its responses.
+
+Everything in :mod:`minisched_tpu.faults` so far injects failures into a
+control plane that keeps existing — calls fail, streams drop, but the
+process survives.  Real clusters lose the apiserver itself: OOM-kill,
+node reboot, rolling upgrade.  This module makes THAT failure happen on
+demand: a :class:`ServerSupervisor` runs the HTTP façade in a child
+process over a ``file://`` WAL store, SIGKILLs it (no shutdown handler
+runs — torn WAL tails and half-written responses included), and restarts
+it on the same port.  Recovery is the durable store's checkpoint ⊕ WAL
+tail replay; the port stays fixed so clients need no re-discovery, only
+the retry/reconnect machinery they already have.
+
+The child is a fresh ``python -c`` subprocess importing only the
+control-plane modules, so the parent's JAX runtime and thread pool never
+leak into it — exactly the process isolation a real apiserver has.  (Not
+multiprocessing spawn: that re-imports the parent's __main__, which under
+pytest or a REPL is somewhere between heavy and impossible.)
+
+The kill schedule can ride the same deterministic fabric as every other
+injection point (``proc.kill``): whether tick *n* kills is the blake2s
+schedule, so a failing soak reproduces byte-for-byte from its seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Optional
+
+
+def _free_port() -> int:
+    """One ephemeral port, reused for every incarnation of the child —
+    the client's base_url must survive restarts.  The race (another
+    process grabbing it between close and child bind) is real but
+    vanishing at test scale; HTTPServer sets allow_reuse_address, so our
+    own TIME_WAIT ghosts never block the rebind."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_main(
+    wal_path: str,
+    port: int,
+    compact_every_s: Optional[float] = None,
+    archive: bool = False,
+    fsync: bool = False,
+    parent_pid: Optional[int] = None,
+) -> None:
+    """The child's whole life: recover the store from disk, serve REST on
+    the fixed port, optionally compact on a timer, park until SIGKILL.
+    Runs in a fresh interpreter — import inside, keep it light."""
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.httpserver import start_api_server
+
+    store = DurableObjectStore(
+        wal_path, fsync=fsync, archive_compacted=archive
+    )
+    start_api_server(store, port=port)
+    if compact_every_s:
+        def compactor() -> None:
+            while True:
+                time.sleep(compact_every_s)
+                try:
+                    store.compact()
+                except Exception:
+                    pass  # compaction is best-effort; the WAL still grows
+
+        threading.Thread(target=compactor, daemon=True).start()
+    if parent_pid:
+        # orphan watchdog: an aborted soak (supervisor process gone
+        # without stop()) must not strand a listener on the fixed port.
+        # Polling beats PR_SET_PDEATHSIG-via-preexec_fn: preexec forces
+        # subprocess onto fork (unsafe under the parent's JAX threads).
+        def watchdog() -> None:
+            while os.getppid() == parent_pid:
+                time.sleep(0.5)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        threading.Thread(target=watchdog, daemon=True).start()
+    threading.Event().wait()  # until SIGKILL — no orderly shutdown, ever
+
+
+#: the -c stub each child incarnation boots through
+_CHILD_CMD = (
+    "import json, sys; "
+    "from minisched_tpu.faults.proc import _child_main; "
+    "_child_main(**json.loads(sys.argv[1]))"
+)
+
+
+class ServerSupervisor:
+    """Run the REST control plane as a killable child process.
+
+    ``compact_every_s`` arms periodic checkpoint compaction in the child
+    (snapshot + WAL truncate), so restarts exercise the bounded-replay
+    path AND watch resumes can hit 410.  ``archive_history=True`` keeps
+    every truncated WAL segment in ``<wal>.history`` — the full-history
+    double-bind audit stays possible across compactions.
+    """
+
+    def __init__(
+        self,
+        wal_path: str,
+        port: int = 0,
+        compact_every_s: Optional[float] = None,
+        archive_history: bool = True,
+        fsync: bool = False,
+        boot_timeout_s: float = 30.0,
+    ):
+        self._wal = wal_path
+        self._port = port or _free_port()
+        self._compact_every_s = compact_every_s
+        self._archive = archive_history
+        self._fsync = fsync
+        self._boot_timeout_s = boot_timeout_s
+        self._proc: Any = None
+        self._chaos_thread: Optional[threading.Thread] = None
+        self._chaos_stop = threading.Event()
+        #: lifecycle evidence the soaks assert on
+        self.kills = 0
+        self.restarts = 0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self._port}"
+
+    @property
+    def wal_path(self) -> str:
+        return self._wal
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> str:
+        """Spawn the child and block until /healthz answers — the same
+        readiness gate the reference's StartAPIServer polls."""
+        if self.alive():
+            raise RuntimeError("control-plane child already running")
+        cfg = {
+            "wal_path": self._wal,
+            "port": self._port,
+            "compact_every_s": self._compact_every_s,
+            "archive": self._archive,
+            "fsync": self._fsync,
+            "parent_pid": os.getpid(),
+        }
+        env = dict(os.environ)
+        # the child must import minisched_tpu from THIS checkout even when
+        # the supervisor runs from a test process whose cwd is elsewhere
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_CMD, json.dumps(cfg)],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + self._boot_timeout_s
+        url = self.base_url + "/healthz"
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"control-plane child died at boot "
+                    f"(exitcode {self._proc.returncode})"
+                )
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    if r.status == 200:
+                        return self.base_url
+            except OSError:
+                pass
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"control-plane child failed /healthz within "
+            f"{self._boot_timeout_s}s"
+        )
+
+    def kill(self) -> None:
+        """SIGKILL — no atexit, no flush, no goodbye.  Whatever the WAL
+        holds at this instant is the whole truth the next life recovers
+        (a torn mid-append tail is truncated at replay)."""
+        if self._proc is None:
+            return
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self.kills += 1
+        try:
+            self._proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
+        self._proc = None
+
+    def restart(self) -> str:
+        base = self.start()
+        self.restarts += 1
+        return base
+
+    def kill_and_restart(self) -> str:
+        self.kill()
+        return self.restart()
+
+    def stop(self) -> None:
+        """Supervisor teardown: stop the chaos thread, then the child."""
+        self._chaos_stop.set()
+        if self._chaos_thread is not None:
+            self._chaos_thread.join(timeout=10.0)
+            self._chaos_thread = None
+        self.kill()
+
+    # -- scheduled chaos ----------------------------------------------------
+    def start_chaos(
+        self,
+        fabric: Any = None,
+        interval_s: float = 1.0,
+        max_kills: int = 3,
+    ) -> None:
+        """Background killer: every ``interval_s`` of child uptime, decide
+        whether to SIGKILL + restart.  With a FaultFabric the decision is
+        its deterministic ``proc.kill`` schedule (arm the point with a
+        rate); without one, every tick kills.  Stops after ``max_kills``
+        or ``stop()``."""
+        if self._chaos_thread is not None:
+            raise RuntimeError("chaos already running")
+        self._chaos_stop.clear()
+
+        def run() -> None:
+            while not self._chaos_stop.is_set() and self.kills < max_kills:
+                if self._chaos_stop.wait(interval_s):
+                    return
+                if fabric is not None and not fabric.should_fire(
+                    "proc.kill", str(self._port)
+                ):
+                    continue
+                try:
+                    self.kill_and_restart()
+                except Exception:
+                    # a failed restart leaves the plane down; the next
+                    # tick retries rather than killing the soak thread
+                    import traceback
+
+                    traceback.print_exc()
+
+        self._chaos_thread = threading.Thread(
+            target=run, name="proc-chaos", daemon=True
+        )
+        self._chaos_thread.start()
+
+    def wait_chaos_done(self, timeout_s: float = 120.0) -> bool:
+        """Block until the scheduled kills all happened (the soak then
+        drives to convergence on a STABLE plane)."""
+        t = self._chaos_thread
+        if t is None:
+            return True
+        t.join(timeout=timeout_s)
+        return not t.is_alive()

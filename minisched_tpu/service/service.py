@@ -183,6 +183,12 @@ class SchedulerService:
     def scheduler(self) -> Optional[Scheduler]:
         return self._scheduler
 
+    @property
+    def informer_factory(self) -> Optional[SharedInformerFactory]:
+        """The live factory (None before start/after shutdown) — the
+        degraded-mode dashboards read ``.staleness()`` off it."""
+        return self._factory
+
 
 def build_scheduler_from_config(
     client: Client, factory: SharedInformerFactory, cfg: SchedulerConfig

@@ -32,3 +32,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from minisched_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak, excluded from tier-1 (-m 'not slow')",
+    )

@@ -302,3 +302,261 @@ def test_crash_recovery_resumes_scheduling(tmp_path):
     finally:
         svc2.shutdown_scheduler()
         store2.close()
+
+
+def test_replay_rv_is_exact_when_last_record_is_rv_op(tmp_path):
+    """Regression (ISSUE 2): the replayed version counter must be EXACT,
+    not merely monotone.  A WAL whose last record is a bare ``rv`` op —
+    e.g. a volatile-kind mutation's watermark, or set_resource_version —
+    must reopen to exactly that counter, and the next mutation must stamp
+    exactly the successor version."""
+    import json
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path)
+    store.create(KIND_NODE, make_node("n1"))
+    store.set_resource_version(7)
+    store.close()
+    with open(path) as f:
+        last = json.loads(f.readlines()[-1])
+    assert last == {"op": "rv", "rv": 7}
+    re = DurableObjectStore(path)
+    assert re.resource_version == 7  # exact, not just >= the object rvs
+    out = re.create(KIND_NODE, make_node("n2"))
+    assert out.metadata.resource_version == 8
+    re.close()
+
+
+def test_volatile_mutations_keep_replayed_rv_exact(tmp_path):
+    """The bug behind the regression above: Event (volatile) mutations
+    bump the global counter with no put/del record, so a reopened store
+    used to re-issue resource_versions that watchers and expected_rv
+    clients had already observed.  The rv watermark records close that."""
+    from minisched_tpu.api.objects import Event, ObjectMeta
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path)
+    store.create(KIND_NODE, make_node("n1"))
+    for i in range(3):
+        store.create(
+            "Event", Event(metadata=ObjectMeta(name=f"ev{i}"))
+        )
+    store.delete("Event", "default", "ev0")
+    rv = store.resource_version
+    store.close()
+    re = DurableObjectStore(path)
+    assert re.resource_version == rv, (
+        "volatile-kind bumps lost at replay: reopened store would "
+        "re-issue observed resource_versions"
+    )
+    re.close()
+
+
+def test_checkpoint_compaction_tail_replay_and_history_floor(tmp_path):
+    """compact() = snapshot (<wal>.ckpt) + truncate: recovery is
+    checkpoint ⊕ WAL tail; a pre-checkpoint delete whose put record
+    survives in an overlapping WAL must NOT resurrect; the reopened
+    store's history floor sits at the checkpoint rv (watch resumes from
+    before it get 410)."""
+    import os
+
+    import pytest
+
+    from minisched_tpu.controlplane.store import HistoryCompacted
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path)
+    store.create(KIND_NODE, make_node("gone"))
+    store.delete(KIND_NODE, "", "gone")
+    store.create(KIND_NODE, make_node("kept"))
+    store.compact()
+    assert os.path.exists(path + ".ckpt")
+    assert os.path.getsize(path) == 0  # tail truncated
+    ckpt_rv = store.resource_version
+    store.create(KIND_POD, make_pod("tail-pod"))  # the WAL tail
+    rv = store.resource_version
+    store.close()
+
+    re = DurableObjectStore(path)
+    assert {n.metadata.name for n in re.list(KIND_NODE)} == {"kept"}
+    assert [p.metadata.name for p in re.list(KIND_POD)] == ["tail-pod"]
+    assert re.resource_version == rv
+    assert re.history_floor == ckpt_rv
+    # tail events are resumable; pre-checkpoint ones are 410
+    w, snap = re.watch(KIND_POD, resume_rv=ckpt_rv)
+    ev = w.next(timeout=1.0)
+    assert ev is not None and ev.obj.metadata.name == "tail-pod"
+    w.stop()
+    with pytest.raises(HistoryCompacted):
+        re.watch(KIND_POD, resume_rv=ckpt_rv - 1)
+    re.close()
+
+
+def test_crash_between_checkpoint_and_truncate_does_not_resurrect(tmp_path):
+    """The overlap window compact() is built to survive: checkpoint
+    written, WAL NOT yet truncated (crash in between).  Replay must skip
+    the pre-snapshot records — naively re-applying a put whose object a
+    later pre-snapshot delete removed would resurrect it."""
+    import json
+    import shutil
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path)
+    store.create(KIND_NODE, make_node("ghost"))
+    store.delete(KIND_NODE, "", "ghost")
+    store.create(KIND_NODE, make_node("real"))
+    # snapshot the WAL bytes, compact, then splice the old records back
+    # IN FRONT of nothing (simulate: ckpt landed, truncate never ran)
+    with open(path) as f:
+        old_records = f.read()
+    store.compact()
+    store.close()
+    with open(path) as f:
+        tail = f.read()
+    with open(path, "w") as f:
+        f.write(old_records + tail)
+    re = DurableObjectStore(path)
+    assert {n.metadata.name for n in re.list(KIND_NODE)} == {"real"}, (
+        "pre-checkpoint put resurrected a deleted object"
+    )
+    re.close()
+
+
+def test_compaction_archives_history_for_the_audit(tmp_path):
+    """archive_compacted: truncated segments append to <wal>.history so
+    wal_double_binds audits the FULL mutation history across
+    compactions."""
+    from minisched_tpu.faults import wal_double_binds
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path, archive_compacted=True)
+    store.create(KIND_NODE, make_node("n1"))
+    p = store.create(KIND_POD, make_pod("p1"))
+    p.spec.node_name = "n1"
+    store.update(KIND_POD, p)
+    store.compact()  # bind record now lives only in .history
+    store.create(KIND_POD, make_pod("p2"))
+    store.close()
+    assert wal_double_binds(path) == []
+    # manufacture a double bind in the live tail: the audit must still
+    # see the ARCHIVED first bind and flag the pair
+    store2 = DurableObjectStore(path, archive_compacted=True)
+    cur = store2.get(KIND_POD, "default", "p1")
+    cur.spec.node_name = "n2"
+    store2.update(KIND_POD, cur)
+    store2.close()
+    violations = wal_double_binds(path)
+    assert len(violations) == 1 and violations[0][1:] == ("n1", "n2")
+
+
+def test_checkpoint_snapshot_under_concurrent_writes_round_trips(tmp_path):
+    """ISSUE-2 satellite: compact() taken MID-WAVE while writer threads
+    hammer binds/creates must stay a consistent cut — on reopen the store
+    equals the uninterrupted writer's final state, object for object and
+    counter-exact."""
+    import threading
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path)
+    client = Client(store=store)
+    n_nodes, n_pods = 4, 120
+    for i in range(n_nodes):
+        client.nodes().create(make_node(f"n{i}"))
+    for i in range(n_pods):
+        client.pods().create(make_pod(f"p{i:03d}"))
+
+    from minisched_tpu.api.objects import Binding
+
+    stop = threading.Event()
+    errs: list = []
+
+    def binder():
+        try:
+            for start in range(0, n_pods, 10):
+                client.pods().bind_many(
+                    [
+                        Binding(f"p{i:03d}", "default", f"n{i % n_nodes}")
+                        for i in range(start, start + 10)
+                    ]
+                )
+        except Exception as e:  # pragma: no cover - failure evidence
+            errs.append(e)
+        finally:
+            stop.set()
+
+    def compactor():
+        while not stop.is_set():
+            store.compact()
+
+    threads = [
+        threading.Thread(target=binder),
+        threading.Thread(target=compactor),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    expect = {
+        p.metadata.name: (
+            p.spec.node_name, p.metadata.resource_version, p.metadata.uid
+        )
+        for p in store.list(KIND_POD)
+    }
+    rv = store.resource_version
+    store.close()
+
+    re = DurableObjectStore(path)
+    got = {
+        p.metadata.name: (
+            p.spec.node_name, p.metadata.resource_version, p.metadata.uid
+        )
+        for p in re.list(KIND_POD)
+    }
+    assert got == expect
+    assert re.resource_version == rv
+    assert all(node for node, _, _ in got.values())  # every bind recovered
+    re.close()
+
+
+def test_interrupted_archive_is_drained_exactly_once(tmp_path):
+    """compact()'s archive claims the retired segment by ATOMIC RENAME
+    before copying it to <wal>.history.  A SIGKILL between the two leaves
+    <wal>.pending-archive; the next open must fold it in exactly once —
+    never duplicate it, never lose it, and never lose live state (the
+    claim only ever happens after the checkpoint landed)."""
+    import json
+    import os
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path, archive_compacted=True)
+    store.create(KIND_NODE, make_node("n1"))
+    # the kill window: checkpoint + rename land, the history copy doesn't
+    store._drain_pending_archive = lambda: None
+    store.compact()
+    del store._drain_pending_archive  # back to the class implementation
+    store.create(KIND_NODE, make_node("n2"))  # WAL tail after the "crash"
+    store.close()
+    assert os.path.exists(path + ".pending-archive")
+
+    re = DurableObjectStore(path, archive_compacted=True)
+    assert not os.path.exists(path + ".pending-archive")  # drained at open
+    # nothing lost: n1 from the checkpoint, n2 from the tail
+    assert {n.metadata.name for n in re.list(KIND_NODE)} == {"n1", "n2"}
+    re.compact()  # and a later compaction must not re-archive n1's record
+    re.close()
+
+    def archived(name):
+        count = 0
+        with open(path + ".history") as f:
+            for line in f:
+                rec = json.loads(line)
+                if (
+                    rec.get("op") == "put"
+                    and rec["obj"]["metadata"]["name"] == name
+                ):
+                    count += 1
+        return count
+
+    assert archived("n1") == 1  # exactly once, across crash + 2 compactions
+    assert archived("n2") == 1

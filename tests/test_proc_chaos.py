@@ -1,0 +1,164 @@
+"""Process-level chaos: SIGKILL the control plane mid-workload.
+
+PR-1's fault fabric made a *surviving* control plane lossy; this suite
+removes the survival: a ServerSupervisor (minisched_tpu.faults.proc)
+runs the REST façade as a child process over a ``file://`` WAL store
+with periodic checkpoint compaction, SIGKILLs it mid-scheduling, and
+restarts it on the same port.  The stack must converge anyway: remote
+retries carry the outage, informers resume (or relist on 410) against
+the recovered server, the engine re-arbitrates its assume ledger against
+the authoritative store, and the recovered WAL must show every pod bound
+exactly once, no node over allocatable, no assumed capacity leaked.
+
+The tier-1 smoke does ONE kill/restart cycle at small scale; the soak
+(slow) runs ≥3 fabric-scheduled kills — `make chaos-proc` pins the seed
+so a failing schedule reproduces byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.faults import FaultFabric, wal_double_binds
+from minisched_tpu.faults.proc import ServerSupervisor
+from minisched_tpu.observability import counters
+from minisched_tpu.service.config import default_full_roster_config
+from minisched_tpu.service.service import SchedulerService
+from test_chaos_soak import (
+    _audit_capacity,
+    _drive_to_convergence,
+    _wait_assume_drain,
+)
+
+SEED = int(os.environ.get("MINISCHED_CHAOS_SEED", "1234"))
+
+
+def _boot_cluster(client, n_nodes: int, n_pods: int) -> None:
+    client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:03d}",
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+            for i in range(n_nodes)
+        ]
+    )
+    client.pods().create_many(
+        [
+            make_pod(f"kp{i:04d}", requests={"cpu": "500m", "memory": "64Mi"})
+            for i in range(n_pods)
+        ]
+    )
+
+
+def _bound_count(client) -> int:
+    try:
+        return sum(1 for p in client.pods().list() if p.spec.node_name)
+    except Exception:
+        return -1  # plane down: caller polls again
+
+
+def test_proc_kill_smoke(tmp_path):
+    """Tier-1: one SIGKILL/restart of the control-plane process while the
+    device engine schedules over the wire — convergence, recovery, and
+    the full-history audits, in seconds not minutes."""
+    wal = str(tmp_path / "proc.wal")
+    sup = ServerSupervisor(wal, compact_every_s=0.25, archive_history=True)
+    base = sup.start()
+    n_nodes, n_pods = 8, 48
+    client = RemoteClient(
+        base, retries=10, backoff_initial_s=0.05, retry_seed=SEED
+    )
+    _boot_cluster(client, n_nodes, n_pods)
+    counters.reset()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=8
+    )
+    sched.assume_ttl_s = 2.0
+    try:
+        # kill mid-workload: once the first waves landed but (usually)
+        # before the last — the recovery path is exercised either way
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if _bound_count(client) >= 8:
+                break
+            time.sleep(0.05)
+        sup.kill_and_restart()
+        assert sup.kills == 1
+
+        bound = _drive_to_convergence(client, sched, n_pods, 120.0)
+        assert len(bound) == n_pods, (
+            f"only {len(bound)}/{n_pods} bound across the restart; "
+            f"queue={sched.queue.stats()} counters={counters.snapshot()}"
+        )
+        _wait_assume_drain(sched, timeout_s=8 * sched.assume_ttl_s)
+        _audit_capacity(client, bound, 500, 8000)
+        # the restart was observed and survived: every informer stream
+        # died with the old process and came back (resume or relist)
+        assert counters.get("informer.reconnect") >= 1, counters.snapshot()
+    finally:
+        svc.shutdown_scheduler()
+        sup.stop()
+    assert wal_double_binds(wal) == []
+    # the recovered WAL agrees with what the clients observed
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+
+    re = DurableObjectStore(wal)
+    assert sum(1 for p in re.list("Pod") if p.spec.node_name) == n_pods
+    re.close()
+
+
+@pytest.mark.slow
+def test_proc_kill_soak(tmp_path):
+    """The acceptance soak: ≥3 fabric-scheduled SIGKILL/restart cycles of
+    the control-plane child mid-workload (checkpoint compaction running
+    under it the whole time), then converge and audit — no double bind
+    in the FULL archived history, no node over allocatable, assume
+    ledger drained, informer staleness back to ~0."""
+    wal = str(tmp_path / "soak.wal")
+    sup = ServerSupervisor(wal, compact_every_s=0.3, archive_history=True)
+    base = sup.start()
+    n_nodes, n_pods = 16, 160
+    client = RemoteClient(
+        base, retries=10, backoff_initial_s=0.05, retry_seed=SEED
+    )
+    _boot_cluster(client, n_nodes, n_pods)
+    counters.reset()
+    fabric = FaultFabric(SEED).on("proc.kill", rate=0.8)
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=16
+    )
+    sched.assume_ttl_s = 2.5
+    try:
+        sup.start_chaos(fabric=fabric, interval_s=1.5, max_kills=3)
+        assert sup.wait_chaos_done(timeout_s=120.0), "kill schedule stalled"
+        assert sup.kills >= 3, sup.kills
+
+        bound = _drive_to_convergence(client, sched, n_pods, 240.0)
+        assert len(bound) == n_pods, (
+            f"only {len(bound)}/{n_pods} bound across {sup.kills} restarts; "
+            f"queue={sched.queue.stats()} counters={counters.snapshot()}"
+        )
+        _wait_assume_drain(sched, timeout_s=8 * sched.assume_ttl_s)
+        _audit_capacity(client, bound, 500, 8000)
+        assert counters.get("informer.reconnect") >= 1, counters.snapshot()
+        # converged on a live plane: the caches re-verified themselves
+        stale = svc.informer_factory.staleness()
+        for kind, rec in stale.items():
+            assert rec["staleness_s"] < 30.0, (kind, stale)
+    finally:
+        svc.shutdown_scheduler()
+        sup.stop()
+    assert wal_double_binds(wal) == []
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+
+    re = DurableObjectStore(wal)
+    assert sum(1 for p in re.list("Pod") if p.spec.node_name) == n_pods
+    re.close()

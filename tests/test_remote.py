@@ -259,3 +259,197 @@ def test_batch_create_collection_post():
         assert out[0].metadata.namespace == "default"
     finally:
         shutdown()
+
+
+def test_stale_put_conflicts_and_mutate_retries_to_success():
+    """Acceptance: a PUT carrying a wrong expected_rv precondition gets
+    409 (store.Conflict), never a silent last-write-wins; RemoteStore's
+    get–mutate–retry re-reads and lands the merge."""
+    import pytest
+
+    from minisched_tpu.controlplane.store import Conflict
+
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        store = client.store
+        node = client.nodes().create(make_node("n1"))
+        stale_rv = node.metadata.resource_version
+        # competing writer bumps the version
+        node2 = client.nodes().get("n1")
+        node2.metadata.labels["who"] = "writer2"
+        client.nodes().update(node2)
+        # the stale precondition is rejected wholesale
+        node.metadata.labels["who"] = "writer1"
+        with pytest.raises(Conflict):
+            store.update("Node", node, expected_rv=stale_rv)
+        assert client.nodes().get("n1").metadata.labels["who"] == "writer2"
+
+        # get–mutate–retry: the first PUT is made stale by a competing
+        # update snuck in DURING fn; the retry re-reads and succeeds
+        calls = {"n": 0}
+
+        def fn(cur):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                racer = client.nodes().get("n1")
+                racer.metadata.labels["racer"] = "yes"
+                client.nodes().update(racer)
+            cur.metadata.labels["mutated"] = str(calls["n"])
+            return cur
+
+        out = store.mutate("Node", "", "n1", fn)
+        assert calls["n"] == 2  # one conflict, one clean retry
+        assert out.metadata.labels["mutated"] == "2"
+        assert out.metadata.labels["racer"] == "yes"  # merge, not clobber
+        from minisched_tpu.observability import counters
+
+        assert counters.get("remote.conflict_retry") >= 1
+    finally:
+        shutdown()
+
+
+def test_bind_with_stale_expected_rv_is_conflict():
+    """A binding that names a pod version the world has moved past must
+    NOT land on stale requirements — per-item Conflict, batch continues."""
+    from minisched_tpu.controlplane.store import Conflict
+
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        client.nodes().create(make_node("n1"))
+        p1 = client.pods().create(make_pod("p1"))
+        p2 = client.pods().create(make_pod("p2"))
+        stale = p1.metadata.resource_version
+        p1b = client.pods().get("p1")
+        p1b.metadata.labels["bump"] = "1"
+        client.pods().update(p1b)
+        res = client.pods().bind_many(
+            [
+                Binding("p1", "default", "n1", expected_rv=stale),
+                Binding("p2", "default", "n1",
+                        expected_rv=p2.metadata.resource_version),
+            ]
+        )
+        assert isinstance(res[0], Conflict)
+        assert res[1].spec.node_name == "n1"
+        assert not client.pods().get("p1").spec.node_name
+        # fresh rv: the retried decision lands
+        cur = client.pods().get("p1")
+        [ok] = client.pods().bind_many(
+            [Binding("p1", "default", "n1",
+                     expected_rv=cur.metadata.resource_version)]
+        )
+        assert ok.spec.node_name == "n1"
+    finally:
+        shutdown()
+
+
+def test_watch_resume_replays_only_the_missed_tail():
+    """?resource_version=N resumes: the new stream replays exactly the
+    events after N (deletes included) with SYNC count 0 — no snapshot
+    re-replay, nothing missed in the gap."""
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        store = client.store
+        client.pods().create(make_pod("a"))
+        client.pods().create(make_pod("b"))
+        w1, snap = store.watch("Pod")
+        assert len(snap) == 2
+        seen = []
+        deadline = time.monotonic() + 5
+        while len(seen) < 2 and time.monotonic() < deadline:
+            seen.extend(w1.next_batch(timeout=0.2))
+        last_rv = max(ev.rv for ev in seen)
+        w1.stop()
+        # the gap: one create, one delete
+        client.pods().create(make_pod("c"))
+        client.pods().delete("a")
+        w2, snap2 = store.watch("Pod", resume_rv=last_rv)
+        assert snap2 == []  # SYNC count 0: nothing to re-sync
+        tail = []
+        deadline = time.monotonic() + 5
+        while len(tail) < 2 and time.monotonic() < deadline:
+            tail.extend(w2.next_batch(timeout=0.2))
+        assert [(e.type.value, e.obj.metadata.name) for e in tail] == [
+            ("ADDED", "c"), ("DELETED", "a"),
+        ]
+        assert all(e.rv > last_rv for e in tail)
+        w2.stop()
+    finally:
+        shutdown()
+
+
+def test_watch_resume_from_compacted_rv_is_410():
+    """Acceptance: a resume older than the retained history gets 410 Gone
+    (store.HistoryCompacted) — the consumer must relist, never silently
+    miss the gap."""
+    import pytest
+
+    from minisched_tpu.controlplane.store import HistoryCompacted, ObjectStore
+
+    store = ObjectStore(history_events=2)  # tiny ring: overflow fast
+    _server, base, shutdown = start_api_server(store)
+    try:
+        client = RemoteClient(base)
+        for i in range(6):
+            client.pods().create(make_pod(f"p{i}"))
+        with pytest.raises(HistoryCompacted):
+            client.store.watch("Pod", resume_rv=1)
+        # a resume inside the ring still works
+        w, snap = client.store.watch(
+            "Pod", resume_rv=store.resource_version
+        )
+        assert snap == []
+        w.stop()
+    finally:
+        shutdown()
+
+
+def test_bind_batch_ack_registry_skips_reposted_entries():
+    """Partial-batch acks: a retried batch (same batch_id — the response
+    was lost) answers already-committed entries from the server's ack
+    registry instead of re-running them, so a replay is success, not a
+    wave of AlreadyBound errors.  A DIFFERENT batch_id re-executes and
+    sees the genuine AlreadyBound."""
+    import json as _json
+    import urllib.request
+
+    _server, base, shutdown = start_api_server()
+    try:
+        client = RemoteClient(base)
+        client.nodes().create(make_node("n1"))
+        client.pods().create(make_pod("p1"))
+        client.pods().create(make_pod("p2"))
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/api/v1/bindings",
+                data=_json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return _json.loads(r.read())
+
+        body = {
+            "batch_id": "wave-1",
+            "items": [
+                {"namespace": "default", "name": "p1", "node_name": "n1"},
+                {"namespace": "default", "name": "p2", "node_name": "n1"},
+            ],
+        }
+        first = post(body)["items"]
+        assert all("error" not in e for e in first)
+        # blind re-POST of the identical batch: everything acked, nothing
+        # re-executed (no AlreadyBound), objects replayed from the registry
+        second = post(body)["items"]
+        assert all(e.get("acked") for e in second), second
+        assert all("error" not in e for e in second), second
+        # a new batch identity re-executes for real
+        third = post(dict(body, batch_id="wave-2"))["items"]
+        assert all(e.get("type") == "AlreadyBound" for e in third), third
+        assert all(e.get("node") == "n1" for e in third)
+    finally:
+        shutdown()
