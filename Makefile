@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench chaos chaos-proc docker clean
+.PHONY: test native start serve bench chaos chaos-proc chaos-ha docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -24,6 +24,16 @@ chaos: native
 chaos-proc: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_proc_chaos.py -q
+
+# HA-plane chaos: 3 sharded active-active scheduler engines (separate OS
+# processes) over one control plane; engines AND the plane get SIGKILLed
+# mid-run (seed-pinned victims).  Runs BOTH the tier-1 smoke (1 engine
+# kill) and the slow soak (≥3 process deaths: engine → control plane →
+# engine), each ending in the exactly-once / capacity / TTL-rebalance
+# audits — mirrors the chaos-proc pattern
+chaos-ha: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_ha_chaos.py -q
 
 # native host-table kernels (auto-built on first import too; this target
 # is for explicit/offline builds)

@@ -1365,12 +1365,130 @@ def bench_chaos() -> dict:
     }
 
 
+def bench_ha() -> dict:
+    """HA plane at bench scale: N active-active sharded engines over one
+    WAL store, one engine hard-killed mid-run (lease abandoned — peers
+    must time it out).  The record carries the product claims: TTL-bounded
+    rebalance, convergence, exactly-once binds across the FULL history,
+    and the ha.* lease/membership counters."""
+    import tempfile
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.ha import start_ha_engine
+    from minisched_tpu.observability import counters
+    from minisched_tpu.service.config import default_full_roster_config
+
+    n_engines = int(os.environ.get("BENCH_HA_ENGINES", "3"))
+    n_nodes = int(os.environ.get("BENCH_HA_NODES", "48"))
+    n_pods = int(os.environ.get("BENCH_HA_PODS", "1200"))
+    ttl_s = float(os.environ.get("BENCH_HA_TTL_S", "2.0"))
+    wal = os.path.join(tempfile.mkdtemp(prefix="minisched-ha-"), "ha.wal")
+    store = DurableObjectStore(wal, archive_compacted=True)
+    setup = Client(store=store)
+    setup.nodes().create_many(
+        [
+            make_node(
+                f"node{i:04d}",
+                capacity={"cpu": "64", "memory": "128Gi", "pods": 256},
+            )
+            for i in range(n_nodes)
+        ]
+    )
+    pods = [
+        make_pod(f"hp{i:05d}", requests={"cpu": "500m", "memory": "64Mi"})
+        for i in range(n_pods)
+    ]
+    first = (2 * n_pods) // 3
+    setup.pods().create_many(pods[:first])
+    counters.reset()
+    t0 = time.monotonic()
+    engines = [
+        start_ha_engine(
+            Client(store=store), f"engine-{i}",
+            cfg=default_full_roster_config(), ttl_s=ttl_s,
+        )
+        for i in range(n_engines)
+    ]
+
+    def bound() -> int:
+        return sum(1 for p in setup.pods().list() if p.spec.node_name)
+
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_HA_DEADLINE_S", "240")
+    )
+    while time.monotonic() < deadline and bound() < first:
+        time.sleep(0.2)
+    if bound() < first:
+        raise SystemExit(f"[ha] first burst stalled: {bound()}/{first}")
+
+    # hard-kill one engine (no lease release), keep the load coming
+    victim = engines[len(engines) // 2]
+    survivors = [e for e in engines if e is not victim]
+    t_kill = time.monotonic()
+    victim.kill()
+    setup.pods().create_many(pods[first:])
+    rebalance_s = None
+    while time.monotonic() < deadline:
+        if all(
+            victim.membership.member_id not in e.membership.members()
+            for e in survivors
+        ):
+            rebalance_s = time.monotonic() - t_kill
+            break
+        time.sleep(0.05)
+    if rebalance_s is None:
+        raise SystemExit("[ha] survivors never dropped the dead member")
+    bound_n = 0
+    while time.monotonic() < deadline:
+        bound_n = bound()
+        if bound_n >= n_pods:
+            break
+        time.sleep(0.2)
+    elapsed = time.monotonic() - t0
+    for e in survivors:
+        e.stop()
+    store.close()
+    if bound_n < n_pods:
+        raise SystemExit(f"[ha] DID NOT CONVERGE: {bound_n}/{n_pods} bound")
+    # rebalance bounded by the lease TTL (+ a heartbeat tick and margin)
+    if rebalance_s > ttl_s + ttl_s / 3.0 + 1.5:
+        raise SystemExit(f"[ha] SLOW REBALANCE: {rebalance_s:.2f}s")
+    from minisched_tpu.faults import wal_double_binds
+
+    violations = wal_double_binds(wal)
+    if violations:
+        raise SystemExit(f"[ha] DOUBLE BIND: {violations[:5]}")
+    log(
+        f"[ha] {n_pods} pods, {n_engines} engines, 1 kill: converged in "
+        f"{elapsed:.1f}s, rebalance {rebalance_s:.2f}s (ttl {ttl_s}s)"
+    )
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "engines": n_engines,
+        "kills": 1,
+        "lease_ttl_s": ttl_s,
+        "total_s": round(elapsed, 1),
+        "rebalance_s": round(rebalance_s, 2),
+        "double_bind": False,
+        # the lease/membership ledger (ROADMAP: surfaced in bench records)
+        "counters": {
+            k: v
+            for k, v in counters.snapshot().items()
+            if k.startswith("ha.")
+        },
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
     "fullchain_parity": bench_fullchain_parity,
     "wire": bench_wire,
     "chaos": bench_chaos,
+    "ha": bench_ha,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -1452,6 +1570,10 @@ def main() -> None:
         # degraded-mode soak: convergence + leak/double-bind audits under
         # a seeded fault schedule (BENCH_CHAOS_SEED reproduces it)
         optional.append(("chaos_soak", "chaos", None, "chaos"))
+    if os.environ.get("BENCH_HA", "1") != "0":
+        # HA plane: sharded active-active engines, one hard kill, with
+        # TTL-bounded rebalance + exactly-once audits in the record
+        optional.append(("ha_plane", "ha", None, "ha"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

@@ -15,18 +15,47 @@ never touching floats for resources.
 
 from __future__ import annotations
 
-import itertools
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-_uid_counter = itertools.count(1)
+_uid_counter = 0
 _uid_lock = threading.Lock()
 
 
 def new_uid(prefix: str = "obj") -> str:
+    global _uid_counter
     with _uid_lock:
-        return f"{prefix}-{next(_uid_counter):08d}"
+        _uid_counter += 1
+        return f"{prefix}-{_uid_counter:08d}"
+
+
+def ensure_uid_floor(n: int) -> None:
+    """Advance the uid sequence to at least ``n`` — crash recovery calls
+    this with the highest numeric suffix found among recovered objects.
+    Without it a RESTARTED control plane (fresh interpreter, counter back
+    at zero) re-issues uids that recovered objects already carry: two
+    DIFFERENT pods then share an identity, confusing every uid-keyed
+    consumer (queue dedup, assume ledger, the double-bind audit).  The
+    sequence stays deterministic — no randomness — so seeded runs still
+    reproduce."""
+    global _uid_counter
+    with _uid_lock:
+        _uid_counter = max(_uid_counter, int(n))
+
+
+def uid_floor() -> int:
+    """The current top of the uid sequence (checkpoints persist it so
+    recovery can floor the counter even past deleted objects' uids)."""
+    with _uid_lock:
+        return _uid_counter
+
+
+def _uid_suffix(uid: str) -> int:
+    """Numeric tail of a generated uid ('pod-00000018' → 18); 0 for
+    foreign/empty uids."""
+    tail = uid.rsplit("-", 1)[-1] if uid else ""
+    return int(tail) if tail.isdigit() else 0
 
 
 # ---------------------------------------------------------------------------
@@ -600,6 +629,59 @@ class PersistentVolumeClaim:
                 self.spec.storage_class_name,
             ),
             status=PVCStatus(self.status.phase),
+        )
+
+
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 Lease subset: a TTL'd, CAS-renewed claim.
+
+    Expiry is evaluated by READERS (``renew_time + ttl_s < now``) — the
+    store never reaps leases itself, exactly like the apiserver: a lease
+    is just an object whose holder keeps bumping ``renew_time`` through
+    optimistic-concurrency updates, and whoever observes it stale may try
+    a takeover (another ``expected_rv`` CAS, 409-arbitrated)."""
+
+    #: identity of the current holder ('' = unheld)
+    holder: str = ""
+    #: seconds a renewal stays valid (leaseDurationSeconds)
+    ttl_s: float = 10.0
+    #: wall-clock (time.time) of the holder's acquisition
+    acquire_time: float = 0.0
+    #: wall-clock (time.time) of the last renewal — the expiry anchor
+    renew_time: float = 0.0
+    #: number of holder changes (leaseTransitions)
+    transitions: int = 0
+    #: the holder's published membership epoch (HA engines gossip their
+    #: shard-map version through renewals so external observers — tests,
+    #: the bench ha role — can watch rebalances converge from the store)
+    epoch: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+    kind = "Lease"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def expired(self, now: float) -> bool:
+        return self.spec.renew_time + self.spec.ttl_s < now
+
+    def clone(self) -> "Lease":
+        return Lease(
+            metadata=self.metadata.clone(),
+            spec=LeaseSpec(
+                self.spec.holder,
+                self.spec.ttl_s,
+                self.spec.acquire_time,
+                self.spec.renew_time,
+                self.spec.transitions,
+                self.spec.epoch,
+            ),
         )
 
 

@@ -33,6 +33,10 @@ KIND_TYPES = {
     "Pod": objects.Pod,
     "PersistentVolume": objects.PersistentVolume,
     "PersistentVolumeClaim": objects.PersistentVolumeClaim,
+    # durable on purpose: a recovered control plane replays member leases
+    # with their pre-crash renew_time — already expired by wall clock, so
+    # survivors arbitrate takeovers exactly as they would have live
+    "Lease": objects.Lease,
 }
 
 
@@ -85,6 +89,11 @@ def build_snapshot_doc(
     return {
         "version": CHECKPOINT_VERSION,
         "resource_version": resource_version,
+        # uid watermark: recovery floors the generated-uid sequence here
+        # so a restarted process never re-issues a uid — even one whose
+        # object was deleted before this snapshot (its put records may be
+        # compacted away, leaving no other trace of the uid)
+        "uid_floor": objects.uid_floor(),
         "objects": {
             kind: [_encode(o) for o in objs.values()]
             for kind in KIND_TYPES
@@ -123,11 +132,17 @@ def restore_store(
     if doc.get("version") != CHECKPOINT_VERSION:
         raise ValueError(f"unsupported checkpoint version {doc.get('version')!r}")
     store = store or ObjectStore()
+    uid_max = int(doc.get("uid_floor", 0))
     for kind, items in doc.get("objects", {}).items():
         tp = KIND_TYPES[kind]
         for data in items:
-            store.restore_object(kind, _decode(tp, data))
+            obj = _decode(tp, data)
+            uid_max = max(uid_max, objects._uid_suffix(obj.metadata.uid))
+            store.restore_object(kind, obj)
     store.set_resource_version(int(doc.get("resource_version", 0)))
+    # uid continuity (see build_snapshot_doc): creates after a restore
+    # must never re-issue a restored object's uid
+    objects.ensure_uid_floor(uid_max)
     return store
 
 

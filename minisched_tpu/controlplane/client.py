@@ -61,8 +61,8 @@ class _ThrottledStore:
 
     _THROTTLED = frozenset(
         # mutate_many is ONE API request (a batch bind), so one token
-        ("create", "get", "list", "update", "delete", "mutate",
-         "mutate_many", "watch")
+        ("create", "get", "list", "list_with_rv", "update", "delete",
+         "mutate", "mutate_many", "watch")
     )
 
     def __init__(self, store: ObjectStore, limiter: TokenBucket):
@@ -93,6 +93,22 @@ KIND_PVC = "PersistentVolumeClaim"
 
 class AlreadyBound(Exception):
     pass
+
+
+class OutOfCapacity(Exception):
+    """Commit-time node-capacity rejection on the bind subresource.
+
+    With ONE engine the scheduler's assume cache makes over-commit
+    impossible; with N active-active engines (the HA plane) each engine
+    evaluates against its own informer snapshot, and two engines can pick
+    the same node for different pods before either bind's event
+    propagates — the pod-level ``expected_rv``/unset-node_name guards
+    arbitrate the POD, but nothing arbitrated the NODE.  Kubernetes
+    leaves that to kubelet admission; this control plane has no kubelet,
+    so the bind TRANSACTION is the backstop (Omega-style commit-time
+    validation): a bind that would push the node past its allocatable
+    CPU / memory / pod count is rejected per-item, and the losing engine
+    requeues the pod against refreshed state."""
 
 
 def _create_all_then_raise(create_one, objs: List[Any]) -> List[Any]:
@@ -192,6 +208,33 @@ class _PodAPI:
             raise res
         return res
 
+    @staticmethod
+    def _node_budgets(store: ObjectStore, targets: set) -> Dict[str, list]:
+        """Remaining [milli_cpu, memory, pods] per TARGET node, computed
+        from the store's live objects — the caller holds the store lock,
+        so the view is the exact state the transaction commits against.
+        Nodes absent from the store get no budget (and no check): unit
+        scenarios bind to names that were never created, matching the
+        reference apiserver, which validates neither.  One pass over the
+        pod population per batch; requests are spec-memoized."""
+        budgets: Dict[str, list] = {}
+        for name in targets:
+            node = store._objects.get(KIND_NODE, {}).get(f"/{name}")
+            if node is None:
+                continue
+            alloc = node.status.allocatable
+            budgets[name] = [alloc.milli_cpu, alloc.memory, alloc.pods]
+        if not budgets:
+            return budgets
+        for pod in store._objects.get(KIND_POD, {}).values():
+            b = budgets.get(pod.spec.node_name)
+            if b is not None:
+                req = pod.resource_requests()
+                b[0] -= req.milli_cpu
+                b[1] -= req.memory
+                b[2] -= req.pods
+        return budgets
+
     def bind_many(
         self, bindings: List[Binding], return_objects: bool = True
     ) -> List[Any]:
@@ -200,9 +243,17 @@ class _PodAPI:
         minisched.go:267-273 — a TPU wave commits thousands).  Returns a
         list aligned with ``bindings``: the bound Pod (None with
         ``return_objects=False`` — skips a clone per bind), or the
-        exception (AlreadyBound, missing-pod KeyError) for that entry."""
+        exception (AlreadyBound, missing-pod KeyError, stale-rv Conflict,
+        OutOfCapacity) for that entry.
 
-        def apply_for(binding: Binding):
+        The whole batch runs under ONE store lock hold: the per-node
+        capacity budgets are computed from exactly the state the commits
+        apply against, and each successful bind debits them — so
+        concurrent binders (N HA engines racing the same node) serialize
+        through the lock and the LATER transaction sees the earlier one's
+        placements (see OutOfCapacity)."""
+
+        def apply_for(binding: Binding, budgets: Dict[str, list]):
             def apply(pod: Pod) -> Pod:
                 # clone_for_write=False contract: ``pod`` is the STORED
                 # object — build a new one, never mutate it.  A bind only
@@ -233,6 +284,23 @@ class _PodAPI:
                         f"expected {binding.expected_rv}, have "
                         f"{pod.metadata.resource_version}"
                     )
+                budget = budgets.get(binding.node_name)
+                if budget is not None:
+                    req = pod.resource_requests()
+                    if (
+                        req.milli_cpu > budget[0]
+                        or req.memory > budget[1]
+                        or req.pods > budget[2]
+                    ):
+                        raise OutOfCapacity(
+                            f"node {binding.node_name} out of capacity for "
+                            f"pod {pod.metadata.key} (remaining "
+                            f"cpu={budget[0]}m mem={budget[1]} "
+                            f"pods={budget[2]})"
+                        )
+                    budget[0] -= req.milli_cpu
+                    budget[1] -= req.memory
+                    budget[2] -= req.pods
                 new_spec = object.__new__(type(spec))
                 new_spec.__dict__.update(spec.__dict__)
                 new_spec.node_name = binding.node_name
@@ -244,15 +312,37 @@ class _PodAPI:
 
             return apply
 
-        return self._store.mutate_many(
-            KIND_POD,
-            [
-                (b.pod_namespace, b.pod_name, apply_for(b))
-                for b in bindings
-            ],
-            return_objects=return_objects,
-            clone_for_write=False,
-        )
+        # one lock hold for budgets + commits (RLock: mutate_many's own
+        # acquire nests).  The rate-limit token (one per batch, matching
+        # _ThrottledStore) is taken BEFORE the lock — TokenBucket.acquire
+        # can sleep, and sleeping while holding the store lock would
+        # stall every other client, informer fanout, and lease heartbeat
+        # behind this binder's throttle.  Inside the lock everything runs
+        # against the RAW store.  Stores without a lock surface (no
+        # in-process transaction view — never the case for the facades
+        # this client fronts) skip the capacity gate rather than fake it.
+        import contextlib
+
+        limiter = getattr(self._store, "_limiter", None)
+        if limiter is not None:
+            limiter.acquire()
+        raw = getattr(self._store, "_store", self._store)
+        locked = getattr(raw, "locked", None)
+        with locked() if callable(locked) else contextlib.nullcontext():
+            budgets = (
+                self._node_budgets(raw, {b.node_name for b in bindings})
+                if callable(locked)
+                else {}
+            )
+            return raw.mutate_many(
+                KIND_POD,
+                [
+                    (b.pod_namespace, b.pod_name, apply_for(b, budgets))
+                    for b in bindings
+                ],
+                return_objects=return_objects,
+                clone_for_write=False,
+            )
 
 
 class Client:

@@ -37,6 +37,7 @@ from minisched_tpu.controlplane.checkpoint import (
     build_snapshot_doc,
 )
 from minisched_tpu.controlplane.store import (
+    DEFAULT_HISTORY_BYTES,
     DEFAULT_HISTORY_EVENTS,
     EventType,
     ObjectStore,
@@ -64,8 +65,11 @@ class DurableObjectStore(ObjectStore):
         checkpoint_path: Optional[str] = None,
         archive_compacted: bool = False,
         history_events: int = DEFAULT_HISTORY_EVENTS,
+        history_bytes: int = DEFAULT_HISTORY_BYTES,
     ):
-        super().__init__(history_events=history_events)
+        super().__init__(
+            history_events=history_events, history_bytes=history_bytes
+        )
         self._path = path
         self._ckpt_path = checkpoint_path or path + ".ckpt"
         self._archive = archive_compacted
@@ -244,6 +248,13 @@ class DurableObjectStore(ObjectStore):
                 obj = _decode(tp, data)
                 objs[obj.metadata.key] = obj
                 self._rv = max(self._rv, obj.metadata.resource_version)
+                self._note_recovered_uid(obj.metadata.uid)
+        # the persisted uid watermark covers even objects deleted BEFORE
+        # the snapshot (their put records were compacted away; the scan
+        # above can't see them) — absent in older checkpoints, fine
+        self._recovered_uid_max = max(
+            self._recovered_uid_max, int(doc.get("uid_floor", 0))
+        )
         rv = int(doc.get("resource_version", 0))
         self._rv = max(self._rv, rv)
         return rv
@@ -279,7 +290,17 @@ class DurableObjectStore(ObjectStore):
                 os.fsync(dst.fileno())
         os.unlink(pending)
 
+    def _note_recovered_uid(self, uid: str) -> None:
+        """Track the highest generated-uid suffix seen during recovery;
+        the floor is applied once replay finishes (see _replay)."""
+        from minisched_tpu.api.objects import _uid_suffix
+
+        n = _uid_suffix(uid)
+        if n > self._recovered_uid_max:
+            self._recovered_uid_max = n
+
     def _replay(self) -> None:
+        self._recovered_uid_max = 0
         if self._archive:
             # a crash mid-archive leaves a claimed segment; fold it into
             # the history file before anything else (its records are all
@@ -315,6 +336,16 @@ class DurableObjectStore(ObjectStore):
             # following reopen (and poisoning every later replay)
             with open(self._path, "rb+") as f:
                 f.truncate(good_end)
+        # uid continuity: a fresh interpreter's counter restarts at zero,
+        # and re-issuing a recovered object's uid would let two DIFFERENT
+        # pods share an identity (false double-bind audit hits, queue
+        # dedup collapsing them).  Floor the sequence past everything this
+        # recovery saw — checkpoint watermark, live objects, and every
+        # replayed put (deleted objects included, via _apply).
+        if self._recovered_uid_max:
+            from minisched_tpu.api.objects import ensure_uid_floor
+
+            ensure_uid_floor(self._recovered_uid_max)
 
     def _apply(self, rec: dict) -> None:
         """Apply one WAL record; also rebuilds the watch-resume history
@@ -333,6 +364,10 @@ class DurableObjectStore(ObjectStore):
             return  # written by a newer schema; skip rather than fail open
         if op == "put":
             obj = _decode(KIND_TYPES[kind], rec["obj"])
+            # noted even for records the rv-skip below drops: their uids
+            # were ISSUED, and re-issuing one after recovery would alias
+            # two different objects
+            self._note_recovered_uid(obj.metadata.uid)
             rv = obj.metadata.resource_version
             if rv <= self._ckpt_rv:
                 return

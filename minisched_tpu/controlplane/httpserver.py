@@ -37,7 +37,11 @@ from urllib.parse import parse_qs
 
 from minisched_tpu.api.objects import Binding, Node, Pod
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
-from minisched_tpu.controlplane.client import AlreadyBound, Client
+from minisched_tpu.controlplane.client import (
+    AlreadyBound,
+    Client,
+    OutOfCapacity,
+)
 from minisched_tpu.controlplane.store import (
     Conflict,
     HistoryCompacted,
@@ -49,6 +53,7 @@ def _kind_for(collection: str) -> str:
     return {"nodes": "Node", "pods": "Pod",
             "persistentvolumes": "PersistentVolume",
             "persistentvolumeclaims": "PersistentVolumeClaim",
+            "leases": "Lease",
             "events": "Event"}[collection]
 
 
@@ -200,10 +205,20 @@ class _Handler(BaseHTTPRequestHandler):
                 obj = self.store.get(kind, ns, name)
                 self._send(200, _encode(obj))
             else:
-                items = self.store.list(kind)
+                # epoch-consistent list: the rv is taken ATOMICALLY with
+                # the snapshot (one store lock hold) so consumers deriving
+                # versioned state from a listing (HA membership) can trust
+                # it reflects exactly these items
+                items, rv = self.store.list_with_rv(kind)
                 if ns:  # namespaced list filters, matching the watch verb
                     items = [o for o in items if o.metadata.namespace == ns]
-                self._send(200, {"items": [_encode(o) for o in items]})
+                self._send(
+                    200,
+                    {
+                        "items": [_encode(o) for o in items],
+                        "resource_version": rv,
+                    },
+                )
         except KeyError as e:
             self._error(404, str(e))
 
@@ -316,7 +331,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(201, _encode(pod))
             except AlreadyBound as e:
                 self._error(409, str(e))
-            except Conflict as e:
+            except (Conflict, OutOfCapacity) as e:
                 self._error(409, str(e))
             except KeyError as e:
                 self._error(404, str(e))
@@ -432,6 +447,8 @@ class _Handler(BaseHTTPRequestHandler):
                     pass  # pod vanished between bind and lookup
             elif isinstance(res, Conflict):
                 entry = {"error": str(res), "type": "Conflict"}
+            elif isinstance(res, OutOfCapacity):
+                entry = {"error": str(res), "type": "OutOfCapacity"}
             elif isinstance(res, BaseException):
                 entry = {"error": str(res), "type": "NotFound"}
             elif res is not None:
@@ -588,6 +605,8 @@ class HTTPClient:
                 raise AlreadyBound(body)
             if e.code == 409 and "stale resource_version" in body:
                 raise Conflict(body)  # == in-process update(expected_rv)
+            if e.code == 409 and "out of capacity" in body:
+                raise OutOfCapacity(body)  # == in-process bind semantics
             if e.code == 409 and "already exists" in body:
                 raise KeyError(body)  # == in-process store.create semantics
             if e.code == 404:

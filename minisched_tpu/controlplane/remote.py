@@ -34,6 +34,7 @@ from minisched_tpu.api.objects import Binding
 from minisched_tpu.controlplane.checkpoint import _decode, _encode
 from minisched_tpu.controlplane.client import (
     AlreadyBound,
+    OutOfCapacity,
     _NodeAPI,
     _PodAPI,
 )
@@ -52,6 +53,7 @@ _COLLECTIONS = {
     "Pod": "pods",
     "PersistentVolume": "persistentvolumes",
     "PersistentVolumeClaim": "persistentvolumeclaims",
+    "Lease": "leases",
     "Event": "events",
 }
 _CLUSTER_SCOPED = {"Node", "PersistentVolume"}
@@ -291,6 +293,8 @@ class RemoteStore:
                     # semantic, never blindly retried: the caller must
                     # re-read before re-applying (see mutate)
                     raise Conflict(body)
+                if e.code == 409 and "out of capacity" in body:
+                    raise OutOfCapacity(body)
                 if e.code in (404, 409):
                     raise KeyError(body)
                 if e.code < 500:
@@ -336,6 +340,17 @@ class RemoteStore:
         typ = _kind_types()[kind]
         out = self._req("GET", self._path(kind))
         return [_decode(typ, o) for o in out["items"]]
+
+    def list_with_rv(self, kind: str) -> Tuple[List[Any], int]:
+        """(items, store resource_version) — the server takes both under
+        one lock hold, so the rv is exactly the version the snapshot
+        reflects (== ObjectStore.list_with_rv over the wire)."""
+        typ = _kind_types()[kind]
+        out = self._req("GET", self._path(kind))
+        return (
+            [_decode(typ, o) for o in out["items"]],
+            int(out.get("resource_version", 0)),
+        )
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
         typ = _kind_types()[kind]
@@ -464,6 +479,12 @@ class RemoteStore:
             if err is not None:
                 if item.get("type") == "Conflict":
                     results.append(Conflict(err))
+                    continue
+                if item.get("type") == "OutOfCapacity":
+                    # the node lost a capacity race to a peer engine's
+                    # bind: per-item, retriable — the engine requeues the
+                    # pod against refreshed state
+                    results.append(OutOfCapacity(err))
                     continue
                 if item.get("type") == "AlreadyBound":
                     # idempotent-retry guard: a retried request whose FIRST

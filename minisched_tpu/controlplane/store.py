@@ -165,24 +165,86 @@ class Watch:
 #: informer actually needs.
 DEFAULT_HISTORY_EVENTS = 65536
 
+#: BYTE budget for the same ring, PER KIND — the count cap alone let
+#: 65536 headline-sized pods (multi-KB of containers/affinity each) pin
+#: hundreds of MB of history.  Whichever cap trips first evicts; both
+#: advance the floor, so 410-Gone + relist behavior is unchanged — a
+#: fat-pod churn burst just compacts sooner.
+DEFAULT_HISTORY_BYTES = 64 * 1024 * 1024
+
+
+def _walk_bytes(x: Any) -> int:
+    """Generic footprint estimate (NOT exact — the ring budget needs
+    proportionality, not accounting): strings/containers by length,
+    dataclass-ish objects via __dict__, private/memo fields skipped."""
+    if x is None:
+        return 8
+    if isinstance(x, str):
+        return 56 + len(x)
+    if isinstance(x, (int, float, bool)):
+        return 32
+    if isinstance(x, dict):
+        return 64 + sum(_walk_bytes(k) + _walk_bytes(v) for k, v in x.items())
+    if isinstance(x, (list, tuple, set, frozenset)):
+        return 56 + sum(_walk_bytes(v) for v in x)
+    d = getattr(x, "__dict__", None)
+    if d is not None:
+        return 64 + sum(
+            _walk_bytes(v) for k, v in d.items() if not k.startswith("_")
+        )
+    return 64
+
+
+def approx_obj_bytes(obj: Any) -> int:
+    """Cheap per-object size estimate for the history ring's byte budget.
+
+    The spec walk is memoized ON the spec (kube semantics: specs are
+    immutable once created, and the bind path shares them structurally
+    between the pending and bound object — exactly like
+    ``Pod.resource_requests``), so a wave's thousands of bind events cost
+    one dict lookup each, not a recursive walk."""
+    total = 256
+    meta = getattr(obj, "metadata", None)
+    if meta is not None:
+        total += 128 + _walk_bytes(meta.labels) + _walk_bytes(meta.annotations)
+    spec = getattr(obj, "spec", None)
+    if spec is not None:
+        d = getattr(spec, "__dict__", None)
+        if d is None:
+            total += _walk_bytes(spec)
+        else:
+            memo = d.get("_approx_bytes_memo")
+            if memo is None:
+                memo = _walk_bytes(spec)
+                d["_approx_bytes_memo"] = memo
+            total += memo
+    return total
+
 
 class ObjectStore:
     """Versioned multi-kind object store + watch hub."""
 
-    def __init__(self, history_events: int = DEFAULT_HISTORY_EVENTS) -> None:
+    def __init__(
+        self,
+        history_events: int = DEFAULT_HISTORY_EVENTS,
+        history_bytes: int = DEFAULT_HISTORY_BYTES,
+    ) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, Any]] = {}  # kind -> key -> obj
         self._watches: Dict[str, List[Watch]] = {}
         self._rv = 0
-        # watch-resume history: per-kind event rings in mutation order.
-        # A kind's floor is the highest rv NO LONGER retained for it —
-        # resume_rv below the floor means the gap cannot be replayed
-        # (HistoryCompacted).  ``_history_floor_min`` is the baseline for
-        # every kind regardless of ring state (a durable reopen sets it
-        # to the checkpoint rv: nothing before the snapshot is
-        # reconstructable for ANY kind).
+        # watch-resume history: per-kind rings of (event, approx bytes) in
+        # mutation order, bounded by COUNT and by BYTES (whichever trips
+        # first evicts — see DEFAULT_HISTORY_BYTES).  A kind's floor is
+        # the highest rv NO LONGER retained for it — resume_rv below the
+        # floor means the gap cannot be replayed (HistoryCompacted).
+        # ``_history_floor_min`` is the baseline for every kind regardless
+        # of ring state (a durable reopen sets it to the checkpoint rv:
+        # nothing before the snapshot is reconstructable for ANY kind).
         self._history: Dict[str, deque] = {}
         self._history_cap = max(int(history_events), 0)
+        self._history_byte_cap = max(int(history_bytes), 0)
+        self._history_bytes_used: Dict[str, int] = {}
         self._history_floors: Dict[str, int] = {}
         self._history_floor_min = 0
         #: fault-injection hook (SURVEY.md §5.3 — the reference has none):
@@ -214,18 +276,14 @@ class ObjectStore:
 
     def _record_history(self, kind: str, event: WatchEvent) -> None:
         """Append one event to the kind's resume ring (caller holds the
-        lock).  Overflow advances that kind's floor to the dropped
-        event's rv — resumes below the floor must relist
-        (HistoryCompacted)."""
+        lock).  Overflow — by event COUNT or by the kind's BYTE budget —
+        advances that kind's floor to the dropped event's rv: resumes
+        below the floor must relist (HistoryCompacted)."""
         if self._history_cap <= 0:
             return
         ring = self._history.get(kind)
         if ring is None:
             ring = self._history[kind] = deque()
-        if len(ring) >= self._history_cap:
-            dropped = ring.popleft()
-            if dropped.rv > self._history_floors.get(kind, 0):
-                self._history_floors[kind] = dropped.rv
         if event.old_obj is not None:
             # retain WITHOUT old_obj: the replaced version is garbage the
             # moment a newer event lands, and pinning it doubles the
@@ -233,7 +291,28 @@ class ObjectStore:
             # 'old' from their own caches (the informer's normalization
             # does exactly that), and the wire encoding never carried it.
             event = WatchEvent(event.type, event.obj, rv=event.rv)
-        ring.append(event)
+        cost = approx_obj_bytes(event.obj) + 96  # + ring/event overhead
+        used = self._history_bytes_used.get(kind, 0) + cost
+        floors = self._history_floors
+        while ring and (
+            len(ring) >= self._history_cap
+            or (self._history_byte_cap > 0 and used > self._history_byte_cap)
+        ):
+            dropped, dropped_cost = ring.popleft()
+            used -= dropped_cost
+            if dropped.rv > floors.get(kind, 0):
+                floors[kind] = dropped.rv
+        ring.append((event, cost))
+        self._history_bytes_used[kind] = used
+
+    def history_stats(self, kind: str) -> Dict[str, int]:
+        """(events retained, approx bytes retained) for one kind — the
+        byte-budget tests and dashboards read this."""
+        with self._lock:
+            return {
+                "events": len(self._history.get(kind, ())),
+                "bytes": self._history_bytes_used.get(kind, 0),
+            }
 
     def _floor_for(self, kind: str) -> int:
         return max(self._history_floor_min, self._history_floors.get(kind, 0))
@@ -323,6 +402,20 @@ class ObjectStore:
         with self._lock:
             self._maybe_fault("list", kind, "")
             return [o.clone() for o in self._objects.get(kind, {}).values()]
+
+    def list_with_rv(self, kind: str) -> Tuple[List[Any], int]:
+        """Epoch-consistent list: (snapshot, the store resource_version it
+        reflects), taken under ONE lock hold.  A consumer deriving
+        versioned state from a listing (the HA membership layer's shard
+        map) needs the rv ATOMIC with the items — list() then
+        resource_version can interleave a mutation and stamp the snapshot
+        with a version it does not reflect."""
+        with self._lock:
+            self._maybe_fault("list", kind, "")
+            return (
+                [o.clone() for o in self._objects.get(kind, {}).values()],
+                self._rv,
+            )
 
     def update(
         self, kind: str, obj: Any, expected_rv: Optional[int] = None
@@ -572,7 +665,7 @@ class ObjectStore:
                 w._deliver_many(
                     [
                         ev
-                        for ev in self._history.get(kind, ())
+                        for ev, _cost in self._history.get(kind, ())
                         if ev.rv > resume_rv
                     ]
                 )

@@ -1854,6 +1854,15 @@ class DeviceScheduler(Scheduler):
         for (qpi, pod, node_name, state), res in zip(ready, results):
             if isinstance(res, BaseException):
                 self.run_unreserve_plugins(state, pod, node_name)
+                if self._is_bind_race(res) and self._bind_race_refresh(qpi):
+                    # bound by a peer / deleted while in-flight: drop
+                    # instead of requeue (see Scheduler._bind_race_refresh
+                    # — a re-parked stale copy would conflict forever),
+                    # releasing the assumed capacity
+                    self._forget(pod.metadata.uid)
+                    if self.on_decision:
+                        self.on_decision(pod, None, Status.from_error(res))
+                    continue
                 self.error_func(qpi, res)
                 if self.on_decision:
                     self.on_decision(pod, None, Status.from_error(res))

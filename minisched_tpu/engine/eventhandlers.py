@@ -53,25 +53,45 @@ def add_all_event_handlers(
     pod_informer = informer_factory.informer_for("Pod")
 
     def unassigned_batch(events):
-        """Pending pods feed the queue.  ADD floods (cluster creation
-        replays every pending pod) take the one-lock batch path; updates
-        and deletes are rare and go one at a time."""
+        """Pending pods feed the queue — gated on the engine's shard
+        filter (``sched.admits``: always-true single-engine, the HA
+        membership's rendezvous map otherwise).  ADD floods (cluster
+        creation replays every pending pod) take the one-lock batch path;
+        a MODIFIED that leaves the engine's schedulable population —
+        bound (possibly by a PEER engine in an HA plane) or re-sharded
+        away — is dropped via the batched ``delete_many`` (one lock +
+        set-intersect for the whole batch: in a single-engine plane every
+        bind event of a wave lands here, and a per-event queue scan would
+        be O(events × queue))."""
         adds = [
             ev.obj
             for ev in events
-            if ev.type == EventType.ADDED and not assigned(ev.obj)
+            if ev.type == EventType.ADDED
+            and not assigned(ev.obj)
+            and sched.admits(ev.obj)
         ]
         if adds:
             sched.queue.add_batch(adds)
+        drops = []
         for ev in events:
             try:
-                if assigned(ev.obj) or ev.type == EventType.ADDED:
+                if ev.type == EventType.ADDED:
                     continue
                 if ev.type == EventType.MODIFIED:
-                    sched.queue.update(ev.old_obj, ev.obj)
-                else:
+                    if assigned(ev.obj) or not sched.admits(ev.obj):
+                        drops.append(ev.obj)
+                    else:
+                        sched.queue.update(ev.old_obj, ev.obj)
+                elif not assigned(ev.obj):
                     sched.queue.delete(ev.obj)
             except Exception:  # one bad event must not drop the rest
+                import traceback
+
+                traceback.print_exc()
+        if drops:
+            try:
+                sched.queue.delete_many(drops)
+            except Exception:
                 import traceback
 
                 traceback.print_exc()

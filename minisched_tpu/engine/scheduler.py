@@ -300,6 +300,13 @@ class Scheduler:
         )
         self.queue = SchedulingQueue(event_map=self.event_map, **(queue_opts or {}))
 
+        #: HA shard filter (ha/membership.Membership.owns_pod): when set,
+        #: the event handlers admit only this engine's shard into the
+        #: queue — None (the default) admits everything (single-engine
+        #: mode is a plane of one).  Installed BEFORE the informers start
+        #: (service.start_scheduler) so the initial replay is filtered.
+        self.shard_filter: Optional[Callable[[Pod], bool]] = None
+
         self._waiting_pods: Dict[str, WaitingPod] = {}
         self._waiting_lock = threading.Lock()
         self._stop = threading.Event()
@@ -334,6 +341,13 @@ class Scheduler:
     def _wire_pre_cache(self, informer_factory: Any) -> None:
         """Hook for subclasses that need informer handlers registered
         BEFORE the NodeInfo cache's (see __init__)."""
+
+    def admits(self, pod: Pod) -> bool:
+        """Queue-admission predicate: does this engine schedule ``pod``?
+        The event handlers consult it on every pending-pod event; an HA
+        plane sets ``shard_filter`` so N engines partition the keyspace."""
+        f = self.shard_filter
+        return True if f is None else f(pod)
 
     # ------------------------------------------------------------------
     # lifecycle (minisched.go:28-30)
@@ -619,9 +633,60 @@ class Scheduler:
                 self._waiting_pods.pop(pod.metadata.uid, None)
 
     def bind(self, pod: Pod, node_name: str) -> None:
+        # expected_rv: the optimistic-concurrency precondition the device
+        # wave path already stamps (_bind_batch) — bind only if the pod is
+        # STILL at the version this cycle evaluated.  A Conflict rides the
+        # normal error_func → requeue path, where the MODIFIED event's
+        # queue.update has already refreshed the parked pod.  In an HA
+        # plane this is also the cross-engine arbitration: two engines
+        # racing one pod commit exactly one bind.
         self.client.pods().bind(
-            Binding(pod.metadata.name, pod.metadata.namespace, node_name)
+            Binding(
+                pod.metadata.name,
+                pod.metadata.namespace,
+                node_name,
+                expected_rv=pod.metadata.resource_version or None,
+            )
         )
+
+    def _bind_race_refresh(self, qpi: QueuedPodInfo) -> bool:
+        """A bind lost a race (Conflict on ``expected_rv``, AlreadyBound
+        from a peer engine).  The MODIFIED event that made our copy stale
+        was delivered while the pod was IN-FLIGHT — invisible to
+        queue.update (pop had discarded the uid) — so a re-parked qpi
+        would carry the stale resource_version forever and every retry
+        would conflict again (livelock).  Consult the informer cache,
+        which DID apply that event: returns True when the pod left the
+        schedulable population (bound by anyone / deleted / recreated) —
+        drop it instead of requeueing; False when it is still pending —
+        the queued copy was refreshed so the retry carries the current
+        version."""
+        try:
+            cur = self.informer_factory.informer_for("Pod").get(
+                qpi.pod.metadata.key
+            )
+        except Exception:
+            return False  # no cache view: park as before, retry later
+        if (
+            cur is None
+            or cur.metadata.uid != qpi.pod.metadata.uid
+            or cur.spec.node_name
+        ):
+            return True
+        qpi.pod_info.pod = cur
+        return False
+
+    @staticmethod
+    def _is_bind_race(err: BaseException) -> bool:
+        from minisched_tpu.controlplane.client import (
+            AlreadyBound,
+            OutOfCapacity,
+        )
+        from minisched_tpu.controlplane.store import Conflict
+
+        # OutOfCapacity included: the pod itself may be stale too, and
+        # the refresh costs one cache lookup
+        return isinstance(err, (AlreadyBound, Conflict, OutOfCapacity))
 
     def _binding_cycle(
         self,
@@ -646,6 +711,17 @@ class Scheduler:
                 self.on_decision(pod, node_name, Status.success())
         except Exception as err:
             self.run_unreserve_plugins(state, pod, node_name)
+            if self._is_bind_race(err) and self._bind_race_refresh(qpi):
+                # bound elsewhere or gone: no longer schedulable work —
+                # requeueing would retry (and re-conflict) forever.  A
+                # device engine's assumption must still release (the
+                # authoritative state owns the capacity now).
+                forget = getattr(self, "_forget", None)
+                if forget is not None:
+                    forget(pod.metadata.uid)
+                if self.on_decision:
+                    self.on_decision(pod, None, Status.from_error(err))
+                return
             self.error_func(qpi, err)
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))

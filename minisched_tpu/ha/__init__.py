@@ -1,0 +1,35 @@
+"""HA scheduling plane: lease-based membership + sharded active-active engines.
+
+One scheduler engine owning the whole cluster is the last single point of
+failure left after the fault fabric (PR 1) and crash–restart recovery
+(PR 2) hardened the control plane.  This package removes it: N engines
+register TTL'd member **Leases** (api.objects.Lease — renewed via
+``expected_rv`` CAS, so acquisition and takeover are 409-arbitrated), a
+**Membership** layer derives a deterministic shard map (rendezvous hash of
+pod uid over the live member set, versioned by a membership epoch), and a
+shard filter threads through the engine's event handlers so each engine
+only admits its shard's pods.  When a member's lease expires, survivors
+observe it through the existing watch path, bump the epoch, and adopt the
+orphaned shard — double-scheduling around the rebalance window is arbitrated
+by the PR-2 primitives the engines already have (the bind subresource's
+unset-node_name guard + per-entry ``expected_rv``), so no pod is ever bound
+twice no matter how the shards flap.
+
+    lease.py       CAS acquire / renew / release over any store facade
+    membership.py  member registry, heartbeat, epochs, rendezvous shard map
+    plane.py       wire an engine + membership into one HA participant
+    proc.py        run an engine as a killable child process (chaos soaks)
+"""
+
+from minisched_tpu.ha.lease import LeaseLost, LeaseManager
+from minisched_tpu.ha.membership import Membership, shard_owner
+from minisched_tpu.ha.plane import HAEngine, start_ha_engine
+
+__all__ = [
+    "LeaseLost",
+    "LeaseManager",
+    "Membership",
+    "shard_owner",
+    "HAEngine",
+    "start_ha_engine",
+]

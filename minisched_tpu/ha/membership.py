@@ -1,0 +1,285 @@
+"""Membership: who is scheduling, and which pods are whose.
+
+Each engine joins the plane by acquiring a member lease
+(``member-<id>``), then heartbeats it at ttl/3.  The live member set is
+derived by READING the lease namespace — informer cache when attached
+(the existing watch path, so renewals/joins/releases propagate as
+events), a consistent ``list_with_rv`` otherwise — and filtering out
+expired leases by wall clock.  Any change to the derived set bumps this
+member's local **epoch** and fires the registered callbacks (the engine
+wiring in plane.py adopts/sheds queue contents there).
+
+The shard map is a **rendezvous (highest-random-weight) hash** of pod uid
+over the sorted member ids: deterministic from the member set alone — two
+engines that agree on WHO is alive agree on every pod's owner without any
+coordination round — and minimal-churn by construction: removing one
+member reassigns exactly that member's pods (each surviving member's
+per-pod score is unchanged), so a failover moves only the orphaned shard.
+
+Epoch semantics: the epoch is a LOCAL monotonic version of this member's
+view (bumped once per observed membership change), published through the
+lease on every renewal so external observers (tests, the bench ``ha``
+role) can watch all survivors converge past a kill.  Correctness never
+depends on epochs agreeing across members — placement conflicts during
+the rebalance window are arbitrated by the store's bind preconditions —
+the epoch only versions the map and gates "did everyone notice yet".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from hashlib import blake2s
+from typing import Any, Callable, List, Optional, Sequence, Set, Tuple
+
+from minisched_tpu.ha.lease import HA_NAMESPACE, LeaseLost, LeaseManager
+from minisched_tpu.observability import counters
+
+#: default member-lease TTL: expiry (and thus worst-case orphaned-shard
+#: detection) is bounded by this; renewal runs every ttl/3 so two missed
+#: heartbeats still keep the lease alive
+DEFAULT_TTL_S = 5.0
+
+MEMBER_PREFIX = "member-"
+
+
+def shard_owner(uid: str, members: Sequence[str]) -> Optional[str]:
+    """Rendezvous hash: the member with the highest blake2s score for
+    this uid owns it.  Pure function of (uid, member set) — identical
+    across processes, minimal churn on membership change."""
+    best: Optional[str] = None
+    best_score = -1
+    for m in members:
+        score = int.from_bytes(
+            blake2s(f"{m}|{uid}".encode(), digest_size=8).digest(), "big"
+        )
+        # deterministic tie-break on the smaller id (ties are a 2^-64
+        # curiosity, but the map must still be a pure function)
+        if score > best_score or (score == best_score and (best is None or m < best)):
+            best, best_score = m, score
+    return best
+
+
+#: callback signature: (epoch, members, joined ids, lost ids)
+ChangeCallback = Callable[[int, Tuple[str, ...], Set[str], Set[str]], None]
+
+
+class Membership:
+    """One engine's membership in the HA plane."""
+
+    def __init__(
+        self,
+        client: Any,
+        member_id: str,
+        ttl_s: float = DEFAULT_TTL_S,
+        namespace: str = HA_NAMESPACE,
+        clock=time.time,
+        heartbeat_interval_s: Optional[float] = None,
+    ):
+        self.member_id = member_id
+        self.ttl_s = float(ttl_s)
+        self._leases = LeaseManager(client, namespace=namespace, clock=clock)
+        self._clock = clock
+        self._interval = (
+            heartbeat_interval_s
+            if heartbeat_interval_s is not None
+            else self.ttl_s / 3.0
+        )
+        self._mu = threading.Lock()
+        self._members: Tuple[str, ...] = ()
+        self._epoch = 0
+        self._lease = None  # our member Lease (latest stored copy)
+        self._informer: Any = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: fired (NOT under the membership lock) on every epoch bump;
+        #: exceptions are contained — a consumer bug must not stop the
+        #: heartbeat
+        self.on_change: List[ChangeCallback] = []
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def lease_name(self) -> str:
+        return MEMBER_PREFIX + self.member_id
+
+    @property
+    def epoch(self) -> int:
+        with self._mu:
+            return self._epoch
+
+    def members(self) -> Tuple[str, ...]:
+        with self._mu:
+            return self._members
+
+    def owns(self, uid: str) -> bool:
+        """Does this member's shard contain ``uid``?  While our own lease
+        write hasn't round-tripped through the view yet (join races the
+        first recompute) we at least own our own shard — a plane of one."""
+        with self._mu:
+            members = self._members
+        if self.member_id not in members:
+            members = tuple(sorted((*members, self.member_id)))
+        return shard_owner(uid, members) == self.member_id
+
+    def owns_pod(self, pod: Any) -> bool:
+        """The shard filter the engine wires (engine.Scheduler.shard_filter)."""
+        return self.owns(pod.metadata.uid or pod.metadata.key)
+
+    # -- lifecycle ----------------------------------------------------------
+    def join(self, timeout_s: float = 30.0) -> None:
+        """Acquire our member lease (CAS-arbitrated; a stale lease from a
+        previous incarnation of this id is taken over once expired), then
+        derive the initial member view."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            got = self._leases.acquire(
+                self.lease_name, self.member_id, self.ttl_s
+            )
+            if got is not None:
+                self._lease = got
+                break
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"member {self.member_id!r}: lease "
+                    f"{self.lease_name!r} held by a live peer"
+                )
+            # a live holder under OUR name means a previous incarnation's
+            # lease hasn't expired yet — wait out the TTL, not a spin
+            time.sleep(min(0.2, self.ttl_s / 4.0))
+        counters.inc("ha.member_join")
+        self.recompute()
+
+    def attach(self, informer_factory: Any) -> None:
+        """Ride the existing watch path: lease events (renewals, joins,
+        releases) trigger a recompute through the factory's Lease
+        informer — so a peer's graceful release rebalances immediately,
+        not at the next heartbeat tick."""
+        from minisched_tpu.controlplane.informer import ResourceEventHandlers
+
+        inf = informer_factory.informer_for("Lease")
+        inf.add_event_handlers(
+            ResourceEventHandlers(on_batch=lambda _events: self.recompute())
+        )
+        self._informer = inf
+
+    def start(self) -> None:
+        """Start the heartbeat thread: renew our lease, re-derive the
+        member view (expiry is a CLOCK event — no watch event fires when
+        a peer merely stops renewing, so the tick is what detects death),
+        and GC long-dead leases."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"ha-heartbeat-{self.member_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.heartbeat_once()
+            except Exception:  # the plane being unreachable is survivable
+                traceback.print_exc()
+
+    def heartbeat_once(self) -> None:
+        lease = self._lease
+        try:
+            if lease is not None:
+                self._lease = self._leases.renew(lease, epoch=self.epoch)
+            else:
+                self._lease = self._leases.acquire(
+                    self.lease_name, self.member_id, self.ttl_s
+                )
+        except LeaseLost:
+            # our TTL lapsed and a peer observed it; re-acquire (our own
+            # expired lease is takeover-able) and let the epoch churn
+            # settle through recompute
+            self._lease = self._leases.acquire(
+                self.lease_name, self.member_id, self.ttl_s
+            )
+        except Exception:
+            # store unreachable: keep the old lease handle — the next
+            # tick retries, and renew()'s re-read path absorbs the case
+            # where this attempt actually landed server-side
+            pass
+        self.recompute()
+        try:
+            self._leases.gc_expired()
+        except Exception:
+            pass  # GC is housekeeping, never load-bearing
+
+    def recompute(self) -> None:
+        """Re-derive the live member set; on change, bump the epoch and
+        fire callbacks.  Reads the informer cache when attached (the
+        watch path), a consistent list otherwise."""
+        try:
+            # the informer is authoritative only once SYNCED: an
+            # unsynced/relisting cache reads as empty, and an empty
+            # member set would collapse owns() to a plane of one — this
+            # engine would transiently adopt EVERY pod.  Until sync, the
+            # epoch-consistent list is the view.
+            if self._informer is not None and self._informer.wait_for_cache_sync(
+                timeout=0
+            ):
+                leases = [
+                    l
+                    for l in self._informer.lister()
+                    if l.metadata.namespace == self._leases._ns
+                ]
+            else:
+                leases, _rv = self._leases.list()
+        except Exception:
+            return  # plane unreachable: keep the last view
+        now = self._clock()
+        live: Set[str] = set()
+        expired_holders: Set[str] = set()
+        for l in leases:
+            if not l.metadata.name.startswith(MEMBER_PREFIX):
+                continue  # non-member coordination lease
+            holder = l.spec.holder or l.metadata.name[len(MEMBER_PREFIX):]
+            if l.expired(now):
+                expired_holders.add(holder)
+            else:
+                live.add(holder)
+        new = tuple(sorted(live))
+        with self._mu:
+            if new == self._members:
+                return
+            old = self._members
+            self._members = new
+            self._epoch += 1
+            epoch = self._epoch
+        joined = set(new) - set(old)
+        lost = set(old) - set(new)
+        counters.inc("ha.epoch_bump")
+        if lost:
+            counters.inc("ha.member_lost", len(lost))
+            # lost-with-a-stale-lease = died (TTL ran out); lost without
+            # one = released gracefully — only the former is an "expiry"
+            died = lost & expired_holders
+            if died:
+                counters.inc("ha.lease_expired", len(died))
+        for cb in list(self.on_change):
+            try:
+                cb(epoch, new, joined, lost)
+            except Exception:  # a consumer bug must not stop the heartbeat
+                traceback.print_exc()
+
+    def stop(self, release: bool = True) -> None:
+        """Leave the plane.  ``release=True`` deletes our lease so peers
+        rebalance immediately (graceful departure); ``release=False``
+        abandons it — from every peer's perspective indistinguishable
+        from a crash (the in-process kill switch for tests/bench)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, 2 * self._interval))
+            self._thread = None
+        if release:
+            try:
+                self._leases.release(self.lease_name, self.member_id)
+            except Exception:
+                pass  # teardown with the plane down: peers time us out

@@ -1,0 +1,134 @@
+"""Wire one scheduler engine into the HA plane.
+
+``start_ha_engine`` composes the pieces: join the membership (lease CAS),
+start a SchedulerService whose engine carries the membership's shard
+filter (threaded through the event handlers' queue admission — see
+engine/eventhandlers.py), attach the membership to the factory's Lease
+informer, and register the **resync** callback that runs on every epoch
+bump:
+
+* adopt — every pending pod the new shard map gives us is (re)queued
+  from the informer cache (``queue.add`` dedupes, so pods already queued
+  cost a set lookup);
+* shed — pending pods the map took away are dropped from our queue (the
+  new owner admits them from its own cache);
+* re-arbitrate — on a LOST member, a device engine's assume ledger is
+  marked due immediately (the PR-1/PR-2 machinery: every assumption gets
+  re-checked against the authoritative store), because the rebalance
+  window is exactly when two engines can race a bind and the loser must
+  release its assumed capacity promptly instead of waiting out the TTL.
+
+Multiple HA engines run against ONE control plane either in-process
+(N ``start_ha_engine`` calls over Clients sharing a store — the bench
+``ha`` role) or over the wire (each engine a RemoteClient against the
+REST façade; ha/proc.py runs them as killable child processes).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Optional, Set, Tuple
+
+from minisched_tpu.ha.membership import DEFAULT_TTL_S, Membership
+from minisched_tpu.observability import counters
+from minisched_tpu.service.service import SchedulerService
+
+
+class HAEngine:
+    """One engine + its membership, joined to the plane."""
+
+    def __init__(
+        self,
+        service: SchedulerService,
+        scheduler: Any,
+        membership: Membership,
+    ):
+        self.service = service
+        self.scheduler = scheduler
+        self.membership = membership
+
+    def stop(self) -> None:
+        """Graceful departure: stop scheduling, then RELEASE the lease so
+        peers adopt our shard immediately instead of waiting out the TTL."""
+        self.membership.stop(release=True)
+        self.service.close()
+
+    def kill(self) -> None:
+        """In-process crash simulation: the engine stops but the lease is
+        ABANDONED — peers must detect the death by TTL expiry, exactly as
+        with a SIGKILL'd process (which ha/proc.py provides for real)."""
+        self.membership.stop(release=False)
+        self.service.close()
+
+
+def start_ha_engine(
+    client: Any,
+    engine_id: str,
+    cfg: Any = None,
+    ttl_s: float = DEFAULT_TTL_S,
+    device_mode: bool = False,
+    max_wave: int = 1024,
+    **start_kwargs: Any,
+) -> HAEngine:
+    """Join the plane and start one sharded engine over ``client``.
+
+    Order matters: the lease is acquired BEFORE the engine starts (so the
+    initial shard map includes us — an engine scheduling before joining
+    would admit everything), and the shard filter is installed before the
+    informers start (so the initial snapshot replay is already filtered;
+    see SchedulerService.start_scheduler).
+    """
+    membership = Membership(client, engine_id, ttl_s=ttl_s)
+    membership.join()
+    service = SchedulerService(client)
+    sched = service.start_scheduler(
+        cfg,
+        device_mode=device_mode,
+        max_wave=max_wave,
+        shard_filter=membership.owns_pod,
+        **start_kwargs,
+    )
+    membership.attach(service.informer_factory)
+
+    pod_informer = service.informer_factory.informer_for("Pod")
+
+    def resync(
+        epoch: int,
+        members: Tuple[str, ...],
+        joined: Set[str],
+        lost: Set[str],
+    ) -> None:
+        """Apply a new shard map to the queue (runs on the membership's
+        heartbeat thread or the Lease informer's dispatch thread)."""
+        adopted = 0
+        shed = []
+        for pod in pod_informer.lister():
+            if pod.spec.node_name:
+                continue  # bound: not schedulable work for anyone
+            if membership.owns_pod(pod):
+                sched.queue.add(pod)  # dedup: queued pods are a no-op
+                adopted += 1
+            else:
+                shed.append(pod)
+        if shed:
+            sched.queue.delete_many(shed)
+        if lost:
+            counters.inc("ha.shard_adopt")
+            counters.inc("ha.shard_adopt_pods", adopted)
+            # a lost member may have died with binds in flight; a device
+            # engine re-arbitrates every assumption against the
+            # authoritative store NOW (the same revalidation a reconnect
+            # triggers) instead of waiting out the assume TTL
+            revalidate = getattr(sched, "_revalidate_assume_ledger", None)
+            if revalidate is not None:
+                try:
+                    revalidate()
+                except Exception:
+                    traceback.print_exc()
+
+    membership.on_change.append(resync)
+    # the engine may have started mid-churn (peers joining while our
+    # informers synced): apply the current map once, unconditionally
+    resync(membership.epoch, membership.members(), set(), set())
+    membership.start()
+    return HAEngine(service, sched, membership)

@@ -9,6 +9,21 @@ have answered them actually ran.
 One process-global registry (``GLOBAL``) keeps call sites one-liners —
 ``counters.inc("remote.retry")`` — without threading a handle through
 every constructor; tests snapshot/reset around their scenario.
+
+The HA plane (minisched_tpu.ha) records its lifecycle here under the
+``ha.`` prefix — surfaced in the bench ``ha`` role's record:
+
+    ha.lease_acquire / ha.lease_takeover / ha.lease_renew
+        — member-lease CAS outcomes (takeover = an expired lease stolen)
+    ha.lease_lost / ha.lease_expired / ha.lease_release / ha.lease_gc
+        — a renewal losing its CAS; a peer observed dead by TTL; a
+          graceful departure; long-dead lease reaping
+    ha.member_join / ha.member_lost / ha.epoch_bump
+        — membership-view changes (each member counts its OWN view, so N
+          survivors observing one death add N to member_lost)
+    ha.shard_adopt / ha.shard_adopt_pods
+        — failover rebalances and how many orphaned pending pods the
+          adopting engine re-admitted
 """
 
 from __future__ import annotations

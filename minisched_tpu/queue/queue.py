@@ -262,18 +262,33 @@ class SchedulingQueue:
 
     def delete(self, pod) -> None:
         """Pod removed from the cluster — drop it everywhere
-        (queue.go:113-116's panic)."""
+        (queue.go:113-116's panic).  One implementation: delete_many."""
+        self.delete_many([pod])
+
+    def delete_many(self, pods) -> None:
+        """Batch delete under ONE lock hold, with a set-intersection fast
+        path for pods not queued at all.  The HA event handlers route
+        every bound-elsewhere / shard-moved-away MODIFIED through here —
+        in a single-engine plane that is EVERY bind event (a wave's
+        thousands), and per-event delete() would rescan the queue each
+        time to remove nothing."""
         with self._cond:
-            uid = self._uid(pod)
+            uids = {self._uid(p) for p in pods} & self._queued_uids
+            if not uids:
+                return
             self._active = deque(
-                q for q in self._active if self._uid(q.pod) != uid
+                q for q in self._active if self._uid(q.pod) not in uids
             )
-            self._backoff = [e for e in self._backoff if self._uid(e[2].pod) != uid]
+            self._backoff = [
+                e for e in self._backoff if self._uid(e[2].pod) not in uids
+            ]
             heapq.heapify(self._backoff)
-            key = self._key(pod)
-            if self._unschedulable.pop(key, None) is not None:
-                self._unindex_unschedulable(key)
-            self._queued_uids.discard(uid)
+            for pod in pods:
+                if self._uid(pod) in uids:
+                    key = self._key(pod)
+                    if self._unschedulable.pop(key, None) is not None:
+                        self._unindex_unschedulable(key)
+            self._queued_uids -= uids
 
     # -- event-driven requeue ---------------------------------------------
     def note_move_request(self, event: Optional[ClusterEvent] = None) -> None:

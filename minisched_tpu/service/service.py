@@ -34,6 +34,7 @@ class SchedulerService:
         self._device_mode = False
         self._max_wave = 1024
         self._device_mesh = None
+        self._shard_filter = None
 
     # scheduler/scheduler.go:50-80
     def start_scheduler(
@@ -47,6 +48,7 @@ class SchedulerService:
         metrics=None,
         prewarm: bool = False,
         prewarm_scan: bool = True,
+        shard_filter=None,
     ) -> Scheduler:
         """``record_results=True`` swaps plugins for their simulator-wrapped
         versions and flushes per-decision results onto pod annotations —
@@ -60,6 +62,13 @@ class SchedulerService:
         conflict-repairing mode.  ``device_mesh``: a jax.sharding.Mesh —
         waves then evaluate SHARDED across the mesh (pod rows data-
         parallel, node columns model-parallel; parallel/sharding.py).
+
+        ``shard_filter``: HA queue-admission predicate (pod → bool; see
+        ha/membership.Membership.owns_pod) — installed on the engine
+        BEFORE the informers start, so even the initial snapshot replay
+        admits only this engine's shard.  N services with complementary
+        filters run active-active against one control plane (ha/plane.py
+        wires the whole participant).
         """
         if self._scheduler is not None:
             raise RuntimeError("scheduler already running; use restart_scheduler")
@@ -100,6 +109,9 @@ class SchedulerService:
                 sched.result_store = self.result_store
         else:
             sched = build_scheduler_from_config(self._client, self._factory, cfg)
+        # before factory.start(): the initial replay must already be
+        # shard-filtered or a rebalance-sized purge follows immediately
+        sched.shard_filter = shard_filter
         self.recorder.eventf(None, "Normal", "SchedulerStarted", "scheduler starting")
         self._factory.start()
         # generous timeout: over-the-wire informers (controlplane/remote.py)
@@ -143,6 +155,7 @@ class SchedulerService:
         self._device_mode = device_mode
         self._max_wave = max_wave
         self._device_mesh = device_mesh
+        self._shard_filter = shard_filter
         return sched
 
     # scheduler/scheduler.go:40-47
@@ -154,6 +167,7 @@ class SchedulerService:
             device_mode=self._device_mode,
             max_wave=self._max_wave,
             device_mesh=self._device_mesh,
+            shard_filter=self._shard_filter,
         )
 
     # scheduler/scheduler.go:82-87

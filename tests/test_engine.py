@@ -213,6 +213,109 @@ class TestEngineEndToEnd:
             sched.stop()
 
 
+class TestScalarBindPrecondition:
+    """ROADMAP crumb closed: the scalar engine's single-bind path stamps
+    ``expected_rv`` (the device wave path already did) — a pod whose spec
+    changed between evaluation and commit must re-evaluate, not land on
+    stale requirements."""
+
+    def _stack(self):
+        client = Client()
+        factory = SharedInformerFactory(client.store)
+        sched = new_scheduler(client, factory)  # never run(): bind direct
+        client.nodes().create(make_node("node0"))
+        return client, sched
+
+    def test_conflict_injection_rejects_stale_bind(self):
+        client, sched = self._stack()
+        client.pods().create(make_pod("p1"))
+        evaluated = client.pods().get("p1")  # the rv the decision saw
+        # concurrent writer (another engine, an annotation flush) bumps
+        # the rv between evaluation and commit
+        client.pods().mutate("p1", lambda p: p)
+        from minisched_tpu.controlplane.store import Conflict
+
+        with pytest.raises(Conflict):
+            sched.bind(evaluated, "node0")
+        assert client.pods().get("p1").spec.node_name == ""
+        # re-evaluated (fresh read) the bind commits
+        sched.bind(client.pods().get("p1"), "node0")
+        assert client.pods().get("p1").spec.node_name == "node0"
+
+    def test_unstamped_pod_still_binds(self):
+        # a pod object that never came off the store (rv 0) falls back to
+        # the unset-node_name guard alone, like before the stamp
+        client, sched = self._stack()
+        client.pods().create(make_pod("p2"))
+        pod = make_pod("p2")  # local object, resource_version 0
+        sched.bind(pod, "node0")
+        assert client.pods().get("p2").spec.node_name == "node0"
+
+    def test_conflict_while_in_flight_refreshes_not_livelocks(self):
+        """The MODIFIED that staled our copy arrived while the pod was
+        in-flight (invisible to queue.update) — the binding cycle must
+        refresh the queued copy from the informer cache so the RETRY
+        carries the current rv, instead of re-parking the stale one and
+        conflicting forever."""
+        from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+
+        client = Client()
+        factory = SharedInformerFactory(client.store)
+        sched = new_scheduler(client, factory)
+        client.nodes().create(make_node("node0"))
+        client.pods().create(make_pod("p3"))
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        stale = client.pods().get("p3")
+        client.pods().mutate("p3", lambda p: p)  # rv bump while in-flight
+        assert wait_until(
+            lambda: factory.informer_for("Pod")
+            .get("default/p3")
+            .metadata.resource_version
+            > stale.metadata.resource_version
+        )
+        qpi = QueuedPodInfo(PodInfo(stale))
+        sched._binding_cycle(qpi, stale, "node0")  # Conflict inside
+        assert client.pods().get("p3").spec.node_name == ""
+        # the re-parked copy was REFRESHED: the retry must commit
+        assert (
+            qpi.pod.metadata.resource_version
+            > stale.metadata.resource_version
+        )
+        sched._binding_cycle(qpi, qpi.pod, "node0")
+        assert client.pods().get("p3").spec.node_name == "node0"
+        factory.shutdown()
+
+    def test_peer_bound_pod_is_dropped_not_requeued(self):
+        """AlreadyBound from a peer engine's bind: once the informer
+        cache shows the pod bound, the loser drops it — requeueing would
+        retry (and re-conflict) forever."""
+        from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+
+        client = Client()
+        factory = SharedInformerFactory(client.store)
+        sched = new_scheduler(client, factory)
+        client.nodes().create(make_node("node0"))
+        client.nodes().create(make_node("node1"))
+        client.pods().create(make_pod("p4"))
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        ours = client.pods().get("p4")
+        # the "peer" wins the race
+        sched.bind(client.pods().get("p4"), "node1")
+        assert wait_until(
+            lambda: (
+                factory.informer_for("Pod").get("default/p4") or ours
+            ).spec.node_name
+            == "node1"
+        )
+        qpi = QueuedPodInfo(PodInfo(ours))
+        sched._binding_cycle(qpi, ours, "node0")  # AlreadyBound inside
+        assert sched.queue.stats()["unschedulable"] == 0  # dropped
+        assert client.pods().get("p4").spec.node_name == "node1"
+        factory.shutdown()
+
+
 class TestScenario:
     def test_readme_scenario(self):
         with ScenarioHarness(default_scheduler_config(time_scale=0.05)) as h:

@@ -1,0 +1,242 @@
+"""HA chaos: concurrent engines, real SIGKILLs, exactly-once binds.
+
+The ISSUE-3 acceptance scenario — and the conversion of the double-bind
+audit from "one writer never conflicts" into a real CONCURRENT-writer
+proof: three scheduler engines run as separate OS processes against one
+control plane (REST façade over a WAL store), each admitting only its
+rendezvous shard.  One engine is SIGKILLed mid-run (no lease release, no
+queue drain); the survivors must observe the expiry through the watch
+path, bump their epochs within the lease TTL, adopt the orphaned shard,
+and finish the workload — with the WAL's FULL history showing every pod
+bound exactly once and no node over allocatable.
+
+The tier-1 smoke does ONE kill at small scale; the soak (slow) adds a
+control-plane SIGKILL/restart (faults/proc.ServerSupervisor) and a
+second engine kill — ≥3 process deaths in one run.  The kill schedule is
+a pure function of MINISCHED_CHAOS_SEED, so a failure reproduces.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.faults import wal_double_binds
+from minisched_tpu.faults.proc import ServerSupervisor
+from minisched_tpu.ha.lease import HA_NAMESPACE
+from minisched_tpu.ha.proc import EngineSupervisor
+from test_chaos_soak import _audit_capacity
+
+SEED = int(os.environ.get("MINISCHED_CHAOS_SEED", "1234"))
+
+
+def _boot_cluster(client, n_nodes: int, pods) -> None:
+    client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:03d}",
+                capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
+            )
+            for i in range(n_nodes)
+        ]
+    )
+    client.pods().create_many(pods)
+
+
+def _make_pods(prefix: str, n: int):
+    return [
+        make_pod(f"{prefix}{i:04d}", requests={"cpu": "500m", "memory": "64Mi"})
+        for i in range(n)
+    ]
+
+
+def _bound_count(client) -> int:
+    try:
+        return sum(1 for p in client.pods().list() if p.spec.node_name)
+    except Exception:
+        return -1  # plane down mid-poll: caller retries
+
+
+def _wait_bound(client, want: int, deadline_s: float) -> int:
+    deadline = time.monotonic() + deadline_s
+    bound = 0
+    while time.monotonic() < deadline:
+        n = _bound_count(client)
+        bound = max(bound, n)
+        if n >= want:
+            return n
+        time.sleep(0.2)
+    return bound
+
+
+def _member_leases(client) -> dict:
+    """holder → lease for the HA coordination namespace (may raise while
+    the plane is down — callers poll)."""
+    return {
+        l.spec.holder: l
+        for l in client.store.list("Lease")
+        if l.metadata.namespace == HA_NAMESPACE
+    }
+
+
+def _wait_adoption(client, survivors, pre_epochs, deadline_s: float):
+    """Seconds until every survivor's PUBLISHED epoch moved past its
+    pre-kill value AND the live member set equals ``survivors`` — the
+    observable form of 'the orphaned shard was adopted' (epochs gossip
+    through lease renewals).  None on timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            leases = _member_leases(client)
+        except Exception:
+            time.sleep(0.05)
+            continue
+        now = time.time()
+        live = {h for h, l in leases.items() if not l.expired(now)}
+        if live == set(survivors) and all(
+            leases[h].spec.epoch > pre_epochs.get(h, 0) for h in survivors
+        ):
+            return time.monotonic() - t0
+        time.sleep(0.05)
+    return None
+
+
+def test_ha_engine_kill_smoke(tmp_path):
+    """Tier-1: 3 engines over one WAL-backed control plane, one SIGKILL
+    mid-run — exactly-once binds, TTL-bounded adoption, capacity audit."""
+    wal = str(tmp_path / "ha.wal")
+    store = DurableObjectStore(wal, archive_compacted=True)
+    _server, base, shutdown = start_api_server(store)
+    client = RemoteClient(base, retries=8, backoff_initial_s=0.05)
+    ttl = 2.0
+    n_nodes, first, second = 8, 60, 30
+    _boot_cluster(client, n_nodes, _make_pods("hp", first))
+    engines = [
+        EngineSupervisor(base, f"engine-{i}", ttl_s=ttl) for i in range(3)
+    ]
+    try:
+        for e in engines:
+            e.start()
+        # all three shards must be producing: wait for the first burst
+        assert _wait_bound(client, first, 90.0) == first, (
+            "3-engine plane never bound the first burst"
+        )
+
+        # seed-pinned victim; record the survivors' published epochs
+        victim = SEED % len(engines)
+        survivors = [
+            e.engine_id for i, e in enumerate(engines) if i != victim
+        ]
+        pre = {
+            h: l.spec.epoch for h, l in _member_leases(client).items()
+        }
+        engines[victim].kill()
+        assert engines[victim].kills == 1
+        # the orphaned shard's pods keep arriving AFTER the death
+        client.pods().create_many(_make_pods("hq", second))
+
+        adopt_s = _wait_adoption(
+            client, survivors, pre, deadline_s=ttl + ttl / 3.0 + 2.0
+        )
+        assert adopt_s is not None, "survivors never adopted the shard"
+        # rebalance bounded by the lease TTL (+ one heartbeat tick and
+        # scheduling margin): expiry ≤ kill + ttl, detection ≤ +ttl/3
+        assert adopt_s <= ttl + ttl / 3.0 + 1.5, adopt_s
+
+        want = first + second
+        assert _wait_bound(client, want, 120.0) == want, (
+            "orphaned shard's pods never landed after adoption"
+        )
+        bound = [p for p in client.pods().list() if p.spec.node_name]
+        _audit_capacity(client, bound, 500, 8000)
+    finally:
+        for e in engines:
+            e.stop()
+        shutdown()
+        store.close()
+    # zero lost or duplicated binds, across the FULL archived history
+    assert wal_double_binds(wal) == []
+    re = DurableObjectStore(wal)
+    try:
+        assert (
+            sum(1 for p in re.list("Pod") if p.spec.node_name)
+            == first + second
+        )
+    finally:
+        re.close()
+
+
+@pytest.mark.slow
+def test_ha_soak_engine_and_plane_kills(tmp_path):
+    """The acceptance soak, ≥3 process deaths: engine SIGKILL → control
+    plane SIGKILL/restart (ServerSupervisor, WAL recovery under the
+    surviving engines) → second engine SIGKILL, leaving ONE engine to
+    adopt everything — then converge and run the full audits."""
+    wal = str(tmp_path / "ha-soak.wal")
+    sup = ServerSupervisor(wal, compact_every_s=0.5, archive_history=True)
+    base = sup.start()
+    client = RemoteClient(base, retries=10, backoff_initial_s=0.05)
+    ttl = 2.5
+    n_nodes, n_pods = 16, 180
+    pods = _make_pods("sp", n_pods)
+    _boot_cluster(client, n_nodes, pods[:120])
+    engines = [
+        EngineSupervisor(base, f"engine-{i}", ttl_s=ttl) for i in range(3)
+    ]
+    kills = 0
+    try:
+        for e in engines:
+            e.start()
+        assert _wait_bound(client, 120, 120.0) == 120
+
+        # kill #1: an engine (seed-pinned), plus fresh load for its shard
+        order = [SEED % 3, (SEED + 1) % 3]
+        engines[order[0]].kill()
+        kills += 1
+        client.pods().create_many(pods[120:150])
+        assert _wait_bound(client, 150, 120.0) == 150
+
+        # kill #2: the CONTROL PLANE — WAL recovery while two sharded
+        # engines retry/reconnect against the same port
+        sup.kill_and_restart()
+        kills += 1
+        client.pods().create_many(pods[150:])
+
+        # kill #3: a second engine; the last one adopts every shard
+        engines[order[1]].kill()
+        kills += 1
+        assert _wait_bound(client, n_pods, 240.0) == n_pods, (
+            "single survivor never converged the full workload"
+        )
+        bound = [p for p in client.pods().list() if p.spec.node_name]
+        _audit_capacity(client, bound, 500, 8000)
+        # exactly one engine should still hold a live lease at quiesce
+        deadline = time.monotonic() + 3 * ttl
+        live = set()
+        while time.monotonic() < deadline:
+            leases = _member_leases(client)
+            live = {
+                h for h, l in leases.items() if not l.expired(time.time())
+            }
+            if len(live) == 1:
+                break
+            time.sleep(0.2)
+        assert len(live) == 1, live
+    finally:
+        for e in engines:
+            e.stop()
+        sup.stop()
+    assert kills >= 3
+    assert wal_double_binds(wal) == []
+    re = DurableObjectStore(wal)
+    try:
+        assert sum(1 for p in re.list("Pod") if p.spec.node_name) == n_pods
+    finally:
+        re.close()

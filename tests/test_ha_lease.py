@@ -1,0 +1,173 @@
+"""Lease kind + CAS protocol: acquisition, renewal, takeover arbitration.
+
+The HA plane's whole safety story reduces to one primitive: every lease
+write is an ``expected_rv`` compare-and-swap, so two contenders racing
+for the same lease resolve exactly one winner (the loser's PUT gets the
+409/Conflict).  These tests pin that arbitration over the in-process
+store AND over the wire (RemoteStore against the REST façade — same
+LeaseManager code, same outcomes).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from minisched_tpu.api.objects import Lease
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.ha.lease import HA_NAMESPACE, LeaseLost, LeaseManager
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mgr(clock, client=None):
+    return LeaseManager(client or Client(ObjectStore()), clock=clock)
+
+
+def test_acquire_fresh_then_peer_blocked_until_expiry():
+    clock = FakeClock()
+    client = Client(ObjectStore())
+    a = LeaseManager(client, clock=clock)
+    b = LeaseManager(client, clock=clock)
+    got = a.acquire("lock", "alice", ttl_s=5.0)
+    assert got is not None and got.spec.holder == "alice"
+    # a live lease is not stealable
+    assert b.acquire("lock", "bob", ttl_s=5.0) is None
+    # ... until it expires; the takeover bumps transitions
+    clock.advance(5.1)
+    taken = b.acquire("lock", "bob", ttl_s=5.0)
+    assert taken is not None and taken.spec.holder == "bob"
+    assert taken.spec.transitions == 1
+
+
+def test_takeover_race_is_409_arbitrated():
+    """Two survivors race for an expired lease: the second CAS hits the
+    rv the first one bumped and loses — never a silent double-acquire."""
+    clock = FakeClock()
+    client = Client(ObjectStore())
+    a = LeaseManager(client, clock=clock)
+    b = LeaseManager(client, clock=clock)
+    assert a.acquire("lock", "dead", ttl_s=1.0) is not None
+    clock.advance(2.0)
+    # simulate the race: both read the expired lease at the same rv, then
+    # write in turn — exactly what two concurrent takeovers do
+    stale = b.get("lock")
+    won = a.acquire("lock", "alice", ttl_s=5.0)
+    assert won is not None
+    # b's CAS carries the pre-takeover rv: must lose
+    from minisched_tpu.controlplane.store import Conflict
+
+    stale.spec.holder = "bob"
+    with pytest.raises(Conflict):
+        client.store.update(
+            "Lease", stale, expected_rv=stale.metadata.resource_version
+        )
+    # and the polite-path API reports the loss as None, not an exception
+    assert b.acquire("lock", "bob", ttl_s=5.0) is None
+    assert a.get("lock").spec.holder == "alice"
+
+
+def test_renew_extends_and_publishes_epoch():
+    clock = FakeClock()
+    mgr = _mgr(clock)
+    lease = mgr.acquire("lock", "alice", ttl_s=2.0)
+    clock.advance(1.5)
+    lease = mgr.renew(lease, epoch=7)
+    assert lease.spec.renew_time == clock()
+    assert lease.spec.epoch == 7
+    clock.advance(1.9)  # 3.4 since acquire, 1.9 since renew: still live
+    assert not mgr.get("lock").expired(clock())
+
+
+def test_renew_after_takeover_raises_lease_lost():
+    clock = FakeClock()
+    client = Client(ObjectStore())
+    a = LeaseManager(client, clock=clock)
+    b = LeaseManager(client, clock=clock)
+    mine = a.acquire("lock", "alice", ttl_s=1.0)
+    clock.advance(2.0)
+    assert b.acquire("lock", "bob", ttl_s=5.0) is not None
+    with pytest.raises(LeaseLost):
+        a.renew(mine)
+    # the loser can re-acquire only once bob expires
+    assert a.acquire("lock", "alice", ttl_s=1.0) is None
+
+
+def test_renew_conflict_with_own_lost_write_self_heals():
+    """A renewal whose response was lost (remote client replays the PUT)
+    conflicts with OUR OWN newer rv — renew must re-read, see the holder
+    is still us, and retry instead of declaring the lease lost."""
+    clock = FakeClock()
+    client = Client(ObjectStore())
+    mgr = LeaseManager(client, clock=clock)
+    lease = mgr.acquire("lock", "alice", ttl_s=5.0)
+    # our own write landed but the caller's handle is stale
+    stale = lease.clone()
+    stale.metadata.resource_version = lease.metadata.resource_version
+    mgr.renew(lease)  # rv moves on
+    out = mgr.renew(stale, epoch=3)  # stale handle: conflicts, self-heals
+    assert out.spec.holder == "alice" and out.spec.epoch == 3
+
+
+def test_release_only_by_holder_and_gc_reaps_long_dead(tmp_path):
+    clock = FakeClock()
+    client = Client(ObjectStore())
+    a = LeaseManager(client, clock=clock)
+    b = LeaseManager(client, clock=clock)
+    a.acquire("lock", "alice", ttl_s=1.0)
+    assert not b.release("lock", "bob")  # not yours
+    assert a.get("lock") is not None
+    # long-dead leases get garbage-collected by any survivor
+    clock.advance(100.0)
+    assert b.gc_expired(grace_factor=10.0) == 1
+    assert a.get("lock") is None
+    # graceful release deletes immediately
+    a.acquire("lock2", "alice", ttl_s=1.0)
+    assert a.release("lock2", "alice")
+    assert a.get("lock2") is None
+
+
+def test_lease_cas_over_the_wire(tmp_path):
+    """Same protocol through the REST façade: create → 409-arbitrated
+    takeover → renewal — and the Lease kind is WAL-durable, so a
+    recovered control plane replays it (already expired by wall clock)."""
+    wal = str(tmp_path / "lease.wal")
+    store = DurableObjectStore(wal)
+    _server, base, shutdown = start_api_server(store)
+    try:
+        clock = FakeClock()
+        a = LeaseManager(RemoteClient(base), clock=clock)
+        b = LeaseManager(RemoteClient(base), clock=clock)
+        got = a.acquire("wire-lock", "alice", ttl_s=5.0)
+        assert got is not None
+        assert b.acquire("wire-lock", "bob", ttl_s=5.0) is None
+        got = a.renew(got, epoch=2)
+        assert got.spec.epoch == 2
+        clock.advance(6.0)
+        taken = b.acquire("wire-lock", "bob", ttl_s=5.0)
+        assert taken is not None and taken.spec.transitions == 1
+    finally:
+        shutdown()
+        store.close()
+    # durability: the reopened WAL carries the lease with bob's takeover
+    re = DurableObjectStore(wal)
+    try:
+        leases = [
+            l for l in re.list("Lease") if isinstance(l, Lease)
+            and l.metadata.namespace == HA_NAMESPACE
+        ]
+        assert len(leases) == 1 and leases[0].spec.holder == "bob"
+    finally:
+        re.close()

@@ -1,0 +1,149 @@
+"""Membership + shard map: determinism, minimal churn, epochs, failover.
+
+The shard map must be a PURE function of the member set — two engines
+that agree on who is alive agree on every pod's owner with no
+coordination round (asserted here across separate OS processes) — and
+rendezvous hashing makes failover minimal-churn by construction: losing
+one member reassigns exactly that member's pods.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+from minisched_tpu.api.objects import make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.store import ObjectStore
+from minisched_tpu.ha.membership import Membership, shard_owner
+from minisched_tpu.observability import counters
+
+MEMBERS = ("engine-a", "engine-b", "engine-c")
+UIDS = [f"pod-{i:08d}" for i in range(2000)]
+
+
+def test_shard_map_deterministic_and_total():
+    first = [shard_owner(u, MEMBERS) for u in UIDS]
+    second = [shard_owner(u, MEMBERS) for u in UIDS]
+    assert first == second
+    assert set(first) == set(MEMBERS)  # every member gets work
+    # reasonably balanced: no member owns more than ~2× its fair share
+    for m in MEMBERS:
+        assert first.count(m) < 2 * len(UIDS) / len(MEMBERS)
+
+
+def test_shard_map_identical_across_processes():
+    """Same members + same uids ⇒ identical assignment computed in a
+    SEPARATE interpreter — the property that lets N engines partition
+    the keyspace with zero coordination."""
+    script = (
+        "import json, sys; "
+        "from minisched_tpu.ha.membership import shard_owner; "
+        "members, uids = json.loads(sys.argv[1]); "
+        "print(json.dumps([shard_owner(u, members) for u in uids]))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script, json.dumps([MEMBERS, UIDS[:500]])],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    theirs = json.loads(out.stdout)
+    ours = [shard_owner(u, MEMBERS) for u in UIDS[:500]]
+    assert theirs == ours
+
+
+def test_single_member_loss_moves_only_the_orphaned_shard():
+    before = {u: shard_owner(u, MEMBERS) for u in UIDS}
+    survivors = ("engine-a", "engine-c")
+    after = {u: shard_owner(u, survivors) for u in UIDS}
+    for u in UIDS:
+        if before[u] != "engine-b":
+            # survivors' pods NEVER move (their per-member scores are
+            # unchanged — the rendezvous property)
+            assert after[u] == before[u], u
+        else:
+            assert after[u] in survivors
+    # and a member JOINING steals only what it now wins
+    rejoined = {u: shard_owner(u, MEMBERS) for u in UIDS}
+    assert rejoined == before
+
+
+def test_membership_epochs_and_expiry_failover():
+    """Two members over one store: mutual visibility, then one crashes
+    (heartbeat stops, lease abandoned) — the survivor times the lease
+    out, bumps its epoch, and reports the loss; counters flow."""
+    store = ObjectStore()
+    counters.reset()
+    m1 = Membership(Client(store), "m1", ttl_s=0.6)
+    m2 = Membership(Client(store), "m2", ttl_s=0.6)
+    changes = []
+    m1.on_change.append(
+        lambda epoch, members, joined, lost: changes.append(
+            (epoch, members, set(joined), set(lost))
+        )
+    )
+    m1.join()
+    m2.join()
+    m1.start()
+    m2.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if m1.members() == ("m1", "m2") and m2.members() == ("m1", "m2"):
+            break
+        time.sleep(0.02)
+    assert m1.members() == ("m1", "m2") == m2.members()
+    epoch_before = m1.epoch
+    # ownership is complementary and total while both live
+    pods = [make_pod(f"p{i}") for i in range(50)]
+    for p in pods:
+        p.metadata.uid = f"uid-{p.metadata.name}"
+    owned1 = {p.metadata.name for p in pods if m1.owns_pod(p)}
+    owned2 = {p.metadata.name for p in pods if m2.owns_pod(p)}
+    assert owned1 | owned2 == {p.metadata.name for p in pods}
+    assert not (owned1 & owned2)
+
+    m2.stop(release=False)  # crash: no release — expiry must do the work
+    t0 = time.monotonic()
+    deadline = t0 + 5.0
+    while time.monotonic() < deadline:
+        if m1.members() == ("m1",):
+            break
+        time.sleep(0.02)
+    detect_s = time.monotonic() - t0
+    assert m1.members() == ("m1",)
+    # detection is bounded by TTL + one heartbeat tick (+ margin)
+    assert detect_s <= m2.ttl_s + m1.ttl_s / 3.0 + 1.0, detect_s
+    assert m1.epoch > epoch_before
+    assert any("m2" in lost for _e, _m, _j, lost in changes)
+    # the crashed member's whole shard now belongs to the survivor
+    assert all(m1.owns_pod(p) for p in pods)
+    snap = counters.snapshot()
+    assert snap.get("ha.epoch_bump", 0) >= 2
+    assert snap.get("ha.member_lost", 0) >= 1
+    assert snap.get("ha.lease_expired", 0) >= 1
+    assert snap.get("ha.lease_renew", 0) >= 1
+    m1.stop()
+
+
+def test_graceful_release_rebalances_without_waiting_out_ttl():
+    store = ObjectStore()
+    m1 = Membership(Client(store), "m1", ttl_s=5.0)
+    m2 = Membership(Client(store), "m2", ttl_s=5.0)
+    m1.join()
+    m2.join()
+    m1.start()
+    m2.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and m1.members() != ("m1", "m2"):
+        time.sleep(0.02)
+    t0 = time.monotonic()
+    m2.stop(release=True)  # graceful: lease DELETED
+    deadline = t0 + 4.0  # far below the 5s TTL
+    while time.monotonic() < deadline and m1.members() != ("m1",):
+        time.sleep(0.02)
+    assert m1.members() == ("m1",)
+    assert time.monotonic() - t0 < 4.0
+    m1.stop()

@@ -1,0 +1,114 @@
+"""Byte-budgeted watch-resume history ring (ROADMAP crumb closed).
+
+The ring was bounded only by event COUNT (65536/kind): headline-sized
+pods (multi-KB of containers/labels/affinity each) could pin hundreds of
+MB of history.  Now a per-kind BYTE budget evicts too — whichever cap
+trips first — and eviction keeps the exact 410-Gone + relist semantics:
+the floor advances to the dropped event's rv, resumes from below it get
+HistoryCompacted, resumes inside the retained tail still replay.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.controlplane.informer import SharedInformerFactory
+from minisched_tpu.controlplane.store import (
+    HistoryCompacted,
+    ObjectStore,
+    approx_obj_bytes,
+)
+
+
+def _fat_pod(i: int):
+    """A pod whose estimated footprint is dominated by labels (cheap to
+    build, a few KB by the estimator)."""
+    return make_pod(
+        f"fat{i:04d}",
+        requests={"cpu": "500m", "memory": "64Mi"},
+        labels={f"label-key-{k}": "v" * 64 for k in range(20)},
+    )
+
+
+def test_estimator_scales_with_object_size():
+    small = make_pod("small")
+    fat = _fat_pod(0)
+    # ~2.4KB of label payload must show up in the estimate
+    assert approx_obj_bytes(fat) > approx_obj_bytes(small) + 2000
+    # memoized on the spec: a second call is the cached walk
+    assert approx_obj_bytes(fat) == approx_obj_bytes(fat)
+
+
+def test_byte_cap_evicts_and_advances_floor():
+    store = ObjectStore(history_events=10_000, history_bytes=64 * 1024)
+    client = Client(store)
+    for i in range(100):
+        client.pods().create(_fat_pod(i))
+    stats = store.history_stats("Pod")
+    # the ring held far fewer than the count cap allows, and stayed
+    # within the byte budget
+    assert stats["events"] < 100
+    assert stats["bytes"] <= 64 * 1024
+    assert store._floor_for("Pod") > 0  # evictions advanced the floor
+
+    # a resume from before the floor must 410
+    with pytest.raises(HistoryCompacted):
+        store.watch("Pod", resume_rv=1)
+    # a resume inside the retained tail replays it
+    floor = store._floor_for("Pod")
+    w, snapshot = store.watch("Pod", resume_rv=floor)
+    assert snapshot == []
+    replayed = w.next_batch(timeout=1.0)
+    assert replayed and all(ev.rv > floor for ev in replayed)
+    w.stop()
+
+
+def test_count_cap_still_applies():
+    store = ObjectStore(history_events=8, history_bytes=1 << 30)
+    client = Client(store)
+    for i in range(20):
+        client.pods().create(make_pod(f"p{i}"))
+    assert store.history_stats("Pod")["events"] <= 8
+
+
+def test_per_kind_isolation():
+    """A fat-pod churn burst must not evict another kind's tail."""
+    store = ObjectStore(history_events=10_000, history_bytes=32 * 1024)
+    client = Client(store)
+    client.nodes().create(make_node("n0"))
+    node_rv = store.resource_version
+    for i in range(100):
+        client.pods().create(_fat_pod(i))
+    assert store._floor_for("Pod") > 0
+    assert store._floor_for("Node") == 0
+    w, _ = store.watch("Node", resume_rv=node_rv)  # still resumable
+    w.stop()
+
+
+def test_informer_relists_past_byte_compaction():
+    """End to end: an informer that lost its stream while the byte budget
+    compacted the gap away must fall back to the full relist (410 path)
+    and converge — the same behavior count overflow always had."""
+    store = ObjectStore(history_events=10_000, history_bytes=32 * 1024)
+    client = Client(store)
+    factory = SharedInformerFactory(store)
+    inf = factory.informer_for("Pod")
+    factory.start()
+    assert inf.wait_for_cache_sync(5.0)
+    # kill the live stream, then churn enough bytes that the resume
+    # cursor's tail is compacted away before the reconnect lands
+    inf._watch.kill()
+    for i in range(100):
+        client.pods().create(_fat_pod(i))
+    import time
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if len(inf.lister()) == 100:
+            break
+        time.sleep(0.05)
+    assert len(inf.lister()) == 100
+    assert inf.reconnects >= 1
+    factory.shutdown()
