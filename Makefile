@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench chaos chaos-proc chaos-ha docker clean
+.PHONY: test native start serve bench bench-wave chaos chaos-proc chaos-ha docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -12,10 +12,19 @@ test: native
 # chaos soak under a FIXED fault-schedule seed: the fabric's injection
 # decisions are a pure function of (seed, point, key, ordinal), so a
 # failure here reproduces byte-for-byte — override the seed with
-# MINISCHED_CHAOS_SEED=<n> to explore other schedules
+# MINISCHED_CHAOS_SEED=<n> to explore other schedules.  Runs with the
+# wave PIPELINE explicitly on (its default): fault-injection and the
+# overlapped build/evaluate stages must compose — a regression that only
+# reproduces serially would otherwise hide behind the kill-switch
 chaos: native
-	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} MINISCHED_PIPELINE=1 \
 		python -m pytest tests/test_chaos_soak.py tests/test_faults.py -q
+
+# pipelined-wave micro-bench (CPU): two laps of the live full-roster
+# wave engine; FAILS when the loop thread's stall time reaches the build
+# time (the pipeline has regressed to serial) or any audit trips
+bench-wave: native
+	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only wave
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

@@ -35,6 +35,57 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def bench_skip(reason: str) -> None:
+    """Abort THIS role as 'skipped' rather than failed: the child prints
+    a ``{"skipped": reason}`` record and exits 0, so the merged artifact
+    distinguishes 'this environment can't run the role' (e.g. requires a
+    real TPU) from a real regression — the ROADMAP's re-earn tracking
+    needs that difference to be visible in BENCH_r06+."""
+    raise SystemExit(f"BENCH_SKIP: {reason}")
+
+
+#: stderr patterns that mean "this role needs capabilities the current
+#: device doesn't have", not "the code is broken".  Only consulted in
+#: the FAILING traceback region of the tail (see _skip_reason) — a
+#: benign startup warning elsewhere in the tail must never convert a
+#: real failure into a skip.
+_TPU_GAP_PATTERNS = (
+    r"(?P<reason>Mosaic[^\n]*(?:not supported|unsupported|requires[^\n]*TPU))",
+    r"(?P<reason>Pallas[^\n]*(?:not supported|unsupported|only[^\n]*TPU))",
+)
+
+
+def _skip_reason(stderr_tail: str) -> str:
+    """Non-empty reason when the failure tail says 'requires TPU' (or a
+    role opted out via bench_skip); '' for real failures.  The explicit
+    BENCH_SKIP marker matches anywhere; the fuzzy capability patterns
+    only match inside the last traceback — the part that actually
+    explains the nonzero exit."""
+    import re
+
+    m = re.search(r"BENCH_SKIP:\s*(?P<reason>.+)", stderr_tail)
+    if m:
+        return m.group("reason").strip()
+    idx = stderr_tail.rfind("Traceback (most recent call last)")
+    if idx < 0:
+        return ""
+    region = stderr_tail[idx:]
+    for pat in _TPU_GAP_PATTERNS:
+        m = re.search(pat, region)
+        if m:
+            return m.group("reason").strip()
+    return ""
+
+
+class BenchChildError(RuntimeError):
+    """A child role failed; carries its stderr tail so the merged record
+    (and a human reading it) sees WHY, not just ``rc=1``."""
+
+    def __init__(self, msg: str, stderr_tail: str = ""):
+        super().__init__(msg)
+        self.stderr_tail = stderr_tail
+
+
 def _mk_cluster(n_nodes: int, n_pods: int, seed: int = 1234, unsched: float = 0.2):
     from minisched_tpu.api.objects import make_node, make_pod
 
@@ -325,6 +376,7 @@ def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int,
 
     rng = random.Random(55)
     normal_nodes = []
+    nodes = []
     for i in range(n_nodes):
         node = make_node(
             f"node{i:05d}",
@@ -332,13 +384,19 @@ def _c5_cluster(client, n_nodes: int, n_pods: int, n_special: int,
             capacity={"cpu": "8", "memory": "16Gi", "pods": 110},
             labels={"zone": f"z{i % 16}"},
         )
-        client.nodes().create(node)
+        nodes.append(node)
         if not node.spec.unschedulable:
             normal_nodes.append(node.metadata.name)
-    for i in range(n_pods - n_special - n_crosspod):
-        client.pods().create(
+    # batched seed: one store transaction per batch (create() per object
+    # paid a lock round-trip + per-watcher fanout each)
+    client.nodes().create_many(nodes, return_objects=False)
+    client.pods().create_many(
+        [
             make_pod(f"pod{i:06d}", requests={"cpu": "500m", "memory": "256Mi"})
-        )
+            for i in range(n_pods - n_special - n_crosspod)
+        ],
+        return_objects=False,
+    )
     for i in range(n_crosspod):
         app = f"app{i % 32}"
         pod = make_pod(
@@ -401,6 +459,7 @@ def _bench_config5_fullchain_once() -> dict:
     import jax  # noqa: F401  (device warmup shares the process backend)
 
     from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.observability import counters as _counters
     from minisched_tpu.observability.profiling import CycleMetrics
     from minisched_tpu.service.config import default_full_roster_config
     from minisched_tpu.service.service import SchedulerService
@@ -667,6 +726,29 @@ def _bench_config5_fullchain_once() -> dict:
             "constraints_store_list_s": phase(
                 "constraints_store_list", "total_s"
             ),
+        },
+        # the pipelined wave engine's overlap ledger: stall is loop-thread
+        # time the device sat idle waiting for a build; overlap_ratio is
+        # the build wall hidden behind device/commit windows
+        "pipeline": {
+            "enabled": os.environ.get("MINISCHED_PIPELINE", "1")
+            not in ("", "0"),
+            "waves": _counters.get("wave_pipeline.waves"),
+            "build_total_s": phase("wave_pipeline_build", "total_s"),
+            "stall_total_s": phase("wave_pipeline_stall", "total_s"),
+            "overlap_ratio": (
+                round(
+                    1.0
+                    - phase("wave_pipeline_stall", "total_s")
+                    / phase("wave_pipeline_build", "total_s"),
+                    3,
+                )
+                if phase("wave_pipeline_build", "total_s") > 0
+                else 0.0
+            ),
+            "build_fallbacks": _counters.get("wave_pipeline.build_fallback"),
+            "rearb_requeued": _counters.get("wave_pipeline.rearb_requeued"),
+            "dirty_rows": _counters.get("wave_pipeline.dirty_rows"),
         },
     }
 
@@ -1115,7 +1197,13 @@ def bench_wire() -> dict:
             for i in range(n_nodes)
         ]
         for start in range(0, len(nodes), CHUNK):
-            client.nodes().create_many(nodes[start : start + CHUNK])
+            # return_objects=False: the server batch-creates in ONE store
+            # transaction and answers {} per item — the seed path was
+            # paying a full encode+transfer+decode per created object
+            # that this loop immediately dropped
+            client.nodes().create_many(
+                nodes[start : start + CHUNK], return_objects=False
+            )
         pods = [
             make_pod(
                 f"pod{i:06d}",
@@ -1140,7 +1228,9 @@ def bench_wire() -> dict:
             ]
             pods.append(pod)
         for start in range(0, len(pods), CHUNK):
-            client.pods().create_many(pods[start : start + CHUNK])
+            client.pods().create_many(
+                pods[start : start + CHUNK], return_objects=False
+            )
         setup_dt = time.monotonic() - t0
         log(
             f"[wire] cluster created over HTTP in {setup_dt:.1f}s "
@@ -1221,6 +1311,142 @@ def bench_wire() -> dict:
         }
     finally:
         shutdown()
+
+
+def bench_wave_pipeline() -> dict:
+    """``make bench-wave`` micro-role: two pipelined laps of the live
+    full-roster wave engine on whatever device JAX gives (CPU in CI),
+    gated on the pipeline actually OVERLAPPING: the loop thread's stall
+    (time the device sat idle waiting for a build) must stay under the
+    total build time — stall ≈ build is exactly what a regression to the
+    serial loop looks like.  Ends with the exactly-once + capacity
+    audits so 'faster' can never mean 'wrong'."""
+    import threading
+    from collections import defaultdict
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.observability import counters
+    from minisched_tpu.observability.profiling import CycleMetrics
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    if os.environ.get("MINISCHED_PIPELINE", "1") in ("", "0"):
+        bench_skip("MINISCHED_PIPELINE=0: pipeline disabled by env")
+    n_nodes = int(os.environ.get("BENCH_WAVEROLE_NODES", "512"))
+    n_pods = int(os.environ.get("BENCH_WAVEROLE_PODS", "6144"))
+    max_wave = int(os.environ.get("BENCH_WAVEROLE_WAVE", "1024"))
+    laps = max(1, int(os.environ.get("BENCH_WAVEROLE_LAPS", "2")))
+
+    client = Client()
+    client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:04d}",
+                capacity={"cpu": "64", "memory": "128Gi", "pods": 256},
+            )
+            for i in range(n_nodes)
+        ],
+        return_objects=False,
+    )
+    bound_n = 0
+    mu = threading.Lock()
+
+    def counting(pod, node_name, status):
+        nonlocal bound_n
+        if node_name:
+            with mu:
+                bound_n += 1
+
+    counters.reset()
+    metrics = CycleMetrics()
+    svc = SchedulerService(client)
+    svc.start_scheduler(
+        default_full_roster_config(), device_mode=True, max_wave=max_wave,
+        on_decision=counting, metrics=metrics,
+    )
+    t0 = time.monotonic()
+    try:
+        target = 0
+        for lap in range(laps):
+            client.pods().create_many(
+                [
+                    make_pod(
+                        f"wp{lap}-{i:05d}",
+                        requests={"cpu": "100m", "memory": "64Mi"},
+                    )
+                    for i in range(n_pods)
+                ],
+                return_objects=False,
+            )
+            target += n_pods
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                with mu:
+                    if bound_n >= target:
+                        break
+                time.sleep(0.05)
+            with mu:
+                if bound_n < target:
+                    raise SystemExit(
+                        f"[wave] lap {lap + 1}: only {bound_n}/{target} bound"
+                    )
+            log(
+                f"[wave] lap {lap + 1}/{laps}: {target} pods bound at "
+                f"{time.monotonic() - t0:.1f}s"
+            )
+        elapsed = time.monotonic() - t0
+        snap = metrics.snapshot()
+    finally:
+        svc.shutdown_scheduler()
+
+    # ---- audits: exactly-once + no node over allocatable ----------------
+    cpu = defaultdict(int)
+    cnt = defaultdict(int)
+    for p in client.pods().list():
+        if not p.spec.node_name:
+            raise SystemExit(f"[wave] pod {p.metadata.name} left unbound")
+        cpu[p.spec.node_name] += p.resource_requests().milli_cpu
+        cnt[p.spec.node_name] += 1
+    for node in client.nodes().list():
+        name = node.metadata.name
+        alloc = node.status.allocatable
+        if cpu[name] > alloc.milli_cpu or cnt[name] > alloc.pods:
+            raise SystemExit(f"[wave] NODE OVER ALLOCATABLE: {name}")
+
+    def phase(name, field):
+        return round(snap.get(name, {}).get(field, 0.0), 3)
+
+    stall_s = phase("wave_pipeline_stall", "total_s")
+    build_s = phase("wave_pipeline_build", "total_s")
+    waves = counters.get("wave_pipeline.waves")
+    if waves == 0:
+        raise SystemExit("[wave] PIPELINE NEVER ENGAGED (0 pipelined waves)")
+    if build_s > 0 and stall_s >= build_s:
+        raise SystemExit(
+            f"[wave] PIPELINE REGRESSED TO SERIAL: stall {stall_s}s >= "
+            f"build {build_s}s over {waves} waves"
+        )
+    overlap = round(1.0 - stall_s / build_s, 3) if build_s > 0 else 0.0
+    log(
+        f"[wave] {laps * n_pods} pods in {elapsed:.1f}s, {waves} pipelined "
+        f"waves: build {build_s}s, stall {stall_s}s (overlap {overlap:.0%}), "
+        f"rearb_requeued={counters.get('wave_pipeline.rearb_requeued')}"
+    )
+    return {
+        "pods": laps * n_pods,
+        "nodes": n_nodes,
+        "laps": laps,
+        "total_s": round(elapsed, 1),
+        "pods_per_sec_e2e": round(laps * n_pods / elapsed, 1),
+        "pipelined_waves": waves,
+        "build_total_s": build_s,
+        "stall_total_s": stall_s,
+        "overlap_ratio": overlap,
+        "rearb_requeued": counters.get("wave_pipeline.rearb_requeued"),
+        "build_fallbacks": counters.get("wave_pipeline.build_fallback"),
+        "dirty_rows": counters.get("wave_pipeline.dirty_rows"),
+    }
 
 
 def bench_chaos() -> dict:
@@ -1487,6 +1713,7 @@ ROLES = {
     "c5": bench_config5_fullchain,
     "fullchain_parity": bench_fullchain_parity,
     "wire": bench_wire,
+    "wave": bench_wave_pipeline,
     "chaos": bench_chaos,
     "ha": bench_ha,
     "c1": bench_config1,
@@ -1499,24 +1726,55 @@ ROLES = {
 def _run_child(role: str, extra_env: dict = None, label: str = None) -> dict:
     """One config in its own process (fresh backend; the persistent
     compile cache makes re-init cheap).  Returns the child's JSON dict.
-    ``label`` names the run in logs when one role serves two configs."""
+    ``label`` names the run in logs when one role serves two configs.
+
+    The child's stderr is TEED: streamed through live (the logs stay
+    watchable) while the last ~120 lines are retained, so a failure
+    raises BenchChildError carrying the tail — a bare ``exited rc=1``
+    told BENCH_r05 readers nothing about c3/c5x/fullchain_parity."""
+    import threading
+    from collections import deque
+
     label = label or role
     t0 = time.monotonic()
     env = dict(os.environ)
     env.update(extra_env or {})
-    proc = subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--only", role],
         stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         cwd=os.path.dirname(os.path.abspath(__file__)),
         env=env,
     )
+    tail: deque = deque(maxlen=120)
+
+    def _tee() -> None:
+        for raw in proc.stderr:
+            line = raw.decode(errors="replace")
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            tail.append(line)
+
+    tee = threading.Thread(target=_tee, name=f"bench-tee-{label}", daemon=True)
+    tee.start()
+    stdout = proc.stdout.read()
+    proc.wait()
+    tee.join(timeout=5.0)
+    tail_text = "".join(tail)
     if proc.returncode != 0:
-        raise RuntimeError(f"bench child {label!r} exited rc={proc.returncode}")
-    lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        raise BenchChildError(
+            f"bench child {label!r} exited rc={proc.returncode}", tail_text
+        )
+    lines = [l for l in stdout.decode().splitlines() if l.strip()]
     if not lines:
-        raise RuntimeError(f"bench child {label!r} produced no JSON")
+        raise BenchChildError(
+            f"bench child {label!r} produced no JSON", tail_text
+        )
     out = json.loads(lines[-1])
-    log(f"[bench] {label} done in {time.monotonic()-t0:.0f}s")
+    if isinstance(out, dict) and out.get("skipped"):
+        log(f"[bench] {label} SKIPPED: {out['skipped']}")
+    else:
+        log(f"[bench] {label} done in {time.monotonic()-t0:.0f}s")
     return out
 
 
@@ -1528,7 +1786,22 @@ def main() -> None:
         import jax
 
         log(f"[{sys.argv[2]}] devices: {jax.devices()} (cache: {cache_dir})")
-        print(json.dumps(ROLES[sys.argv[2]]()), flush=True)
+        try:
+            result = ROLES[sys.argv[2]]()
+        except SystemExit as err:
+            msg = str(err)
+            if msg.startswith("BENCH_SKIP:"):
+                # the role opted out (bench_skip) — a structured skip
+                # record, not a failure (rc stays 0)
+                print(
+                    json.dumps(
+                        {"skipped": msg[len("BENCH_SKIP:"):].strip()}
+                    ),
+                    flush=True,
+                )
+                return
+            raise
+        print(json.dumps(result), flush=True)
         return
 
     record = _run_child("headline")  # a headline failure fails the bench
@@ -1584,8 +1857,19 @@ def main() -> None:
         try:
             record[field] = _run_child(role, extra_env=extra_env, label=label)
         except BaseException as err:
+            tail = getattr(err, "stderr_tail", "")
+            skip = _skip_reason(tail)
+            if skip:
+                # a capability gap (needs a real TPU), not a regression —
+                # recorded as skipped so the re-earn status stays legible
+                log(f"[bench] {label} SKIPPED: {skip}")
+                record[field] = {"skipped": skip}
+                continue
             log(f"[bench] {label} FAILED: {err!r}")
-            record[field] = {"error": str(err)}
+            rec = {"error": str(err)}
+            if tail:
+                rec["stderr_tail"] = tail[-2000:]
+            record[field] = rec
     print(json.dumps(record), flush=True)
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from minisched_tpu.api.objects import Binding, Node, Pod, PodStatus
 from minisched_tpu.controlplane.store import Conflict, ObjectStore
@@ -60,9 +60,10 @@ class _ThrottledStore:
     limiter covers requests, not watch deliveries)."""
 
     _THROTTLED = frozenset(
-        # mutate_many is ONE API request (a batch bind), so one token
-        ("create", "get", "list", "list_with_rv", "update", "delete",
-         "mutate", "mutate_many", "watch")
+        # mutate_many / create_many are ONE API request each (batch
+        # bind / batch create), so one token
+        ("create", "create_many", "get", "list", "list_with_rv", "update",
+         "delete", "mutate", "mutate_many", "watch")
     )
 
     def __init__(self, store: ObjectStore, limiter: TokenBucket):
@@ -111,21 +112,24 @@ class OutOfCapacity(Exception):
     requeues the pod against refreshed state."""
 
 
-def _create_all_then_raise(create_one, objs: List[Any]) -> List[Any]:
+def _raise_first_error(results: List[Any]) -> List[Any]:
     """The shared batch-create contract of BOTH facades: each item is
-    independent — per-item KeyErrors (conflicts) are collected while the
-    rest keep creating, then the FIRST one raises.  This is what
-    RemoteStore.create_many already does server-side; the in-process loop
-    must not predict different cluster state."""
+    independent — the store creates every non-conflicting item and
+    returns per-item results; the facade re-raises the FIRST error
+    (conflicts come back as KeyError), with failed slots left as None.
+    A non-KeyError (injected fault, closed store) raises immediately —
+    the single-create path would have surfaced it too."""
     out: List[Any] = []
     first_err: Optional[KeyError] = None
-    for obj in objs:
-        try:
-            out.append(create_one(obj))
-        except KeyError as err:
+    for res in results:
+        if isinstance(res, KeyError):
             out.append(None)
             if first_err is None:
-                first_err = err
+                first_err = res
+        elif isinstance(res, BaseException):
+            raise res
+        else:
+            out.append(res)
     if first_err is not None:
         raise first_err
     return out
@@ -141,15 +145,20 @@ class _NodeAPI:
         node.metadata.namespace = ""
         return self._store.create(KIND_NODE, node)
 
-    def create_many(self, nodes: List[Node]) -> List[Node]:
-        """Batch create, aligned with ``nodes`` — the remote client turns
-        this into ONE collection POST (k8sapiserver setup at bench scale
-        was ~380 obj/s with a round-trip per object); in-process it's a
-        plain loop.  Partial-failure semantics MATCH the remote facade:
-        every non-conflicting item is created, then the first per-item
-        KeyError raises — aborting at the first conflict (the old
-        behavior) made cluster state facade-dependent."""
-        return _create_all_then_raise(self.create, nodes)
+    def create_many(
+        self, nodes: List[Node], return_objects: bool = True
+    ) -> List[Node]:
+        """Batch create, aligned with ``nodes`` — ONE store transaction
+        (one lock hold, one fanout; the remote facade's analog is one
+        collection POST).  Partial-failure semantics MATCH the remote
+        facade: every non-conflicting item is created, then the first
+        per-item KeyError raises.  ``return_objects=False`` skips the
+        per-item clone (seed paths that drop the results)."""
+        for n in nodes:
+            n.metadata.namespace = ""
+        return _raise_first_error(
+            self._store.create_many(KIND_NODE, nodes, return_objects)
+        )
 
     def get(self, name: str) -> Node:
         return self._store.get(KIND_NODE, "", name)
@@ -174,10 +183,17 @@ class _PodAPI:
             pod.metadata.namespace = self._ns
         return self._store.create(KIND_POD, pod)
 
-    def create_many(self, pods: List[Pod]) -> List[Pod]:
+    def create_many(
+        self, pods: List[Pod], return_objects: bool = True
+    ) -> List[Pod]:
         """Batch create, aligned with ``pods`` — see _NodeAPI.create_many
         (all independent items, first KeyError raised at the end)."""
-        return _create_all_then_raise(self.create, pods)
+        for p in pods:
+            if not p.metadata.namespace:
+                p.metadata.namespace = self._ns
+        return _raise_first_error(
+            self._store.create_many(KIND_POD, pods, return_objects)
+        )
 
     def get(self, name: str, namespace: Optional[str] = None) -> Pod:
         return self._store.get(KIND_POD, namespace or self._ns, name)
@@ -211,12 +227,18 @@ class _PodAPI:
     @staticmethod
     def _node_budgets(store: ObjectStore, targets: set) -> Dict[str, list]:
         """Remaining [milli_cpu, memory, pods] per TARGET node, computed
-        from the store's live objects — the caller holds the store lock,
+        from the store's live state — the caller holds the store lock,
         so the view is the exact state the transaction commits against.
         Nodes absent from the store get no budget (and no check): unit
         scenarios bind to names that were never created, matching the
-        reference apiserver, which validates neither.  One pass over the
-        pod population per batch; requests are spec-memoized."""
+        reference apiserver, which validates neither.
+
+        Reads the store's INCREMENTAL per-node aggregates
+        (``_pod_node_agg``, maintained on every Pod commit) — O(target
+        nodes) per batch; the full pod-population scan this replaces was
+        the last O(all pods) term in the bind path (ROADMAP crumb).  A
+        store without the index (foreign test double) falls back to the
+        scan."""
         budgets: Dict[str, list] = {}
         for name in targets:
             node = store._objects.get(KIND_NODE, {}).get(f"/{name}")
@@ -226,13 +248,22 @@ class _PodAPI:
             budgets[name] = [alloc.milli_cpu, alloc.memory, alloc.pods]
         if not budgets:
             return budgets
-        for pod in store._objects.get(KIND_POD, {}).values():
-            b = budgets.get(pod.spec.node_name)
-            if b is not None:
-                req = pod.resource_requests()
-                b[0] -= req.milli_cpu
-                b[1] -= req.memory
-                b[2] -= req.pods
+        agg = getattr(store, "_pod_node_agg", None)
+        if agg is None:
+            for pod in store._objects.get(KIND_POD, {}).values():
+                b = budgets.get(pod.spec.node_name)
+                if b is not None:
+                    req = pod.resource_requests()
+                    b[0] -= req.milli_cpu
+                    b[1] -= req.memory
+                    b[2] -= req.pods
+            return budgets
+        for name, b in budgets.items():
+            a = agg.get(name)
+            if a is not None:
+                b[0] -= a[0]
+                b[1] -= a[1]
+                b[2] -= a[2]
         return budgets
 
     def bind_many(

@@ -197,6 +197,25 @@ class DurableObjectStore(ObjectStore):
             self._check_wal_writable(kind)
             return super().create(kind, obj)
 
+    def create_many(
+        self, kind: str, objs: list, return_objects: bool = True
+    ) -> list:
+        """Batch create with ONE log flush — same deferred-flush contract
+        as mutate_many (records append in commit order via
+        _on_batch_commit, the barrier lands before the batched fanout)."""
+        with self._lock:
+            self._check_open()
+            self._check_wal_writable(kind)
+            self._defer_flush = True
+            try:
+                return super().create_many(kind, objs, return_objects)
+            finally:
+                self._defer_flush = False
+                if self._log is not None:
+                    self._log.flush()
+                    if self._fsync:
+                        os.fsync(self._log.fileno())
+
     def update(self, kind: str, obj: Any, expected_rv: Optional[int] = None) -> Any:
         with self._lock:
             self._check_open()
@@ -346,6 +365,10 @@ class DurableObjectStore(ObjectStore):
             from minisched_tpu.api.objects import ensure_uid_floor
 
             ensure_uid_floor(self._recovered_uid_max)
+        # checkpoint restore + WAL replay write _objects directly — the
+        # per-node bind aggregates (client._node_budgets' index) rebuild
+        # once here instead of tracking per replayed record
+        self._rebuild_node_agg()
 
     def _apply(self, rec: dict) -> None:
         """Apply one WAL record; also rebuilds the watch-resume history

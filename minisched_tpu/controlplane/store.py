@@ -242,6 +242,15 @@ class ObjectStore:
         # of ring state (a durable reopen sets it to the checkpoint rv:
         # nothing before the snapshot is reconstructable for ANY kind).
         self._history: Dict[str, deque] = {}
+        # per-node Pod request aggregates, maintained INCREMENTALLY on
+        # every Pod mutation (node name → [milli_cpu, memory bytes, pod
+        # count] summed over pods bound there).  The capacity-validated
+        # bind transaction (client._node_budgets) used to scan the whole
+        # pod population once per batch — O(all pods) per bind batch at
+        # 100k-pod scale; this index makes it O(target nodes).  Kept
+        # exact under the store lock: every commit path (create/update/
+        # delete/mutate_many/restore) routes through _node_agg_track.
+        self._pod_node_agg: Dict[str, List[int]] = {}
         self._history_cap = max(int(history_events), 0)
         self._history_byte_cap = max(int(history_bytes), 0)
         self._history_bytes_used: Dict[str, int] = {}
@@ -273,6 +282,40 @@ class ObjectStore:
     def _bump(self) -> int:
         self._rv += 1
         return self._rv
+
+    # -- per-node aggregate index ------------------------------------------
+    def _node_agg_track(self, kind: str, old: Any, new: Any) -> None:
+        """Fold one Pod mutation into the per-node request aggregates
+        (caller holds the lock).  ``old``/``new`` are the stored objects
+        before/after (None for create/delete).  Requests are spec-memoized
+        (Pod.resource_requests), so this is a few dict ops per commit."""
+        if kind != "Pod":
+            return
+        agg = self._pod_node_agg
+        for obj, sign in ((old, -1), (new, 1)):
+            if obj is None:
+                continue
+            node = obj.spec.node_name
+            if not node:
+                continue
+            req = obj.resource_requests()
+            a = agg.get(node)
+            if a is None:
+                a = agg[node] = [0, 0, 0]
+            a[0] += sign * req.milli_cpu
+            a[1] += sign * req.memory
+            a[2] += sign * req.pods
+            if sign < 0 and not (a[0] or a[1] or a[2]):
+                del agg[node]  # bound pods all gone: don't accrete names
+
+    def _rebuild_node_agg(self) -> None:
+        """Recompute the index from the live objects — recovery paths
+        (WAL replay, checkpoint restore) that write ``_objects`` directly
+        call this once at the end instead of tracking per record."""
+        with self._lock:
+            self._pod_node_agg = {}
+            for pod in self._objects.get("Pod", {}).values():
+                self._node_agg_track("Pod", None, pod)
 
     def _record_history(self, kind: str, event: WatchEvent) -> None:
         """Append one event to the kind's resume ring (caller holds the
@@ -371,6 +414,7 @@ class ObjectStore:
             if not stored.metadata.creation_timestamp:
                 stored.metadata.creation_timestamp = time.time()
             objs[key] = stored
+            self._node_agg_track(kind, None, stored)
             out = stored.clone()
             # durability BEFORE visibility: the WAL record lands (and
             # flushes) before any watcher can observe the event — a crash
@@ -388,6 +432,64 @@ class ObjectStore:
                     rv=stored.metadata.resource_version,
                 ),
             )
+        return out
+
+    def create_many(
+        self, kind: str, objs: List[Any], return_objects: bool = True
+    ) -> List[Any]:
+        """Batch create under ONE lock hold — the seed path of every
+        bench/scenario (a 10k-object cluster through create() paid a lock
+        round-trip, a history append, and a per-watcher fanout each).
+        Returns a list aligned with ``objs``: the stored clone (None with
+        ``return_objects=False`` — skips a clone per item), or the
+        exception for that entry (KeyError on conflict) — one failed item
+        never aborts the rest, matching mutate_many.  Durability before
+        visibility holds batch-wide: every WAL record lands (one flush)
+        before the single batched fanout."""
+        from minisched_tpu.api.objects import new_uid
+
+        out: List[Any] = []
+        events: List[WatchEvent] = []
+        with self._lock:
+            objs_map = self._objects.setdefault(kind, {})
+            for obj in objs:
+                key = self._key(obj)
+                try:
+                    self._maybe_fault("create", kind, key)
+                    if key in objs_map:
+                        raise KeyError(f"{kind} {key!r} already exists")
+                    stored = obj.clone()
+                    if not stored.metadata.uid:
+                        stored.metadata.uid = new_uid(kind.lower())
+                    stored.metadata.resource_version = self._bump()
+                    if not stored.metadata.creation_timestamp:
+                        stored.metadata.creation_timestamp = time.time()
+                    objs_map[key] = stored
+                    self._node_agg_track(kind, None, stored)
+                    self._on_batch_commit(kind, stored)
+                    out.append(stored.clone() if return_objects else None)
+                    events.append(
+                        WatchEvent(
+                            EventType.ADDED, stored,
+                            rv=stored.metadata.resource_version,
+                        )
+                    )
+                except Exception as err:  # noqa: BLE001 — returned, not lost
+                    out.append(err)
+            self._flush_log()
+            for ev in events:
+                self._record_history(kind, ev)
+            faults = self.faults
+            for w in list(self._watches.get(kind, ())):
+                if w.stopped:
+                    self._remove_watch(kind, w)  # see _fanout
+                    continue
+                if faults is not None and faults.should_fire(
+                    "watch.drop", kind
+                ):
+                    w.kill()
+                    continue
+                w._deliver_many(events)
         return out
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -444,6 +546,7 @@ class ObjectStore:
             stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             stored.metadata.resource_version = self._bump()
             objs[key] = stored
+            self._node_agg_track(kind, old, stored)
             out = stored.clone()
             self._commit_record(
                 kind, "put", stored, stored.metadata.resource_version
@@ -465,6 +568,7 @@ class ObjectStore:
             old = objs.pop(key, None)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
+            self._node_agg_track(kind, old, None)
             rv = self._bump()
             self._commit_record(kind, "del", old, rv)
             self._fanout(kind, WatchEvent(EventType.DELETED, old, rv=rv))
@@ -534,6 +638,7 @@ class ObjectStore:
                     )
                     work.metadata.resource_version = self._bump()
                     objs[key] = work
+                    self._node_agg_track(kind, old, work)
                     self._on_batch_commit(kind, work)
                     out.append(work.clone() if return_objects else None)
                     events.append(
@@ -603,6 +708,7 @@ class ObjectStore:
                 raise KeyError(f"{kind} {key!r} already exists")
             stored = obj.clone()
             objs[key] = stored
+            self._node_agg_track(kind, None, stored)
             self._rv = max(self._rv, stored.metadata.resource_version)
             self._commit_record(
                 kind, "put", stored, stored.metadata.resource_version

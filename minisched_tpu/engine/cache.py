@@ -19,7 +19,7 @@ is O(nodes), not O(pods).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from minisched_tpu.framework.nodeinfo import NodeInfo
 
@@ -33,6 +33,13 @@ class SchedulerCache:
         #: tolerance: a pod bound to a node whose ADD arrives later)
         self._orphans: Dict[str, Any] = {}
         self._sorted: Optional[List[NodeInfo]] = None
+        # dirty-set for incremental table builds: names of nodes whose
+        # AGGREGATES (assigned-pod sums) changed since the last drain.
+        # None = "everything" (initial state, or node membership/order
+        # changed — row indices shifted, so per-row patching is unsound).
+        # Drained ONLY by snapshot_for_tables (the wave path); plain
+        # snapshots leave it alone so the wave builder misses nothing.
+        self._dirty: Optional[Set[str]] = None
 
     # -- node events -------------------------------------------------------
     def _create_node(self, node: Any) -> None:
@@ -43,6 +50,7 @@ class SchedulerCache:
         ni = NodeInfo(node)
         self._nodes[node.metadata.name] = ni
         self._sorted = None
+        self._dirty = None  # membership changed: row indices shifted
         for uid, pod in list(self._orphans.items()):
             if pod.spec.node_name == node.metadata.name:
                 del self._orphans[uid]
@@ -72,6 +80,7 @@ class SchedulerCache:
     def _delete_node_locked(self, node: Any) -> None:
         ni = self._nodes.pop(node.metadata.name, None)
         self._sorted = None
+        self._dirty = None  # membership changed: row indices shifted
         if ni is not None:
             # the pods are still bound in the cluster view and will
             # emit no further events — re-orphan them so a node
@@ -100,9 +109,14 @@ class SchedulerCache:
             if ni is not None:
                 ni.remove_pod(new)
                 ni.add_pod(new)
+                self._mark_dirty(prev)
             return
         self._remove(new)
         self._place(new)
+
+    def _mark_dirty(self, name: str) -> None:
+        if self._dirty is not None:
+            self._dirty.add(name)
 
     def delete_pod(self, pod: Any) -> None:
         with self._mu:
@@ -118,6 +132,7 @@ class SchedulerCache:
             return
         ni.add_pod(pod)
         self._pod_node[uid] = pod.spec.node_name
+        self._mark_dirty(pod.spec.node_name)
 
     def _remove(self, pod: Any) -> None:
         uid = pod.metadata.uid
@@ -127,6 +142,7 @@ class SchedulerCache:
             ni = self._nodes.get(name)
             if ni is not None:
                 ni.remove_pod(pod)
+                self._mark_dirty(name)
 
     # -- reads -------------------------------------------------------------
     def snapshot(self) -> List[NodeInfo]:
@@ -145,6 +161,60 @@ class SchedulerCache:
                     self._nodes.values(), key=lambda ni: ni.name
                 )
             return [ni.clone() for ni in self._sorted], set(self._pod_node)
+
+    def snapshot_for_tables(self):
+        """(snapshot, assigned-pod uids, dirty node names) from ONE locked
+        read — the wave table builder's entry point.  ``dirty`` is the set
+        of node names whose aggregates changed since the PREVIOUS drain
+        (None = full rebuild needed: first snapshot, or node membership
+        changed and row indices shifted); draining it here, atomically
+        with the snapshot, is what makes the incremental aggregate base
+        exact — the builder re-encodes exactly the rows this snapshot
+        changed, in snapshot order (the wave path is single-threaded).
+        Consumers that don't feed the builder use snapshot_with_assigned,
+        which leaves the dirty-set alone."""
+        with self._mu:
+            if self._sorted is None:
+                self._sorted = sorted(
+                    self._nodes.values(), key=lambda ni: ni.name
+                )
+            dirty = self._dirty
+            self._dirty = set()
+            return (
+                [ni.clone() for ni in self._sorted],
+                set(self._pod_node),
+                dirty,
+            )
+
+    def capacity_view(
+        self, names: Any
+    ) -> Tuple[Dict[str, List[int]], Dict[str, Set[str]]]:
+        """({name: [free milli_cpu, free mem MiB, free eph MiB, free pod
+        slots]}, {name: uids of pods the cache already counts there}) for
+        the given nodes, from the LIVE NodeInfos under one lock hold —
+        the pipelined wave's commit-time re-arbitration base.  The
+        counted-uid sets let the caller fold its assume-cache WITHOUT
+        double-subtracting a pod whose bind event already landed (the
+        assumption outlives the event until the next snapshot prune).
+        Same MiB-floored integer quantization as the table builders."""
+        from minisched_tpu.api.objects import MIB
+
+        free: Dict[str, List[int]] = {}
+        counted: Dict[str, Set[str]] = {}
+        with self._mu:
+            for name in names:
+                ni = self._nodes.get(name)
+                if ni is None:
+                    continue
+                alloc = ni.node.status.allocatable
+                free[name] = [
+                    alloc.milli_cpu - ni.requested.milli_cpu,
+                    alloc.memory // MIB - ni.req_mem_mib,
+                    alloc.ephemeral_storage // MIB - ni.req_eph_mib,
+                    alloc.pods - len(ni.pods),
+                ]
+                counted[name] = {p.metadata.uid for p in ni.pods}
+        return free, counted
 
     # -- batch ingestion (informer on_batch fast path) ---------------------
     def _pod_batch(self, events: List[Any]) -> None:

@@ -21,7 +21,7 @@ Conventions:
 from __future__ import annotations
 
 from dataclasses import dataclass, fields
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import os
 
@@ -807,6 +807,10 @@ _NODE_AGG_COLS = (
     "used_port", "num_used_ports",
 )
 
+#: sentinel for "caller does not participate in dirty tracking" — distinct
+#: from None, which means "everything is dirty, rebuild the base fully"
+DIRTY_UNTRACKED = object()
+
 
 class CachedNodeTableBuilder:
     """Per-wave NodeTable builds with the static columns cached.
@@ -821,9 +825,36 @@ class CachedNodeTableBuilder:
     """
 
     def __init__(self, device_static: bool = True):
+        import threading
+
+        # scan lanes (loop thread) and the wave-pipeline build worker
+        # share ONE builder — the static cache, aggregate base, and
+        # double buffers below are all mutable state, so every build
+        # serializes through this lock (contention only when a scan
+        # flush coincides with a pipelined build)
+        self._build_lock = threading.RLock()
         self._sig = None
         self._static: Dict[str, Any] = {}
         self._static_dev: Dict[str, Any] = {}
+        # incremental AGGREGATE base: persistent host copies of the
+        # assigned-pod sum columns, re-encoded only for the rows a
+        # snapshot's dirty-set names (informer events mark nodes dirty;
+        # SchedulerCache.snapshot_for_tables drains the set atomically
+        # with the snapshot).  A full _fill_aggregates walk is O(all
+        # nodes) of Python attribute access per wave (~0.7s of the
+        # config5 wave loop); the incremental path is O(touched nodes).
+        self._agg_base: Optional[Dict[str, Any]] = None
+        self._agg_base_names: Tuple[str, ...] = ()
+        #: dirty rows re-encoded by the last build (0 = full rebuild
+        #: counted as len(nodes)); observability reads it per wave
+        self.last_dirty_rows = 0
+        # reusable per-wave aggregate scratch: the assume-delta folds
+        # into a COPY of the base (never the base itself).  ONE buffer
+        # suffices — what keeps an in-flight wave's tables safe from the
+        # next build is not buffer rotation but the copy every packing
+        # path makes under _build_lock (pack_columns' np.concatenate /
+        # batched_device_put) before the lock releases.
+        self._agg_scratch: Optional[Dict[str, Any]] = None
         # incremental-rebuild state: host copy of the static columns, the
         # persistent profile registry, and the encoded profile capacity —
         # a node UPDATE re-encodes just its row instead of all N (a 2k-
@@ -972,36 +1003,135 @@ class CachedNodeTableBuilder:
             raise ValueError(f"{n} nodes exceed table capacity {cap}")
         return cap
 
-    def build(self, node_infos: Sequence[Any], capacity: int = None,
-              prof_capacity: int = None, agg_delta=None):
-        cap = self._cap_for(node_infos, capacity)
-        self._ensure_static(node_infos, cap, prof_capacity)
-        t = self._fill_aggregates(node_infos, cap)
+    def _update_agg_base(
+        self, node_infos: Sequence[Any], cap: int, dirty
+    ) -> Dict[str, Any]:
+        """Bring the persistent aggregate base up to this snapshot.
+        ``dirty`` names the nodes whose aggregates changed since the last
+        drained snapshot (None = rebuild everything).  Any failure
+        invalidates the base — a partial application must never survive
+        into the next wave's increments."""
+        names = tuple(ni.name for ni in node_infos)
+        base = self._agg_base
+        try:
+            if (
+                base is None
+                or dirty is None
+                or self._agg_base_names != names
+                or base["req_cpu"].shape[0] != cap
+            ):
+                base = self._fill_aggregates(node_infos, cap)
+                self._agg_base = base
+                self._agg_base_names = names
+                self.last_dirty_rows = len(node_infos)
+                return base
+            idx = self._name_index
+            n = 0
+            for name in dirty:
+                i = idx.get(name)
+                if i is None:
+                    continue  # left the roster: membership change would
+                    # have arrived as dirty=None; a stray name is stale
+                # clear variable-length slots a shorter re-encode would
+                # leave stale, then re-encode the row from ITS NodeInfo
+                base["used_port"][i] = 0
+                _fill_aggregate_row(base, i, node_infos[i])
+                n += 1
+            self.last_dirty_rows = n
+            return base
+        except Exception:
+            self._agg_base = None  # never trust a half-applied base
+            raise
+
+    def _wave_agg_copy(self, base: Dict[str, Any], cap: int) -> Dict[str, Any]:
+        """Copy the base into the reusable scratch buffer — the per-wave
+        assume-delta folds into the copy, never the base.  Reuse is safe
+        because every consumer path copies out of the scratch (see
+        _agg_scratch) before _build_lock releases."""
+        buf = self._agg_scratch
+        if buf is None or buf["req_cpu"].shape[0] != cap:
+            buf = self._agg_scratch = {
+                k: np.empty_like(v) for k, v in base.items()
+            }
+        for k, v in base.items():
+            np.copyto(buf[k], v)
+        return buf
+
+    def _aggregates_for(
+        self, node_infos: Sequence[Any], cap: int, dirty, agg_delta
+    ) -> Dict[str, Any]:
+        if dirty is DIRTY_UNTRACKED:
+            # caller outside the dirty protocol (scan lanes, prewarm,
+            # one-shot builds): fresh fill, persistent base untouched —
+            # its undrained changes stay pending for the wave path
+            t = self._fill_aggregates(node_infos, cap)
+            self.last_dirty_rows = len(node_infos)
+        else:
+            base = self._update_agg_base(node_infos, cap, dirty)
+            t = self._wave_agg_copy(base, cap)
         if agg_delta:
             self._apply_agg_delta(t, agg_delta)
-        if self._device_static:
-            cols = dict(self._static_dev)
-            cols.update(batched_device_put(t))
-        else:
-            cols = dict(self._static)
-            cols.update(t)
-            cols = batched_device_put(cols)
-        return NodeTable(**cols), list(self._names)
+        return t
+
+    def build(self, node_infos: Sequence[Any], capacity: int = None,
+              prof_capacity: int = None, agg_delta=None,
+              dirty=DIRTY_UNTRACKED):
+        with self._build_lock:
+            try:
+                cap = self._cap_for(node_infos, capacity)
+                self._ensure_static(node_infos, cap, prof_capacity)
+                t = self._aggregates_for(node_infos, cap, dirty, agg_delta)
+                if self._device_static:
+                    cols = dict(self._static_dev)
+                    cols.update(batched_device_put(t))
+                else:
+                    cols = dict(self._static)
+                    cols.update(t)
+                    cols = batched_device_put(cols)
+                return NodeTable(**cols), list(self._names)
+            except Exception:
+                # a TRACKED build consumed its snapshot's drained dirty
+                # set the moment the snapshot was taken — failing at ANY
+                # point (static encode, device put) before the base
+                # reflects those rows would strand them stale forever;
+                # invalidate so the next tracked build refills fully
+                if dirty is not DIRTY_UNTRACKED:
+                    self._agg_base = None
+                raise
 
     def build_packed(self, node_infos: Sequence[Any], capacity: int = None,
-                     prof_capacity: int = None, agg_delta=None):
+                     prof_capacity: int = None, agg_delta=None,
+                     dirty=DIRTY_UNTRACKED):
         """Single-program variant: (static device cols, PackedTable of the
         per-wave aggregate columns, names).  The consumer jit unpacks the
         aggregates and merges the device-resident statics inside its own
         program — no splitter executable per wave.  Requires
-        ``device_static=True`` (the statics must already live on device)."""
-        assert self._device_static, "build_packed needs device-resident statics"
-        cap = self._cap_for(node_infos, capacity)
-        self._ensure_static(node_infos, cap, prof_capacity)
-        t = self._fill_aggregates(node_infos, cap)
-        if agg_delta:
-            self._apply_agg_delta(t, agg_delta)
-        return self._static_dev, pack_table(t, (), cap), list(self._names)
+        ``device_static=True`` (the statics must already live on device).
+
+        ``dirty``: the snapshot's drained dirty-set (see
+        SchedulerCache.snapshot_for_tables) — the aggregate columns then
+        re-encode only those rows into the persistent base instead of
+        walking every NodeInfo.  Callers outside the dirty protocol leave
+        the default (full fresh fill, base untouched)."""
+        with self._build_lock:
+            try:
+                assert self._device_static, (
+                    "build_packed needs device-resident statics"
+                )
+                cap = self._cap_for(node_infos, capacity)
+                self._ensure_static(node_infos, cap, prof_capacity)
+                t = self._aggregates_for(node_infos, cap, dirty, agg_delta)
+                return (
+                    self._static_dev,
+                    pack_table(t, (), cap),
+                    list(self._names),
+                )
+            except Exception:
+                # see build(): a failed TRACKED build must not strand the
+                # drained dirty rows — invalidate, full refill next time
+                if dirty is not DIRTY_UNTRACKED:
+                    self._agg_base = None
+                raise
 
 
 def _encode_terms(t: Dict[str, Any], prefix: str, i: int, terms, max_terms: int,

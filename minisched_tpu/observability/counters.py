@@ -24,6 +24,22 @@ The HA plane (minisched_tpu.ha) records its lifecycle here under the
     ha.shard_adopt / ha.shard_adopt_pods
         — failover rebalances and how many orphaned pending pods the
           adopting engine re-admitted
+
+The pipelined wave engine (engine/pipeline.py) records under
+``wave_pipeline.``; its TIMERS (stall, build) live in the engine's
+CycleMetrics, not here — counters are integers:
+
+    wave_pipeline.waves
+        — waves evaluated through the pipelined (overlapped) path
+    wave_pipeline.build_fallback
+        — batches the build worker handed back to the serial wave path
+          (encode overflow, empty roster, priority bypass, build fault)
+    wave_pipeline.rearb_requeued
+        — pipelined winners rejected by commit-time re-arbitration
+          (capacity taken by the overlapped previous wave) and requeued
+    wave_pipeline.dirty_rows
+        — node aggregate rows re-encoded incrementally (vs a full
+          O(all nodes) fill per wave); the bench divides by waves
 """
 
 from __future__ import annotations

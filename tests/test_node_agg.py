@@ -1,0 +1,211 @@
+"""Incremental per-node request aggregates in the store.
+
+The capacity-validated bind transaction used to scan the whole pod
+population once per batch (``client._node_budgets`` — the ROADMAP crumb
+from the HA plane); the store now maintains per-node sums on every Pod
+commit, so the budget check is O(target nodes).  These tests pin:
+
+* exactness across every mutation path (create / batch create / bind /
+  update / delete / durable replay) against a brute-force scan;
+* the regression the crumb names: a bind batch must not touch the pod
+  population at all, no matter how many unrelated bound pods exist.
+"""
+
+from __future__ import annotations
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+
+
+def _brute(store) -> dict:
+    agg: dict = {}
+    for pod in store._objects.get("Pod", {}).values():
+        if pod.spec.node_name:
+            a = agg.setdefault(pod.spec.node_name, [0, 0, 0])
+            r = pod.resource_requests()
+            a[0] += r.milli_cpu
+            a[1] += r.memory
+            a[2] += r.pods
+    return {k: tuple(v) for k, v in agg.items()}
+
+
+def _index(store) -> dict:
+    return {k: tuple(v) for k, v in store._pod_node_agg.items()}
+
+
+def test_index_tracks_every_mutation_path():
+    client = Client()
+    store = client.store
+    for i in range(4):
+        client.nodes().create(
+            make_node(
+                f"n{i}", capacity={"cpu": "64", "memory": "128Gi", "pods": 256}
+            )
+        )
+    client.pods().create_many(
+        [
+            make_pod(f"p{i}", requests={"cpu": "500m", "memory": "64Mi"})
+            for i in range(20)
+        ]
+    )
+    assert _index(store) == _brute(store) == {}  # nothing bound yet
+
+    # batch bind (mutate_many path)
+    res = client.pods().bind_many(
+        [Binding(f"p{i}", "default", f"n{i % 4}") for i in range(10)]
+    )
+    assert not any(isinstance(r, BaseException) for r in res)
+    assert _index(store) == _brute(store)
+
+    # delete bound pods
+    client.pods().delete("p0")
+    client.pods().delete("p1")
+    assert _index(store) == _brute(store)
+
+    # update of a bound pod (same node): net zero, still exact
+    p2 = client.pods().get("p2")
+    client.pods().update(p2)
+    assert _index(store) == _brute(store)
+
+    # a create that arrives ALREADY bound (restore-style seed)
+    pre = make_pod("pre", requests={"cpu": "250m"})
+    pre.spec.node_name = "n3"
+    client.pods().create(pre)
+    assert _index(store) == _brute(store)
+
+    # budgets = allocatable - index, and absent nodes get no budget
+    budgets = client.pods()._node_budgets(store, {"n0", "n3", "ghost"})
+    brute = _brute(store)
+    for name in ("n0", "n3"):
+        node = client.nodes().get(name)
+        alloc = node.status.allocatable
+        used = brute.get(name, (0, 0, 0))
+        assert budgets[name] == [
+            alloc.milli_cpu - used[0],
+            alloc.memory - used[1],
+            alloc.pods - used[2],
+        ]
+    assert "ghost" not in budgets
+
+
+def test_bind_batch_cost_independent_of_unrelated_bound_pods():
+    """The named regression: the bind-batch budget check must read the
+    per-node index, never iterate the pod population — enforced by a pod
+    map whose iteration surface raises."""
+    client = Client()
+    store = client.store
+    client.nodes().create(
+        make_node("a", capacity={"cpu": "640", "memory": "128Gi", "pods": 1000})
+    )
+    client.nodes().create(
+        make_node("b", capacity={"cpu": "64", "memory": "128Gi", "pods": 256})
+    )
+    client.pods().create_many(
+        [make_pod(f"bg{i}", requests={"cpu": "100m"}) for i in range(300)]
+    )
+    res = client.pods().bind_many(
+        [Binding(f"bg{i}", "default", "a") for i in range(300)]
+    )
+    assert not any(isinstance(r, BaseException) for r in res)
+    client.pods().create(make_pod("t1", requests={"cpu": "100m"}))
+
+    class NoScan(dict):
+        """A pod map whose population iteration fails the test."""
+
+        def values(self):
+            raise AssertionError(
+                "bind batch scanned the pod population (O(all pods) again)"
+            )
+
+        def items(self):
+            raise AssertionError("bind batch scanned the pod population")
+
+        def __iter__(self):
+            raise AssertionError("bind batch scanned the pod population")
+
+    store._objects["Pod"] = NoScan(store._objects["Pod"].items())
+    [res] = client.pods().bind_many([Binding("t1", "default", "b")])
+    assert not isinstance(res, BaseException)
+    # restore a plain dict so teardown/list paths work normally
+    plain = {}
+    plain.update(dict.items(store._objects["Pod"]))
+    store._objects["Pod"] = plain
+    assert _index(store) == _brute(store)
+    assert client.pods().get("t1").spec.node_name == "b"
+
+
+def test_out_of_capacity_still_enforced_via_index():
+    """The commit-time capacity gate (HA over-commit backstop) answers
+    from the index with unchanged semantics: the batch that fits commits,
+    the one that would over-commit is rejected per-item."""
+    from minisched_tpu.controlplane.client import OutOfCapacity
+
+    client = Client()
+    client.nodes().create(
+        make_node("tiny", capacity={"cpu": "1", "memory": "4Gi", "pods": 10})
+    )
+    client.pods().create_many(
+        [make_pod(f"c{i}", requests={"cpu": "600m"}) for i in range(2)]
+    )
+    res = client.pods().bind_many(
+        [Binding("c0", "default", "tiny"), Binding("c1", "default", "tiny")]
+    )
+    assert res[0] is None or not isinstance(res[0], BaseException)
+    assert isinstance(res[1], OutOfCapacity)
+
+
+def test_durable_reopen_rebuilds_index(tmp_path):
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+
+    wal = str(tmp_path / "agg.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+    client.nodes().create(
+        make_node("n0", capacity={"cpu": "64", "memory": "128Gi", "pods": 256})
+    )
+    client.pods().create_many(
+        [make_pod(f"d{i}", requests={"cpu": "200m"}) for i in range(6)]
+    )
+    res = client.pods().bind_many(
+        [Binding(f"d{i}", "default", "n0") for i in range(4)]
+    )
+    assert not any(isinstance(r, BaseException) for r in res)
+    client.pods().delete("d0")
+    expected = _brute(store)
+    assert _index(store) == expected
+    store.close()
+
+    reopened = DurableObjectStore(wal)
+    try:
+        assert _index(reopened) == _brute(reopened) == expected
+    finally:
+        reopened.close()
+
+
+def test_store_create_many_batch_semantics():
+    """store.create_many: one transaction, per-item conflicts, watchers
+    see one batched fanout in creation order, return_objects=False skips
+    the clones."""
+    from minisched_tpu.controlplane.store import EventType, ObjectStore
+
+    store = ObjectStore()
+    w, _ = store.watch("Pod", send_initial=False)
+    a, b = make_pod("a"), make_pod("b")
+    for p in (a, b):
+        p.metadata.namespace = "default"
+    first = store.create_many("Pod", [a, b])
+    assert [o.metadata.name for o in first] == ["a", "b"]
+    # conflict on "a" comes back per-item; "c" still creates
+    c = make_pod("c")
+    c.metadata.namespace = "default"
+    res = store.create_many("Pod", [a, c], return_objects=False)
+    assert isinstance(res[0], KeyError)
+    assert res[1] is None
+    events = w.next_batch(timeout=2.0)
+    assert [
+        (e.type, e.obj.metadata.name) for e in events
+    ] == [
+        (EventType.ADDED, "a"),
+        (EventType.ADDED, "b"),
+        (EventType.ADDED, "c"),
+    ]

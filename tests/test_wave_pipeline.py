@@ -1,0 +1,347 @@
+"""Pipelined wave engine: build/evaluate overlap must not change WHAT
+gets scheduled.
+
+Three layers:
+
+* serial-vs-pipelined parity — same seed, same workload, a chain whose
+  placements are bind-independent (the nodenumber roster): the two modes
+  must produce IDENTICAL placements, and every pod binds exactly once.
+* staleness re-arbitration — a wave built from a snapshot the overlapped
+  previous wave's commits staled must reject (and requeue) winners that
+  no longer fit, never over-commit (the deterministic forced-conflict
+  test drives the pipeline's build stage by hand).
+* the incremental aggregate base (models/tables.py) — dirty-row builds
+  must be bit-identical to a from-scratch build.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.client import Client
+from minisched_tpu.observability import counters
+from minisched_tpu.service.config import (
+    default_full_roster_config,
+    default_scheduler_config,
+)
+from minisched_tpu.service.service import SchedulerService
+
+
+def _wait(pred, timeout=180.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _run_nodenumber_workload(monkeypatch, pipeline: bool):
+    """One full engine run of 48 bind-independent pods over 10 nodes;
+    returns ({pod: node}, bind decision count)."""
+    import threading
+
+    monkeypatch.setenv("MINISCHED_PIPELINE", "1" if pipeline else "0")
+    client = Client()
+    svc = SchedulerService(client)
+    binds = []
+    mu = threading.Lock()
+
+    def on_decision(pod, node_name, status):
+        if node_name:
+            with mu:
+                binds.append(pod.metadata.name)
+
+    sched = svc.start_scheduler(
+        default_scheduler_config(time_scale=0.01),
+        device_mode=True,
+        max_wave=16,
+        on_decision=on_decision,
+    )
+    assert sched.pipeline_enabled == pipeline
+    try:
+        for i in range(10):
+            client.nodes().create(make_node(f"node{i}"))
+        client.pods().create_many(
+            [make_pod(f"pp{i:03d}") for i in range(48)], return_objects=False
+        )
+        assert _wait(
+            lambda: sum(1 for p in client.pods().list() if p.spec.node_name)
+            == 48,
+            timeout=300.0,  # first wait absorbs the evaluator compile
+        ), "all 48 pods must bind"
+        placements = {
+            p.metadata.name: p.spec.node_name for p in client.pods().list()
+        }
+    finally:
+        svc.shutdown_scheduler()
+    with mu:
+        decisions = list(binds)
+    return placements, decisions
+
+
+def test_pipelined_vs_serial_parity(monkeypatch):
+    """MINISCHED_PIPELINE=0 restores the serial path; with the pipeline
+    on, a bind-independent chain must place every pod IDENTICALLY (wave
+    composition may differ — placements may not), and the exactly-once
+    bind audit holds."""
+    serial, serial_binds = _run_nodenumber_workload(monkeypatch, False)
+    piped, piped_binds = _run_nodenumber_workload(monkeypatch, True)
+    assert serial == piped, {
+        k: (serial[k], piped[k]) for k in serial if serial[k] != piped[k]
+    }
+    # exactly-once: one successful bind decision per pod, both modes
+    assert sorted(serial_binds) == sorted(set(serial_binds))
+    assert sorted(piped_binds) == sorted(set(piped_binds))
+    assert len(piped_binds) == 48
+
+
+def test_pipelined_overcommit_burst_never_overcommits(monkeypatch):
+    """8 × 1cpu pods into 2 × 2cpu nodes through small overlapped waves:
+    exactly 4 bind, the rest park, and no node exceeds allocatable even
+    though later waves were built from snapshots the earlier waves
+    staled (re-arbitration + the bind transaction's OutOfCapacity are
+    the two backstops this exercises end-to-end)."""
+    monkeypatch.setenv("MINISCHED_PIPELINE", "1")
+    client = Client()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(time_scale=0.01),
+        device_mode=True,
+        max_wave=4,
+    )
+    try:
+        for i in range(2):
+            client.nodes().create(
+                make_node(
+                    f"n{i}", capacity={"cpu": "2", "memory": "8Gi", "pods": 110}
+                )
+            )
+        client.pods().create_many(
+            [make_pod(f"op{i}", requests={"cpu": "1"}) for i in range(8)],
+            return_objects=False,
+        )
+        assert _wait(
+            lambda: sum(1 for p in client.pods().list() if p.spec.node_name)
+            == 4,
+            timeout=300.0,
+        ), "exactly the fitting 4 pods must bind"
+        assert _wait(
+            lambda: sched.queue.stats()["unschedulable"] == 4, timeout=120.0
+        ), "the surplus must park unschedulable"
+        per_node = {}
+        for p in client.pods().list():
+            if p.spec.node_name:
+                per_node[p.spec.node_name] = (
+                    per_node.get(p.spec.node_name, 0)
+                    + p.resource_requests().milli_cpu
+                )
+        assert all(v <= 2000 for v in per_node.values()), per_node
+    finally:
+        svc.shutdown_scheduler()
+
+
+def test_stale_prepared_wave_rearbitrates(monkeypatch):
+    """The forced-conflict case, deterministically: wave N+1 is built BY
+    HAND from a snapshot taken before wave N commits; running it after
+    wave N's commit must reject its winner at re-arbitration (capacity
+    gone) and requeue it — not double-book the node."""
+    from minisched_tpu.controlplane.informer import SharedInformerFactory
+    from minisched_tpu.engine.device_scheduler import new_device_scheduler
+    from minisched_tpu.engine.pipeline import WavePipeline
+
+    monkeypatch.setenv("MINISCHED_PIPELINE", "1")
+    counters.reset()
+    client = Client()
+    factory = SharedInformerFactory(client.store)
+    sched = new_device_scheduler(
+        client, factory, default_full_roster_config(time_scale=0.01),
+        max_wave=8,
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    try:
+        client.nodes().create(
+            make_node("n1", capacity={"cpu": "1", "memory": "4Gi", "pods": 10})
+        )
+        assert _wait(lambda: len(sched.cache.snapshot()) == 1)
+        client.pods().create(make_pod("pa", requests={"cpu": "800m"}))
+        client.pods().create(make_pod("pb", requests={"cpu": "800m"}))
+        qpis = []
+
+        def drained():
+            qpis.extend(sched.queue.pop_batch(8, timeout=0.2))
+            return len(qpis) == 2
+
+        assert _wait(drained, timeout=30.0)
+        qa = next(q for q in qpis if q.pod.metadata.name == "pa")
+        qb = next(q for q in qpis if q.pod.metadata.name == "pb")
+
+        # build wave N+1 (pb) from the PRE-COMMIT snapshot: n1 has 1000m
+        # free, so the device places pb there
+        pipe = WavePipeline(sched)
+        prepared = pipe._build([qb])
+        assert prepared.node_names
+
+        # wave N (pa) commits through the serial path, staling it
+        sched.schedule_wave([qa])
+        assert _wait(
+            lambda: client.pods().get("pa").spec.node_name == "n1",
+            timeout=120.0,
+        )
+
+        # running the stale wave must re-arbitrate pb away, not bind it
+        sched._run_prepared_wave(prepared)
+        assert client.pods().get("pb").spec.node_name == ""
+        assert counters.get("wave_pipeline.rearb_requeued") >= 1
+        # the rejected winner went back through the active queue
+        assert sched.queue.stats()["active"] >= 1
+    finally:
+        sched.stop()
+        factory.shutdown()
+
+
+def test_rearbitration_unit(monkeypatch):
+    """_rearbitrate_winners against a live cache: an assumed pod eats the
+    node's remaining capacity; winners that still fit keep their slot and
+    debit it for later winners in the same wave."""
+    from minisched_tpu.controlplane.informer import SharedInformerFactory
+    from minisched_tpu.engine.device_scheduler import new_device_scheduler
+
+    monkeypatch.setenv("MINISCHED_PIPELINE", "1")
+    client = Client()
+    factory = SharedInformerFactory(client.store)
+    sched = new_device_scheduler(
+        client, factory, default_full_roster_config(), max_wave=8
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    try:
+        client.nodes().create(
+            make_node("n1", capacity={"cpu": "2", "memory": "8Gi", "pods": 10})
+        )
+        assert _wait(lambda: len(sched.cache.snapshot()) == 1)
+        taken = make_pod("taken", requests={"cpu": "1"})
+        taken.metadata.uid = "uid-taken"
+        sched._assume(taken, "n1")
+
+        def win(name, cpu):
+            pod = make_pod(name, requests={"cpu": cpu})
+            pod.metadata.uid = f"uid-{name}"
+            return (None, pod, "n1")
+
+        # 1000m left after the assumption: w1 (600m) fits, w2 (600m)
+        # loses to w1's local debit, w3 (300m) fits behind w1
+        kept, rejected = sched._rearbitrate_winners(
+            [win("w1", "600m"), win("w2", "600m"), win("w3", "300m")]
+        )
+        assert [w[1].metadata.name for w in kept] == ["w1", "w3"]
+        assert [w[1].metadata.name for w in rejected] == ["w2"]
+
+        # a chain without NodeResourcesFit never re-arbitrates (the
+        # serial engine would over-book identically — parity first)
+        sched._rearb_capacity = False
+        kept2, rejected2 = sched._rearbitrate_winners(
+            [win("w4", "600m"), win("w5", "600m")]
+        )
+        assert len(kept2) == 2 and not rejected2
+    finally:
+        sched.stop()
+        factory.shutdown()
+
+
+def test_incremental_agg_base_matches_full_build():
+    """Dirty-row aggregate builds are bit-identical to from-scratch
+    builds — including port-column clearing and the assume-delta staying
+    out of the persistent base."""
+    from minisched_tpu.framework.nodeinfo import build_node_infos
+    from minisched_tpu.models.tables import CachedNodeTableBuilder
+
+    nodes = [
+        make_node(
+            f"n{i:02d}", capacity={"cpu": "8", "memory": "16Gi", "pods": 110}
+        )
+        for i in range(10)
+    ]
+    infos = build_node_infos(nodes, [])
+    inc = CachedNodeTableBuilder()
+    _, agg0, _ = inc.build_packed(infos, dirty=None)  # full: base seeded
+
+    def bound(name, node, cpu="1", ports=()):
+        p = make_pod(name, requests={"cpu": cpu})
+        p.metadata.uid = name
+        p.spec.node_name = node
+        if ports:
+            p.spec.containers[0].ports = list(ports)
+        return p
+
+    by_name = {ni.name: ni for ni in infos}
+    by_name["n02"].add_pod(bound("x1", "n02", "1"))
+    by_name["n05"].add_pod(bound("x2", "n05", "2", ports=(8080,)))
+    _, agg1, _ = inc.build_packed(infos, dirty={"n02", "n05"})
+    assert inc.last_dirty_rows == 2
+    fresh = CachedNodeTableBuilder()
+    _, full1, _ = fresh.build_packed(infos, dirty=None)
+    np.testing.assert_array_equal(agg1.flat, full1.flat)
+
+    # ports must CLEAR on re-encode (shorter row must not keep slots)
+    by_name["n05"].remove_pod(bound("x2", "n05", "2", ports=(8080,)))
+    _, agg2, _ = inc.build_packed(infos, dirty={"n05"})
+    fresh2 = CachedNodeTableBuilder()
+    _, full2, _ = fresh2.build_packed(infos, dirty=None)
+    np.testing.assert_array_equal(agg2.flat, full2.flat)
+
+    # the per-wave assume-delta folds into the COPY, never the base:
+    # a delta'd build followed by a no-delta build must equal the full
+    delta = {"n03": [500, 64, 0, 1, 500, 64, []]}
+    inc.build_packed(infos, agg_delta=delta, dirty=set())
+    _, agg3, _ = inc.build_packed(infos, dirty=set())
+    np.testing.assert_array_equal(agg3.flat, full2.flat)
+
+    # an UNTRACKED build (scan lane) between dirty builds must not eat
+    # pending increments: base stays consistent with the drain sequence
+    by_name["n07"].add_pod(bound("x3", "n07", "1"))
+    inc.build_packed(infos)  # untracked: fresh fill, base untouched
+    _, agg4, _ = inc.build_packed(infos, dirty={"n07"})
+    fresh3 = CachedNodeTableBuilder()
+    _, full3, _ = fresh3.build_packed(infos, dirty=None)
+    np.testing.assert_array_equal(agg4.flat, full3.flat)
+
+    # node membership change arrives as dirty=None → full rebuild
+    infos2 = build_node_infos(nodes[:8], [])
+    _, agg5, _ = inc.build_packed(infos2, dirty=None)
+    fresh4 = CachedNodeTableBuilder()
+    _, full4, _ = fresh4.build_packed(infos2, dirty=None)
+    np.testing.assert_array_equal(agg5.flat, full4.flat)
+
+
+def test_cache_dirty_tracking():
+    """SchedulerCache drains dirty names atomically with the snapshot;
+    membership changes collapse to a full-rebuild signal; plain
+    snapshots leave the set alone."""
+    from minisched_tpu.engine.cache import SchedulerCache
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("a"))
+    cache.add_node(make_node("b"))
+    infos, _assigned, dirty = cache.snapshot_for_tables()
+    assert dirty is None  # first drain: everything
+    p = make_pod("p1", requests={"cpu": "1"})
+    p.metadata.uid = "u1"
+    p.spec.node_name = "a"
+    cache.add_pod(p)
+    # a plain snapshot must NOT drain
+    cache.snapshot_with_assigned()
+    _, _, dirty = cache.snapshot_for_tables()
+    assert dirty == {"a"}
+    _, _, dirty = cache.snapshot_for_tables()
+    assert dirty == set()
+    cache.delete_pod(p)
+    _, _, dirty = cache.snapshot_for_tables()
+    assert dirty == {"a"}
+    cache.add_node(make_node("c"))  # membership: full rebuild again
+    _, _, dirty = cache.snapshot_for_tables()
+    assert dirty is None
