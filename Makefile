@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave chaos chaos-proc chaos-ha docker clean
+.PHONY: test native start serve bench bench-wave chaos chaos-proc chaos-ha chaos-disk docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -43,6 +43,16 @@ chaos-proc: native
 chaos-ha: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_ha_chaos.py -q
+
+# storage-integrity chaos: the disk LIES — CRC-framed WAL bit-flips,
+# torn mid-file writes, ENOSPC degraded episodes, checkpoint rot — under
+# the same fixed seed.  Runs BOTH the tier-1 smoke (in-process engine,
+# ≥5% append faults + one ENOSPC episode + one bit-flip, detection
+# asserted by replay AND fsck) and the slow soak (ServerSupervisor
+# SIGKILL/restarts with the disk fabric armed inside the child)
+chaos-disk: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_disk_chaos.py -q
 
 # native host-table kernels (auto-built on first import too; this target
 # is for explicit/offline builds)

@@ -1591,6 +1591,160 @@ def bench_chaos() -> dict:
     }
 
 
+def bench_disk() -> dict:
+    """Storage-integrity soak at bench scale: the device wave engine over
+    an ARCHIVED WAL store with periodic compaction while the disk fabric
+    injects append refusals, a sustained ENOSPC episode, one bit-flip,
+    and one checkpoint-rot — the product claim is 'survives a lying
+    disk', so the record carries degraded-mode dwell time, the scrub/
+    fsck findings (the injected corruption MUST be detected, never
+    silently applied), and the exactly-once audit, not just throughput."""
+    import tempfile
+    import threading
+
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import fsck
+    from minisched_tpu.faults import FaultFabric
+    from minisched_tpu.observability import counters
+    from minisched_tpu.service.config import default_full_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    n_nodes = int(os.environ.get("BENCH_DISK_NODES", "64"))
+    n_pods = int(os.environ.get("BENCH_DISK_PODS", "1500"))
+    wal = os.path.join(tempfile.mkdtemp(prefix="minisched-disk-"), "d.wal")
+    store = DurableObjectStore(
+        wal, archive_compacted=True, probe_interval_s=0.05
+    )
+    store.start_scrub(interval_s=0.5)
+    client = Client(store=store)
+    client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:04d}",
+                capacity={"cpu": "64", "memory": "128Gi", "pods": 256},
+            )
+            for i in range(n_nodes)
+        ]
+    )
+    client.pods().create_many(
+        [
+            make_pod(f"dk{i:05d}", requests={"cpu": "500m", "memory": "64Mi"})
+            for i in range(n_pods)
+        ]
+    )
+    # armed AFTER the seed: the workload, not the setup, takes the weather
+    fabric = (
+        FaultFabric(seed)
+        .on("wal.append", rate=0.05)
+        .on("disk.enospc", rate=1.0, after=100, max_fires=8)
+        .on("wal.bitflip", rate=1.0, after=250, max_fires=1)
+        .on("ckpt.corrupt", rate=1.0, after=1, max_fires=1)
+    )
+    store.faults = fabric
+    counters.reset()
+    compact_stop = threading.Event()
+
+    def compactor() -> None:
+        while not compact_stop.wait(0.5):
+            try:
+                store.compact()
+            except Exception:
+                pass  # ENOSPC mid-compaction is exactly this role's weather
+
+    threading.Thread(target=compactor, daemon=True).start()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        default_full_roster_config(), device_mode=True,
+        max_wave=int(os.environ.get("BENCH_DISK_WAVE", "256")),
+    )
+    sched.assume_ttl_s = 3.0
+    t0 = time.monotonic()
+    deadline = t0 + float(os.environ.get("BENCH_DISK_DEADLINE_S", "300"))
+    bound = 0
+    try:
+        while time.monotonic() < deadline:
+            try:
+                bound = sum(
+                    1 for p in client.pods().list() if p.spec.node_name
+                )
+            except Exception:
+                continue
+            if bound >= n_pods:
+                break
+            if sched.queue.stats()["unschedulable"]:
+                sched.queue.flush_unschedulable_leftover()
+                sched.queue.flush_backoff_completed()
+            time.sleep(0.25)
+        elapsed = time.monotonic() - t0
+        drain_deadline = time.monotonic() + 10 * sched.assume_ttl_s
+        leaked = True
+        while time.monotonic() < drain_deadline:
+            with sched._assumed_lock:
+                leaked = bool(sched._assumed)
+            if not leaked:
+                break
+            time.sleep(0.25)
+        if bound < n_pods:
+            raise SystemExit(
+                f"[disk] DID NOT CONVERGE: {bound}/{n_pods} bound; "
+                f"faults={fabric.stats()} counters={counters.snapshot()}"
+            )
+        if leaked:
+            raise SystemExit("[disk] ASSUMED-CAPACITY LEAK at quiesce")
+    finally:
+        compact_stop.set()
+        svc.shutdown_scheduler()
+        scrub = store.scrub()
+        stats = store.storage_stats()
+        store.faults = None
+        store.close()
+    from minisched_tpu.faults import wal_double_binds
+
+    violations = wal_double_binds(wal)
+    if violations:
+        raise SystemExit(f"[disk] DOUBLE BIND: {violations[:5]}")
+    fire_stats = fabric.stats()
+    if fire_stats["fires"].get("disk.enospc", 0) < 1:
+        raise SystemExit("[disk] ENOSPC episode never fired")
+    report = fsck(wal)
+    flipped = fire_stats["fires"].get("wal.bitflip", 0)
+    crc_findings = sum("crc mismatch" in e for e in report["errors"])
+    if flipped and not crc_findings:
+        raise SystemExit(
+            f"[disk] UNDETECTED BIT-FLIP: {flipped} injected, fsck found "
+            f"none — a lying disk went unnoticed; report={report['errors']}"
+        )
+    log(
+        f"[disk] {n_pods} pods converged in {elapsed:.1f}s under "
+        f"{sum(fire_stats['fires'].values())} disk faults "
+        f"(degraded {stats['degraded_episodes']}x / "
+        f"{stats['degraded_dwell_s']}s dwell; {flipped} bit-flip(s) "
+        f"detected by fsck; no leak, no double-bind)"
+    )
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "total_s": round(elapsed, 1),
+        "seed": seed,
+        "injected": fire_stats["fires"],
+        "degraded_episodes": stats["degraded_episodes"],
+        "degraded_dwell_s": stats["degraded_dwell_s"],
+        "scrub_findings": scrub["findings"],
+        "fsck_errors": report["errors"],
+        "bitflips_detected": crc_findings,
+        "recovered": {
+            k: v
+            for k, v in counters.snapshot().items()
+            if v and (k.startswith("storage.") or k.startswith("remote."))
+        },
+        "leak": False,
+        "double_bind": False,
+    }
+
+
 def bench_ha() -> dict:
     """HA plane at bench scale: N active-active sharded engines over one
     WAL store, one engine hard-killed mid-run (lease abandoned — peers
@@ -1715,6 +1869,7 @@ ROLES = {
     "wire": bench_wire,
     "wave": bench_wave_pipeline,
     "chaos": bench_chaos,
+    "disk": bench_disk,
     "ha": bench_ha,
     "c1": bench_config1,
     "c2": bench_config2,
@@ -1843,6 +1998,10 @@ def main() -> None:
         # degraded-mode soak: convergence + leak/double-bind audits under
         # a seeded fault schedule (BENCH_CHAOS_SEED reproduces it)
         optional.append(("chaos_soak", "chaos", None, "chaos"))
+    if os.environ.get("BENCH_DISK", "1") != "0":
+        # lying-disk soak: degraded-mode dwell, scrub/fsck detection of
+        # injected corruption, and the exactly-once audit in the record
+        optional.append(("disk_integrity", "disk", None, "disk"))
     if os.environ.get("BENCH_HA", "1") != "0":
         # HA plane: sharded active-active engines, one hard kill, with
         # TTL-bounded rebalance + exactly-once audits in the record

@@ -8,6 +8,16 @@ then serve until interrupted.
 
     PORT=10251 FRONTEND_URL=http://localhost:3000 python -m minisched_tpu
 
+Subcommands:
+
+    python -m minisched_tpu fsck <wal> [--checkpoint PATH]
+
+        offline storage-integrity check (controlplane/fsck): WAL frame
+        CRCs, checkpoint sha256 sidecars (both generations), replay
+        through the real recovery path, rv/uid monotonicity, the
+        per-node aggregate index, and the exactly-once bind audit.
+        Prints a JSON report; exit 1 on any integrity error.
+
 Optional env:
 
     MINISCHED_TPU_STORE_URL=file:///tmp/cluster.wal   durable WAL store
@@ -78,6 +88,12 @@ def start(cfg: ProcessConfig, device_mode: bool = False, mesh_devices: int = 0):
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "fsck":
+        # the integrity CLI must not boot JAX or the scheduler stack —
+        # it runs against dead files, often on a box mid-incident
+        from minisched_tpu.controlplane.fsck import main as fsck_main
+
+        return fsck_main(sys.argv[2:])
     cfg = ProcessConfig.from_env()
     device_mode = os.environ.get("MINISCHED_DEVICE_MODE", "0") == "1"
     mesh_devices = int(os.environ.get("MINISCHED_MESH_DEVICES", "0"))

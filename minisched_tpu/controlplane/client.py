@@ -507,8 +507,16 @@ class EventRecorder:
                         self._store.delete(KIND_EVENT, drop[0], drop[1])
                     except KeyError:
                         pass  # already gone (store swapped/cleared)
-            except Exception:
-                pass  # a full/closed store must not kill the writer
+            except Exception as err:
+                # a full/closed store must not kill the writer; an event
+                # shed to a degraded DISK is counted so an ENOSPC episode
+                # shows up in the recovery ledger, not just as silence
+                from minisched_tpu.controlplane.store import StorageDegraded
+
+                if isinstance(err, StorageDegraded):
+                    from minisched_tpu.observability import counters
+
+                    counters.inc("storage.event_dropped_degraded")
             finally:
                 self._q.task_done()
 

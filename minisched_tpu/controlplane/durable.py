@@ -5,18 +5,42 @@ k8sapiserver.go:93-105 wires the apiserver's storage to it) — every write
 is durable before the API call returns, and restarting the process
 recovers the cluster state.  This backend closes that layer for the
 in-process control plane (SURVEY.md §7 stage 9's optional store): a
-``DurableObjectStore`` appends one JSON line per mutation to a WAL before
-the call returns, and re-opening the same path replays the log.
-``compact()`` is etcd's snapshot+compaction cycle in miniature: the live
-state lands in ``<path>.ckpt`` (atomic replace) and the WAL truncates, so
-recovery = checkpoint ⊕ WAL tail and replay cost is bounded by the write
-volume since the last compaction, not by process lifetime.
+``DurableObjectStore`` appends one framed JSON record per mutation to a
+WAL before the call returns, and re-opening the same path replays the
+log.  ``compact()`` is etcd's snapshot+compaction cycle in miniature:
+the live state lands in ``<path>.ckpt`` (atomic replace) and the WAL
+truncates, so recovery = checkpoint ⊕ WAL tail and replay cost is
+bounded by the write volume since the last compaction, not by process
+lifetime.
+
+Storage integrity (DESIGN.md §19) — the disk is allowed to LIE:
+
+* WAL records are **v2 frames** (``walio``): length + CRC header.  A
+  flipped bit or torn mid-file write is *detected* at replay — a typed
+  :class:`walio.WalCorrupt` with byte offset, record index, and rv
+  window — never silently applied.  Legacy v1 JSONL WALs replay
+  unchanged through the same mixed-mode reader.  ``salvage="covered"``
+  truncates at the first bad frame instead of failing, but only when
+  the checkpoint provably covers the loss (see ``_replay_wal``).
+* The checkpoint carries a **sha256 sidecar** (``<ckpt>.sha256``),
+  verified on restore, with a fallback chain: bad/missing checkpoint →
+  previous generation (``<ckpt>.prev``, one kept) → full WAL+archive
+  replay.  rv-skip and uid-floor semantics hold on every arm.
+* An append failure (ENOSPC/EIO, real or injected) flips the store into
+  **degraded read-only mode**: mutations are refused with a typed
+  :class:`store.StorageDegraded` BEFORE touching memory (durability
+  before commit — store.py), reads keep serving, and a rate-limited
+  recovery probe re-arms writes the moment an append succeeds again.
+* ``scrub()`` / ``start_scrub()`` run the background integrity pass
+  (frames, checkpoint digest, aggregate index vs live state) the
+  ``python -m minisched_tpu fsck`` CLI runs offline.
 
 Replay also rebuilds the watch-resume history ring from the WAL tail
 (ADDED/MODIFIED inferred from key presence, DELETED from the popped
-object), so a restarted server can answer ``?resource_version=N`` resumes
-for everything after the checkpoint — and sets the history floor at the
-checkpoint's rv, so resumes from before it get HistoryCompacted (410).
+object), so a restarted server can answer ``?resource_version=N``
+resumes for everything after the checkpoint — and sets the history
+floor at the checkpoint's rv, so resumes from before it get
+HistoryCompacted (410).
 
 The record encoding reuses the checkpoint codec (controlplane/checkpoint)
 so WAL, checkpoint files, and the HTTP façade all speak the same
@@ -25,9 +49,13 @@ language-neutral JSON.
 
 from __future__ import annotations
 
+import errno
+import hashlib
 import json
 import os
-from typing import Any, Optional
+import threading
+import time
+from typing import Any, Dict, Optional
 
 from minisched_tpu.controlplane.checkpoint import (
     CHECKPOINT_VERSION,
@@ -41,12 +69,60 @@ from minisched_tpu.controlplane.store import (
     DEFAULT_HISTORY_EVENTS,
     EventType,
     ObjectStore,
+    StorageDegraded,
     WatchEvent,
 )
+from minisched_tpu.controlplane.walio import (
+    HEADER_SIZE,
+    WalCorrupt,
+    WalReader,
+    encode_frame,
+    resync_scan,
+)
+from minisched_tpu.observability import counters
+
+
+class CheckpointCorrupt(Exception):
+    """Every arm of the checkpoint fallback chain failed AND no archived
+    history exists to rebuild from — recovery would be silently partial
+    (the WAL holds only the post-compaction tail).  Refused loudly; the
+    operator decides (restore a checkpoint, or accept the loss by
+    deleting the artifacts)."""
+
+
+#: ack records replayed from the WAL are bounded the same way as the
+#: HTTP façade's in-memory registry (oldest evicted first)
+ACK_REPLAY_CAP = 65536
+
+#: sha256 sidecar suffix for checkpoint files
+CKPT_DIGEST_SUFFIX = ".sha256"
+
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def checkpoint_digest(path: str, data: Optional[bytes] = None) -> dict:
+    """Sidecar verdict for one checkpoint file, shared by the restore
+    chain, the live scrub, and offline fsck (one parser for the sidecar
+    format, so the reserved algorithm byte can't drift three ways):
+    ``{"ok": True/False/None, "want": sidecar hex, "got": file hex}``;
+    ``ok=None`` means no sidecar (a pre-integrity generation)."""
+    if data is None:
+        with open(path, "rb") as f:
+            data = f.read()
+    got = _sha256_hex(data)
+    sidecar = path + CKPT_DIGEST_SUFFIX
+    if not os.path.exists(sidecar):
+        return {"ok": None, "want": "", "got": got}
+    with open(sidecar, encoding="utf-8") as f:
+        fields = f.read().strip().split()
+    want = fields[-1] if fields else ""
+    return {"ok": got == want, "want": want, "got": got}
 
 
 class DurableObjectStore(ObjectStore):
-    """ObjectStore whose mutations are logged to ``path`` before returning.
+    """ObjectStore whose mutations are logged to ``path`` before committing.
 
     ``fsync=True`` makes every append an fsync (etcd-grade durability at
     file-IO cost); the default flushes to the OS, surviving process death
@@ -55,7 +131,17 @@ class DurableObjectStore(ObjectStore):
     ``checkpoint_path`` (default ``<path>.ckpt``) holds the compaction
     snapshot; ``archive_compacted=True`` appends every truncated WAL
     segment to ``<path>.history`` first, so the FULL mutation history
-    stays auditable (faults.wal_double_binds) across compactions.
+    stays auditable (faults.wal_double_binds) across compactions — and
+    the checkpoint fallback chain can rebuild from scratch.
+
+    ``salvage`` is the mid-file corruption policy at replay: ``"off"``
+    (default) hard-fails with a precise WalCorrupt report; ``"covered"``
+    truncates at the first bad frame when the checkpoint covers the
+    loss (every decodable lost record has rv ≤ the restored snapshot's).
+
+    ``readonly=True`` replays without opening the append log, without
+    truncating torn tails, and with every mutation refused — the fsck
+    CLI's view of the artifacts.
     """
 
     def __init__(
@@ -66,7 +152,12 @@ class DurableObjectStore(ObjectStore):
         archive_compacted: bool = False,
         history_events: int = DEFAULT_HISTORY_EVENTS,
         history_bytes: int = DEFAULT_HISTORY_BYTES,
+        salvage: str = "off",
+        readonly: bool = False,
+        probe_interval_s: float = 0.25,
     ):
+        if salvage not in ("off", "covered"):
+            raise ValueError(f"salvage must be 'off' or 'covered', got {salvage!r}")
         super().__init__(
             history_events=history_events, history_bytes=history_bytes
         )
@@ -74,12 +165,37 @@ class DurableObjectStore(ObjectStore):
         self._ckpt_path = checkpoint_path or path + ".ckpt"
         self._archive = archive_compacted
         self._fsync = fsync
+        self._salvage = salvage
+        self._readonly = readonly
         self._closed = False
-        self._defer_flush = False  # batch mutations share one flush
+        self._defer_flush = False  # batch mutations share one fsync
         self._log = None  # replay must not re-log
         self._ckpt_rv = 0  # WAL records at/below this are pre-snapshot
+        self._ckpt_source = "none"  # current | prev | replay | none
+        #: binding acks recovered from WAL ``ack`` records (insertion
+        #: order == append order; the HTTP façade seeds its registry
+        #: from this so retried batches stay idempotent across restarts)
+        self._acks: Dict[str, dict] = {}
+        # -- degraded-mode state (all guarded by the store lock) --------
+        self._degraded = False
+        self._degraded_reason = ""
+        self._degraded_since = 0.0
+        self._degraded_seconds_total = 0.0
+        self._degraded_episodes = 0
+        self._probe_interval_s = probe_interval_s
+        self._last_probe = 0.0
+        self._scrub_stop: Optional[threading.Event] = None
+        self._scrub_thread: Optional[threading.Thread] = None
         self._replay()
-        self._log = open(self._path, "a", encoding="utf-8")
+        if readonly:
+            self._closed = True  # mutations refused; reads keep serving
+        else:
+            # unbuffered binary appends: every frame is ONE write() that
+            # hits the OS immediately, so ENOSPC/EIO surfaces on the
+            # failing record itself (pre-commit — store.py orders the
+            # append before the in-memory insert), not on a later flush
+            # after a whole batch already committed
+            self._log = open(self._path, "ab", buffering=0)
 
     # -- logging -----------------------------------------------------------
     @staticmethod
@@ -100,46 +216,176 @@ class DurableObjectStore(ObjectStore):
             )
 
     def _check_wal_writable(self, kind: str) -> None:
-        """``wal.append`` injection point (faults.FaultFabric): a WAL
-        write failure surfaces as a failed API call BEFORE the in-memory
-        commit — same reason as _check_open: failing AFTER the mutation
-        would leave watchers and the reopened WAL divergent.  (A real
-        mid-append crash is the other failure mode; the torn-tail
-        truncation in _replay covers that one.)"""
+        """Gate every mutation on the WAL being writable.  Two layers:
+        the degraded latch (a previous append hit ENOSPC/EIO — probe for
+        recovery, else refuse with the typed StorageDegraded), and the
+        ``wal.append`` injection point (faults.FaultFabric), which
+        surfaces as a failed API call.  Both fire BEFORE the in-memory
+        commit; the append itself is ALSO pre-commit (store.py), so even
+        a first-time disk failure never leaves memory ahead of disk."""
+        if self._degraded:
+            self._maybe_probe_recovery()
+            if self._degraded:
+                raise StorageDegraded(
+                    f"durable store {self._path!r} is read-only "
+                    f"(degraded: {self._degraded_reason})"
+                )
         faults = self.faults
         if faults is not None and self._loggable(kind):
             faults.check("wal.append", kind)
 
+    def _enter_degraded(self, err: BaseException) -> None:
+        if not self._degraded:
+            self._degraded = True
+            self._degraded_reason = str(err)
+            self._degraded_since = time.monotonic()
+            self._degraded_episodes += 1
+            counters.inc("storage.degraded_enter")
+
+    def _exit_degraded(self) -> None:
+        if self._degraded:
+            self._degraded = False
+            self._degraded_seconds_total += (
+                time.monotonic() - self._degraded_since
+            )
+            self._degraded_reason = ""
+            counters.inc("storage.degraded_recovered")
+
+    def _maybe_probe_recovery(self) -> None:
+        """Rate-limited write probe while degraded: append a bare rv
+        watermark (harmless at replay — it carries the counter the store
+        already holds).  Success means the disk came back (space freed,
+        IO error cleared) — re-arm writes; failure re-stamps the latch.
+        Called with the lock held, from the mutation gate and the scrub
+        loop, so recovery needs no operator action."""
+        now = time.monotonic()
+        if self._log is None or now - self._last_probe < self._probe_interval_s:
+            return
+        self._last_probe = now
+        counters.inc("storage.recovery_probe")
+        try:
+            self._append_raw({"op": "rv", "rv": self._rv}, probing=True)
+        except (OSError, StorageDegraded) as e:
+            self._degraded_reason = str(e)
+            return
+        self._exit_degraded()
+
     def _append(self, rec: dict) -> None:
         if self._log is None:
             return  # replay: the record being applied is already in the log
-        self._log.write(json.dumps(rec) + "\n")
-        if self._defer_flush:
-            return  # mutate_many flushes once for the whole batch
-        self._log.flush()
-        if self._fsync:
-            os.fsync(self._log.fileno())
+        self._append_raw(rec)
+
+    def _append_raw(self, rec: dict, probing: bool = False) -> None:
+        """Frame and write one record.  The fault fabric's disk points
+        live here — AFTER the JSON encode, so the schedule keys on real
+        appends:
+
+        ``disk.enospc``  the write fails (OSError) → degraded latch +
+                         StorageDegraded to the caller, pre-commit
+        ``wal.bitflip``  the write SUCCEEDS but a bit flipped inside the
+                         payload after the CRC was computed — the lying
+                         disk; memory and every observer proceed, replay
+                         and fsck must detect it
+        ``wal.torn_mid`` only a prefix of the frame reaches the file and
+                         later appends bury it — a torn write replay
+                         must locate, not JSONDecodeError past
+        """
+        payload = json.dumps(rec).encode()
+        frame = encode_frame(payload)
+        faults = self.faults
+        if faults is not None:
+            # disk.enospc fires for recovery PROBES too: a full disk
+            # stays full until the schedule's max_fires "frees space",
+            # so an injected episode has real dwell time instead of
+            # ending at the first probe tick
+            if faults.should_fire("disk.enospc", self._path):
+                err = OSError(
+                    errno.ENOSPC, "injected: no space left on device"
+                )
+                self._enter_degraded(err)
+                counters.inc("storage.append_error")
+                raise StorageDegraded(
+                    f"WAL append failed: {err}"
+                ) from err
+        if faults is not None and not probing:
+            if faults.should_fire("wal.bitflip", self._path):
+                buf = bytearray(frame)
+                buf[HEADER_SIZE + len(payload) // 2] ^= 0x01
+                frame = bytes(buf)
+                counters.inc("storage.bitflip_injected")
+            elif faults.should_fire("wal.torn_mid", self._path):
+                frame = frame[: HEADER_SIZE + max(len(payload) // 2, 1)]
+                counters.inc("storage.torn_injected")
+        try:
+            pre_end = self._log.tell()  # append mode: current EOF
+        except OSError:
+            pre_end = None
+        try:
+            n = self._log.write(frame)
+            if n is not None and n != len(frame):
+                # a SHORT raw write is how a filling disk often says
+                # ENOSPC without raising: the record did NOT land —
+                # latch degraded, refuse (the partial bytes are cut
+                # below so recovery probes never append after garbage)
+                raise OSError(
+                    errno.ENOSPC,
+                    f"short WAL write ({n}/{len(frame)} bytes)",
+                )
+            if not self._defer_flush and self._fsync:
+                os.fsync(self._log.fileno())
+        except OSError as e:
+            if pre_end is not None:
+                # a failed/short write may have left a PARTIAL frame at
+                # EOF; truncating back (truncate-to-smaller needs no new
+                # blocks, so it works on a full disk) keeps the tail
+                # clean — otherwise the recovery probe's next append
+                # would bury the garbage mid-file and the following
+                # restart would refuse the whole WAL as corrupt
+                try:
+                    self._log.truncate(pre_end)
+                except OSError:
+                    pass  # garbage stays; replay's detection owns it
+            self._enter_degraded(e)
+            counters.inc("storage.append_error")
+            raise StorageDegraded(f"WAL append failed: {e}") from e
+        if self._degraded and probing is False:
+            # an organic append succeeded while latched (shouldn't happen
+            # — the gate refuses first — but never strand the latch)
+            self._exit_degraded()
 
     def mutate_many(self, kind: str, items, return_objects: bool = True,
                     clone_for_write: bool = True) -> list:
-        """Batch read-modify-write with ONE log flush: every record is
+        """Batch read-modify-write with ONE fsync: every record is
         written (durability order preserved — same lock, same order via
-        the _on_batch_commit hook), but the flush/fsync is paid once per
-        batch instead of per bind."""
+        the _on_batch_commit hook, each an immediate unbuffered write),
+        but the fsync is paid once per batch instead of per bind."""
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
             self._defer_flush = True
             try:
+                # the batched fsync is the base class's _flush_log call,
+                # which lands BEFORE the fanout and RAISES on failure —
+                # an un-fsynced batch must not be acknowledged or fanned
+                # out (with fsync=True that is the whole durability
+                # promise); the finally only clears the defer flag
                 return super().mutate_many(
                     kind, items, return_objects, clone_for_write
                 )
             finally:
                 self._defer_flush = False
-                if self._log is not None:
-                    self._log.flush()
-                    if self._fsync:
-                        os.fsync(self._log.fileno())
+
+    def _fsync_log(self) -> None:
+        """The deferred-batch fsync barrier: raises StorageDegraded on
+        failure — callers must not acknowledge (or fan out) a batch the
+        disk refused to make durable."""
+        if self._log is not None and self._fsync:
+            try:
+                os.fsync(self._log.fileno())
+            except OSError as e:
+                self._enter_degraded(e)
+                counters.inc("storage.append_error")
+                raise StorageDegraded(f"WAL fsync failed: {e}") from e
 
     def _append_rv_watermark(self, rv: int) -> None:
         """Persist a bare version-counter record for a mutation whose kind
@@ -154,17 +400,19 @@ class DurableObjectStore(ObjectStore):
     def _on_batch_commit(self, kind: str, obj: Any) -> None:
         # the inlined batch path commits without calling update() — log
         # each stored object here, inside the same lock hold and order
+        # (and BEFORE the insert: store.py calls this hook pre-commit)
         if self._loggable(kind):
             self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
         else:
             self._append_rv_watermark(obj.metadata.resource_version)
 
     def _commit_record(self, kind: str, op: str, obj: Any, rv: int) -> None:
-        # the base store calls this AFTER the in-memory commit and BEFORE
-        # the watch fanout — so the record (flushed by _append) is on
-        # disk before any observer can see the resource_version.  A crash
-        # after fanout can then never roll back an observed rv, which is
-        # what keeps ``?resource_version=N`` resumes honest.
+        # the base store calls this BEFORE the in-memory commit and the
+        # watch fanout — the record is on disk (one unbuffered write)
+        # before the object exists anywhere an observer could see it.  A
+        # failed append therefore means the mutation never happened: no
+        # phantom state, no resource_version a crash could roll back,
+        # which is what keeps ``?resource_version=N`` resumes honest.
         if op == "put":
             if self._loggable(kind):
                 self._append({"op": "put", "kind": kind, "obj": _encode(obj)})
@@ -184,12 +432,9 @@ class DurableObjectStore(ObjectStore):
                 self._append_rv_watermark(rv)
 
     def _flush_log(self) -> None:
-        # mutate_many's pre-fanout barrier: records were appended under
-        # _defer_flush — force them out before the batch's events go live
-        if self._log is not None:
-            self._log.flush()
-            if self._fsync:
-                os.fsync(self._log.fileno())
+        # mutate_many's pre-fanout barrier: with unbuffered appends the
+        # bytes are already at the OS — only the batched fsync is owed
+        self._fsync_log()
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:
@@ -200,7 +445,7 @@ class DurableObjectStore(ObjectStore):
     def create_many(
         self, kind: str, objs: list, return_objects: bool = True
     ) -> list:
-        """Batch create with ONE log flush — same deferred-flush contract
+        """Batch create with ONE fsync — same deferred-fsync contract
         as mutate_many (records append in commit order via
         _on_batch_commit, the barrier lands before the batched fanout)."""
         with self._lock:
@@ -208,13 +453,11 @@ class DurableObjectStore(ObjectStore):
             self._check_wal_writable(kind)
             self._defer_flush = True
             try:
+                # fsync rides the base class's pre-fanout _flush_log
+                # barrier and raises on failure (see mutate_many)
                 return super().create_many(kind, objs, return_objects)
             finally:
                 self._defer_flush = False
-                if self._log is not None:
-                    self._log.flush()
-                    if self._fsync:
-                        os.fsync(self._log.fileno())
 
     def update(self, kind: str, obj: Any, expected_rv: Optional[int] = None) -> Any:
         with self._lock:
@@ -242,22 +485,72 @@ class DurableObjectStore(ObjectStore):
             # or reopened stores would re-issue observed versions
             self._append({"op": "rv", "rv": self.resource_version})
 
+    # -- binding-ack persistence (WAL-backed retry idempotency) ------------
+    def record_acks(self, entries: Dict[str, dict]) -> None:
+        """Persist binding-batch ack outcomes as volatile WAL records
+        (``{"op": "ack", "id", "entry"}``) so a RETRIED batch stays
+        idempotent across a server restart — the ROADMAP crumb the
+        in-memory registry left open.  Best-effort by design: acks are a
+        dedup optimization layered over the bind subresource's own
+        preconditions (AlreadyBound-to-the-requested-node ⇒ the retried
+        entry landed), so a degraded disk drops them silently rather
+        than failing the bind response that already committed."""
+        if not entries:
+            return
+        with self._lock:
+            if self._closed or self._degraded or self._log is None:
+                return
+            self._defer_flush = True
+            try:
+                for ack_id, entry in entries.items():
+                    self._append_raw(
+                        {"op": "ack", "id": str(ack_id), "entry": entry}
+                    )
+                    self._acks[str(ack_id)] = entry
+                    while len(self._acks) > ACK_REPLAY_CAP:
+                        self._acks.pop(next(iter(self._acks)))
+                self._fsync_log()
+            except StorageDegraded:
+                pass  # latched; the in-memory registry still answers
+            finally:
+                self._defer_flush = False
+
+    def recovered_acks(self) -> Dict[str, dict]:
+        """Ack outcomes replayed from the WAL, in append order (the HTTP
+        façade seeds its registry + FIFO from this at boot)."""
+        with self._lock:
+            return dict(self._acks)
+
     # -- recovery ----------------------------------------------------------
-    def _load_checkpoint(self) -> int:
-        """Restore the compaction snapshot (if any) directly into the
-        object maps — no WAL re-log, no watch fanout (a fresh store has no
-        watchers; the ring starts at the tail).  Returns the snapshot's
-        resource_version: the skip watermark for tail replay and the
-        history floor for watch resume."""
-        if not os.path.exists(self._ckpt_path):
-            return 0
-        with open(self._ckpt_path, encoding="utf-8") as f:
-            doc = json.load(f)
+    def _read_checkpoint_file(self, path: str) -> dict:
+        """Read + digest-verify one checkpoint generation.  A sidecar
+        mismatch or unparseable body raises ValueError; a MISSING sidecar
+        is accepted unverified (pre-integrity checkpoints carry none)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        verdict = checkpoint_digest(path, data)
+        if verdict["ok"] is False:
+            counters.inc("storage.ckpt_digest_mismatch")
+            raise ValueError(
+                f"checkpoint digest mismatch for {path!r}: sidecar "
+                f"{verdict['want'][:12]}…, file {verdict['got'][:12]}…"
+            )
+        if verdict["ok"] is None:
+            counters.inc("storage.ckpt_unverified")
+        doc = json.loads(data)
         if doc.get("version") != CHECKPOINT_VERSION:
             raise ValueError(
                 f"unsupported checkpoint version {doc.get('version')!r} "
-                f"in {self._ckpt_path!r}"
+                f"in {path!r}"
             )
+        return doc
+
+    def _restore_snapshot_doc(self, doc: dict) -> int:
+        """Apply one verified snapshot document directly into the object
+        maps — no WAL re-log, no watch fanout (a fresh store has no
+        watchers; the ring starts at the tail).  Returns the snapshot's
+        resource_version: the skip watermark for tail replay and the
+        history floor for watch resume."""
         for kind, items in (doc.get("objects") or {}).items():
             tp = KIND_TYPES.get(kind)
             if tp is None:
@@ -274,9 +567,56 @@ class DurableObjectStore(ObjectStore):
         self._recovered_uid_max = max(
             self._recovered_uid_max, int(doc.get("uid_floor", 0))
         )
+        # binding acks compacted into the snapshot; WAL ``ack`` records
+        # replayed afterwards overwrite/extend (they are newer)
+        for ack_id, entry in (doc.get("acks") or {}).items():
+            self._acks[str(ack_id)] = entry
+        while len(self._acks) > ACK_REPLAY_CAP:
+            self._acks.pop(next(iter(self._acks)))
         rv = int(doc.get("resource_version", 0))
         self._rv = max(self._rv, rv)
         return rv
+
+    def _load_checkpoint(self) -> int:
+        """The fallback chain: current generation (digest-verified) →
+        previous generation → full WAL+archive replay.  Returns the rv
+        watermark of whichever snapshot restored (0 = none: replay the
+        whole log; with an archive that is the FULL history, so nothing
+        is lost even when both generations rot).  Refuses loudly
+        (CheckpointCorrupt) when every arm fails AND there is no archive
+        — the bare WAL tail would be silently-partial state."""
+        candidates = [
+            (self._ckpt_path, "current"),
+            (self._ckpt_path + ".prev", "prev"),
+        ]
+        errors = []
+        any_present = False
+        for path, which in candidates:
+            if not os.path.exists(path):
+                continue
+            any_present = True
+            try:
+                doc = self._read_checkpoint_file(path)
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                errors.append(f"{which}: {e}")
+                continue
+            if which == "prev":
+                counters.inc("storage.ckpt_fallback_prev")
+            self._ckpt_source = which
+            return self._restore_snapshot_doc(doc)
+        if not any_present:
+            self._ckpt_source = "none"
+            return 0
+        # both generations unusable: rebuild from the archived history
+        if os.path.exists(self._path + ".history"):
+            counters.inc("storage.ckpt_fallback_replay")
+            self._ckpt_source = "replay"
+            return 0  # full replay: _replay reads .history before the WAL
+        raise CheckpointCorrupt(
+            f"no usable checkpoint for {self._path!r} and no archive to "
+            f"rebuild from ({'; '.join(errors)}); the WAL alone is only "
+            f"the post-compaction tail — refusing silent partial recovery"
+        )
 
     def _drain_pending_archive(self) -> None:
         """Finish an interrupted archive: compact() atomically RENAMES the
@@ -320,41 +660,37 @@ class DurableObjectStore(ObjectStore):
 
     def _replay(self) -> None:
         self._recovered_uid_max = 0
-        if self._archive:
+        if self._archive and not self._readonly:
             # a crash mid-archive leaves a claimed segment; fold it into
             # the history file before anything else (its records are all
             # at/below the checkpoint that retired it — replay skips them)
             self._drain_pending_archive()
         self._ckpt_rv = self._load_checkpoint()
+        if self._ckpt_source in ("prev", "replay"):
+            # fallback arms that need the archive: with "replay" both
+            # checkpoint generations were unusable and the state rebuilds
+            # from the FULL history (rv-skip moot, _ckpt_rv == 0); with
+            # "prev" the records between the previous generation and the
+            # rotten current one were TRUNCATED out of the live WAL at
+            # the last compaction and survive only in the archive —
+            # replaying it over the prev snapshot is what makes the
+            # fallback lossless (rv-skip drops the ≤ prev-rv overlap).
+            # A non-archived store falling back to prev has no such
+            # middle to recover — best effort, counted by the fallback
+            # counter so the gap is visible.  Segments replay in append
+            # (= mutation) order, then the live WAL.
+            for p in (
+                self._path + ".history",
+                self._path + ".pending-archive",
+            ):
+                if os.path.exists(p):
+                    self._replay_wal(p, truncate=False)
         if self._ckpt_rv:
             # events at/below the snapshot's rv are not reconstructable —
             # a watch resuming from before it must get 410 and relist
             self.set_history_floor(self._ckpt_rv)
-        if not os.path.exists(self._path):
-            return
-        good_end = 0  # byte offset past the last decodable record
-        with open(self._path, "rb") as f:
-            data = f.read()
-        lines = data.splitlines(keepends=True)
-        for idx, raw in enumerate(lines):
-            line = raw.decode("utf-8", errors="replace").strip()
-            if not line:
-                good_end += len(raw)
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                if idx == len(lines) - 1:
-                    break  # torn tail from a crash mid-append: drop it
-                raise
-            self._apply(rec)
-            good_end += len(raw)
-        if good_end < len(data):
-            # physically truncate the torn tail — appending after it would
-            # concatenate the next record onto garbage, losing it on the
-            # following reopen (and poisoning every later replay)
-            with open(self._path, "rb+") as f:
-                f.truncate(good_end)
+        if os.path.exists(self._path):
+            self._replay_wal(self._path, truncate=not self._readonly)
         # uid continuity: a fresh interpreter's counter restarts at zero,
         # and re-issuing a recovered object's uid would let two DIFFERENT
         # pods share an identity (false double-bind audit hits, queue
@@ -370,6 +706,75 @@ class DurableObjectStore(ObjectStore):
         # once here instead of tracking per replayed record
         self._rebuild_node_agg()
 
+    def _replay_wal(self, path: str, truncate: bool) -> None:
+        """Replay one WAL file through the mixed v1/v2 frame reader.
+
+        A torn TAIL (crash mid-append) is dropped and — when
+        ``truncate`` — physically truncated, so the next append never
+        concatenates onto garbage.  Mid-file corruption raises the
+        reader's WalCorrupt (offset, record index, rv window) unless
+        ``salvage="covered"`` AND the checkpoint covers the loss:
+        every record still decodable at/after the bad frame (magic-scan
+        resync) has rv ≤ the restored snapshot's — i.e. replay would
+        have SKIPPED it anyway — in which case the file truncates at the
+        bad frame and recovery proceeds losslessly.  An undecodable BAD
+        TAIL (nothing resyncs after the corruption) is treated like a
+        torn tail under salvage — with ``fsync=False`` the tail's
+        durability was never promised — and hard-fails by default (a CRC
+        mismatch is a lie, not an incomplete write)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        reader = WalReader(data, path=path)
+        corrupt: Optional[WalCorrupt] = None
+        try:
+            for rec, _end in reader:
+                self._apply(rec)
+        except WalCorrupt as err:
+            counters.inc("storage.wal_corrupt_detected")
+            corrupt = err
+        good_end = reader.good_end
+        if corrupt is not None:
+            if self._salvage != "covered":
+                raise corrupt
+            resync = resync_scan(data, corrupt.offset + 1)
+            if resync is not None:
+                from minisched_tpu.controlplane.walio import _rec_rv
+
+                lost_rvs = [
+                    rv for r in resync[1] if (rv := _rec_rv(r)) > 0
+                ]
+                # coverage needs an rv-carrying WITNESS: records are in
+                # append (= rv) order, so one put/del/rv record at
+                # rv ≤ ckpt bounds everything before it — but a suffix
+                # of only rv-less records (acks) bounds NOTHING; the
+                # corrupt frame itself could be a post-checkpoint bind,
+                # and truncating would silently lose it
+                if not lost_rvs or max(lost_rvs) > self._ckpt_rv:
+                    reach = (
+                        f"reach rv {max(lost_rvs)}"
+                        if lost_rvs
+                        else "carry no resource_version"
+                    )
+                    raise WalCorrupt(
+                        path,
+                        corrupt.offset,
+                        corrupt.index,
+                        f"{corrupt.reason}; salvage refused: records past "
+                        f"the corruption {reach} (checkpoint rv "
+                        f"{self._ckpt_rv}) — truncating could lose "
+                        f"committed state",
+                        last_good_rv=corrupt.last_good_rv,
+                        resync_rv=corrupt.resync_rv,
+                    )
+            counters.inc("storage.wal_salvaged")
+        if truncate and good_end < len(data):
+            # physically truncate the torn tail (or, under salvage, the
+            # covered corrupt region) — appending after it would
+            # concatenate the next record onto garbage, losing it on the
+            # following reopen (and poisoning every later replay)
+            with open(path, "rb+") as f:
+                f.truncate(good_end)
+
     def _apply(self, rec: dict) -> None:
         """Apply one WAL record; also rebuilds the watch-resume history
         ring (replay = the tail of the live event stream).  Records at or
@@ -381,6 +786,13 @@ class DurableObjectStore(ObjectStore):
         op = rec["op"]
         if op == "rv":
             self._rv = max(self._rv, rec["rv"])
+            return
+        if op == "ack":
+            # binding-ack registry records (volatile: no object, no rv);
+            # bounded exactly like the façade's in-memory registry
+            self._acks[str(rec.get("id"))] = rec.get("entry") or {}
+            while len(self._acks) > ACK_REPLAY_CAP:
+                self._acks.pop(next(iter(self._acks)))
             return
         kind = rec["kind"]
         if kind not in KIND_TYPES:
@@ -420,23 +832,73 @@ class DurableObjectStore(ObjectStore):
     # -- compaction --------------------------------------------------------
     def compact(self) -> None:
         """Checkpoint compaction: snapshot the live state to
-        ``checkpoint_path`` (temp file + fsync + atomic replace), then
-        truncate the WAL — recovery is snapshot ⊕ WAL tail.  Crash-safe at
-        every step: until the rename lands, the old checkpoint + full WAL
-        recover; between the rename and the truncate, replay's rv-skip
-        ignores the now-redundant WAL prefix.  ``archive_compacted``
+        ``checkpoint_path`` (temp file + fsync + atomic replace, with a
+        sha256 sidecar and the previous generation kept as ``.prev``),
+        then truncate the WAL — recovery is snapshot ⊕ WAL tail.
+        Crash-safe at every step: until the rename lands, the old
+        checkpoint + full WAL recover; between the rename and the
+        truncate, replay's rv-skip ignores the now-redundant WAL prefix;
+        a digest mismatch at restore (bit rot, a crash between the body
+        and sidecar renames) falls back to the prev generation — and the
+        WAL truncation only ever happens after BOTH renames, so the prev
+        arm always has the full tail it needs.  ``archive_compacted``
         appends the truncated records to ``<path>.history`` first so the
         full mutation history stays auditable."""
         with self._lock:
-            if self._log is not None:
-                self._log.flush()
             doc = build_snapshot_doc(self._objects, self._rv)
+            if self._acks:
+                # the binding-ack registry rides the checkpoint (bounded
+                # — ACK_REPLAY_CAP tiny dicts): its WAL records are about
+                # to be truncated away, and 'idempotent across restarts'
+                # must survive compaction, not just the WAL tail.  Extra
+                # keys are ignored by older/foreign checkpoint readers.
+                doc["acks"] = dict(self._acks)
+            body = json.dumps(doc).encode()
+            digest = _sha256_hex(body)
+            sidecar = self._ckpt_path + CKPT_DIGEST_SUFFIX
             tmp = self._ckpt_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(doc, f)
+            tmp_side = sidecar + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(body)
                 f.flush()
                 os.fsync(f.fileno())
+            with open(tmp_side, "w", encoding="utf-8") as f:
+                f.write(f"sha256 {digest}\n")
+                f.flush()
+                os.fsync(f.fileno())
+            # rotate the old generation aside (keep exactly one), then
+            # land the new pair.  A crash between any two renames leaves
+            # a chain arm that still recovers: prev + full WAL.
+            if os.path.exists(self._ckpt_path):
+                os.replace(self._ckpt_path, self._ckpt_path + ".prev")
+                if os.path.exists(sidecar):
+                    os.replace(
+                        sidecar, self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
+                    )
+                else:
+                    # the old generation predates sidecars — drop any
+                    # stale prev sidecar so it can't mis-verify it
+                    try:
+                        os.unlink(
+                            self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
+                        )
+                    except FileNotFoundError:
+                        pass
             os.replace(tmp, self._ckpt_path)
+            os.replace(tmp_side, sidecar)
+            faults = self.faults
+            if faults is not None and faults.should_fire(
+                "ckpt.corrupt", self._ckpt_path
+            ):
+                # the lying disk rots the checkpoint AFTER a clean write:
+                # flip one byte mid-file; the sidecar now convicts it and
+                # the next restore must take the fallback chain
+                with open(self._ckpt_path, "rb+") as f:
+                    f.seek(len(body) // 2)
+                    b = f.read(1)
+                    f.seek(len(body) // 2)
+                    f.write(bytes([b[0] ^ 0x01]))
+                counters.inc("storage.ckpt_corrupt_injected")
             self._ckpt_rv = self._rv
             if self._log is not None:
                 self._log.close()
@@ -466,12 +928,119 @@ class DurableObjectStore(ObjectStore):
                 # refused loudly instead of acknowledged and lost.
                 if not self._closed:
                     try:
-                        self._log = open(self._path, "a", encoding="utf-8")
+                        self._log = open(self._path, "ab", buffering=0)
                     except OSError:
                         self._closed = True
                         raise
 
+    # -- scrub -------------------------------------------------------------
+    def scrub(self) -> dict:
+        """One background integrity pass over the live artifacts — the
+        in-process half of ``python -m minisched_tpu fsck`` (which runs
+        the same checks offline over a closed store's files):
+
+        * WAL frame scan (the stable prefix; a torn tail under a live
+          writer is expected, not a finding)
+        * checkpoint sha256 sidecar verification (both generations)
+        * per-node aggregate index vs a fresh recompute from the live
+          objects (the invariant client._node_budgets trusts)
+        * rv-counter sanity (counter ≥ every live object's rv)
+        * degraded-mode recovery probe (a scrub pass is the natural
+          re-arm tick when no mutation has tried recently)
+
+        Returns ``{findings: [...], ...stats}``; every finding also
+        bumps ``storage.scrub_findings``."""
+        counters.inc("storage.scrub_runs")
+        findings = []
+        with self._lock:
+            if self._degraded:
+                self._maybe_probe_recovery()
+            from minisched_tpu.controlplane.store import compute_node_agg
+
+            agg_live = {k: list(v) for k, v in self._pod_node_agg.items()}
+            recompute = compute_node_agg(
+                self._objects.get("Pod", {}).values()
+            )
+            if agg_live != recompute:
+                findings.append(
+                    "node aggregate index diverged from live objects: "
+                    f"{sorted(set(agg_live) ^ set(recompute))[:5]}"
+                )
+            max_obj_rv = max(
+                (
+                    o.metadata.resource_version
+                    for objs in self._objects.values()
+                    for o in objs.values()
+                ),
+                default=0,
+            )
+            if max_obj_rv > self._rv:
+                findings.append(
+                    f"rv counter {self._rv} behind live object rv "
+                    f"{max_obj_rv}"
+                )
+            degraded = self._degraded
+        from minisched_tpu.controlplane.walio import scan_file
+
+        wal_report = scan_file(self._path)
+        if wal_report.get("corrupt"):
+            c = wal_report["corrupt"]
+            findings.append(
+                f"WAL corruption at byte {c['offset']} ({c['reason']})"
+            )
+        for path in (self._ckpt_path, self._ckpt_path + ".prev"):
+            if not os.path.exists(path):
+                continue
+            try:
+                self._read_checkpoint_file(path)
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                findings.append(f"checkpoint {path!r}: {e}")
+        if findings:
+            counters.inc("storage.scrub_findings", len(findings))
+        return {
+            "findings": findings,
+            "degraded": degraded,
+            "wal": wal_report,
+        }
+
+    def start_scrub(self, interval_s: float = 1.0) -> None:
+        """Arm the background scrub loop (idempotent)."""
+        if self._scrub_thread is not None:
+            return
+        self._scrub_stop = threading.Event()
+
+        def loop() -> None:
+            while not self._scrub_stop.wait(interval_s):
+                try:
+                    self.scrub()
+                except Exception:
+                    pass  # scrub is advisory; never kill the thread
+
+        self._scrub_thread = threading.Thread(
+            target=loop, name="wal-scrub", daemon=True
+        )
+        self._scrub_thread.start()
+
+    def storage_stats(self) -> dict:
+        """The degraded-mode ledger for benches and dashboards."""
+        with self._lock:
+            dwell = self._degraded_seconds_total
+            if self._degraded:
+                dwell += time.monotonic() - self._degraded_since
+            return {
+                "degraded": self._degraded,
+                "degraded_reason": self._degraded_reason,
+                "degraded_episodes": self._degraded_episodes,
+                "degraded_dwell_s": round(dwell, 3),
+                "ckpt_source": self._ckpt_source,
+            }
+
     def close(self) -> None:
+        if self._scrub_stop is not None:
+            self._scrub_stop.set()
+        if self._scrub_thread is not None:
+            self._scrub_thread.join(timeout=5.0)
+            self._scrub_thread = None
         with self._lock:
             self._closed = True
             if self._log is not None:

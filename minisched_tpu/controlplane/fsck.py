@@ -1,0 +1,237 @@
+"""Offline storage-integrity verifier: ``python -m minisched_tpu fsck``.
+
+The scrub thread (DurableObjectStore.scrub) checks a LIVE store; this
+module is the offline half — point it at a WAL path and it verifies
+every durable artifact the way a paranoid operator would before trusting
+a recovered plane:
+
+* **frames** — every record in the WAL, ``.history`` archive, and any
+  ``.pending-archive`` segment decodes with a valid CRC; torn tails are
+  classified (expected crash weather), mid-file corruption is an error
+  with byte offset + rv window
+* **checkpoint digests** — both generations against their sha256
+  sidecars (a missing sidecar on a pre-integrity checkpoint is a
+  warning, not an error)
+* **replay** — the REAL recovery path (a readonly DurableObjectStore:
+  checkpoint fallback chain ⊕ WAL tail, strict corruption policy)
+  actually produces a state
+* **rv/uid monotonicity** — put/del record rvs never regress within a
+  file, no uid ever names two different object keys
+* **aggregate index** — the per-node request aggregates the bind
+  transaction trusts (client._node_budgets) equal an independent
+  recompute from the replayed objects
+* **exactly-once** — the full-history double-bind audit
+  (faults.wal_double_binds)
+
+Returns a JSON-able report; ``ok`` is False iff any error was found.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from minisched_tpu.controlplane.walio import WalCorrupt, scan_file
+
+
+def _check_record_stream(path: str, errors: List[str], warnings: List[str]) -> Dict[str, Any]:
+    """One file's frame scan folded into the report lists."""
+    rep = scan_file(path)
+    if rep.get("missing"):
+        return rep
+    if rep.get("corrupt"):
+        c = rep["corrupt"]
+        errors.append(
+            f"{path}: corrupt record at byte {c['offset']} (record "
+            f"#{c['index']}): {c['reason']}; last good rv "
+            f"{c['last_good_rv']}, first resynced rv {c['resync_rv']}"
+        )
+    if rep.get("torn_tail"):
+        warnings.append(
+            f"{path}: torn tail after {rep['records']} records "
+            f"(crash mid-append; replay truncates it)"
+        )
+    return rep
+
+
+def _check_rv_uid(path: str, errors: List[str], uid_keys: Dict[str, str]) -> None:
+    """rv monotonicity within one file + uid↔key aliasing across all
+    files (the caller shares ``uid_keys``)."""
+    from minisched_tpu.controlplane.walio import (
+        _rec_rv,
+        iter_wal_records_lenient,
+    )
+
+    last_rv = 0
+    for rec in iter_wal_records_lenient(path):
+        op = rec.get("op")
+        if op in ("put", "del"):
+            rv = _rec_rv(rec)
+            if rv and rv < last_rv:
+                errors.append(
+                    f"{path}: rv regressed {last_rv} -> {rv} "
+                    f"(op={op}, kind={rec.get('kind')})"
+                )
+            last_rv = max(last_rv, rv)
+        if op == "put":
+            meta = (rec.get("obj") or {}).get("metadata") or {}
+            uid, key = meta.get("uid"), (
+                f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+            )
+            if uid:
+                prev = uid_keys.setdefault(uid, key)
+                if prev != key:
+                    errors.append(
+                        f"{path}: uid {uid!r} names two objects "
+                        f"({prev!r} and {key!r})"
+                    )
+
+
+def _check_checkpoints(
+    wal_path: str, checkpoint_path: str,
+    errors: List[str], warnings: List[str],
+) -> Dict[str, Any]:
+    from minisched_tpu.controlplane.durable import checkpoint_digest
+
+    out: Dict[str, Any] = {}
+    for path, which in (
+        (checkpoint_path, "current"),
+        (checkpoint_path + ".prev", "prev"),
+    ):
+        if not os.path.exists(path):
+            out[which] = {"missing": True}
+            continue
+        entry: Dict[str, Any] = {"size": os.path.getsize(path)}
+        with open(path, "rb") as f:
+            data = f.read()
+        verdict = checkpoint_digest(path, data)
+        entry["digest_ok"] = verdict["ok"]
+        if verdict["ok"] is False:
+            errors.append(
+                f"{path}: sha256 mismatch (sidecar {verdict['want'][:12]}…, "
+                f"file {verdict['got'][:12]}…)"
+            )
+        elif verdict["ok"] is None:
+            warnings.append(f"{path}: no sha256 sidecar (pre-integrity)")
+        try:
+            doc = json.loads(data)
+            entry["resource_version"] = int(doc.get("resource_version", 0))
+            entry["uid_floor"] = int(doc.get("uid_floor", 0))
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            entry["parse_error"] = str(e)
+            if entry.get("digest_ok"):
+                # digest valid but body unparseable = writer bug, always
+                # an error; digest-invalid bodies were already reported
+                errors.append(f"{path}: unparseable checkpoint body: {e}")
+        out[which] = entry
+    return out
+
+
+def fsck(wal_path: str, checkpoint_path: Optional[str] = None) -> Dict[str, Any]:
+    """Run every offline integrity check; see the module docstring."""
+    from minisched_tpu.controlplane.durable import (
+        CheckpointCorrupt,
+        DurableObjectStore,
+    )
+    from minisched_tpu.faults import wal_double_binds
+
+    checkpoint_path = checkpoint_path or wal_path + ".ckpt"
+    errors: List[str] = []
+    warnings: List[str] = []
+    files: Dict[str, Any] = {}
+    for p in (
+        wal_path,
+        wal_path + ".history",
+        wal_path + ".pending-archive",
+    ):
+        files[os.path.basename(p)] = _check_record_stream(p, errors, warnings)
+    files["checkpoints"] = _check_checkpoints(
+        wal_path, checkpoint_path, errors, warnings
+    )
+    uid_keys: Dict[str, str] = {}
+    for p in (wal_path + ".history", wal_path + ".pending-archive", wal_path):
+        if os.path.exists(p):
+            _check_rv_uid(p, errors, uid_keys)
+
+    state: Dict[str, Any] = {}
+    store = None
+    try:
+        # the REAL recovery path, read-only: fallback chain + strict replay
+        store = DurableObjectStore(
+            wal_path, checkpoint_path=checkpoint_path,
+            archive_compacted=os.path.exists(wal_path + ".history"),
+            readonly=True,
+        )
+    except WalCorrupt as e:
+        errors.append(f"replay: {e}")
+    except CheckpointCorrupt as e:
+        errors.append(f"checkpoint chain: {e}")
+    except Exception as e:  # noqa: BLE001 — fsck reports, never crashes
+        errors.append(f"replay failed: {type(e).__name__}: {e}")
+    if store is not None:
+        state["resource_version"] = store.resource_version
+        state["ckpt_source"] = store._ckpt_source
+        state["objects"] = {
+            kind: len(objs)
+            for kind, objs in store._objects.items()
+            if objs
+        }
+        max_obj_rv = max(
+            (
+                o.metadata.resource_version
+                for objs in store._objects.values()
+                for o in objs.values()
+            ),
+            default=0,
+        )
+        if max_obj_rv > store.resource_version:
+            errors.append(
+                f"replayed rv counter {store.resource_version} behind "
+                f"object rv {max_obj_rv} — reopen would re-issue versions"
+            )
+        # the aggregate index the bind transaction trusts, against the
+        # shared independent recompute (same check the live scrub runs)
+        from minisched_tpu.controlplane.store import compute_node_agg
+
+        recompute = compute_node_agg(store._objects.get("Pod", {}).values())
+        if {k: list(v) for k, v in store._pod_node_agg.items()} != recompute:
+            errors.append(
+                "per-node aggregate index diverged from replayed pods"
+            )
+    violations = wal_double_binds(wal_path)
+    if violations:
+        errors.append(
+            f"double binds in history: {violations[:5]}"
+            + ("…" if len(violations) > 5 else "")
+        )
+    return {
+        "wal": wal_path,
+        "ok": not errors,
+        "errors": errors,
+        "warnings": warnings,
+        "files": files,
+        "state": state,
+        "double_binds": len(violations),
+    }
+
+
+def main(argv: List[str]) -> int:
+    """CLI entry (dispatched from ``python -m minisched_tpu fsck``):
+    prints the JSON report; exit 0 clean, 1 on any integrity error."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m minisched_tpu fsck",
+        description="verify WAL frames, checkpoint digests, rv/uid "
+        "monotonicity, aggregate index, and exactly-once binds",
+    )
+    parser.add_argument("wal", help="path to the WAL file")
+    parser.add_argument(
+        "--checkpoint", default=None,
+        help="checkpoint path (default: <wal>.ckpt)",
+    )
+    args = parser.parse_args(argv)
+    report = fsck(args.wal, checkpoint_path=args.checkpoint)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1

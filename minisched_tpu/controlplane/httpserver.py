@@ -46,6 +46,7 @@ from minisched_tpu.controlplane.store import (
     Conflict,
     HistoryCompacted,
     ObjectStore,
+    StorageDegraded,
 )
 
 
@@ -333,6 +334,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(409, str(e))
             except (Conflict, OutOfCapacity) as e:
                 self._error(409, str(e))
+            except StorageDegraded as e:
+                # 507 Insufficient Storage: the WAL cannot append (ENOSPC/
+                # EIO latch) — transient by contract (the store probes its
+                # own recovery), so the remote client retries with backoff
+                self._error(507, str(e))
             except KeyError as e:
                 self._error(404, str(e))
             return
@@ -359,6 +365,8 @@ class _Handler(BaseHTTPRequestHandler):
         _fixup_namespace(kind, ns, obj)
         try:
             self._send(201, _encode(self.store.create(kind, obj)))
+        except StorageDegraded as e:
+            self._error(507, str(e))
         except KeyError as e:
             self._error(409, str(e))
 
@@ -382,12 +390,21 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             _fixup_namespace(kind, ns, obj)
             decoded.append((i, obj))
-        results = self.store.create_many(
-            kind, [o for _, o in decoded], return_objects=return_objects
-        )
+        try:
+            results = self.store.create_many(
+                kind, [o for _, o in decoded], return_objects=return_objects
+            )
+        except StorageDegraded as e:
+            self._error(507, str(e))
+            return
         for (i, _), res in zip(decoded, results):
             if isinstance(res, KeyError):
                 out[i] = {"error": str(res), "type": "Conflict"}
+            elif isinstance(res, StorageDegraded):
+                # mid-batch ENOSPC: earlier items landed, this one (and
+                # the rest) were refused pre-commit — typed per entry so
+                # the remote facade can surface a retriable error
+                out[i] = {"error": str(res), "type": "StorageDegraded"}
             elif isinstance(res, BaseException):
                 out[i] = {"error": str(res), "type": "Error"}
             elif res is None:
@@ -445,9 +462,15 @@ class _Handler(BaseHTTPRequestHandler):
                     if entry is not None:
                         replayed[i] = entry
         todo = [i for i in range(len(bindings)) if i not in replayed]
-        results = Client(self.store).pods().bind_many(
-            [bindings[i] for i in todo], return_objects=return_objects
-        )
+        try:
+            results = Client(self.store).pods().bind_many(
+                [bindings[i] for i in todo], return_objects=return_objects
+            )
+        except StorageDegraded as e:
+            # the WHOLE transaction was refused pre-commit (degraded
+            # latch): 507, retryable — nothing to ack, nothing landed
+            self._error(507, str(e))
+            return
         out: list = [None] * len(bindings)
         fresh: dict = {}
         for i, res in zip(todo, results):
@@ -468,6 +491,10 @@ class _Handler(BaseHTTPRequestHandler):
                 entry = {"error": str(res), "type": "Conflict"}
             elif isinstance(res, OutOfCapacity):
                 entry = {"error": str(res), "type": "OutOfCapacity"}
+            elif isinstance(res, StorageDegraded):
+                # ENOSPC hit mid-batch: this bind never committed —
+                # typed so the remote client requeues it as retriable
+                entry = {"error": str(res), "type": "StorageDegraded"}
             elif isinstance(res, BaseException):
                 entry = {"error": str(res), "type": "NotFound"}
             elif res is not None:
@@ -477,8 +504,12 @@ class _Handler(BaseHTTPRequestHandler):
             out[i] = entry
             # the registry keeps the OUTCOME, never the encoded pod: a
             # success pins one tiny dict, not a multi-KB document, at
-            # 65536 entries (the replay re-reads the live object below)
-            fresh[i] = entry if "error" in entry else {"committed": True}
+            # 65536 entries (the replay re-reads the live object below).
+            # A degraded entry is NOT an outcome — the bind never ran,
+            # and acking it would make the retry replay the transient
+            # error instead of re-executing the bind.
+            if entry.get("type") != "StorageDegraded":
+                fresh[i] = entry if "error" in entry else {"committed": True}
         for i, entry in replayed.items():
             if entry.get("committed"):
                 ack: dict = {"acked": True}
@@ -502,6 +533,20 @@ class _Handler(BaseHTTPRequestHandler):
                     self.ack_registry[ack_id] = entry
                 while len(self.ack_order) > _ACK_REGISTRY_CAP:
                     self.ack_registry.pop(self.ack_order.popleft(), None)
+            # WAL-back the acks (ROADMAP crumb): a durable store persists
+            # each outcome as a volatile ``ack`` record, so a RETRIED
+            # batch stays idempotent across a server restart — not just
+            # across a lost response.  Best-effort: the bind subresource's
+            # own preconditions remain the backstop when the disk is
+            # degraded or the store is in-memory.
+            record_acks = getattr(self.store, "record_acks", None)
+            if record_acks is not None:
+                try:
+                    record_acks(
+                        {f"{batch_id}/{i}": e for i, e in fresh.items()}
+                    )
+                except Exception:
+                    pass  # never fail a response whose binds committed
         self._send(200, {"items": out})
 
     def do_PUT(self) -> None:
@@ -539,6 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
             # 409 with the stale-rv marker: the remote client maps it to
             # store.Conflict and retries get→re-apply→PUT, never blindly
             self._error(409, str(e))
+        except StorageDegraded as e:
+            self._error(507, str(e))
         except KeyError as e:
             self._error(404, str(e))
 
@@ -549,6 +596,8 @@ class _Handler(BaseHTTPRequestHandler):
             kind, ns, name, _ = _route(self.path)
             self.store.delete(kind, ns, name)
             self._send(200, {})
+        except StorageDegraded as e:
+            self._error(507, str(e))
         except (KeyError, ValueError) as e:
             self._error(404, str(e))
 
@@ -564,12 +613,18 @@ def start_api_server(
     store = store or ObjectStore()
     from collections import deque as _deque
 
+    # seed the binding-ack registry from WAL ``ack`` records (durable
+    # stores replay them): a batch retried across a server RESTART then
+    # answers from the recovered outcomes instead of re-executing —
+    # closing the per-process gap the in-memory registry had
+    recovered = getattr(store, "recovered_acks", None)
+    acks = dict(recovered()) if recovered is not None else {}
     handler = type(
         "BoundHandler",
         (_Handler,),
         {"store": store, "active_watches": set(),
          "watch_lock": threading.Lock(), "faults": faults,
-         "ack_registry": {}, "ack_order": _deque(),
+         "ack_registry": acks, "ack_order": _deque(acks),
          "ack_lock": threading.Lock()},
     )
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -630,6 +685,8 @@ class HTTPClient:
                 raise KeyError(body)  # == in-process store.create semantics
             if e.code == 404:
                 raise KeyError(body)
+            if e.code == 507:
+                raise StorageDegraded(body)  # == in-process WAL refusal
             raise RuntimeError(f"HTTP {e.code}: {body}")
 
     class _Nodes:

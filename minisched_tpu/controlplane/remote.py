@@ -42,6 +42,7 @@ from minisched_tpu.controlplane.store import (
     Conflict,
     EventType,
     HistoryCompacted,
+    StorageDegraded,
     WatchEvent,
 )
 from minisched_tpu.faults import InjectedFault
@@ -297,14 +298,29 @@ class RemoteStore:
                     raise OutOfCapacity(body)
                 if e.code in (404, 409):
                     raise KeyError(body)
-                if e.code < 500:
+                if e.code == 507:
+                    # Insufficient Storage: the server's WAL is degraded
+                    # (ENOSPC/EIO latch).  In the backoff set on purpose —
+                    # the store probes its own recovery, so a later retry
+                    # can succeed; when they all fail, the TYPED error
+                    # surfaces so the engine parks waves instead of
+                    # treating it as an unknown 5xx
+                    counters.inc("storage.remote_degraded_retry")
+                    last_err = StorageDegraded(body)
+                elif e.code < 500:
                     raise RuntimeError(f"HTTP {e.code}: {body}")
-                last_err = RuntimeError(f"HTTP {e.code}: {body}")
+                else:
+                    last_err = RuntimeError(f"HTTP {e.code}: {body}")
             except _TRANSIENT_ERRORS as e:
                 last_err = e
             if attempt < self._retries:
                 counters.inc("remote.retry")
                 time.sleep(next(delays))
+        if isinstance(last_err, StorageDegraded):
+            raise StorageDegraded(
+                f"remote {method} {path} still degraded after "
+                f"{self._retries + 1} attempts: {last_err}"
+            )
         raise RuntimeError(
             f"remote {method} {path} failed after {self._retries + 1} "
             f"attempts: {last_err}"
@@ -395,7 +411,11 @@ class RemoteStore:
             for i, item in zip(idxs, out["items"]):
                 err = item.get("error")
                 if err is not None:
-                    results[i] = KeyError(err)
+                    results[i] = (
+                        StorageDegraded(err)
+                        if item.get("type") == "StorageDegraded"
+                        else KeyError(err)
+                    )
                 elif item.get("object") is not None:
                     results[i] = _decode(typ, item["object"])
                 else:
@@ -491,6 +511,12 @@ class RemoteStore:
                     # bind: per-item, retriable — the engine requeues the
                     # pod against refreshed state
                     results.append(OutOfCapacity(err))
+                    continue
+                if item.get("type") == "StorageDegraded":
+                    # the server's disk gave out mid-batch: this bind
+                    # never committed — typed and retriable, the engine
+                    # parks the pod and retries once the store re-arms
+                    results.append(StorageDegraded(err))
                     continue
                 if item.get("type") == "AlreadyBound":
                     # idempotent-retry guard: a retried request whose FIRST

@@ -51,6 +51,18 @@ class HistoryCompacted(Exception):
     the apiserver's 410 Gone.  The consumer must relist."""
 
 
+class StorageDegraded(Exception):
+    """The durable layer cannot persist mutations (ENOSPC/EIO on the WAL
+    append, or the degraded latch a prior failure set) — etcd's NOSPACE
+    alarm in miniature.  The store stays READABLE; every mutation is
+    refused with this error BEFORE touching in-memory state, so nothing
+    is ever acknowledged that a restart would lose.  On the wire it is
+    HTTP 507 (Insufficient Storage), which the remote client treats as
+    transient: retried with backoff, because the store re-arms itself
+    via a recovery probe the moment appends succeed again (disk space
+    freed, IO error cleared)."""
+
+
 @dataclass
 class WatchEvent:
     type: EventType
@@ -219,6 +231,27 @@ def approx_obj_bytes(obj: Any) -> int:
                 d["_approx_bytes_memo"] = memo
             total += memo
     return total
+
+
+def compute_node_agg(pods) -> Dict[str, List[int]]:
+    """Per-node ``[milli_cpu, memory, pods]`` summed over BOUND pods —
+    the independent recompute of ``ObjectStore._pod_node_agg`` that the
+    live scrub and offline fsck check the incremental index against.
+    One definition on purpose: two hand-rolled copies of the aggregation
+    would let the invariant check drift from the index it polices."""
+    agg: Dict[str, List[int]] = {}
+    for pod in pods:
+        node = pod.spec.node_name
+        if not node:
+            continue
+        req = pod.resource_requests()
+        a = agg.get(node)
+        if a is None:
+            a = agg[node] = [0, 0, 0]
+        a[0] += req.milli_cpu
+        a[1] += req.memory
+        a[2] += req.pods
+    return agg
 
 
 class ObjectStore:
@@ -413,18 +446,21 @@ class ObjectStore:
             stored.metadata.resource_version = self._bump()
             if not stored.metadata.creation_timestamp:
                 stored.metadata.creation_timestamp = time.time()
-            objs[key] = stored
-            self._node_agg_track(kind, None, stored)
-            out = stored.clone()
-            # durability BEFORE visibility: the WAL record lands (and
-            # flushes) before any watcher can observe the event — a crash
-            # in between must never let a remote informer hold a
-            # resource_version the recovered server rolls back (and later
-            # re-issues), or its resume would silently skip the re-issued
-            # events.  Base store: no-op.
+            # durability BEFORE commit: the WAL record lands (and
+            # flushes) before the object enters the maps or any watcher
+            # can observe the event — a failed append (disk full, fault
+            # injection) then means the mutation simply never happened:
+            # no phantom in-memory object a restart would lose, no
+            # resource_version a remote informer holds that the
+            # recovered server rolls back.  The rv bump above may leave
+            # a gap on failure; gaps are legal (volatile kinds make them
+            # routinely).  Base store: no-op.
             self._commit_record(
                 kind, "put", stored, stored.metadata.resource_version
             )
+            objs[key] = stored
+            self._node_agg_track(kind, None, stored)
+            out = stored.clone()
             self._fanout(
                 kind,
                 WatchEvent(
@@ -464,9 +500,11 @@ class ObjectStore:
                     stored.metadata.resource_version = self._bump()
                     if not stored.metadata.creation_timestamp:
                         stored.metadata.creation_timestamp = time.time()
+                    # durability before commit (see create): a refused
+                    # append fails THIS item only, leaving memory clean
+                    self._on_batch_commit(kind, stored)
                     objs_map[key] = stored
                     self._node_agg_track(kind, None, stored)
-                    self._on_batch_commit(kind, stored)
                     out.append(stored.clone() if return_objects else None)
                     events.append(
                         WatchEvent(
@@ -545,12 +583,13 @@ class ObjectStore:
             stored.metadata.uid = old.metadata.uid
             stored.metadata.creation_timestamp = old.metadata.creation_timestamp
             stored.metadata.resource_version = self._bump()
-            objs[key] = stored
-            self._node_agg_track(kind, old, stored)
-            out = stored.clone()
+            # durability before commit (see create)
             self._commit_record(
                 kind, "put", stored, stored.metadata.resource_version
             )
+            objs[key] = stored
+            self._node_agg_track(kind, old, stored)
+            out = stored.clone()
             self._fanout(
                 kind,
                 WatchEvent(
@@ -565,12 +604,14 @@ class ObjectStore:
             objs = self._objects.get(kind, {})
             key = f"{namespace}/{name}"
             self._maybe_fault("delete", kind, key)
-            old = objs.pop(key, None)
+            old = objs.get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
-            self._node_agg_track(kind, old, None)
             rv = self._bump()
+            # durability before commit (see create)
             self._commit_record(kind, "del", old, rv)
+            objs.pop(key, None)
+            self._node_agg_track(kind, old, None)
             self._fanout(kind, WatchEvent(EventType.DELETED, old, rv=rv))
 
     def mutate(
@@ -637,9 +678,11 @@ class ObjectStore:
                         old.metadata.creation_timestamp
                     )
                     work.metadata.resource_version = self._bump()
+                    # durability before commit (see create): a refused
+                    # append fails this item, memory stays clean
+                    self._on_batch_commit(kind, work)
                     objs[key] = work
                     self._node_agg_track(kind, old, work)
-                    self._on_batch_commit(kind, work)
                     out.append(work.clone() if return_objects else None)
                     events.append(
                         WatchEvent(
@@ -707,12 +750,13 @@ class ObjectStore:
             if key in objs:
                 raise KeyError(f"{kind} {key!r} already exists")
             stored = obj.clone()
-            objs[key] = stored
-            self._node_agg_track(kind, None, stored)
-            self._rv = max(self._rv, stored.metadata.resource_version)
+            # durability before commit (see create)
             self._commit_record(
                 kind, "put", stored, stored.metadata.resource_version
             )
+            objs[key] = stored
+            self._node_agg_track(kind, None, stored)
+            self._rv = max(self._rv, stored.metadata.resource_version)
             self._fanout(
                 kind,
                 WatchEvent(

@@ -2173,6 +2173,7 @@ class DeviceScheduler(Scheduler):
                 # bind returns AlreadyBound and the informer's bind event
                 # settles it — converges either way, and the assume-lease
                 # TTL backstops anything this path itself loses.
+                from minisched_tpu.controlplane.store import StorageDegraded
                 from minisched_tpu.observability import counters
 
                 counters.inc("engine.bind_batch_failed")
@@ -2186,6 +2187,18 @@ class DeviceScheduler(Scheduler):
         self.queue.note_move_request(ClusterEvent(GVK.POD, ActionType.UPDATE))
         for (qpi, pod, node_name, state), res in zip(ready, results):
             if isinstance(res, BaseException):
+                from minisched_tpu.controlplane.store import StorageDegraded
+
+                if isinstance(res, StorageDegraded):
+                    # the control plane's DISK gave out (ENOSPC/EIO, or
+                    # HTTP 507 outlasting the remote client's backoff):
+                    # the wave PARKS instead of crashing — error_func
+                    # below forgets the assumption (releasing the
+                    # capacity) and requeues, so the pod retries once
+                    # the store's recovery probe re-arms appends
+                    from minisched_tpu.observability import counters
+
+                    counters.inc("storage.degraded_parks")
                 self.run_unreserve_plugins(state, pod, node_name)
                 if self._is_bind_race(res) and self._bind_race_refresh(qpi):
                     # bound by a peer / deleted while in-flight: drop

@@ -722,6 +722,16 @@ class Scheduler:
                 if self.on_decision:
                     self.on_decision(pod, None, Status.from_error(err))
                 return
+            from minisched_tpu.controlplane.store import StorageDegraded
+
+            if isinstance(err, StorageDegraded):
+                # degraded WAL (ENOSPC/EIO latch): park-and-retry, the
+                # same path the device engine's wave takes — capacity
+                # releases with the requeue, and the retry lands once
+                # the store's recovery probe re-arms appends
+                from minisched_tpu.observability import counters
+
+                counters.inc("storage.degraded_parks")
             self.error_func(qpi, err)
             if self.on_decision:
                 self.on_decision(pod, None, Status.from_error(err))

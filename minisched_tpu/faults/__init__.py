@@ -23,6 +23,22 @@ Named points wired through the tree (grep for the literal string):
     wal.append
         — DurableObjectStore refuses the mutation before touching memory
           (disk full / IO error surfaced as a failed API call)
+    disk.enospc
+        — the WAL append itself fails with OSError(ENOSPC): the store
+          latches DEGRADED read-only (typed store.StorageDegraded, HTTP
+          507 on the wire) until its recovery probe re-arms writes
+    wal.bitflip
+        — the append SUCCEEDS but one payload bit flips after the CRC
+          was computed (the lying disk); replay and fsck must DETECT the
+          frame, never silently apply it
+    wal.torn_mid
+        — only a prefix of the frame reaches the file and later appends
+          bury it: mid-file torn write, located (offset/rv window) by
+          replay instead of a bare JSONDecodeError
+    ckpt.corrupt
+        — one byte of a freshly-written checkpoint flips post-rename
+          (bit rot); the sha256 sidecar convicts it and restore takes
+          the fallback chain (prev generation → full WAL+archive replay)
     http.500 / http.reset
         — the REST façade answers 503, or closes the connection without
           any response bytes (the client sees a transport error and must
@@ -166,9 +182,17 @@ def wal_double_binds(wal_path: str):
     When the store compacts with ``archive_compacted=True`` the truncated
     segments live in ``<path>.history``; the audit reads them first (in
     append order, i.e. mutation order) so compaction never shrinks the
-    evidence."""
-    import json
+    evidence.
+
+    Records ride the walio frame reader in LENIENT mode: both legacy
+    JSONL and v2 CRC-framed WALs audit identically, torn tails from a
+    SIGKILL mid-append drop silently, and a corrupt region (an injected
+    bit-flip that chaos later archived) is skipped by magic resync — an
+    audit wants every record it can still prove intact, while REPLAY of
+    the same bytes hard-fails (fsck reports the divergence)."""
     import os
+
+    from minisched_tpu.controlplane.walio import iter_wal_records_lenient
 
     bound_to: dict = {}
     violations = []
@@ -182,23 +206,15 @@ def wal_double_binds(wal_path: str):
         if os.path.exists(p)
     ]
     for path in paths:
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail from a SIGKILL mid-append
-                if rec.get("op") != "put" or rec.get("kind") != "Pod":
-                    continue
-                obj = rec["obj"]
-                node = (obj.get("spec") or {}).get("node_name")
-                uid = (obj.get("metadata") or {}).get("uid")
-                if not node:
-                    continue
-                prev = bound_to.setdefault(uid, node)
-                if prev != node:
-                    violations.append((uid, prev, node))
+        for rec in iter_wal_records_lenient(path):
+            if rec.get("op") != "put" or rec.get("kind") != "Pod":
+                continue
+            obj = rec["obj"]
+            node = (obj.get("spec") or {}).get("node_name")
+            uid = (obj.get("metadata") or {}).get("uid")
+            if not node:
+                continue
+            prev = bound_to.setdefault(uid, node)
+            if prev != node:
+                violations.append((uid, prev, node))
     return violations

@@ -55,16 +55,38 @@ def _child_main(
     archive: bool = False,
     fsync: bool = False,
     parent_pid: Optional[int] = None,
+    salvage: str = "off",
+    scrub_every_s: Optional[float] = None,
+    fault_seed: Optional[int] = None,
+    fault_rules: Optional[dict] = None,
 ) -> None:
     """The child's whole life: recover the store from disk, serve REST on
     the fixed port, optionally compact on a timer, park until SIGKILL.
-    Runs in a fresh interpreter — import inside, keep it light."""
+    Runs in a fresh interpreter — import inside, keep it light.
+
+    ``salvage`` is the store's mid-file-corruption policy at replay (the
+    disk-chaos soaks reopen with ``"covered"`` so a checkpoint-covered
+    bad frame never bricks a restart).  ``fault_rules`` arms a
+    FaultFabric in THIS process — ``{point: {rate, after, max_fires}}``
+    — which is how the disk points (``disk.enospc`` / ``wal.bitflip`` /
+    ``wal.torn_mid`` / ``ckpt.corrupt``) fire inside the server that
+    owns the WAL, not in the test harness.  ``scrub_every_s`` starts the
+    store's background integrity scrub."""
     from minisched_tpu.controlplane.durable import DurableObjectStore
     from minisched_tpu.controlplane.httpserver import start_api_server
 
     store = DurableObjectStore(
-        wal_path, fsync=fsync, archive_compacted=archive
+        wal_path, fsync=fsync, archive_compacted=archive, salvage=salvage
     )
+    if fault_rules:
+        from minisched_tpu.faults import FaultFabric
+
+        fabric = FaultFabric(fault_seed or 0)
+        for point, rule in fault_rules.items():
+            fabric.on(point, **rule)
+        store.faults = fabric
+    if scrub_every_s:
+        store.start_scrub(scrub_every_s)
     start_api_server(store, port=port)
     if compact_every_s:
         def compactor() -> None:
@@ -116,6 +138,10 @@ class ServerSupervisor:
         archive_history: bool = True,
         fsync: bool = False,
         boot_timeout_s: float = 30.0,
+        salvage: str = "off",
+        scrub_every_s: Optional[float] = None,
+        fault_seed: Optional[int] = None,
+        fault_rules: Optional[dict] = None,
     ):
         self._wal = wal_path
         self._port = port or _free_port()
@@ -123,6 +149,10 @@ class ServerSupervisor:
         self._archive = archive_history
         self._fsync = fsync
         self._boot_timeout_s = boot_timeout_s
+        self._salvage = salvage
+        self._scrub_every_s = scrub_every_s
+        self._fault_seed = fault_seed
+        self._fault_rules = fault_rules
         self._proc: Any = None
         self._chaos_thread: Optional[threading.Thread] = None
         self._chaos_stop = threading.Event()
@@ -158,6 +188,10 @@ class ServerSupervisor:
             "archive": self._archive,
             "fsync": self._fsync,
             "parent_pid": os.getpid(),
+            "salvage": self._salvage,
+            "scrub_every_s": self._scrub_every_s,
+            "fault_seed": self._fault_seed,
+            "fault_rules": self._fault_rules,
         }
         env = dict(os.environ)
         # the child must import minisched_tpu from THIS checkout even when

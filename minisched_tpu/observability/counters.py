@@ -40,6 +40,42 @@ CycleMetrics, not here — counters are integers:
     wave_pipeline.dirty_rows
         — node aggregate rows re-encoded incrementally (vs a full
           O(all nodes) fill per wave); the bench divides by waves
+
+The durable layer (controlplane/durable + walio + fsck) records the
+storage-integrity story under ``storage.`` — surfaced in the bench
+``disk`` role's record:
+
+    storage.degraded_enter / storage.degraded_recovered
+        — ENOSPC/EIO latched the store read-only; a recovery probe
+          re-armed writes (dwell time lives in
+          DurableObjectStore.storage_stats, not here)
+    storage.append_error / storage.recovery_probe
+        — WAL appends that failed at the OS; probe attempts while
+          degraded (each consults the disk.enospc schedule, so an
+          injected episode has real dwell)
+    storage.degraded_parks
+        — engine waves/binds parked on a typed StorageDegraded instead
+          of crashing (capacity released with the requeue)
+    storage.remote_degraded_retry
+        — HTTP 507 answers the remote client retried with backoff
+    storage.event_dropped_degraded
+        — volatile Events shed while the disk was full (best-effort)
+    storage.wal_corrupt_detected / storage.wal_salvaged
+        — replay found a bad frame (bit-flip / torn mid-file write);
+          salvage truncated at it because the checkpoint covered the
+          loss (refusals re-raise the typed WalCorrupt instead)
+    storage.ckpt_digest_mismatch / storage.ckpt_unverified
+        — sha256 sidecar convicted a checkpoint; a pre-integrity
+          checkpoint restored without a sidecar
+    storage.ckpt_fallback_prev / storage.ckpt_fallback_replay
+        — the restore chain fell back to the previous generation / to
+          full WAL+archive replay
+    storage.scrub_runs / storage.scrub_findings
+        — background integrity passes and what they found
+    storage.bitflip_injected / storage.torn_injected /
+    storage.ckpt_corrupt_injected
+        — the fault fabric's lying-disk evidence (what was WRITTEN
+          corrupt; the detection counters above are the other half)
 """
 
 from __future__ import annotations

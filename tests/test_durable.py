@@ -310,15 +310,14 @@ def test_replay_rv_is_exact_when_last_record_is_rv_op(tmp_path):
     e.g. a volatile-kind mutation's watermark, or set_resource_version —
     must reopen to exactly that counter, and the next mutation must stamp
     exactly the successor version."""
-    import json
+    from minisched_tpu.controlplane.walio import iter_wal_records_lenient
 
     path = str(tmp_path / "store.wal")
     store = DurableObjectStore(path)
     store.create(KIND_NODE, make_node("n1"))
     store.set_resource_version(7)
     store.close()
-    with open(path) as f:
-        last = json.loads(f.readlines()[-1])
+    last = list(iter_wal_records_lenient(path))[-1]
     assert last == {"op": "rv", "rv": 7}
     re = DurableObjectStore(path)
     assert re.resource_version == 7  # exact, not just >= the object rvs
@@ -407,13 +406,13 @@ def test_crash_between_checkpoint_and_truncate_does_not_resurrect(tmp_path):
     store.create(KIND_NODE, make_node("real"))
     # snapshot the WAL bytes, compact, then splice the old records back
     # IN FRONT of nothing (simulate: ckpt landed, truncate never ran)
-    with open(path) as f:
+    with open(path, "rb") as f:
         old_records = f.read()
     store.compact()
     store.close()
-    with open(path) as f:
+    with open(path, "rb") as f:
         tail = f.read()
-    with open(path, "w") as f:
+    with open(path, "wb") as f:
         f.write(old_records + tail)
     re = DurableObjectStore(path)
     assert {n.metadata.name for n in re.list(KIND_NODE)} == {"real"}, (
@@ -547,16 +546,14 @@ def test_interrupted_archive_is_drained_exactly_once(tmp_path):
     re.close()
 
     def archived(name):
-        count = 0
-        with open(path + ".history") as f:
-            for line in f:
-                rec = json.loads(line)
-                if (
-                    rec.get("op") == "put"
-                    and rec["obj"]["metadata"]["name"] == name
-                ):
-                    count += 1
-        return count
+        from minisched_tpu.controlplane.walio import iter_wal_records_lenient
+
+        return sum(
+            1
+            for rec in iter_wal_records_lenient(path + ".history")
+            if rec.get("op") == "put"
+            and rec["obj"]["metadata"]["name"] == name
+        )
 
     assert archived("n1") == 1  # exactly once, across crash + 2 compactions
     assert archived("n2") == 1
