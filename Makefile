@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave chaos chaos-proc chaos-ha chaos-disk docker clean
+.PHONY: test native start serve bench bench-wave bench-gang chaos chaos-proc chaos-ha chaos-disk docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -25,6 +25,12 @@ chaos: native
 # time (the pipeline has regressed to serial) or any audit trips
 bench-wave: native
 	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only wave
+
+# gang churn role (CPU): mixed gang+singleton rounds over a sliced torus
+# cluster + a two-gang deadlock probe; FAILS on any stranded partial
+# gang, a deadlocked probe, an assume-ledger leak, or node overcommit
+bench-gang: native
+	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only gang
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

@@ -1862,6 +1862,242 @@ def bench_ha() -> dict:
     }
 
 
+def bench_gang() -> dict:
+    """Gang + topology-aware placement under mixed gang+singleton churn
+    (ISSUE 6): rounds of gangs (all-or-nothing, slice-local preference)
+    interleaved with singleton pods over a sliced torus cluster, then a
+    DEADLOCK PROBE — two gangs competing for overlapping capacity that
+    cannot hold both, resolved by freeing filler pods.  Audits are the
+    product claims: ZERO stranded partial gangs (every gang fully bound,
+    permit ledger empty), deadlock-freedom (both competing gangs
+    eventually place; TTL releases observed in between are the mechanism,
+    not a failure), the assume ledger drains to zero, and no node over
+    allocatable.  Locality is reported (fraction of gangs fully on one
+    slice), not gated — it is a preference, never feasibility."""
+    import threading
+    from collections import defaultdict
+
+    from minisched_tpu.api.objects import (
+        gang_key,
+        make_gang_pods,
+        make_node,
+        make_pod,
+    )
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.observability import counters
+    from minisched_tpu.service.config import gang_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    n_slices = int(os.environ.get("BENCH_GANG_SLICES", "4"))
+    hosts = int(os.environ.get("BENCH_GANG_HOSTS", "8"))
+    rounds = int(os.environ.get("BENCH_GANG_ROUNDS", "4"))
+    gang_size = int(os.environ.get("BENCH_GANG_SIZE", "8"))
+    singles_per_round = int(os.environ.get("BENCH_GANG_SINGLES", "24"))
+    ttl_s = float(os.environ.get("BENCH_GANG_TTL_S", "5.0"))
+    deadline_s = float(os.environ.get("BENCH_GANG_DEADLINE_S", "420"))
+
+    client = Client()
+    nodes = []
+    for s in range(n_slices):
+        for h in range(hosts):
+            nodes.append(
+                make_node(
+                    f"slice{s:02d}-host{h:02d}",
+                    capacity={"cpu": "8", "memory": "32Gi", "pods": 64},
+                    slice_id=f"slice{s:02d}",
+                    torus=(h % 4, h // 4, 0),
+                    host_index=h,
+                )
+            )
+    client.nodes().create_many(nodes, return_objects=False)
+    n_nodes = len(nodes)
+
+    bound_n = 0
+    mu = threading.Lock()
+
+    def counting(pod, node_name, status):
+        nonlocal bound_n
+        if node_name:
+            with mu:
+                bound_n += 1
+
+    counters.reset()
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        gang_roster_config(), device_mode=True,
+        max_wave=int(os.environ.get("BENCH_GANG_WAVE", "256")),
+        on_decision=counting,
+    )
+    cosched = next(
+        p for p in sched.permit_plugins if p.name() == "Coscheduling"
+    )
+    # short assume-lease TTL: the quiesce audit waits for the ledger to
+    # drain via the idle-path lease confirm (default 30s is the window)
+    sched.assume_ttl_s = 3.0
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+
+    def wait_bound(target: int, what: str) -> None:
+        while time.monotonic() < deadline:
+            with mu:
+                if bound_n >= target:
+                    return
+            time.sleep(0.1)
+        raise SystemExit(
+            f"[gang] DEADLOCK/timeout waiting for {what}: "
+            f"{bound_n}/{target} bound; queue={sched.queue.stats()} "
+            f"pending_gangs={cosched.pending_gangs()} "
+            f"gang_counters={ {k: v for k, v in counters.snapshot().items() if k.startswith('gang.')} }"
+        )
+
+    # ---- phase 1: mixed gang+singleton churn ----------------------------
+    target = 0
+    gang_names = []
+    for r in range(rounds):
+        name = f"train-{r}"
+        gang_names.append(name)
+        batch = make_gang_pods(
+            name, gang_size, ttl_s=ttl_s,
+            requests={"cpu": "500m", "memory": "256Mi"},
+        ) + [
+            make_pod(
+                f"single-{r}-{i:03d}",
+                requests={"cpu": "250m", "memory": "64Mi"},
+            )
+            for i in range(singles_per_round)
+        ]
+        client.pods().create_many(batch, return_objects=False)
+        target += len(batch)
+        wait_bound(target, f"churn round {r + 1}/{rounds}")
+        log(
+            f"[gang] round {r + 1}/{rounds}: {target} pods bound at "
+            f"{time.monotonic() - t0:.1f}s"
+        )
+    churn_s = time.monotonic() - t0
+
+    # ---- phase 2: deadlock probe ----------------------------------------
+    # fill the cluster until free cpu holds ~1.5 gangs, then launch TWO
+    # gangs that cannot both fit: they compete (partial placements TTL-
+    # release), and freeing the filler must let BOTH land — the
+    # deadlock-freedom criterion.
+    used = defaultdict(int)
+    for p in client.pods().list():
+        used[p.spec.node_name] += p.resource_requests().milli_cpu
+    # count whole 2-cpu SLOTS per node (total free milli-cpu over-counts:
+    # the churn singles leave sub-2cpu holes no 2-cpu pod can use)
+    free_slots = sum(
+        max(n.status.allocatable.milli_cpu - used[n.metadata.name], 0) // 2000
+        for n in nodes
+    )
+    filler = [
+        make_pod(f"filler-{i:04d}", requests={"cpu": "2", "memory": "64Mi"})
+        for i in range(max(free_slots - int(1.5 * gang_size), 0))
+    ]
+    client.pods().create_many(filler, return_objects=False)
+    target += len(filler)
+    wait_bound(target, "deadlock-probe filler")
+    probe = make_gang_pods(
+        "probe-a", gang_size, ttl_s=ttl_s, requests={"cpu": "2"}
+    ) + make_gang_pods(
+        "probe-b", gang_size, ttl_s=ttl_s, requests={"cpu": "2"}
+    )
+    client.pods().create_many(probe, return_objects=False)
+    gang_names += ["probe-a", "probe-b"]
+    # one probe gang fits in the remaining headroom and must land even
+    # while the other competes for the SAME capacity
+    t_probe = time.monotonic()
+    wait_bound(target + gang_size, "first probe gang vs competitor")
+    ttl_during_probe = counters.get("gang.ttl_expired")
+    # free the filler: the loser's members must now place too
+    for p in filler:
+        client.pods().delete(p.metadata.name, p.metadata.namespace)
+    target += 2 * gang_size
+    wait_bound(target, "second probe gang after capacity freed")
+    probe_s = time.monotonic() - t_probe
+    elapsed = time.monotonic() - t0
+
+    # ---- quiesce + audits ------------------------------------------------
+    drain_deadline = time.monotonic() + 30
+    leaked = True
+    while time.monotonic() < drain_deadline:
+        with sched._assumed_lock:
+            leaked = bool(sched._assumed)
+        if not leaked:
+            break
+        time.sleep(0.1)
+    pending = cosched.pending_gangs()
+    svc.shutdown_scheduler()
+    if leaked:
+        raise SystemExit("[gang] ASSUMED-CAPACITY LEAK at quiesce")
+    if pending:
+        raise SystemExit(f"[gang] STRANDED PARTIAL GANGS at permit: {pending}")
+
+    # zero stranded partial gangs: every gang fully bound, exactly size
+    members = defaultdict(list)
+    for p in client.pods().list():
+        k = gang_key(p)
+        if k is not None:
+            members[k].append(p)
+    partial = {
+        k: sum(1 for p in v if p.spec.node_name)
+        for k, v in members.items()
+        if sum(1 for p in v if p.spec.node_name) not in (0, len(v))
+    }
+    if partial:
+        raise SystemExit(f"[gang] PARTIAL GANGS BOUND: {partial}")
+    unbound_gangs = [
+        k for k, v in members.items() if not all(p.spec.node_name for p in v)
+    ]
+    if unbound_gangs:
+        raise SystemExit(f"[gang] GANGS NEVER PLACED: {unbound_gangs}")
+
+    # capacity audit: no node over allocatable
+    cpu = defaultdict(int)
+    cnt = defaultdict(int)
+    for p in client.pods().list():
+        if p.spec.node_name:
+            cpu[p.spec.node_name] += p.resource_requests().milli_cpu
+            cnt[p.spec.node_name] += 1
+    for node in client.nodes().list():
+        alloc = node.status.allocatable
+        nm = node.metadata.name
+        if cpu[nm] > alloc.milli_cpu or cnt[nm] > alloc.pods:
+            raise SystemExit(f"[gang] NODE OVER ALLOCATABLE: {nm}")
+
+    # locality: fraction of gangs fully on one slice (reported, not gated)
+    slice_of = {n.metadata.name: n.spec.slice_id for n in nodes}
+    one_slice = sum(
+        1
+        for v in members.values()
+        if len({slice_of.get(p.spec.node_name) for p in v}) == 1
+    )
+    gang_counters = {
+        k: v for k, v in counters.snapshot().items() if k.startswith("gang.")
+    }
+    log(
+        f"[gang] {target} pods ({len(members)} gangs × {gang_size} + "
+        f"singletons/filler) on {n_nodes} nodes in {elapsed:.1f}s; "
+        f"deadlock probe resolved in {probe_s:.1f}s "
+        f"({ttl_during_probe} TTL releases observed); "
+        f"{one_slice}/{len(members)} gangs slice-local; no partial gangs, "
+        f"no leak, no overcommit"
+    )
+    return {
+        "pods": target,
+        "nodes": n_nodes,
+        "gangs": len(members),
+        "gang_size": gang_size,
+        "rounds": rounds,
+        "total_s": round(elapsed, 1),
+        "churn_s": round(churn_s, 1),
+        "deadlock_probe_s": round(probe_s, 1),
+        "gangs_slice_local": one_slice,
+        "counters": gang_counters,
+        "stranded_partial_gangs": 0,
+        "leak": False,
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
@@ -1871,6 +2107,7 @@ ROLES = {
     "chaos": bench_chaos,
     "disk": bench_disk,
     "ha": bench_ha,
+    "gang": bench_gang,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -1990,7 +2227,13 @@ def main() -> None:
             (
                 "scheduler_over_http_crosspod",
                 "wire",
-                {"BENCH_WIRE_CROSSPOD": "5000"},
+                # overridable so CPU re-earn runs can scale the scan-lane
+                # load down with the rest of the knobs
+                {
+                    "BENCH_WIRE_CROSSPOD": os.environ.get(
+                        "BENCH_WIRE_CROSSPOD", "5000"
+                    )
+                },
                 "wire-crosspod",
             )
         )
@@ -2006,6 +2249,11 @@ def main() -> None:
         # HA plane: sharded active-active engines, one hard kill, with
         # TTL-bounded rebalance + exactly-once audits in the record
         optional.append(("ha_plane", "ha", None, "ha"))
+    if os.environ.get("BENCH_GANG", "1") != "0":
+        # gang churn: mixed gang+singleton rounds + a two-gang deadlock
+        # probe, audited for zero stranded partial gangs and
+        # deadlock-freedom (ISSUE 6)
+        optional.append(("gang_churn", "gang", None, "gang"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

@@ -230,6 +230,17 @@ class Toleration:
 class NodeSpec:
     unschedulable: bool = False
     taints: List[Taint] = field(default_factory=list)
+    #: multi-host accelerator topology (ISSUE 6 / Tesserae): the slice
+    #: this host belongs to ('' = not part of any slice), its coordinates
+    #: in the slice's torus, and its host index within the slice.  Real
+    #: clusters publish these as node labels (cloud.google.com/gke-tpu-*);
+    #: first-class fields keep the device tables' encoding one hash away
+    #: instead of a label-parse per wave.
+    slice_id: str = ""
+    torus_x: int = 0
+    torus_y: int = 0
+    torus_z: int = 0
+    host_index: int = -1
 
 
 @dataclass
@@ -257,6 +268,11 @@ class Node:
             spec=NodeSpec(
                 unschedulable=self.spec.unschedulable,
                 taints=[Taint(t.key, t.value, t.effect) for t in self.spec.taints],
+                slice_id=self.spec.slice_id,
+                torus_x=self.spec.torus_x,
+                torus_y=self.spec.torus_y,
+                torus_z=self.spec.torus_z,
+                host_index=self.spec.host_index,
             ),
             status=NodeStatus(
                 capacity=self.status.capacity.clone(),
@@ -396,6 +412,31 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class GangSpec:
+    """All-or-nothing coscheduling group (the PodGroup/gang of Tesserae
+    and the out-of-tree coscheduling plugin, collapsed to the scheduler-
+    relevant fields).  A gang is identified by (pod namespace, name);
+    ``size`` is the member count that must ALL hold assume leases before
+    any member binds; ``ttl_s`` bounds how long a partial gang may park
+    capacity at Permit before every member's assume is released and the
+    members requeue."""
+
+    name: str = ""
+    size: int = 1
+    ttl_s: float = 30.0
+
+
+def gang_key(pod: "Pod") -> Optional[str]:
+    """'namespace/gangname' for a gang member, None for singletons — THE
+    gang identity every layer (queue adjacency, permit ledger, table
+    encoding, re-arbitration) keys on."""
+    g = pod.spec.gang
+    if g is None or not g.name:
+        return None
+    return f"{pod.metadata.namespace}/{g.name}"
+
+
+@dataclass
 class PodSpec:
     node_name: str = ""  # set by binding
     containers: List[Container] = field(default_factory=list)
@@ -410,6 +451,8 @@ class PodSpec:
     volumes: List[str] = field(default_factory=list)
     priority: int = 0
     scheduler_name: str = "default-scheduler"
+    #: all-or-nothing coscheduling membership; None = singleton pod
+    gang: Optional[GangSpec] = None
 
 
 def _clone_term(t: NodeSelectorTerm) -> NodeSelectorTerm:
@@ -493,6 +536,9 @@ def _clone_pod_spec(spec: "PodSpec") -> "PodSpec":
         volumes=list(spec.volumes),
         priority=spec.priority,
         scheduler_name=spec.scheduler_name,
+        gang=None
+        if spec.gang is None
+        else GangSpec(spec.gang.name, spec.gang.size, spec.gang.ttl_s),
     )
 
 
@@ -743,11 +789,23 @@ def make_node(
     labels: Optional[Dict[str, str]] = None,
     capacity: Optional[Dict[str, Any]] = None,
     taints: Optional[List[Taint]] = None,
+    slice_id: str = "",
+    torus: Optional[tuple] = None,
+    host_index: int = -1,
 ) -> Node:
     cap = ResourceList.parse(capacity or {CPU: "4", MEMORY: "16Gi", PODS: 110})
+    tx, ty, tz = (tuple(torus) + (0, 0, 0))[:3] if torus else (0, 0, 0)
     return Node(
         metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
-        spec=NodeSpec(unschedulable=unschedulable, taints=list(taints or [])),
+        spec=NodeSpec(
+            unschedulable=unschedulable,
+            taints=list(taints or []),
+            slice_id=slice_id,
+            torus_x=tx,
+            torus_y=ty,
+            torus_z=tz,
+            host_index=host_index,
+        ),
         status=NodeStatus(capacity=cap, allocatable=cap.clone()),
     )
 
@@ -766,3 +824,24 @@ def make_pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
         spec=PodSpec(containers=containers, **spec_kwargs),
     )
+
+
+def make_gang_pods(
+    gang_name: str,
+    size: int,
+    namespace: str = "default",
+    ttl_s: float = 30.0,
+    requests: Optional[Dict[str, Any]] = None,
+    labels: Optional[Dict[str, str]] = None,
+) -> List[Pod]:
+    """``size`` member pods of one gang (bench/test convenience)."""
+    return [
+        make_pod(
+            f"{gang_name}-{i}",
+            namespace=namespace,
+            requests=requests,
+            labels=labels,
+            gang=GangSpec(gang_name, size, ttl_s),
+        )
+        for i in range(size)
+    ]

@@ -216,9 +216,168 @@ def fsck(wal_path: str, checkpoint_path: Optional[str] = None) -> Dict[str, Any]
     }
 
 
+def repair(
+    wal_path: str,
+    checkpoint_path: Optional[str] = None,
+    accept_loss: bool = False,
+) -> Dict[str, Any]:
+    """``fsck --repair``: make a corrupt WAL replayable again.
+
+    Two escalation levels (the PR-5 crumb this closes):
+
+    1. **covered salvage** — open the store non-readonly with
+       ``salvage="covered"``: the bad region truncates ONLY when every
+       resync-decodable record past it has rv ≤ the restored
+       checkpoint's (replay would have skipped them anyway — lossless).
+    2. **accept-loss** — when salvage refuses (records past the
+       corruption reach beyond the checkpoint), ``--accept-loss``
+       truncates at the last good record anyway, DISCARDING committed
+       state.  The rv range being thrown away is computed first and
+       printed/returned so the operator's decision is informed, never
+       silent: ``(last_good_rv, max resynced rv]`` plus however many
+       records resynced (the corrupt frame itself is unreadable and may
+       hide one more).
+
+    Returns ``{repaired, action, discarded?, error?}``; a post-repair
+    ``fsck()`` is the caller's verification step (main() runs it)."""
+    from minisched_tpu.controlplane.durable import (
+        CheckpointCorrupt,
+        DurableObjectStore,
+    )
+    from minisched_tpu.controlplane.walio import (
+        WalReader,
+        _rec_rv,
+        iter_records_lenient,
+    )
+
+    checkpoint_path = checkpoint_path or wal_path + ".ckpt"
+    out: Dict[str, Any] = {"wal": wal_path, "repaired": False, "action": "none"}
+
+    def _try_open(salvage: str) -> Optional[str]:
+        """Open (non-readonly: torn tails / covered regions physically
+        truncate) then close; returns the error string or None."""
+        try:
+            store = DurableObjectStore(
+                wal_path,
+                checkpoint_path=checkpoint_path,
+                archive_compacted=os.path.exists(wal_path + ".history"),
+                salvage=salvage,
+            )
+            store.close()
+            return None
+        except (WalCorrupt, CheckpointCorrupt) as e:
+            return str(e)
+
+    # scan for mid-file corruption BEFORE any salvage open: the loss
+    # bound must be measured from the original bytes (the store's own
+    # covered-salvage truncates as a side effect of a successful open)
+    try:
+        with open(wal_path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        out["error"] = str(e)
+        return out
+    reader = WalReader(data, path=wal_path)
+    corrupt: Optional[WalCorrupt] = None
+    try:
+        for _rec, _end in reader:
+            pass
+    except WalCorrupt as e:
+        corrupt = e
+    if corrupt is None:
+        # frames are clean — any repair needed is torn-tail truncation
+        # or the checkpoint chain, both handled by a normal salvage open
+        err = _try_open("covered")
+        if err is None:
+            out["repaired"] = True
+            out["action"] = "salvage-covered"
+        else:
+            out["error"] = err
+        return out
+
+    # bound what truncating at the last good record would LOSE — via the
+    # LENIENT iterator, which resyncs to v2 magic (either checksum) AND
+    # legacy v1 line boundaries; the store's own coverage probe
+    # (resync_scan) sees only v2 magic, so a legacy-JSONL suffix would
+    # otherwise be discarded silently under a "lossless" banner
+    lost = list(iter_records_lenient(data, corrupt.offset + 1))
+    lost_rvs = [rv for r in lost if (rv := _rec_rv(r)) > 0]
+    # the checkpoint rv the restore chain can actually cover, taken
+    # CONSERVATIVELY as the lowest parseable generation (restore may
+    # fall back from current to prev)
+    ckpt_rvs = []
+    for p in (checkpoint_path, checkpoint_path + ".prev"):
+        try:
+            with open(p) as f:
+                ckpt_rvs.append(int(json.load(f).get("resource_version", 0)))
+        except (OSError, ValueError, TypeError, json.JSONDecodeError):
+            continue
+    ckpt_rv = min(ckpt_rvs) if ckpt_rvs else 0
+    discarded = {
+        "from_rv_exclusive": corrupt.last_good_rv,
+        "to_rv": max(lost_rvs) if lost_rvs else None,
+        "resynced_records": len(lost),
+        "bytes": len(data) - reader.good_end,
+        "offset": corrupt.offset,
+    }
+    # covered when every decodable lost record is already in the
+    # snapshot, OR when NOTHING decodes past the corruption — the store
+    # treats an undecodable bad tail like a torn tail and truncates it
+    # under salvage (records that decode but carry no rv stay
+    # uncovered: they bound nothing, mirroring _replay_wal's refusal)
+    covered = (not lost) or (bool(lost_rvs) and max(lost_rvs) <= ckpt_rv)
+
+    if covered:
+        # provably lossless: every decodable lost record is already in
+        # the snapshot — delegate the truncation to the store's salvage
+        err = _try_open("covered")
+        if err is None:
+            out["repaired"] = True
+            out["action"] = "salvage-covered"
+            out["covered_loss"] = discarded
+        else:
+            out["error"] = err
+        return out
+    if not accept_loss:
+        out["error"] = str(corrupt)
+        out["discarded_if_accepted"] = discarded
+        out["hint"] = (
+            "records past the corruption are NOT covered by the checkpoint "
+            f"(checkpoint rv {ckpt_rv}, lost records "
+            f"{'reach rv ' + str(discarded['to_rv']) if lost_rvs else 'carry no resource_version'}); "
+            "re-run with --accept-loss to discard them"
+        )
+        return out
+
+    out["discarded"] = discarded
+    import sys
+
+    print(
+        f"[fsck --repair] ACCEPTING LOSS on {wal_path}: discarding "
+        f"{discarded['bytes']} bytes past byte {reader.good_end} — rv range "
+        f"({discarded['from_rv_exclusive']}, {discarded['to_rv']}] "
+        f"({discarded['resynced_records']} resynced records; the corrupt "
+        "frame itself is unreadable and may hide one more)",
+        file=sys.stderr,
+        flush=True,
+    )
+    with open(wal_path, "rb+") as f:
+        f.truncate(reader.good_end)
+    err = _try_open("covered")
+    if err is not None:
+        out["error"] = err
+        return out
+    out["repaired"] = True
+    out["action"] = "accept-loss-truncate"
+    return out
+
+
 def main(argv: List[str]) -> int:
     """CLI entry (dispatched from ``python -m minisched_tpu fsck``):
-    prints the JSON report; exit 0 clean, 1 on any integrity error."""
+    prints the JSON report; exit 0 clean, 1 on any integrity error.
+    ``--repair`` attempts covered salvage first; ``--accept-loss``
+    additionally truncates uncovered tails, printing the rv range being
+    discarded."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -231,7 +390,28 @@ def main(argv: List[str]) -> int:
         "--checkpoint", default=None,
         help="checkpoint path (default: <wal>.ckpt)",
     )
+    parser.add_argument(
+        "--repair", action="store_true",
+        help="attempt repair before verifying: covered salvage "
+        "(lossless; truncates only records the checkpoint already holds)",
+    )
+    parser.add_argument(
+        "--accept-loss", action="store_true",
+        help="with --repair: if salvage refuses because records past the "
+        "corruption are NOT covered, truncate anyway and print the rv "
+        "range being discarded",
+    )
     args = parser.parse_args(argv)
+    repair_report = None
+    if args.repair:
+        repair_report = repair(
+            args.wal,
+            checkpoint_path=args.checkpoint,
+            accept_loss=args.accept_loss,
+        )
     report = fsck(args.wal, checkpoint_path=args.checkpoint)
+    if repair_report is not None:
+        report["repair"] = repair_report
+        # a repair that didn't complete keeps exit 1 via the fsck errors
     print(json.dumps(report, indent=2))
     return 0 if report["ok"] else 1

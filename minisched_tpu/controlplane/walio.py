@@ -47,11 +47,86 @@ import zlib
 from typing import Any, Iterator, List, Optional, Tuple
 
 #: v2 frame magic.  0xAB first so no frame can be mistaken for JSON or
-#: UTF-8 text; "W2" for humans in a hexdump; 0x00 reserved as an
-#: algorithm/flags byte (0 = zlib crc32).
-WAL_MAGIC = b"\xabW2\x00"
-_HEADER = struct.Struct("<4sII")  # magic, payload_len, crc32(payload)
+#: UTF-8 text; "W2" for humans in a hexdump; the last byte is the
+#: algorithm/flags byte the original framing reserved: 0 = zlib crc32
+#: (CRC-32/ISO-HDLC), 1 = CRC32C (Castagnoli).
+WAL_MAGIC_PREFIX = b"\xabW2"
+WAL_MAGIC = WAL_MAGIC_PREFIX + b"\x00"
+WAL_MAGIC_C = WAL_MAGIC_PREFIX + b"\x01"
+_HEADER = struct.Struct("<4sII")  # magic, payload_len, checksum(payload)
 HEADER_SIZE = _HEADER.size
+
+# -- CRC32C (flags byte 1) ---------------------------------------------------
+# The native switch the flags byte reserved: google-crc32c (a C extension
+# already in this environment's image) checksums at memcpy speed.  The
+# WRITER only emits CRC32C frames when the native library is importable —
+# otherwise it stays on zlib crc32, never a pure-Python table walk on the
+# append path.  The READER is mixed-mode across v1 lines and BOTH frame
+# algorithms regardless of which writer produced them; verifying a CRC32C
+# frame without the native library falls back to a pure-Python table
+# (slow, but replay of a foreign WAL must not depend on an optional
+# extension).
+try:  # pragma: no cover - exercised via _crc32c below
+    import google_crc32c as _gcrc32c
+
+    def _crc32c_native(payload: bytes) -> int:
+        return _gcrc32c.value(payload)
+
+except ImportError:  # pragma: no cover
+    _gcrc32c = None
+    _crc32c_native = None
+
+HAVE_NATIVE_CRC32C = _crc32c_native is not None
+
+_CRC32C_TABLE: Optional[List[int]] = None
+
+
+def _crc32c_py(payload: bytes) -> int:
+    """Pure-Python CRC32C (Castagnoli, reflected 0x82F63B78) — the
+    reader-side fallback only; the writer never takes this path."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for b in payload:
+        crc = tab[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _crc32c(payload: bytes) -> int:
+    if _crc32c_native is not None:
+        return _crc32c_native(payload)
+    return _crc32c_py(payload)
+
+
+def _find_magic(data: bytes, start: int) -> int:
+    """Offset of the next frame magic (either algorithm) at/after
+    ``start``, -1 if none — resync and lenient audits must find CRC32C
+    frames too."""
+    n = len(data)
+    off = data.find(WAL_MAGIC_PREFIX, start)
+    while 0 <= off:
+        if off + 3 < n and data[off + 3] in (0, 1):
+            return off
+        off = data.find(WAL_MAGIC_PREFIX, off + 1)
+    return -1
+
+
+def _magic_at(data: bytes, off: int) -> bool:
+    """O(1): does a frame magic (either algorithm) sit exactly at
+    ``off``?  Boundary checks must not pay a forward scan per probe."""
+    return (
+        data[off:off + 3] == WAL_MAGIC_PREFIX
+        and off + 3 < len(data)
+        and data[off + 3] in (0, 1)
+    )
 
 #: a frame claiming a payload larger than this is corruption, not data —
 #: no single store record approaches it (the biggest are multi-KB pod
@@ -102,11 +177,23 @@ class WalCorrupt(Exception):
         )
 
 
-def encode_frame(rec: Any) -> bytes:
-    """One v2 frame for a record dict (or pre-encoded payload bytes)."""
+def encode_frame(rec: Any, crc32c: Optional[bool] = None) -> bytes:
+    """One v2 frame for a record dict (or pre-encoded payload bytes).
+
+    ``crc32c`` selects the checksum algorithm (and the matching flags
+    byte); the default — None — uses CRC32C when the native library is
+    present and zlib crc32 otherwise, so one WAL may legitimately carry
+    BOTH frame kinds (a file started before the library landed keeps
+    growing; the mixed-mode reader accepts each frame by its own flags
+    byte)."""
     payload = (
         rec if isinstance(rec, (bytes, bytearray)) else json.dumps(rec).encode()
     )
+    use_c = HAVE_NATIVE_CRC32C if crc32c is None else crc32c
+    if use_c:
+        return (
+            _HEADER.pack(WAL_MAGIC_C, len(payload), _crc32c(payload)) + payload
+        )
     return _HEADER.pack(WAL_MAGIC, len(payload), zlib.crc32(payload)) + payload
 
 
@@ -171,11 +258,11 @@ class WalReader:
                 off += 1
                 self.good_end = off
                 continue
-            if data[off:off + 4] == WAL_MAGIC:
+            if _magic_at(data, off):
                 if off + HEADER_SIZE > n:
                     self.torn_tail = True  # header cut by a crash
                     return
-                _, length, crc = _HEADER.unpack_from(data, off)
+                magic, length, crc = _HEADER.unpack_from(data, off)
                 if length > MAX_FRAME_PAYLOAD:
                     raise self._corrupt(
                         off, f"frame length {length} exceeds max"
@@ -185,11 +272,17 @@ class WalReader:
                     self.torn_tail = True  # payload cut by a crash
                     return
                 payload = data[off + HEADER_SIZE:end]
-                if zlib.crc32(payload) != crc:
+                # flags byte selects the checksum: 0 = zlib crc32,
+                # 1 = CRC32C — one file may carry both frame kinds
+                computed = (
+                    _crc32c(payload) if magic[3] == 1 else zlib.crc32(payload)
+                )
+                if computed != crc:
                     raise self._corrupt(
                         off,
                         f"crc mismatch (stored {crc:#010x}, computed "
-                        f"{zlib.crc32(payload):#010x})",
+                        f"{computed:#010x}, "
+                        f"{'crc32c' if magic[3] == 1 else 'crc32'})",
                     )
                 try:
                     rec = json.loads(payload)
@@ -214,8 +307,12 @@ class WalReader:
             else:
                 # neither a frame nor JSON where a boundary must be; a
                 # partial magic at EOF is a torn header, anything else
-                # mid-file is corruption
-                if n - off < 4 and WAL_MAGIC.startswith(data[off:n]):
+                # mid-file is corruption (both algorithms share the
+                # 3-byte prefix, so a <4-byte tail matching it is torn
+                # regardless of which flags byte was coming)
+                if n - off < 4 and WAL_MAGIC_PREFIX.startswith(
+                    data[off:off + 3]
+                ):
                     self.torn_tail = True
                     return
                 raise self._corrupt(
@@ -240,7 +337,7 @@ def resync_scan(
     None.  This is the salvage-coverage probe: it tells the durable
     store what a truncate-at-the-bad-frame recovery would LOSE."""
     n = len(data)
-    off = data.find(WAL_MAGIC, start)
+    off = _find_magic(data, start)
     while 0 <= off < n:
         reader = WalReader(data[off:], path="<resync>")
         recs: List[dict] = []
@@ -253,7 +350,7 @@ def resync_scan(
             pass  # keep what decoded before the next bad region
         if recs:
             return _rec_rv(recs[0]), recs
-        off = data.find(WAL_MAGIC, off + 1)
+        off = _find_magic(data, off + 1)
     return None
 
 
@@ -262,7 +359,7 @@ def _next_record_boundary(data: bytes, start: int) -> int:
     or a newline followed by a legacy ``{`` line (how a v1 JSONL file
     resyncs — it has no magic to find).  -1 when neither exists."""
     candidates = []
-    mg = data.find(WAL_MAGIC, start)
+    mg = _find_magic(data, start)
     if mg >= 0:
         candidates.append(mg)
     nl = data.find(b"\n", start)
@@ -270,29 +367,29 @@ def _next_record_boundary(data: bytes, start: int) -> int:
         nxt = nl + 1
         if nxt >= len(data):
             break
-        if data[nxt:nxt + 1] == b"{" or data[nxt:nxt + 4] == WAL_MAGIC:
+        if data[nxt:nxt + 1] == b"{" or _magic_at(data, nxt):
             candidates.append(nxt)
             break
         nl = data.find(b"\n", nxt)
     return min(candidates) if candidates else -1
 
 
-def iter_wal_records_lenient(path: str) -> Iterator[dict]:
-    """Best-effort record iterator for AUDITS (wal_double_binds, fsck's
-    history pass): skips over corrupt regions by resyncing to the next
-    record boundary — v2 magic OR a legacy line start, so a garbled
-    line mid-JSONL doesn't drop every record after it — and drops torn
-    tails silently.  Replay must NEVER use this — silently skipping a
-    record is exactly the bug the framing exists to catch — but an
-    audit over a deliberately-corrupted archive wants every record it
-    can still prove intact."""
-    try:
-        with open(path, "rb") as f:
-            data = f.read()
-    except OSError:
-        return
-    off = 0
+def iter_records_lenient(
+    data: bytes, start: int = 0, path: str = "<lenient>"
+) -> Iterator[dict]:
+    """Best-effort record iterator over raw WAL bytes from ``start``:
+    skips corrupt regions by resyncing to the next record boundary — v2
+    magic (either checksum) OR a legacy line start — and drops torn
+    tails silently.  The byte-level half of
+    :func:`iter_wal_records_lenient`; fsck's repair also uses it to
+    bound what a truncation would LOSE (legacy records included, which
+    the v2-only ``resync_scan`` cannot see)."""
+    off = start
     n = len(data)
+    if off and not (data[off:off + 1] == b"{" or _magic_at(data, off)):
+        off = _next_record_boundary(data, off)
+        if off < 0:
+            return
     while off < n:
         reader = WalReader(data[off:], path=path)
         try:
@@ -304,6 +401,20 @@ def iter_wal_records_lenient(path: str) -> Iterator[dict]:
             if nxt < 0:
                 return
             off = nxt
+
+
+def iter_wal_records_lenient(path: str) -> Iterator[dict]:
+    """Best-effort record iterator for AUDITS (wal_double_binds, fsck's
+    history pass): see :func:`iter_records_lenient`.  Replay must NEVER
+    use this — silently skipping a record is exactly the bug the
+    framing exists to catch — but an audit over a deliberately-
+    corrupted archive wants every record it can still prove intact."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return
+    yield from iter_records_lenient(data, 0, path=path)
 
 
 def scan_file(path: str) -> dict:

@@ -201,6 +201,22 @@ class DeviceScheduler(Scheduler):
 
             self.constraint_index = ConstraintIndex()
             self.constraint_index.wire(informer_factory)
+        # gang placement directory: wired pre-cache for the same reason
+        # the constraint index is — the assume-cache prunes against the
+        # NodeInfo cache, so the gang view must never lag it
+        self.gang_index = None
+        if any(
+            p.name() in ("GangTopology", "Coscheduling")
+            for p in (
+                *self.filter_plugins,
+                *self.score_plugins,
+                *self.permit_plugins,
+            )
+        ):
+            from minisched_tpu.engine.gang import GangIndex
+
+            self.gang_index = GangIndex()
+            self.gang_index.wire(informer_factory)
 
     def _build_constraints(self, pods_, nodes, assigned, **kw) -> Any:
         """Constraint tables for one wave/chunk.  With a live index the
@@ -238,6 +254,34 @@ class DeviceScheduler(Scheduler):
             )
         finally:
             lock_cm.__exit__(None, None, None)
+
+    def _gang_placed_count(self, key: str, exclude=()) -> int:
+        """GangIndex-backed placed-member count (O(gang), not O(pods))."""
+        if self.gang_index is None:
+            return super()._gang_placed_count(key, exclude)
+        return self.gang_index.placed_count(key, exclude)
+
+    def _gang_view(self, pods_) -> Any:
+        """Placed-gang aggregates for this wave's gang members: the
+        incremental GangIndex plus the assume-cache folded on top (an
+        assumed member is placed capacity before its bind event lands).
+        None when the wave carries no gang members — build_pod_table
+        then skips the columns entirely."""
+        if self.gang_index is None:
+            return None
+        from minisched_tpu.api.objects import gang_key
+
+        keys = {gang_key(p) for p in pods_}
+        keys.discard(None)
+        if not keys:
+            return None
+        with self._assumed_lock:
+            extra = [
+                (k, uid, a.spec.node_name)
+                for uid, a in self._assumed.items()
+                if (k := gang_key(a)) is not None
+            ]
+        return self.gang_index.view_for(keys, extra)
 
     # -- assume-pod cache ---------------------------------------------------
     def _assume(self, pod: Pod, node_name: str) -> None:
@@ -971,6 +1015,7 @@ class DeviceScheduler(Scheduler):
             ]
             pad_rows = [i for i, m in enumerate(cur) if m is None]
             pods_ = [m.pod if m is not None else dummy for m in cur]
+            gang_view = self._gang_view(pods_)
             packed_mode = self._packed_mode
             if packed_mode:
                 with self.metrics.timed("scan_build"):
@@ -983,7 +1028,7 @@ class DeviceScheduler(Scheduler):
                     with self.metrics.timed("scan_build_pods"):
                         pod_table, _ = build_pod_table(
                             pods_, capacity=cap, device=False,
-                            invalid_rows=pad_rows,
+                            invalid_rows=pad_rows, gang_view=gang_view,
                         )
                     with self.metrics.timed("scan_build_constraints"):
                         extra = self._build_constraints(
@@ -1019,7 +1064,8 @@ class DeviceScheduler(Scheduler):
                         node_infos, agg_delta=agg_delta
                     )
                     pod_table, _ = build_pod_table(
-                        pods_, capacity=cap, invalid_rows=pad_rows
+                        pods_, capacity=cap, invalid_rows=pad_rows,
+                        gang_view=gang_view,
                     )
                     extra = self._build_constraints(
                         pods_, nodes, assigned,
@@ -1097,6 +1143,7 @@ class DeviceScheduler(Scheduler):
 
             def build_and_scan(part_):
                 pods_ = [qpi.pod for qpi in part_]
+                gang_view = self._gang_view(pods_)
                 packed_mode = self._packed_mode
                 if packed_mode:
                     # single-program chunk: flat host buffers unpacked
@@ -1108,7 +1155,8 @@ class DeviceScheduler(Scheduler):
                             )
                         )
                         pod_table, _ = build_pod_table(
-                            pods_, capacity=cap, device=False
+                            pods_, capacity=cap, device=False,
+                            gang_view=gang_view,
                         )
                         extra = self._build_constraints(
                             pods_, nodes, assigned,
@@ -1128,7 +1176,9 @@ class DeviceScheduler(Scheduler):
                     node_table, node_names = self._table_builder.build(
                         node_infos, agg_delta=agg_delta
                     )
-                    pod_table, _ = build_pod_table(pods_, capacity=cap)
+                    pod_table, _ = build_pod_table(
+                        pods_, capacity=cap, gang_view=gang_view
+                    )
                     extra = self._build_constraints(
                         pods_, nodes, assigned,
                         pod_capacity=cap,
@@ -1506,6 +1556,23 @@ class DeviceScheduler(Scheduler):
         if reject:
             from minisched_tpu.observability import counters
 
+            # gang atomicity: a gang is released or kept WHOLE.  A member
+            # rejected here means the overlapped wave took capacity the
+            # build assumed free — keeping its siblings would admit a
+            # partial gang that parks at Permit burning its TTL for a
+            # member that cannot come.  Moving keepers to reject only
+            # FREES locally-debited capacity, so earlier keep decisions
+            # stay conservative-valid.
+            from minisched_tpu.api.objects import gang_key
+
+            hit = {gang_key(pod) for _q, pod, _n in reject}
+            hit.discard(None)
+            if hit:
+                moved = [w for w in keep if gang_key(w[1]) in hit]
+                if moved:
+                    keep = [w for w in keep if gang_key(w[1]) not in hit]
+                    reject = reject + moved
+                    counters.inc("gang.rearb_atomic_release", len(moved))
             counters.inc("wave_pipeline.rearb_requeued", len(reject))
         return keep, reject
 
@@ -1808,6 +1875,7 @@ class DeviceScheduler(Scheduler):
         pods_ = [qpi.pod for qpi in qpis_]
         packed_mode = self._packed_mode
         pod_capacity = self._wave_cap(len(pods_))
+        gang_view = self._gang_view(pods_)
         with self.metrics.timed("wave_build_tables"):
             if packed_mode:
                 node_static, node_agg, node_names = (
@@ -1817,14 +1885,17 @@ class DeviceScheduler(Scheduler):
                 )
                 node_capacity = node_agg.capacity
                 pod_table, _ = build_pod_table(
-                    pods_, capacity=pod_capacity, device=False
+                    pods_, capacity=pod_capacity, device=False,
+                    gang_view=gang_view,
                 )
             else:
                 node_table, node_names = self._table_builder.build(
                     node_infos, agg_delta=agg_delta, dirty=dirty
                 )
                 node_capacity = node_table.capacity
-                pod_table, _ = build_pod_table(pods_, capacity=pod_capacity)
+                pod_table, _ = build_pod_table(
+                    pods_, capacity=pod_capacity, gang_view=gang_view
+                )
         extra = None
         if self._needs_extra:
             with self.metrics.timed("wave_build_constraints"):

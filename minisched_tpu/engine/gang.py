@@ -1,0 +1,204 @@
+"""Gang placement directory: where a gang's members already landed.
+
+The GangTopology scorer (plugins/gangtopology.py) pulls each gang
+member toward its ALREADY-PLACED peers: same slice first, then torus
+proximity to the placed centroid.  The placed view has to be cheap per
+wave — walking the pod population per build is exactly the pattern this
+repo exists to avoid — so this module keeps an informer-wired
+incremental index (``GangIndex``: gang key → member uid → node, plus a
+node-name → topology map), and the engine folds its assume-cache on top
+at table-build time (an assumed member is placed capacity even before
+its bind event lands).
+
+The SCALAR path (parity oracle, scalar engine) derives the identical
+view from a NodeInfo snapshot instead (``gang_view_from_infos``) —
+both paths share ``aggregate_coords`` so the encoded gang_* columns
+are bit-identical given the same placed set.
+
+Aggregate format (the tuple every consumer passes around):
+
+    (majority_slice_hash, sum_x, sum_y, sum_z, n)
+
+Integer sums, never a centroid float: the scorer divides on device with
+the same floor semantics the scalar plugin uses, so parity holds.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from minisched_tpu.api.objects import gang_key
+from minisched_tpu.models.tables import fnv1a32
+
+#: node topology tuple: (slice_hash, torus_x, torus_y, torus_z)
+Topo = Tuple[int, int, int, int]
+#: gang aggregate tuple: (majority_slice_hash, sx, sy, sz, n)
+GangAgg = Tuple[int, int, int, int, int]
+
+
+def node_topo(node: Any) -> Topo:
+    """A node's topology tuple, with the SAME zeroing rule the node
+    table encodes (sliceless nodes contribute zero coordinates) — the
+    scalar and device views must sum identical numbers."""
+    spec = node.spec
+    if not spec.slice_id:
+        return (0, 0, 0, 0)
+    return (fnv1a32(spec.slice_id), spec.torus_x, spec.torus_y, spec.torus_z)
+
+
+def aggregate_coords(coords: Iterable[Topo]) -> Optional[GangAgg]:
+    """Fold placed-member topology tuples into the gang aggregate.
+    Majority slice is deterministic: highest count, ties to the SMALLEST
+    hash (a stable rule both the host paths share)."""
+    counts: Dict[int, int] = {}
+    sx = sy = sz = n = 0
+    for sh, x, y, z in coords:
+        n += 1
+        sx += x
+        sy += y
+        sz += z
+        if sh:
+            counts[sh] = counts.get(sh, 0) + 1
+    if n == 0:
+        return None
+    slice_hash = 0
+    if counts:
+        best = max(counts.values())
+        slice_hash = min(k for k, v in counts.items() if v == best)
+    return (slice_hash, sx, sy, sz, n)
+
+
+def gang_view_from_infos(
+    node_infos: Iterable[Any], keys: Optional[set] = None
+) -> Dict[str, GangAgg]:
+    """The placed-gang view derived from a NodeInfo snapshot (scalar
+    engine / parity oracle path).  ``keys`` restricts to the gangs of
+    interest; None aggregates every gang found."""
+    coords: Dict[str, List[Topo]] = {}
+    for ni in node_infos:
+        topo = node_topo(ni.node)
+        for pod in ni.pods:
+            key = gang_key(pod)
+            if key is None or (keys is not None and key not in keys):
+                continue
+            coords.setdefault(key, []).append(topo)
+    return {k: aggregate_coords(v) for k, v in coords.items()}
+
+
+class GangIndex:
+    """Incremental placed-gang-member index, informer-wired like the
+    ConstraintIndex: Pod events maintain gang membership (bound members
+    only), Node events the topology map.  All reads/writes under one
+    lock; gangs are small (a slice is tens of hosts), so per-wave
+    aggregation over members of the WAVE'S gangs is O(gang members),
+    never O(pod population)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        #: gang key → member uid → node name (BOUND members only)
+        self._members: Dict[str, Dict[str, str]] = {}
+        self._pod_gang: Dict[str, str] = {}  # uid → gang key
+        self._node_topo: Dict[str, Topo] = {}
+
+    def wire(self, informer_factory: Any) -> None:
+        from minisched_tpu.controlplane.informer import ResourceEventHandlers
+
+        informer_factory.informer_for("Pod").add_event_handlers(
+            ResourceEventHandlers(on_batch=self._pod_batch)
+        )
+        informer_factory.informer_for("Node").add_event_handlers(
+            ResourceEventHandlers(
+                on_add=lambda node: self._node_changed(node),
+                on_update=lambda old, new: self._node_changed(new),
+                on_delete=lambda node: self._node_gone(node),
+            )
+        )
+
+    # -- event handlers ----------------------------------------------------
+    def _pod_batch(self, events: List[Any]) -> None:
+        from minisched_tpu.controlplane.store import EventType
+
+        with self._mu:
+            for ev in events:
+                try:
+                    pod = ev.obj
+                    key = gang_key(pod)
+                    if key is None:
+                        continue
+                    uid = pod.metadata.uid
+                    if ev.type == EventType.DELETED or not pod.spec.node_name:
+                        self._drop_locked(uid)
+                    else:
+                        self._drop_locked(uid)  # node may have changed
+                        self._members.setdefault(key, {})[uid] = (
+                            pod.spec.node_name
+                        )
+                        self._pod_gang[uid] = key
+                except Exception:
+                    continue  # contain per event (informer batch contract)
+
+    def _drop_locked(self, uid: str) -> None:
+        key = self._pod_gang.pop(uid, None)
+        if key is not None:
+            bucket = self._members.get(key)
+            if bucket is not None:
+                bucket.pop(uid, None)
+                if not bucket:
+                    del self._members[key]
+
+    def _node_changed(self, node: Any) -> None:
+        with self._mu:
+            self._node_topo[node.metadata.name] = node_topo(node)
+
+    def _node_gone(self, node: Any) -> None:
+        with self._mu:
+            self._node_topo.pop(node.metadata.name, None)
+
+    # -- reads -------------------------------------------------------------
+    def placed_count(self, key: str, exclude: Iterable[str] = ()) -> int:
+        """How many members of ``key`` are bound (uid-distinct), minus
+        any in ``exclude`` — the Coscheduling plugin counts a gang's
+        already-bound members toward admission so a rebound straggler
+        (bind conflict after its peers landed) can complete the gang
+        alone instead of waiting for N fresh arrivals."""
+        ex = set(exclude)
+        with self._mu:
+            bucket = self._members.get(key)
+            if not bucket:
+                return 0
+            return sum(1 for uid in bucket if uid not in ex)
+
+    def view_for(
+        self,
+        keys: Iterable[str],
+        extra_members: Iterable[Tuple[str, str, str]] = (),
+    ) -> Dict[str, GangAgg]:
+        """Aggregates for the given gang keys.  ``extra_members`` are
+        (gang key, uid, node name) triples folded on top — the engine's
+        assume-cache (placed this wave, bind not yet landed); uids
+        already in the index are skipped (no double count)."""
+        want = set(keys)
+        coords: Dict[str, List[Topo]] = {}
+        with self._mu:
+            for key in want:
+                bucket = self._members.get(key)
+                if bucket:
+                    coords[key] = [
+                        self._node_topo.get(node, (0, 0, 0, 0))
+                        for node in bucket.values()
+                    ]
+            for key, uid, node in extra_members:
+                if key not in want:
+                    continue
+                bucket = self._members.get(key)
+                if bucket is not None and uid in bucket:
+                    continue
+                coords.setdefault(key, []).append(
+                    self._node_topo.get(node, (0, 0, 0, 0))
+                )
+        return {
+            k: agg
+            for k, v in coords.items()
+            if (agg := aggregate_coords(v)) is not None
+        }

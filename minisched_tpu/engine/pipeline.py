@@ -243,6 +243,11 @@ class WavePipeline:
                 + assumed_pods
             )
         pod_capacity = sched._wave_cap(len(pods_))
+        # placed-gang aggregates for this wave's members (assume-cache
+        # folded in): computed on the worker against the same snapshot
+        # the tables encode; the loop thread's re-arbitration handles
+        # anything the overlapped wave commits after this
+        gang_view = sched._gang_view(pods_)
         with sched.metrics.timed("wave_build_tables"):
             node_static, node_agg, node_names = (
                 sched._table_builder.build_packed(
@@ -251,7 +256,8 @@ class WavePipeline:
             )
             prepared.dirty_rows = sched._table_builder.last_dirty_rows
             pod_table, _ = build_pod_table(
-                pods_, capacity=pod_capacity, device=False
+                pods_, capacity=pod_capacity, device=False,
+                gang_view=gang_view,
             )
         prepared.node_static = node_static
         prepared.node_agg = node_agg

@@ -338,6 +338,33 @@ class Scheduler:
             self, informer_factory, unioned_gvks(self.event_map)
         )
 
+        # gang-aware permit plugins (Coscheduling) count a gang's
+        # already-BOUND members toward admission; inject the engine's
+        # placed-member lookup (the device engine overrides it with its
+        # incremental GangIndex)
+        for p in permit_plugins:
+            if hasattr(p, "gang_lister") and p.gang_lister is None:
+                p.gang_lister = self._gang_placed_count
+
+    def _gang_placed_count(self, key: str, exclude=()) -> int:
+        """Bound members of gang ``key`` (uid-distinct, minus
+        ``exclude``) from the informer cache — O(pods), fine at scalar-
+        engine scale; DeviceScheduler overrides with its GangIndex."""
+        from minisched_tpu.api.objects import gang_key
+
+        try:
+            pods = self.informer_factory.informer_for("Pod").lister()
+        except Exception:
+            return 0
+        ex = set(exclude)
+        return sum(
+            1
+            for p in pods
+            if p.spec.node_name
+            and p.metadata.uid not in ex
+            and gang_key(p) == key
+        )
+
     def _wire_pre_cache(self, informer_factory: Any) -> None:
         """Hook for subclasses that need informer handlers registered
         BEFORE the NodeInfo cache's (see __init__)."""
@@ -701,6 +728,29 @@ class Scheduler:
                 status = self.wait_on_permit(pod)
             if not status.is_success():
                 self.run_unreserve_plugins(state, pod, node_name)
+                from minisched_tpu.plugins.coscheduling import (
+                    is_gang_ttl_status,
+                )
+
+                if is_gang_ttl_status(status):
+                    # gang TTL release: the member was FEASIBLE — its
+                    # peers just never arrived.  No cluster event is
+                    # coming to wake it from the unschedulableQ, so the
+                    # assume lease is forgotten (capacity released) and
+                    # the member requeues through the ACTIVE queue for a
+                    # prompt retry; the queue's gang-adjacent pop order
+                    # then serializes competing gangs instead of
+                    # re-interleaving them (deadlock-freedom).
+                    forget = getattr(self, "_forget", None)
+                    if forget is not None:
+                        forget(pod.metadata.uid)
+                    from minisched_tpu.observability import counters
+
+                    counters.inc("gang.ttl_requeued")
+                    self.queue.add(qpi.pod)
+                    if self.on_decision:
+                        self.on_decision(pod, None, status)
+                    return
                 self.error_func(qpi, status.as_error(), plugin=status.plugin)
                 if self.on_decision:
                     self.on_decision(pod, None, status)

@@ -33,6 +33,7 @@ from minisched_tpu.api.objects import (
     DEFAULT_POD_CPU_REQUEST,
     DEFAULT_POD_MEMORY_REQUEST,
     MIB,
+    gang_key as _gang_key,
 )
 
 # upstream GetNonzeroRequests defaults in device units, applied by the
@@ -454,6 +455,15 @@ class NodeTable:
     unschedulable: Any  # bool[N] (spec.unschedulable)
     # nodenumber plugin
     suffix: Any  # i32[N] trailing-digit of name, -1 if none
+    # multi-host slice topology (gang/topology-aware placement):
+    # fnv hash of spec.slice_id (0 = not part of a slice), torus
+    # coordinates within the slice, and host index — static node
+    # columns read by the GangTopology locality scorer
+    slice_hash: Any  # i32[N]
+    torus_x: Any  # i32[N]
+    torus_y: Any  # i32[N]
+    torus_z: Any  # i32[N]
+    host_index: Any  # i32[N] (-1 = none)
     # label/taint PROFILES: real clusters are built from node pools, so
     # 10k nodes collapse to a handful of distinct (labels, taints)
     # signatures.  Label/taint-dependent kernels (NodeAffinity,
@@ -534,6 +544,17 @@ class PodTable:
     num_containers: Any  # i32[P]
     port: Any  # i32[P, MAX_PORTS]
     num_ports: Any  # i32[P]
+    # gang/topology placement (GangTopology scorer): gang identity hash
+    # (0 = singleton) plus the gang's ALREADY-PLACED aggregate, computed
+    # host-side at table build (engine/gang.py): majority slice hash,
+    # torus coordinate SUMS (centroid × count — integer math, no
+    # division until the kernel) and placed-member count
+    gang_id: Any  # i32[P] fnv of 'namespace/gangname', 0 = none
+    gang_slice: Any  # i32[P] majority slice of placed members, 0 = none
+    gang_sx: Any  # i32[P] sum of placed members' torus_x
+    gang_sy: Any  # i32[P]
+    gang_sz: Any  # i32[P]
+    gang_n: Any  # i32[P] placed-member count
     # deterministic tie-break seed per pod
     seed: Any  # u32[P]
     valid: Any  # bool[P]
@@ -584,6 +605,8 @@ def _node_table_skeleton(cap: int, prof_cap: int) -> Dict[str, Any]:
         req_cpu=zeros(cap), req_mem=zeros(cap), req_eph=zeros(cap),
         req_pods=zeros(cap), nzreq_cpu=zeros(cap), nzreq_mem=zeros(cap),
         unschedulable=np.zeros(cap, bool), suffix=np.full(cap, -1, np.int32),
+        slice_hash=zeros(cap), torus_x=zeros(cap), torus_y=zeros(cap),
+        torus_z=zeros(cap), host_index=np.full(cap, -1, np.int32),
         profile_id=zeros(cap),
         prof_taint_key=zeros((prof_cap, MAX_TAINTS)),
         prof_taint_value=zeros((prof_cap, MAX_TAINTS)),
@@ -694,6 +717,14 @@ def _encode_node_static(t: Dict[str, Any], i: int, node: Any, pid: int) -> None:
     t["alloc_pods"][i] = alloc.pods
     t["unschedulable"][i] = node.spec.unschedulable
     t["suffix"][i] = _name_suffix(node.metadata.name)
+    # written unconditionally: _patch_rows re-encodes updated rows in
+    # place, and a node LEAVING a slice must clear its old coordinates
+    has_slice = bool(node.spec.slice_id)
+    t["slice_hash"][i] = fnv1a32(node.spec.slice_id) if has_slice else 0
+    t["torus_x"][i] = node.spec.torus_x if has_slice else 0
+    t["torus_y"][i] = node.spec.torus_y if has_slice else 0
+    t["torus_z"][i] = node.spec.torus_z if has_slice else 0
+    t["host_index"][i] = node.spec.host_index
     t["profile_id"][i] = pid
     images = node.status.images
     if len(images) > MAX_IMAGES:
@@ -800,6 +831,7 @@ def _fill_aggregate_row(t: Dict[str, Any], i: int, ni: Any) -> None:
 _NODE_STATIC_COLS = (
     "name_hash", "alloc_cpu", "alloc_mem", "alloc_eph", "alloc_pods",
     "unschedulable", "suffix", "profile_id",
+    "slice_hash", "torus_x", "torus_y", "torus_z", "host_index",
     "image_key", "image_size_mb", "num_images", "valid",
 ) + NODE_PROFILE_COLS
 _NODE_AGG_COLS = (
@@ -1173,6 +1205,7 @@ def _pod_is_simple(pod: Any) -> bool:
         and spec.affinity is None
         and not spec.topology_spread_constraints
         and not spec.node_name
+        and spec.gang is None
         and len(spec.containers) <= 1
         and not (spec.containers and spec.containers[0].ports)
     )
@@ -1293,13 +1326,20 @@ def _zero_pod_metas(cap: int) -> Tuple[Tuple[str, str, Tuple[int, ...]], ...]:
         ("pref_nterms", i32, (cap,)),
         ("port", i32, (cap, MAX_PORTS)),
         ("num_ports", i32, (cap,)),
+        ("gang_id", i32, (cap,)),
+        ("gang_slice", i32, (cap,)),
+        ("gang_sx", i32, (cap,)),
+        ("gang_sy", i32, (cap,)),
+        ("gang_sz", i32, (cap,)),
+        ("gang_n", i32, (cap,)),
     )
 
 
 def build_pod_table(pods: Sequence[Any], capacity: int = None,
                     force_packed: bool = False, device: bool = True,
                     invalid_rows: Sequence[int] = (),
-                    elide_zeros: bool = False):
+                    elide_zeros: bool = False,
+                    gang_view: Optional[Dict[str, Tuple]] = None):
     """``device=False`` returns (PackedTable, names) instead of a
     device-resident PodTable — for consumers that unpack the flat
     buffer inside their own jitted program (ops/repair packed mode).
@@ -1308,7 +1348,12 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
     rows between real pods (tail padding is automatic).
     ``elide_zeros`` (device=True slow path only): materialize all-zero
     columns on device instead of shipping them — for one-shot big
-    builds (see batched_device_put); wave-loop builds must not set it."""
+    builds (see batched_device_put); wave-loop builds must not set it.
+    ``gang_view``: gang key → (slice_hash, sx, sy, sz, n) aggregate of
+    the gang's ALREADY-PLACED members (engine/gang.py) — encoded into
+    each member row's gang_* columns so the GangTopology scorer pulls
+    new members toward them; None leaves the aggregates zero (cold
+    start / gang-less callers)."""
     p = len(pods)
     cap = capacity or pad_to(p)
     if p > cap:
@@ -1343,6 +1388,9 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
         pref_nreqs=zeros(PR[:2]), pref_nterms=zeros(cap),
         image_key=zeros((cap, MAX_CONTAINERS)), num_containers=zeros(cap),
         port=zeros((cap, MAX_PORTS)), num_ports=zeros(cap),
+        gang_id=zeros(cap), gang_slice=zeros(cap),
+        gang_sx=zeros(cap), gang_sy=zeros(cap), gang_sz=zeros(cap),
+        gang_n=zeros(cap),
         seed=np.zeros(cap, np.uint32), valid=np.zeros(cap, bool),
     )
     # common columns go columnar (listcomps + native batch kernels — same
@@ -1456,6 +1504,16 @@ def build_pod_table(pods: Sequence[Any], capacity: int = None,
             for j, port in enumerate(ports):
                 t["port"][i, j] = port
             t["num_ports"][i] = len(ports)
+        key = _gang_key(pod)
+        if key is not None:
+            t["gang_id"][i] = fnv1a32(key)
+            agg = (gang_view or {}).get(key)
+            if agg is not None:
+                t["gang_slice"][i] = agg[0]
+                t["gang_sx"][i] = agg[1]
+                t["gang_sy"][i] = agg[2]
+                t["gang_sz"][i] = agg[3]
+                t["gang_n"][i] = agg[4]
     if invalid_rows:
         t["valid"][list(invalid_rows)] = False
     if not device:

@@ -76,6 +76,23 @@ storage-integrity story under ``storage.`` — surfaced in the bench
     storage.ckpt_corrupt_injected
         — the fault fabric's lying-disk evidence (what was WRITTEN
           corrupt; the detection counters above are the other half)
+
+The gang subsystem (plugins/coscheduling + engine/gang) records under
+``gang.`` — surfaced in the bench ``gang`` role's record:
+
+    gang.admitted
+        — gangs whose members ALL held assume leases and were allowed
+          through Permit together (the all-or-nothing invariant)
+    gang.ttl_expired
+        — gang TTLs that fired on a partial gang (every waiting member
+          rejected, their assumes released)
+    gang.ttl_requeued
+        — members a TTL release sent back through the ACTIVE queue
+          (prompt retry; no cluster event would wake them from the
+          unschedulableQ)
+    gang.rearb_atomic_release
+        — pipelined gang members released WITH a sibling that lost
+          commit-time re-arbitration (a gang is kept or released whole)
 """
 
 from __future__ import annotations

@@ -1,0 +1,159 @@
+"""GangTopology — torus-locality scoring for gang members.
+
+The device half of the gang subsystem (ISSUE 6 tentpole part 3): a score
+plugin in the fused chain that pulls each gang member toward its
+already-placed peers at zero marginal device cost — the gang aggregates
+ride as six PodTable columns (models/tables.py), the node side is five
+static columns, and the kernel is a handful of vector ops folded into
+the one jitted evaluation.
+
+Scoring rule (identical in the scalar and batch forms, pure ints):
+
+* singleton pods (``gang_id == 0``) and sliceless nodes score 0 — with
+  no gang specs present the plugin contributes an all-zero matrix, so
+  placements are BIT-IDENTICAL to the chain without it (the parity rule
+  the acceptance criteria pin).
+* warm gang (placed members exist, ``gang_n > 0``):
+  ``SLICE_BONUS`` for nodes on the gang's majority slice, plus a torus
+  proximity term ``clamp(TORUS_MAX - dist, 0, TORUS_MAX)`` where
+  ``dist`` is the Manhattan distance to the placed centroid, computed
+  scaled-by-n so the math stays integral:
+  ``dist = (|x·n − Σx| + |y·n − Σy| + |z·n − Σz|) // n``.
+  (Non-wrapping distance; torus wraparound needs the slice dims on
+  device and is left as a follow-up.)
+* cold gang (no member placed yet): a deterministic hash preference
+  ``mix32(gang_id, slice_hash) >> 27`` (0..31) — every member of one
+  gang ranks slices identically, so even the first wave packs the gang
+  toward one slice instead of scattering it.
+
+Max raw score is SLICE_BONUS + TORUS_MAX = 96 < MAX_NODE_SCORE; no
+normalization needed (identity extensions).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+
+from minisched_tpu.api.objects import gang_key
+from minisched_tpu.engine.tiebreak import mix32 as mix32_py
+from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
+from minisched_tpu.framework.plugin import BatchEvaluable, Plugin
+from minisched_tpu.framework.types import CycleState, Status
+from minisched_tpu.models.tables import fnv1a32
+
+NAME = "GangTopology"
+PRE_SCORE_STATE_KEY = "PreScore" + NAME
+
+#: same-slice bonus — dominates the proximity term so members pack onto
+#: one slice before optimizing intra-slice distance
+SLICE_BONUS = 64
+#: proximity band: nodes further than this many torus hops from the
+#: placed centroid score 0 on the proximity term
+TORUS_MAX = 32
+_M32 = 0xFFFFFFFF
+
+
+def _score_one(
+    gang_id: int, agg, slice_hash: int, x: int, y: int, z: int
+) -> int:
+    """The shared scalar rule (see module docstring); ``agg`` is the
+    gang aggregate tuple or None (cold)."""
+    if gang_id == 0 or slice_hash == 0:
+        return 0
+    if agg is None or agg[4] <= 0:
+        return mix32_py(gang_id & _M32, slice_hash & _M32) >> 27
+    maj, sx, sy, sz, n = agg
+    score = SLICE_BONUS if (maj and slice_hash == maj) else 0
+    dist = (abs(x * n - sx) + abs(y * n - sy) + abs(z * n - sz)) // n
+    prox = TORUS_MAX - dist
+    if prox < 0:
+        prox = 0
+    elif prox > TORUS_MAX:
+        prox = TORUS_MAX
+    return score + prox
+
+
+class GangTopology(Plugin, BatchEvaluable):
+    """Score plugin (scalar + batch) — no filter half: locality is a
+    preference, never a feasibility constraint (a gang that cannot fit
+    on one slice must still place)."""
+
+    def name(self) -> str:
+        return NAME
+
+    # -- scalar ------------------------------------------------------------
+    def pre_score(self, state: CycleState, pod: Any, nodes: List[Any]) -> Status:
+        key = gang_key(pod)
+        if key is None:
+            return Status.success()
+        from minisched_tpu.engine.gang import gang_view_from_infos
+
+        try:
+            node_infos = state.read("nodeinfos")
+        except KeyError:
+            return Status.success()  # snapshotless caller: cold-start rule
+        view = gang_view_from_infos(node_infos, keys={key})
+        state.write(PRE_SCORE_STATE_KEY, view.get(key))
+        return Status.success()
+
+    def score(
+        self, state: CycleState, pod: Any, node_name: str
+    ) -> Tuple[int, Status]:
+        key = gang_key(pod)
+        if key is None:
+            return 0, Status.success()
+        try:
+            agg = state.read(PRE_SCORE_STATE_KEY)
+        except KeyError:
+            agg = None
+        from minisched_tpu.engine.gang import node_topo
+
+        node = state.read("nodeinfo/" + node_name).node
+        sh, x, y, z = node_topo(node)
+        return (
+            _score_one(fnv1a32(key), agg, sh, x, y, z),
+            Status.success(),
+        )
+
+    def score_extensions(self):
+        return None
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # a peer's bind (Pod UPDATE) changes the locality landscape; a
+        # node join can open a slice
+        return [
+            ClusterEvent(GVK.POD, ActionType.UPDATE),
+            ClusterEvent(GVK.NODE, ActionType.ADD),
+        ]
+
+    # -- batch -------------------------------------------------------------
+    def batch_score(self, ctx: Any, pods: Any, nodes: Any, aux: Dict[str, Any]):
+        from minisched_tpu.ops.fused import mix32
+
+        sh = nodes.slice_hash[None, :]  # i32[1, N]
+        gid = pods.gang_id[:, None]  # i32[P, 1]
+        n = pods.gang_n[:, None]
+        nz = jnp.maximum(n, 1)
+        # warm branch: slice bonus + torus proximity to the centroid
+        match = (sh == pods.gang_slice[:, None]) & (
+            pods.gang_slice[:, None] != 0
+        )
+        dist = (
+            jnp.abs(nodes.torus_x[None, :] * n - pods.gang_sx[:, None])
+            + jnp.abs(nodes.torus_y[None, :] * n - pods.gang_sy[:, None])
+            + jnp.abs(nodes.torus_z[None, :] * n - pods.gang_sz[:, None])
+        ) // nz
+        prox = jnp.clip(TORUS_MAX - dist, 0, TORUS_MAX)
+        warm = jnp.where(match, SLICE_BONUS, 0) + prox
+        # cold branch: deterministic per-(gang, slice) hash preference —
+        # int32 → uint32 wraps two's-complement, matching the scalar
+        # ``& 0xFFFFFFFF``
+        cold = (
+            mix32(gid.astype(jnp.uint32), sh.astype(jnp.uint32))
+            >> jnp.uint32(27)
+        ).astype(jnp.int32)
+        raw = jnp.where(n > 0, warm, cold)
+        live = (gid != 0) & (sh != 0)
+        return jnp.where(live, raw, 0).astype(jnp.int32)

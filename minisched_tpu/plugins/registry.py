@@ -106,6 +106,11 @@ def _ensure_builtins() -> None:
             ),
         ),
     )
+    from minisched_tpu.plugins.coscheduling import Coscheduling
+    from minisched_tpu.plugins.gangtopology import GangTopology
+
+    register("Coscheduling", lambda args, ts: Coscheduling(time_scale=ts))
+    register("GangTopology", lambda args, ts: GangTopology())
     register("VolumeBinding", lambda args, ts: VolumeBinding())
     register("VolumeRestrictions", lambda args, ts: VolumeRestrictions())
     register("VolumeZone", lambda args, ts: VolumeZone())

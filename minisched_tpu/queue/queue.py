@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Set
 
+from minisched_tpu.api.objects import gang_key
 from minisched_tpu.framework.events import (
     GVK,
     ClusterEvent,
@@ -499,7 +500,32 @@ class SchedulingQueue:
                 # releases the lock; producers/events can land meanwhile
                 self._cond.wait(wait + 0.001)
                 self.flush_backoff_completed_locked()
+            self._complete_gangs_locked(batch)
+        _sort_gangs_adjacent(batch)
         return batch
+
+    def _complete_gangs_locked(self, batch: List[QueuedPodInfo]) -> None:
+        """Pull every still-queued member of a gang already in ``batch``
+        out of the activeQ and into the batch — even past ``max_pods``:
+        one wave must see the WHOLE gang, or its tail waits a full wave
+        behind its head with the gang TTL burning (and two interleaved
+        gangs would hold partial capacity against each other).  Bounded
+        by gang sizes, which are slice-host counts, not wave counts."""
+        keys = {gang_key(q.pod) for q in batch}
+        keys.discard(None)
+        if not keys or not self._active:
+            return
+        kept: Deque[QueuedPodInfo] = deque()
+        for qpi in self._active:
+            if gang_key(qpi.pod) in keys:
+                qpi.attempts += 1
+                self._scheduling_cycle += 1
+                qpi.scheduling_cycle = self._scheduling_cycle
+                self._queued_uids.discard(self._uid(qpi.pod))
+                batch.append(qpi)
+            else:
+                kept.append(qpi)
+        self._active = kept
 
     def flush_backoff_completed_locked(self) -> None:
         # caller holds self._cond
@@ -525,6 +551,22 @@ class SchedulingQueue:
     def pending_unschedulable(self) -> List[QueuedPodInfo]:
         with self._cond:
             return list(self._unschedulable.values())
+
+
+def _sort_gangs_adjacent(batch: List[QueuedPodInfo]) -> None:
+    """Stable in-place reorder: members of one gang become adjacent at
+    the gang's FIRST occurrence; singletons and distinct gangs keep
+    their relative pop order.  The wave engine then evaluates a gang as
+    one contiguous run — its members arbitrate capacity together and
+    reach Permit in the same commit pass."""
+    first: Dict[str, int] = {}
+    keyed = []
+    for i, qpi in enumerate(batch):
+        k = gang_key(qpi.pod)
+        slot = i if k is None else first.setdefault(k, i)
+        keyed.append((slot, i, qpi))
+    keyed.sort(key=lambda e: (e[0], e[1]))
+    batch[:] = [qpi for _, _, qpi in keyed]
 
 
 def _spec_changed(old_pod, new_pod) -> bool:

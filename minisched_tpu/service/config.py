@@ -158,6 +158,26 @@ def default_full_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
     )
 
 
+def gang_roster_config(time_scale: float = 1.0) -> SchedulerConfig:
+    """The full default roster plus the gang subsystem: Coscheduling at
+    Permit (all-or-nothing admission over the waiting-pod machinery) and
+    GangTopology in the score chain (slice/torus locality toward placed
+    gang members).  A SEPARATE roster on purpose: the default permit
+    chain is empty, which lets the wave engine skip per-pod WaitingPod
+    registration entirely (_commit_winners' fast path) — workloads
+    without gangs keep that; with no gang specs present this roster's
+    placements are bit-identical anyway (GangTopology scores 0
+    everywhere, Coscheduling passes every singleton)."""
+    cfg = default_full_roster_config(time_scale=time_scale)
+    # pre_score too: the scalar score reads the placed-gang aggregate
+    # its pre_score derives from the snapshot (the batch path gets the
+    # same aggregate through the PodTable's gang_* columns)
+    cfg.pre_score.enabled.append(PluginEnabled("GangTopology"))
+    cfg.score.enabled.append(PluginEnabled("GangTopology", weight=1))
+    cfg.permit = PluginSet(enabled=[PluginEnabled("Coscheduling")])
+    return cfg
+
+
 def apply_plugin_customization(
     default: SchedulerConfig, custom: SchedulerConfig
 ) -> SchedulerConfig:

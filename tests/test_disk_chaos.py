@@ -34,7 +34,9 @@ from minisched_tpu.controlplane.fsck import fsck
 from minisched_tpu.controlplane.store import StorageDegraded
 from minisched_tpu.controlplane.walio import (
     WAL_MAGIC,
+    WAL_MAGIC_C,
     WalCorrupt,
+    _find_magic,
     encode_frame,
 )
 from minisched_tpu.faults import FaultFabric, wal_double_binds
@@ -62,10 +64,12 @@ def _frame_offsets(path: str):
     """Byte offsets of every v2 frame in the file."""
     with open(path, "rb") as f:
         data = f.read()
-    offs, off = [], data.find(WAL_MAGIC)
+    # either checksum algorithm (the flags byte selects zlib crc32 or
+    # CRC32C per frame; the writer's default depends on the native lib)
+    offs, off = [], _find_magic(data, 0)
     while off >= 0:
         offs.append(off)
-        off = data.find(WAL_MAGIC, off + 1)
+        off = _find_magic(data, off + 1)
     return offs
 
 
@@ -242,7 +246,8 @@ def test_legacy_jsonl_wal_replays_identically(tmp_path):
     with open(path, "rb") as f:
         data = f.read()
     assert data.startswith(legacy_bytes)  # v1 prefix byte-identical
-    assert WAL_MAGIC in data[len(legacy_bytes):]  # v2 frames follow
+    tail = data[len(legacy_bytes):]  # v2 frames follow (either checksum)
+    assert WAL_MAGIC in tail or WAL_MAGIC_C in tail
 
     re = DurableObjectStore(path)  # mixed file replays
     assert {n.metadata.name for n in re.list("Node")} == {"n1", "n2"}

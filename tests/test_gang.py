@@ -1,0 +1,365 @@
+"""Gang + topology-aware placement (ISSUE 6).
+
+Covers the tentpole's product claims:
+
+* gang adjacency in the queue (one wave sees the whole gang);
+* the parity rule — with NO gang specs present, the GangTopology scorer
+  leaves placements bit-identical to the chain without it;
+* scalar vs batch GangTopology parity on a warm gang;
+* live all-or-nothing admission over the permit/waiting-pod machinery;
+* TTL release under the pipelined engine: every member assume released,
+  members requeue via the ACTIVE queue, the capacity audit (assume
+  ledger) drains to zero;
+* GangIndex incremental membership.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import (
+    GangSpec,
+    gang_key,
+    make_gang_pods,
+    make_node,
+    make_pod,
+)
+from minisched_tpu.framework.types import PodInfo, QueuedPodInfo
+from minisched_tpu.observability import counters
+from minisched_tpu.queue.queue import SchedulingQueue
+
+
+def _mk_slice_nodes(n_slices=2, hosts=4, cpu="8"):
+    nodes = []
+    for s in range(n_slices):
+        for h in range(hosts):
+            nodes.append(
+                make_node(
+                    f"slice{s}-host{h}",
+                    capacity={"cpu": cpu, "memory": "16Gi", "pods": 110},
+                    slice_id=f"slice{s}",
+                    torus=(h % 2, h // 2, 0),
+                    host_index=h,
+                )
+            )
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# model + clone/serialization round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_gang_and_topology_fields_roundtrip():
+    from minisched_tpu.controlplane.checkpoint import _decode, _encode
+
+    node = make_node("n0", slice_id="s7", torus=(1, 2, 3), host_index=5)
+    pod = make_pod("p0", gang=GangSpec("g", 4, 12.5))
+    assert gang_key(pod) == "default/g"
+    assert gang_key(make_pod("solo")) is None
+    # clone preserves, never aliases
+    c = pod.clone()
+    assert c.spec.gang.size == 4 and c.spec.gang is not pod.spec.gang
+    nc = node.clone()
+    assert (nc.spec.slice_id, nc.spec.torus_y, nc.spec.host_index) == (
+        "s7", 2, 5,
+    )
+    # WAL/checkpoint codec round-trips the new fields
+    from minisched_tpu.api.objects import Node, Pod
+
+    pod2 = _decode(Pod, _encode(pod))
+    assert pod2.spec.gang.name == "g" and pod2.spec.gang.ttl_s == 12.5
+    node2 = _decode(Node, _encode(node))
+    assert node2.spec.slice_id == "s7" and node2.spec.torus_z == 3
+    # back-compat: documents written before the fields existed decode
+    old = _encode(pod)
+    del old["spec"]["gang"]
+    assert _decode(Pod, old).spec.gang is None
+
+
+# ---------------------------------------------------------------------------
+# queue gang-awareness
+# ---------------------------------------------------------------------------
+
+
+def _qpi(pod):
+    return QueuedPodInfo(PodInfo(pod))
+
+
+def test_pop_batch_sorts_gang_members_adjacent():
+    q = SchedulingQueue()
+    a = make_gang_pods("ga", 3)
+    b = make_gang_pods("gb", 2)
+    solo = [make_pod(f"solo{i}") for i in range(3)]
+    # interleave: a0 s0 b0 a1 s1 b1 a2 s2
+    order = [a[0], solo[0], b[0], a[1], solo[1], b[1], a[2], solo[2]]
+    for p in order:
+        q.add(p)
+    batch = q.pop_batch(len(order), timeout=1.0)
+    names = [qpi.pod.metadata.name for qpi in batch]
+    assert names == [
+        "ga-0", "ga-1", "ga-2", "solo0", "gb-0", "gb-1", "solo1", "solo2",
+    ]
+
+
+def test_pop_batch_completes_gang_past_max_pods():
+    q = SchedulingQueue()
+    members = make_gang_pods("g", 6)
+    for p in members:
+        q.add(p)
+    # max_pods splits the gang — the completion pull must fetch the rest
+    batch = q.pop_batch(3, timeout=1.0)
+    assert len(batch) == 6
+    assert q.stats()["active"] == 0
+    assert {qpi.pod.metadata.name for qpi in batch} == {
+        p.metadata.name for p in members
+    }
+
+
+# ---------------------------------------------------------------------------
+# GangTopology scoring: parity rules
+# ---------------------------------------------------------------------------
+
+
+def _batch_choices(pods, nodes, filters, pre_scores, scores, weights=None,
+                   assigned=None, gang_view=None):
+    from minisched_tpu.models.tables import build_node_table, build_pod_table
+    from minisched_tpu.ops.fused import FusedEvaluator
+
+    nodes_sorted = sorted(nodes, key=lambda n: n.metadata.name)
+    by_node = {}
+    for p in assigned or []:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    node_table, node_names = build_node_table(nodes_sorted, by_node)
+    pod_table, _ = build_pod_table(pods, gang_view=gang_view)
+    ev = FusedEvaluator(filters, pre_scores, scores, weights)
+    choice = ev(pod_table, node_table).choice.tolist()[: len(pods)]
+    return [node_names[c] if c >= 0 else "" for c in choice]
+
+
+def test_no_gangs_means_bit_identical_placements():
+    """The acceptance-criteria parity rule: no gang specs + the scorer
+    in the chain ≡ the chain without it, bit for bit."""
+    import random
+
+    from minisched_tpu.plugins.gangtopology import GangTopology
+    from minisched_tpu.plugins.noderesources import (
+        NodeResourcesFit,
+        NodeResourcesLeastAllocated,
+    )
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    rng = random.Random(7)
+    nodes = _mk_slice_nodes(2, 4) + [
+        make_node(f"plain{i}", unschedulable=rng.random() < 0.3)
+        for i in range(8)
+    ]
+    pods = [
+        make_pod(f"p{i}", requests={"cpu": rng.choice(["500m", "1", "2"])})
+        for i in range(40)
+    ]
+    gt = GangTopology()
+    base = _batch_choices(
+        pods, nodes,
+        [NodeUnschedulable(), NodeResourcesFit()], [],
+        [NodeResourcesLeastAllocated()],
+    )
+    with_gang = _batch_choices(
+        pods, nodes,
+        [NodeUnschedulable(), NodeResourcesFit()], [gt],
+        [NodeResourcesLeastAllocated(), gt],
+    )
+    assert base == with_gang
+
+
+def test_gang_topology_scalar_batch_parity_warm_gang():
+    """Scalar (oracle) and batch GangTopology agree on a warm gang —
+    placed members pulled from the same snapshot both paths see."""
+    from minisched_tpu.engine.gang import gang_view_from_infos
+    from minisched_tpu.engine.scheduler import schedule_pod_once
+    from minisched_tpu.framework.nodeinfo import build_node_infos
+    from minisched_tpu.framework.types import FitError
+    from minisched_tpu.plugins.gangtopology import GangTopology
+    from minisched_tpu.plugins.noderesources import (
+        NodeResourcesFit,
+        NodeResourcesLeastAllocated,
+    )
+    from minisched_tpu.plugins.nodeunschedulable import NodeUnschedulable
+
+    nodes = sorted(_mk_slice_nodes(3, 4), key=lambda n: n.metadata.name)
+    # two members already placed on slice1
+    assigned = []
+    for i, node in enumerate(["slice1-host0", "slice1-host1"]):
+        m = make_pod(f"placed{i}", gang=GangSpec("g", 6), requests={"cpu": "1"})
+        m.metadata.uid = f"placed{i}"
+        m.spec.node_name = node
+        assigned.append(m)
+    pending = [
+        make_pod(f"m{i}", gang=GangSpec("g", 6), requests={"cpu": "1"})
+        for i in range(3)
+    ] + [make_pod("solo", requests={"cpu": "1"})]
+
+    gt = GangTopology()
+    filters = [NodeUnschedulable(), NodeResourcesFit()]
+    scores = [NodeResourcesLeastAllocated(), gt]
+    node_infos = build_node_infos(nodes, assigned)
+    oracle = []
+    for pod in pending:
+        try:
+            oracle.append(
+                schedule_pod_once(filters, [gt], scores, {}, pod, node_infos)
+            )
+        except FitError:
+            oracle.append("")
+    gang_view = gang_view_from_infos(node_infos)
+    got = _batch_choices(
+        pending, nodes, filters, [gt], scores,
+        assigned=assigned, gang_view=gang_view,
+    )
+    assert oracle == got
+    # and the warm members do get pulled to the placed slice
+    assert all(name.startswith("slice1-") for name in got[:3])
+
+
+def test_gang_index_incremental_membership():
+    from minisched_tpu.engine.gang import GangIndex, aggregate_coords
+
+    class _Ev:
+        def __init__(self, typ, obj):
+            self.type = typ
+            self.obj = obj
+
+    from minisched_tpu.controlplane.store import EventType
+
+    idx = GangIndex()
+    for node in _mk_slice_nodes(1, 3):
+        idx._node_changed(node)
+    m0, m1, _m2 = make_gang_pods("g", 3)
+    m0.metadata.uid, m1.metadata.uid = "u0", "u1"
+    m0.spec.node_name = "slice0-host0"
+    m1.spec.node_name = "slice0-host2"
+    idx._pod_batch([_Ev(EventType.ADDED, m0), _Ev(EventType.ADDED, m1)])
+    assert idx.placed_count("default/g") == 2
+    assert idx.placed_count("default/g", exclude=["u1"]) == 1
+    view = idx.view_for({"default/g"})
+    from minisched_tpu.engine.gang import node_topo
+
+    want = aggregate_coords(
+        [node_topo(n) for n in _mk_slice_nodes(1, 3) if n.metadata.name in
+         ("slice0-host0", "slice0-host2")]
+    )
+    assert view["default/g"] == want
+    # assumed fold dedupes against indexed members
+    view2 = idx.view_for(
+        {"default/g"},
+        extra_members=[
+            ("default/g", "u1", "slice0-host2"),  # already indexed: skip
+            ("default/g", "u9", "slice0-host1"),  # new: folded
+        ],
+    )
+    assert view2["default/g"][4] == 3
+    idx._pod_batch([_Ev(EventType.DELETED, m0)])
+    assert idx.placed_count("default/g") == 1
+
+
+# ---------------------------------------------------------------------------
+# live engine: all-or-nothing admission + TTL release under the pipeline
+# ---------------------------------------------------------------------------
+
+
+def _start_gang_engine(client, max_wave=64):
+    from minisched_tpu.service.config import gang_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        gang_roster_config(), device_mode=True, max_wave=max_wave
+    )
+    # short assume-lease TTL so the idle-path lease confirm drains the
+    # ledger within the test's quiesce window (default is 30s)
+    sched.assume_ttl_s = 2.0
+    return svc, sched
+
+
+def _wait(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _bound_count(client) -> int:
+    return sum(1 for p in client.pods().list() if p.spec.node_name)
+
+
+def test_gang_admitted_all_or_nothing_live():
+    """Gang smoke (tier-1): a full gang + a singleton drain through the
+    live pipelined device engine; the gang admits exactly once and every
+    member binds — the all-or-nothing invariant end to end."""
+    from minisched_tpu.controlplane.client import Client
+
+    counters.reset()
+    client = Client()
+    client.nodes().create_many(_mk_slice_nodes(2, 4), return_objects=False)
+    svc, sched = _start_gang_engine(client)
+    try:
+        pods = make_gang_pods(
+            "trainer", 4, ttl_s=30.0, requests={"cpu": "1"}
+        ) + [make_pod("solo", requests={"cpu": "1"})]
+        client.pods().create_many(pods, return_objects=False)
+        _wait(lambda: _bound_count(client) >= 5, 120, "gang + solo bound")
+    finally:
+        svc.shutdown_scheduler()
+    assert counters.get("gang.admitted") == 1
+    assert counters.get("gang.ttl_expired") == 0
+    # no partial gangs, ledger empty
+    cosched = next(
+        p for p in sched.permit_plugins if p.name() == "Coscheduling"
+    )
+    assert cosched.pending_gangs() == {}
+
+
+def test_gang_ttl_release_under_pipeline_drains_capacity():
+    """The TTL satellite: a partial gang's TTL fires mid-run — every
+    member assume releases, the members requeue via the ACTIVE queue
+    (gang.ttl_requeued), and once the members are deleted the capacity
+    audit (assume ledger + queue) drains to zero.  Late members then
+    complete a NEW gang through the same machinery."""
+    from minisched_tpu.controlplane.client import Client
+
+    counters.reset()
+    client = Client()
+    client.nodes().create_many(_mk_slice_nodes(1, 4), return_objects=False)
+    svc, sched = _start_gang_engine(client)
+    try:
+        members = make_gang_pods(
+            "gang", 4, ttl_s=0.5, requests={"cpu": "1"}
+        )
+        client.pods().create_many(members[:2], return_objects=False)
+        _wait(
+            lambda: counters.get("gang.ttl_expired") >= 1
+            and counters.get("gang.ttl_requeued") >= 2,
+            120,
+            "gang TTL expiry + activeQ requeue",
+        )
+        # TTL released and requeued — now complete the gang: the two
+        # released members and the two late ones must ALL bind
+        client.pods().create_many(members[2:], return_objects=False)
+        _wait(lambda: _bound_count(client) >= 4, 120, "late members bound")
+        assert counters.get("gang.admitted") >= 1
+        # capacity audit drains to zero at quiesce
+        _wait(
+            lambda: not sched._assumed, 60, "assume ledger drained"
+        )
+        q = sched.queue.stats()
+        assert q["active"] == 0 and q["unschedulable"] == 0
+    finally:
+        svc.shutdown_scheduler()
+    cosched = next(
+        p for p in sched.permit_plugins if p.name() == "Coscheduling"
+    )
+    assert cosched.pending_gangs() == {}

@@ -1,0 +1,191 @@
+"""CRC32C frame switch (walio flags byte) + fsck --repair accept-loss.
+
+The v2 frame magic's last byte was reserved to version the checksum
+algorithm; this suite pins the switch: flags 0 = zlib crc32, flags 1 =
+CRC32C (google-crc32c native, pure-Python fallback on the READ side
+only), one file may carry both, and the legacy v1 JSONL prefix still
+replays through the same mixed-mode reader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from minisched_tpu.controlplane import walio
+
+
+def _recs(n, start_rv=1):
+    return [
+        {
+            "op": "put",
+            "kind": "Pod",
+            "obj": {
+                "metadata": {
+                    "resource_version": start_rv + i,
+                    "uid": f"u{start_rv + i}",
+                    "namespace": "d",
+                    "name": f"p{start_rv + i}",
+                }
+            },
+        }
+        for i in range(n)
+    ]
+
+
+def test_mixed_algorithm_roundtrip():
+    recs = _recs(6)
+    data = (
+        walio.encode_frame(recs[0], crc32c=False)
+        + walio.encode_frame(recs[1], crc32c=True)
+        + json.dumps(recs[2]).encode() + b"\n"  # legacy v1 line
+        + walio.encode_frame(recs[3])  # writer default
+        + walio.encode_frame(recs[4], crc32c=True)
+        + walio.encode_frame(recs[5], crc32c=False)
+    )
+    reader = walio.WalReader(data)
+    assert [rec for rec, _ in reader] == recs
+    assert reader.legacy_records == 1
+    assert reader.framed_records == 5
+    assert not reader.torn_tail
+
+
+def test_crc32c_python_fallback_matches_native():
+    if not walio.HAVE_NATIVE_CRC32C:
+        pytest.skip("google-crc32c not importable here")
+    for size in (0, 1, 3, 64, 1000, 4096):
+        payload = os.urandom(size)
+        assert walio._crc32c_py(payload) == walio._crc32c_native(payload)
+
+
+def test_crc32c_frame_corruption_located():
+    recs = _recs(3)
+    frames = [walio.encode_frame(r, crc32c=True) for r in recs]
+    data = bytearray(b"".join(frames))
+    off = len(frames[0]) + walio.HEADER_SIZE + 4  # payload byte of frame 1
+    data[off] ^= 0x20
+    reader = walio.WalReader(bytes(data))
+    with pytest.raises(walio.WalCorrupt) as err:
+        list(reader)
+    assert err.value.offset == len(frames[0])
+    assert "crc32c" in err.value.reason
+    assert err.value.last_good_rv == 1
+    assert err.value.resync_rv == 3  # magic-scan resync finds crc32c frames
+
+
+def test_resync_and_lenient_iterate_over_both_magics(tmp_path):
+    recs = _recs(4)
+    data = (
+        walio.encode_frame(recs[0], crc32c=False)
+        + b"\x00garbage\x00"
+        + walio.encode_frame(recs[1], crc32c=True)
+        + walio.encode_frame(recs[2], crc32c=False)
+        + walio.encode_frame(recs[3], crc32c=True)
+    )
+    path = tmp_path / "mixed.wal"
+    path.write_bytes(data)
+    got = list(walio.iter_wal_records_lenient(str(path)))
+    assert got == recs  # audits skip the bad region, keep BOTH kinds
+    resync = walio.resync_scan(data, len(walio.encode_frame(recs[0], crc32c=False)) + 1)
+    assert resync is not None and resync[0] == 2
+
+
+def test_torn_crc32c_header_is_tail_not_corruption():
+    data = walio.encode_frame(_recs(1)[0], crc32c=True) + walio.WAL_MAGIC_C[:3]
+    reader = walio.WalReader(data)
+    assert len(list(reader)) == 1
+    assert reader.torn_tail
+
+
+def test_durable_store_roundtrip_with_crc32c_writer(tmp_path):
+    """The live writer (encode_frame default) replays through reopen and
+    passes fsck whichever algorithm the environment selected."""
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import fsck
+
+    wal = str(tmp_path / "c.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+    client.nodes().create(make_node("n0"))
+    client.pods().create_many([make_pod(f"p{i}") for i in range(8)])
+    store.close()
+    re = DurableObjectStore(wal)
+    assert len(re.list("Pod")) == 8
+    re.close()
+    assert fsck(wal)["ok"]
+
+
+def test_fsck_repair_accept_loss(tmp_path):
+    """--repair: covered salvage refuses when uncovered records follow
+    the corruption; --accept-loss truncates anyway and reports the rv
+    range being discarded; the repaired WAL then replays clean."""
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import fsck, repair
+
+    wal = str(tmp_path / "r.wal")
+    store = DurableObjectStore(wal)
+    client = Client(store=store)
+    client.pods().create_many([make_pod(f"p{i}") for i in range(20)])
+    store.close()
+    data = bytearray(open(wal, "rb").read())
+    data[len(data) // 3] ^= 0x10  # mid-file flip, later records uncovered
+    open(wal, "wb").write(bytes(data))
+
+    refused = repair(wal)
+    assert not refused["repaired"] and "accept-loss" in refused["hint"]
+
+    rep = repair(wal, accept_loss=True)
+    assert rep["repaired"] and rep["action"] == "accept-loss-truncate"
+    d = rep["discarded"]
+    assert d["to_rv"] == 20 and d["from_rv_exclusive"] < d["to_rv"]
+    assert d["resynced_records"] > 0 and d["bytes"] > 0
+    report = fsck(wal)
+    assert report["ok"], report["errors"]
+    # the surviving prefix replays
+    re = DurableObjectStore(wal)
+    assert 0 < len(re.list("Pod")) < 20
+    re.close()
+
+
+def test_fsck_repair_bad_tail_covered_without_accept_loss(tmp_path):
+    """A corrupt FINAL frame with nothing decodable after it is a bad
+    tail: the store's covered salvage truncates it automatically, so
+    --repair must fix it WITHOUT demanding --accept-loss."""
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import fsck, repair
+
+    wal = str(tmp_path / "tail.wal")
+    store = DurableObjectStore(wal)
+    Client(store=store).pods().create_many([make_pod(f"p{i}") for i in range(5)])
+    store.close()
+    data = bytearray(open(wal, "rb").read())
+    data[-3] ^= 0x40  # payload byte of the LAST frame
+    open(wal, "wb").write(bytes(data))
+
+    rep = repair(wal)  # no accept_loss
+    assert rep["repaired"] and rep["action"] == "salvage-covered"
+    assert rep["covered_loss"]["resynced_records"] == 0
+    assert fsck(wal)["ok"]
+
+
+def test_fsck_repair_clean_wal_noop(tmp_path):
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import repair
+
+    wal = str(tmp_path / "clean.wal")
+    store = DurableObjectStore(wal)
+    Client(store=store).pods().create(make_pod("p0"))
+    store.close()
+    rep = repair(wal, accept_loss=True)
+    assert rep["repaired"] and rep["action"] == "salvage-covered"
+    assert "discarded" not in rep
