@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-gang chaos chaos-proc chaos-ha chaos-disk docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang chaos chaos-proc chaos-ha chaos-disk docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -25,6 +25,18 @@ chaos: native
 # time (the pipeline has regressed to serial) or any audit trips
 bench-wave: native
 	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only wave
+
+# multi-chip live wave engine (ISSUE 7) on an 8-virtual-device CPU mesh:
+# the SAME uid-pinned workload through the single-device and the
+# mesh-sharded pipelined engine; FAILS on any placement difference, on
+# sharded device_total_s >= single-device, on stall >= build (pipeline
+# regressed), on any per-wave fallback, on the exactly-once/capacity
+# audits, or if XLA's >2s slow-constant-folding alarm fires.  On a real
+# multi-chip box drop the XLA_FLAGS forcing to shard over real devices.
+bench-mesh: native
+	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 \
+		XLA_FLAGS="$$XLA_FLAGS --xla_force_host_platform_device_count=8" \
+		python bench.py --only mesh
 
 # gang churn role (CPU): mixed gang+singleton rounds over a sliced torus
 # cluster + a two-gang deadlock probe; FAILS on any stranded partial

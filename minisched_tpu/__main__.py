@@ -23,8 +23,15 @@ Optional env:
     MINISCHED_TPU_STORE_URL=file:///tmp/cluster.wal   durable WAL store
                                                       (reference: etcd URL)
     MINISCHED_DEVICE_MODE=1                           TPU wave engine
-    MINISCHED_MESH_DEVICES=8                          shard waves over an
-                                                      N-device mesh
+    MINISCHED_MESH_DEVICES=8                          pin an N-device mesh
+                                                      (overrides the policy)
+    MINISCHED_MESH=0|1                                mesh policy when no pin
+                                                      is set: 0 = never,
+                                                      1 = always (all visible
+                                                      devices), unset = auto
+                                                      when >1 device
+                                                      (parallel/sharding.
+                                                      resolve_mesh)
 """
 
 from __future__ import annotations

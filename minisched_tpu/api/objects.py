@@ -241,6 +241,15 @@ class NodeSpec:
     torus_y: int = 0
     torus_z: int = 0
     host_index: int = -1
+    #: the slice's torus DIMENSIONS (ring size per axis; 0 = unknown).
+    #: With dims on the node, the GangTopology scorer measures ring
+    #: (wraparound) distance instead of non-wrapping Manhattan — ISSUE 7
+    #: satellite closing the ISSUE 6 follow-up.  dims=0 keeps the exact
+    #: non-wrapping behavior (identity), so dim-less clusters are
+    #: placement-bit-identical to before.
+    slice_dx: int = 0
+    slice_dy: int = 0
+    slice_dz: int = 0
 
 
 @dataclass
@@ -273,6 +282,9 @@ class Node:
                 torus_y=self.spec.torus_y,
                 torus_z=self.spec.torus_z,
                 host_index=self.spec.host_index,
+                slice_dx=self.spec.slice_dx,
+                slice_dy=self.spec.slice_dy,
+                slice_dz=self.spec.slice_dz,
             ),
             status=NodeStatus(
                 capacity=self.status.capacity.clone(),
@@ -792,9 +804,13 @@ def make_node(
     slice_id: str = "",
     torus: Optional[tuple] = None,
     host_index: int = -1,
+    slice_dims: Optional[tuple] = None,
 ) -> Node:
     cap = ResourceList.parse(capacity or {CPU: "4", MEMORY: "16Gi", PODS: 110})
     tx, ty, tz = (tuple(torus) + (0, 0, 0))[:3] if torus else (0, 0, 0)
+    dx, dy, dz = (
+        (tuple(slice_dims) + (0, 0, 0))[:3] if slice_dims else (0, 0, 0)
+    )
     return Node(
         metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
         spec=NodeSpec(
@@ -805,6 +821,9 @@ def make_node(
             torus_y=ty,
             torus_z=tz,
             host_index=host_index,
+            slice_dx=dx,
+            slice_dy=dy,
+            slice_dz=dz,
         ),
         status=NodeStatus(capacity=cap, allocatable=cap.clone()),
     )

@@ -192,7 +192,10 @@ class SchedulerCache:
         """({name: [free milli_cpu, free mem MiB, free eph MiB, free pod
         slots]}, {name: uids of pods the cache already counts there}) for
         the given nodes, from the LIVE NodeInfos under one lock hold —
-        the pipelined wave's commit-time re-arbitration base.  The
+        the pipelined wave's commit-time re-arbitration base, single-
+        device and mesh engines alike (the mesh shards the DEVICE
+        compute; this host-side capacity view is whole either way, which
+        is what keeps re-arbitration mesh-agnostic — ISSUE 7).  The
         counted-uid sets let the caller fold its assume-cache WITHOUT
         double-subtracting a pod whose bind event already landed (the
         assumption outlives the event until the next snapshot prune).

@@ -94,8 +94,43 @@ class DeviceScheduler(Scheduler):
         #: optional jax.sharding.Mesh — waves then evaluate SHARDED over
         #: the (pods × nodes) device mesh (parallel/sharding.py): pod rows
         #: data-parallel, node columns model-parallel, XLA collectives
-        #: over ICI.  None = single-device.
+        #: over ICI.  None at construction resolves the startup policy
+        #: (parallel/sharding.resolve_mesh): MINISCHED_MESH=1 forces a
+        #: mesh over every visible device (a degenerate 1-device mesh
+        #: keeps current behavior), MINISCHED_MESH=0 pins single-device,
+        #: unset auto-shards exactly when jax.device_count() > 1.
+        #: ``mesh=False`` pins single-device EXPLICITLY (bypassing the
+        #: policy) — the mesh bench's baseline lap needs it on a box
+        #: whose device count would auto-shard.
+        if mesh is None:
+            from minisched_tpu.parallel.sharding import resolve_mesh
+
+            mesh = resolve_mesh()
+        elif mesh is False:
+            mesh = None
         self.mesh = mesh
+        #: pod-table capacity quantum: lane-padded AND divisible by the
+        #: mesh pod axis so every shard gets equal whole tiles (the node
+        #: quantum lives in the table builder)
+        self._pod_cap_mult = 128
+        #: per-wave single-device fallback evaluator (mesh mode only) —
+        #: mirrors the pipeline's _BuildFallback: any sharded-evaluate
+        #: failure re-runs THAT wave on one device, later waves retry
+        #: the mesh (see _eval_packed_wave)
+        self._mesh_fallback_evaluator: Any = None
+        if mesh is not None:
+            from minisched_tpu.observability import counters
+            from minisched_tpu.parallel.sharding import (
+                cap_multiple,
+                mesh_axis_sizes,
+            )
+
+            pod_ax, node_ax = mesh_axis_sizes(mesh)
+            self._pod_cap_mult = cap_multiple(128, pod_ax)
+            # gauges, not counters: the factoring is state — restarts and
+            # multi-engine processes must not sum 2x4 into 4x8
+            counters.set_gauge("wave_mesh.pod_shards", pod_ax)
+            counters.set_gauge("wave_mesh.node_shards", node_ax)
         # chains with a combo-carrying (cross-pod) plugin route constrained
         # pods through the sequential scan; volume-only chains never do —
         # nothing in them evaluates spread/affinity constraints.  Unknown
@@ -130,9 +165,12 @@ class DeviceScheduler(Scheduler):
         )
         # static node columns cached across waves, keyed on each node's
         # (name, resource_version) — only the assigned-pod aggregates are
-        # re-encoded per wave.  Device-resident statics only off-mesh:
-        # the sharded steps donate the node table (see the builder)
-        self._table_builder = CachedNodeTableBuilder(device_static=mesh is None)
+        # re-encoded per wave.  Under a mesh the device-resident statics
+        # live SHARDED on the node axis (the packed mesh program consumes
+        # them in place; nothing donates them)
+        self._table_builder = CachedNodeTableBuilder(
+            device_static=True, mesh=self.mesh
+        )
         #: observability.resultstore.Store — set by the service when
         #: record_results is on: each wave then also runs a diagnostics
         #: evaluation and records the same per-plugin artifact scalar
@@ -516,12 +554,14 @@ class DeviceScheduler(Scheduler):
     @property
     def _packed_mode(self) -> bool:
         """Single-program packed waves: tables ride as flat host buffers
-        unpacked inside the evaluator's program.  Off under a mesh (the
-        sharded step shards device tables) and under record_results (the
-        diagnostics evaluation needs device tables).  One definition —
-        prewarm and the live paths must never disagree, or the first live
-        wave compiles mid-run (~30s on the tunnel)."""
-        return self.mesh is None and self.result_store is None
+        unpacked inside the evaluator's program — WITH or WITHOUT a mesh
+        (under one, the unpacked tables get sharding constraints and
+        GSPMD partitions the program; parallel/sharding.MeshPackedCaller).
+        Off only under record_results (the diagnostics evaluation needs
+        device tables).  One definition — prewarm and the live paths must
+        never disagree, or the first live wave compiles mid-run (~30s on
+        the tunnel)."""
+        return self.result_store is None
 
     def _get_evaluator(self) -> RepairingEvaluator:
         if self._evaluator is None:
@@ -537,6 +577,69 @@ class DeviceScheduler(Scheduler):
                 mesh=self.mesh,
             )
         return self._evaluator
+
+    def _get_mesh_fallback_evaluator(self) -> RepairingEvaluator:
+        """Single-device twin of the mesh evaluator — consumes the same
+        packed wave the build stage produced (against the builder's
+        default-device static copy), so a sharded failure costs one
+        re-dispatch, never a rebuild."""
+        if self._mesh_fallback_evaluator is None:
+            self._mesh_fallback_evaluator = RepairingEvaluator(
+                self.filter_plugins,
+                self.pre_score_plugins,
+                self.score_plugins,
+                weights=self.score_weights,
+                with_diagnostics=True,
+                mesh=None,
+            )
+        return self._mesh_fallback_evaluator
+
+    def _eval_packed_wave(
+        self, pod_table, node_static, node_agg, extra,
+        n_pods: int, n_nodes: int,
+    ):
+        """One packed repair-wave dispatch with the mesh ladder (ISSUE 7):
+        sharded evaluate when a mesh is live, single-device re-dispatch of
+        the SAME packed wave on any sharding failure (mirroring the build
+        stage's _BuildFallback: this wave degrades, later waves retry the
+        mesh), the caller's _evaluate_or_park park as the last rung."""
+        ev = self._get_evaluator()
+        if self.mesh is None:
+            return ev.call_packed(pod_table, node_static, node_agg, extra)
+        import jax
+
+        from minisched_tpu.observability import counters
+
+        # pad-waste ledger: rows shipped beyond the live roster/wave —
+        # the bench divides by waves to watch mesh-alignment overhead
+        counters.inc("wave_mesh.pad_pod_rows", pod_table.capacity - n_pods)
+        counters.inc("wave_mesh.pad_node_rows", node_agg.capacity - n_nodes)
+        try:
+            if self.faults is not None:
+                self.faults.check("mesh.evaluate", str(n_pods))
+            out = ev.call_packed(pod_table, node_static, node_agg, extra)
+            # execution is async — block HERE so a sharded-dispatch
+            # failure surfaces inside this handler, not at the caller's
+            # device_get past the fallback's chance
+            jax.block_until_ready(out[1])
+            counters.inc("wave_mesh.waves")
+            return out
+        except Exception as err:
+            import sys as _sys
+
+            counters.inc("wave_mesh.fallbacks")
+            print(
+                f"[wave-mesh] sharded evaluate failed, single-device "
+                f"fallback: {type(err).__name__}: {str(err)[-160:]}",
+                file=_sys.stderr,
+                flush=True,
+            )
+            return self._get_mesh_fallback_evaluator().call_packed(
+                pod_table,
+                self._table_builder.static_dev_default(),
+                node_agg,
+                extra,
+            )
 
     #: scan chunks pad to power-of-two capacities ≥ this (few executables,
     #: each persistent-cached) and never exceed this many pods per chunk
@@ -561,8 +664,10 @@ class DeviceScheduler(Scheduler):
     WAVE_SMALL_CAP = 2048
 
     def _wave_cap(self, n_pods: int) -> int:
-        full = pad_to(max(self.max_wave, 128))
-        small = min(self.WAVE_SMALL_CAP, full)
+        # capacities quantize to the mesh pod-axis multiple too (equal
+        # whole tiles per shard); off-mesh this is the plain 128 padding
+        full = pad_to(max(self.max_wave, 128), self._pod_cap_mult)
+        small = min(pad_to(self.WAVE_SMALL_CAP, self._pod_cap_mult), full)
         return small if n_pods <= small else full
     #: blocked-scan lane (VERDICT r3 item 4): cross-pod pods pre-grouped
     #: into blocks of pairwise-disjoint interaction sets, each block one
@@ -641,9 +746,17 @@ class DeviceScheduler(Scheduler):
         from minisched_tpu.models.tables import node_profile_capacity
 
         live_nodes = self.informer_factory.informer_for("Node").lister()
-        node_capacity = pad_to(max(len(live_nodes), 2))
+        # mesh-aligned: the live builder quantizes node capacity to the
+        # mesh node-axis multiple; a warm at plain pad_to would compile
+        # the wrong shape and be wasted
+        node_capacity = self._table_builder.node_capacity(
+            max(len(live_nodes), 2)
+        )
         prof_capacity = node_profile_capacity(live_nodes)
-        pod_capacity = pad_to(max(self.max_wave, 128))
+        # pod capacity quantizes to the mesh pod-axis multiple exactly
+        # like the live _wave_cap — a plain pad_to warm would compile the
+        # wrong full-tier shape on a non-128-divisor pod axis (e.g. 3)
+        pod_capacity = pad_to(max(self.max_wave, 128), self._pod_cap_mult)
         # both wave tiers compile: the full max_wave shape and the small
         # one partial/requeue waves take (identical when max_wave is small)
         wave_caps = sorted({pod_capacity, self._wave_cap(1)})
@@ -677,8 +790,12 @@ class DeviceScheduler(Scheduler):
             # warm the single-program packed entry points for BOTH pod
             # schemas a live wave can take: the fast (simple-pod) schema
             # and the slow one (any pod with selector/affinity/...), each
-            # a distinct executable keyed on the packed metas
-            node_static, node_agg, _ = CachedNodeTableBuilder().build_packed(
+            # a distinct executable keyed on the packed metas.  The
+            # throwaway builder carries the mesh so the warm statics are
+            # sharded exactly like the live ones.
+            node_static, node_agg, _ = CachedNodeTableBuilder(
+                mesh=self.mesh
+            ).build_packed(
                 infos, capacity=node_capacity, prof_capacity=prof_capacity
             )
             for wave_cap in wave_caps:
@@ -863,6 +980,7 @@ class DeviceScheduler(Scheduler):
                 self.pre_score_plugins,
                 self.score_plugins,
                 weights=self.score_weights,
+                mesh=self.mesh,
             )
         return self._scan_scheduler
 
@@ -876,6 +994,7 @@ class DeviceScheduler(Scheduler):
                 self.score_plugins,
                 weights=self.score_weights,
                 block_size=self.SCAN_BLOCK_SIZE,
+                mesh=self.mesh,
             )
         return self._blocked_scheduler
 
@@ -1310,17 +1429,16 @@ class DeviceScheduler(Scheduler):
 
     # the loop: one wave per iteration instead of one pod ------------------
     def _pipeline_active(self) -> bool:
-        """Pipelined waves only in packed single-device mode: the mesh
-        path donates sharded tables and record_results needs device
-        tables — both keep the serial loop.  Latched once the worker
-        exists (it owns queue popping from then on)."""
+        """Pipelined waves in packed mode — single-device AND mesh (the
+        mesh-packed program consumes the same host-built flat buffers, so
+        depth-1 overlap, incremental dirty-row encoding, and commit-time
+        re-arbitration survive unchanged; ISSUE 7 tentpole).  Only
+        record_results keeps the serial loop (it needs device tables).
+        Latched once the worker exists (it owns queue popping from then
+        on)."""
         if self._pipeline is not None:
             return True
-        return (
-            self.pipeline_enabled
-            and self.mesh is None
-            and self.result_store is None
-        )
+        return self.pipeline_enabled and self.result_store is None
 
     def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
         if self._pipeline_active():
@@ -1453,11 +1571,13 @@ class DeviceScheduler(Scheduler):
         try:
             with self.metrics.timed("wave_evaluate"):
                 with self.metrics.timed("wave_device"):
-                    _, choice, _, unsched = self._get_evaluator().call_packed(
+                    _, choice, _, unsched = self._eval_packed_wave(
                         prepared.pod_table,
                         prepared.node_static,
                         prepared.node_agg,
                         prepared.extra,
+                        len(qpis),
+                        len(prepared.node_infos),
                     )
                     choice, unsched = jax.device_get((choice, unsched))
                 with self.metrics.timed("wave_postfetch"):
@@ -1913,8 +2033,9 @@ class DeviceScheduler(Scheduler):
         self.informer_factory.resume_dispatch()
         with self.metrics.timed("wave_device"):
             if packed_mode:
-                _, choice, _, unsched = self._get_evaluator().call_packed(
-                    pod_table, node_static, node_agg, extra
+                _, choice, _, unsched = self._eval_packed_wave(
+                    pod_table, node_static, node_agg, extra,
+                    len(pods_), len(node_infos),
                 )
             else:
                 _, choice, _, unsched = self._get_evaluator()(
@@ -2296,11 +2417,19 @@ def new_device_scheduler(
 ) -> DeviceScheduler:
     """Build a DeviceScheduler from a SchedulerConfig (default: the full
     roster) — the device-mode analog of service.build_scheduler_from_config.
-    ``mesh``: evaluate waves sharded over a jax.sharding.Mesh."""
+    ``mesh``: evaluate waves sharded over a jax.sharding.Mesh; None defers
+    to the config's ``mesh_devices`` pin, then the MINISCHED_MESH startup
+    policy (auto-shard when >1 device; see parallel/sharding.resolve_mesh)."""
     from minisched_tpu.plugins.registry import build_plugins
     from minisched_tpu.service.config import default_full_roster_config
 
     cfg = cfg or default_full_roster_config()
+    if mesh is None and (cfg.mesh_devices or cfg.mesh_pod_shards):
+        from minisched_tpu.parallel.sharding import make_mesh
+
+        mesh = make_mesh(
+            cfg.mesh_devices or None, pod_shards=cfg.mesh_pod_shards
+        )
     chains = build_plugins(cfg)
     sched = DeviceScheduler(
         client,

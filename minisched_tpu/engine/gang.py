@@ -47,6 +47,17 @@ def node_topo(node: Any) -> Topo:
     return (fnv1a32(spec.slice_id), spec.torus_x, spec.torus_y, spec.torus_z)
 
 
+def node_dims(node: Any) -> Tuple[int, int, int]:
+    """The node's slice torus DIMENSIONS (ring size per axis), with the
+    table's zeroing rule (sliceless → all zero; 0 = unknown, the scorer
+    then measures non-wrapping distance on that axis — the identity the
+    parity tests pin)."""
+    spec = node.spec
+    if not spec.slice_id:
+        return (0, 0, 0)
+    return (spec.slice_dx, spec.slice_dy, spec.slice_dz)
+
+
 def aggregate_coords(coords: Iterable[Topo]) -> Optional[GangAgg]:
     """Fold placed-member topology tuples into the gang aggregate.
     Majority slice is deterministic: highest count, ties to the SMALLEST

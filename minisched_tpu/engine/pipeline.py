@@ -21,6 +21,14 @@ cannot handle (encode overflow, an empty roster, the cross-pod priority
 bypass, an injected build fault) is handed back RAW and takes the exact
 serial wave path.
 
+Mesh composition (ISSUE 7): the build stage's output is packed HOST
+buffers, so the same pipeline drives the mesh-sharded evaluator
+unchanged — the shared table builder pads capacities to the mesh-axis
+multiples and keeps the static node columns device-resident sharded;
+the loop thread's device call dispatches the sharded program
+(DeviceScheduler._eval_packed_wave, with its own per-wave single-device
+fallback ladder).  Nothing in this module is mesh-aware by design.
+
 ``MINISCHED_PIPELINE=0`` disables the whole stage — the engine then runs
 the untouched serial loop (DeviceScheduler._schedule_one_serial).
 """

@@ -68,6 +68,7 @@ def start_ha_engine(
     ttl_s: float = DEFAULT_TTL_S,
     device_mode: bool = False,
     max_wave: int = 1024,
+    device_mesh: Any = None,
     **start_kwargs: Any,
 ) -> HAEngine:
     """Join the plane and start one sharded engine over ``client``.
@@ -77,6 +78,14 @@ def start_ha_engine(
     would admit everything), and the shard filter is installed before the
     informers start (so the initial snapshot replay is already filtered;
     see SchedulerService.start_scheduler).
+
+    ``device_mesh`` (device_mode only): the engine's wave evaluation then
+    shards over the (pods × nodes) device mesh.  The two shardings are
+    ORTHOGONAL axes (ISSUE 7): HA splits the POD POPULATION across
+    engines by rendezvous hash (which pods an engine pops at all), the
+    mesh splits each popped WAVE's compute across that engine's devices
+    — composing them changes neither the shard map nor placement parity.
+    None defers to the MINISCHED_MESH startup policy, like any engine.
     """
     membership = Membership(client, engine_id, ttl_s=ttl_s)
     membership.join()
@@ -85,6 +94,7 @@ def start_ha_engine(
         cfg,
         device_mode=device_mode,
         max_wave=max_wave,
+        device_mesh=device_mesh,
         shard_filter=membership.owns_pod,
         **start_kwargs,
     )

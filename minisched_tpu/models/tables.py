@@ -273,7 +273,8 @@ class PackedCaller:
         self._consumer = consumer
         self._fns: Dict[Tuple, Any] = {}
 
-    def _build_fn(self, key, pod_packed, node_agg_packed, extra_packed):
+    def _build_fn(self, key, pod_packed, node_static, node_agg_packed,
+                  extra_packed):
         from minisched_tpu.models.constraints import ConstraintTables
 
         ex_schema = extra_packed.schema if extra_packed is not None else None
@@ -300,14 +301,21 @@ class PackedCaller:
 
         return jax.jit(run)
 
+    def _key(self, pod_packed, node_static, node_agg_packed, ex_schema):
+        """The jit-cache key for one call signature — subclasses extend
+        it (the mesh variant folds the mesh factoring in)."""
+        return (pod_packed.schema, node_agg_packed.schema, ex_schema,
+                tuple(sorted(node_static)))
+
     def __call__(self, pod_packed, node_static, node_agg_packed,
                  extra_packed=None):
         ex_schema = extra_packed.schema if extra_packed is not None else None
-        key = (pod_packed.schema, node_agg_packed.schema, ex_schema,
-               tuple(sorted(node_static)))
+        key = self._key(pod_packed, node_static, node_agg_packed, ex_schema)
         fn = self._fns.get(key)
         if fn is None:
-            fn = self._build_fn(key, pod_packed, node_agg_packed, extra_packed)
+            fn = self._build_fn(
+                key, pod_packed, node_static, node_agg_packed, extra_packed
+            )
             self._fns[key] = fn
         ex_flat = (
             extra_packed.flat
@@ -333,7 +341,9 @@ class PackedCaller:
                 fn.clear_cache()
             except Exception:
                 pass
-            fn = self._build_fn(key, pod_packed, node_agg_packed, extra_packed)
+            fn = self._build_fn(
+                key, pod_packed, node_static, node_agg_packed, extra_packed
+            )
             self._fns[key] = fn
             return fn(
                 pod_packed.flat, node_agg_packed.flat, ex_flat, node_static
@@ -457,13 +467,17 @@ class NodeTable:
     suffix: Any  # i32[N] trailing-digit of name, -1 if none
     # multi-host slice topology (gang/topology-aware placement):
     # fnv hash of spec.slice_id (0 = not part of a slice), torus
-    # coordinates within the slice, and host index — static node
+    # coordinates within the slice, host index, and the slice's torus
+    # DIMENSIONS (0 = unknown → non-wrapping distance) — static node
     # columns read by the GangTopology locality scorer
     slice_hash: Any  # i32[N]
     torus_x: Any  # i32[N]
     torus_y: Any  # i32[N]
     torus_z: Any  # i32[N]
     host_index: Any  # i32[N] (-1 = none)
+    slice_dx: Any  # i32[N] torus ring size per axis (0 = unknown)
+    slice_dy: Any  # i32[N]
+    slice_dz: Any  # i32[N]
     # label/taint PROFILES: real clusters are built from node pools, so
     # 10k nodes collapse to a handful of distinct (labels, taints)
     # signatures.  Label/taint-dependent kernels (NodeAffinity,
@@ -607,6 +621,7 @@ def _node_table_skeleton(cap: int, prof_cap: int) -> Dict[str, Any]:
         unschedulable=np.zeros(cap, bool), suffix=np.full(cap, -1, np.int32),
         slice_hash=zeros(cap), torus_x=zeros(cap), torus_y=zeros(cap),
         torus_z=zeros(cap), host_index=np.full(cap, -1, np.int32),
+        slice_dx=zeros(cap), slice_dy=zeros(cap), slice_dz=zeros(cap),
         profile_id=zeros(cap),
         prof_taint_key=zeros((prof_cap, MAX_TAINTS)),
         prof_taint_value=zeros((prof_cap, MAX_TAINTS)),
@@ -725,6 +740,9 @@ def _encode_node_static(t: Dict[str, Any], i: int, node: Any, pid: int) -> None:
     t["torus_y"][i] = node.spec.torus_y if has_slice else 0
     t["torus_z"][i] = node.spec.torus_z if has_slice else 0
     t["host_index"][i] = node.spec.host_index
+    t["slice_dx"][i] = node.spec.slice_dx if has_slice else 0
+    t["slice_dy"][i] = node.spec.slice_dy if has_slice else 0
+    t["slice_dz"][i] = node.spec.slice_dz if has_slice else 0
     t["profile_id"][i] = pid
     images = node.status.images
     if len(images) > MAX_IMAGES:
@@ -832,6 +850,7 @@ _NODE_STATIC_COLS = (
     "name_hash", "alloc_cpu", "alloc_mem", "alloc_eph", "alloc_pods",
     "unschedulable", "suffix", "profile_id",
     "slice_hash", "torus_x", "torus_y", "torus_z", "host_index",
+    "slice_dx", "slice_dy", "slice_dz",
     "image_key", "image_size_mb", "num_images", "valid",
 ) + NODE_PROFILE_COLS
 _NODE_AGG_COLS = (
@@ -856,7 +875,7 @@ class CachedNodeTableBuilder:
     NodeInfos' incrementally-maintained sums.
     """
 
-    def __init__(self, device_static: bool = True):
+    def __init__(self, device_static: bool = True, mesh: Any = None):
         import threading
 
         # scan lanes (loop thread) and the wave-pipeline build worker
@@ -865,6 +884,24 @@ class CachedNodeTableBuilder:
         # serializes through this lock (contention only when a scan
         # flush coincides with a pipelined build)
         self._build_lock = threading.RLock()
+        #: jax.sharding.Mesh — static columns then live device-resident
+        #: SHARDED on the node axis (profile planes replicated), and node
+        #: capacities quantize to lcm(128, node-axis size) so every shard
+        #: gets equal whole tiles (parallel/sharding.cap_multiple)
+        self._mesh = mesh
+        self._cap_mult = 128
+        if mesh is not None:
+            from minisched_tpu.parallel.sharding import (
+                cap_multiple,
+                mesh_axis_sizes,
+            )
+
+            self._cap_mult = cap_multiple(128, mesh_axis_sizes(mesh)[1])
+        #: lazily-built single-default-device copy of the static columns
+        #: — the mesh engine's per-wave sharding-failure fallback runs
+        #: the single-device evaluator against it (see
+        #: DeviceScheduler._eval_packed_wave)
+        self._static_dev_fallback: Optional[Dict[str, Any]] = None
         self._sig = None
         self._static: Dict[str, Any] = {}
         self._static_dev: Dict[str, Any] = {}
@@ -938,7 +975,7 @@ class CachedNodeTableBuilder:
         # patching (~2MB at 10k nodes).
         self._static = {} if self._device_static else dict(self._host_static)
         if self._device_static:
-            self._static_dev = batched_device_put(self._host_static)
+            self._place_static_dev(self._host_static)
         self._names = names
         self._name_index = {name: i for i, name in enumerate(names)}
         self._sig = sig
@@ -980,11 +1017,39 @@ class CachedNodeTableBuilder:
         # existing rows are rewritten in place (idempotent)
         self._reg.encode_rows(t)
         if self._device_static:
-            self._static_dev = batched_device_put(t)
+            self._place_static_dev(t)
         else:
             self._static = dict(t)
         self._sig = sig
         return True
+
+    def _place_static_dev(self, t: Dict[str, Any]) -> None:
+        """Upload the static columns; under a mesh they land SHARDED
+        (node axis split, profile planes replicated) so the packed wave
+        program consumes them in place — no per-wave resharding."""
+        cols = batched_device_put(t)
+        if self._mesh is not None:
+            from minisched_tpu.parallel.sharding import static_col_shardings
+
+            cols = jax.device_put(
+                cols, static_col_shardings(self._mesh, cols)
+            )
+        self._static_dev = cols
+        self._static_dev_fallback = None  # stale: re-derive on demand
+
+    def static_dev_default(self) -> Dict[str, Any]:
+        """Single-default-device copy of the current static columns —
+        what the mesh engine's per-wave fallback evaluator consumes when
+        a sharded wave fails (the sharded statics would drag the
+        single-device program back onto the mesh)."""
+        with self._build_lock:
+            if not self._host_static:
+                raise RuntimeError("no static columns built yet")
+            if self._static_dev_fallback is None:
+                self._static_dev_fallback = batched_device_put(
+                    dict(self._host_static)
+                )
+            return self._static_dev_fallback
 
     @staticmethod
     def _fill_aggregates(node_infos: Sequence[Any], cap: int) -> Dict[str, Any]:
@@ -1027,12 +1092,22 @@ class CachedNodeTableBuilder:
                     t["used_port"][i, j] = port
                 t["num_used_ports"][i] = n + len(ports)
 
-    @staticmethod
-    def _cap_for(node_infos: Sequence[Any], capacity) -> int:
+    def node_capacity(self, n: int) -> int:
+        """The capacity a table over ``n`` nodes will get — pad_to with
+        this builder's mesh-aligned multiple (prewarm must match it or
+        the warm executable is wasted)."""
+        return pad_to(max(n, 1), self._cap_mult)
+
+    def _cap_for(self, node_infos: Sequence[Any], capacity) -> int:
         n = len(node_infos)
-        cap = capacity or pad_to(n)
+        cap = capacity or pad_to(n, self._cap_mult)
         if n > cap:
             raise ValueError(f"{n} nodes exceed table capacity {cap}")
+        if cap % self._cap_mult:
+            raise ValueError(
+                f"node capacity {cap} not a multiple of {self._cap_mult} "
+                "(mesh node-axis shards need equal whole tiles)"
+            )
         return cap
 
     def _update_agg_base(

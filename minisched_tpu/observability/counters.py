@@ -41,6 +41,25 @@ CycleMetrics, not here — counters are integers:
         — node aggregate rows re-encoded incrementally (vs a full
           O(all nodes) fill per wave); the bench divides by waves
 
+The multi-chip live wave engine (ISSUE 7: DeviceScheduler over a
+jax.sharding.Mesh, parallel/sharding.MeshPackedCaller) records under
+``wave_mesh.`` — surfaced in the bench ``mesh`` child and the c5
+``wave_breakdown`` block:
+
+    wave_mesh.pod_shards / wave_mesh.node_shards
+        — the mesh factoring the engine acquired at startup (set once
+          per engine construction; 2×4 on an 8-device host)
+    wave_mesh.waves
+        — repair waves evaluated SHARDED over the mesh (the tentpole
+          path; a mesh engine whose count stays 0 is running degraded)
+    wave_mesh.fallbacks
+        — waves re-dispatched on ONE device after a sharded-evaluate
+          failure (the per-wave fallback ladder; later waves retry the
+          mesh — repeated fallbacks mean the mesh is effectively dead)
+    wave_mesh.pad_pod_rows / wave_mesh.pad_node_rows
+        — table rows shipped beyond the live wave/roster (mesh-axis
+          capacity alignment waste); the bench divides by waves
+
 The durable layer (controlplane/durable + walio + fsck) records the
 storage-integrity story under ``storage.`` — surfaced in the bench
 ``disk`` role's record:
@@ -110,6 +129,13 @@ class Counters:
         with self._mu:
             self._counts[name] = self._counts.get(name, 0) + n
 
+    def set_gauge(self, name: str, n: int) -> None:
+        """Last-write-wins value for state-shaped entries (a mesh
+        factoring, a shard count) — engine restarts and multi-engine
+        processes must not sum them into nonsense."""
+        with self._mu:
+            self._counts[name] = n
+
     def get(self, name: str) -> int:
         with self._mu:
             return self._counts.get(name, 0)
@@ -128,6 +154,10 @@ GLOBAL = Counters()
 
 def inc(name: str, n: int = 1) -> None:
     GLOBAL.inc(name, n)
+
+
+def set_gauge(name: str, n: int) -> None:
+    GLOBAL.set_gauge(name, n)
 
 
 def get(name: str) -> int:

@@ -21,6 +21,7 @@ array values; everything is pure so XLA can fuse.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -100,6 +101,40 @@ def set_force_pallas_route(enabled: bool) -> None:
     _FORCE_PALLAS_ROUTE = enabled
 
 
+#: trace-time depth of mesh-sharded program builds (parallel/sharding.
+#: MeshPackedCaller) — a pallas_call inside a GSPMD-partitioned program
+#: would need a shard_map wrapper the kernel doesn't have, so the mesh
+#: path takes the (bit-identical) XLA tail instead.  A depth counter,
+#: not a bool: nested/overlapping traces from several callers must not
+#: clear the guard early.  THREAD-LOCAL: jax traces run on the calling
+#: thread, and a multi-engine process (HA plane) can trace a mesh
+#: program and a single-device program concurrently — a process-global
+#: flag would make the single-device engine permanently compile without
+#: its Pallas route.
+_MESH_TRACING = threading.local()
+
+
+class mesh_trace_guard:
+    """Context manager marking 'a mesh-sharded program is being traced'
+    on this thread.
+
+    Trace-time only — dispatch of an already-compiled executable never
+    re-enters select_hosts, so wrapping every sharded call site costs a
+    counter bump, and the flag is only ever read during trace."""
+
+    def __enter__(self):
+        _MESH_TRACING.depth = getattr(_MESH_TRACING, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _MESH_TRACING.depth -= 1
+        return False
+
+
+def tracing_under_mesh() -> bool:
+    return getattr(_MESH_TRACING, "depth", 0) > 0
+
+
 def _pallas_shape_ok(P: int, N: int) -> bool:
     """Whether select_hosts_pallas can tile (P, N) — the kernel's
     smallest tiles are 8 (pods) × 128 (nodes) (pallas_kernels._tiling).
@@ -121,12 +156,14 @@ def select_hosts(scores, mask, seeds):
     pick the one minimizing mix32(seed, node_index); remaining ties (hash
     collisions) go to the lowest index.
     """
-    if _USE_PALLAS:
+    if _USE_PALLAS and not tracing_under_mesh():
         import jax as _jax
 
         # only route to Pallas where it compiles natively — interpreter
         # mode off-TPU would be far slower than the XLA path below (tests
-        # exercise the kernel directly with interpret=True) — and only
+        # exercise the kernel directly with interpret=True), never inside
+        # a mesh-sharded trace (a pallas_call under GSPMD needs a
+        # shard_map the kernel doesn't have) — and only
         # for shapes the kernel can tile: P=1 scan steps and other
         # non-divisible shapes fall through to the XLA tail (bit-exact
         # either way; the Pallas kernel is a perf route, not a semantic)

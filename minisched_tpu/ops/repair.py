@@ -502,11 +502,12 @@ class RepairingEvaluator:
         the device-resident static node columns and are unpacked inside
         the one jitted program (models/tables.PackedCaller — program
         alternation on the tunneled runtime stalled ~1.4s per switch).
-        Single-device only (the mesh path shards device tables instead)."""
-        assert self._mesh is None, "packed mode is single-device"
+        Under a mesh the SAME packed contract holds, but the unpacked
+        tables get sharding constraints so GSPMD partitions the wave over
+        the (pods × nodes) device mesh and the static node columns are
+        expected to arrive node-sharded
+        (parallel/sharding.MeshPackedCaller — the ISSUE 7 live path)."""
         if self._packed_caller is None:
-            from minisched_tpu.models.tables import PackedCaller
-
             filters, pre_scores, scores = self._chains
 
             def consume(pods, nodes, extra):
@@ -522,7 +523,14 @@ class RepairingEvaluator:
                     split_static=self._split_static,
                 )
 
-            self._packed_caller = PackedCaller(consume)
+            if self._mesh is not None:
+                from minisched_tpu.parallel.sharding import MeshPackedCaller
+
+                self._packed_caller = MeshPackedCaller(consume, self._mesh)
+            else:
+                from minisched_tpu.models.tables import PackedCaller
+
+                self._packed_caller = PackedCaller(consume)
         return self._packed_caller(
             pod_packed, node_static, node_agg_packed, extra_packed
         )

@@ -620,6 +620,20 @@ def blocked_scan_schedule(
     )
 
 
+def _make_packed_caller(consume, mesh: Any):
+    """PackedCaller for the scan lanes: single-device by default; under
+    a mesh the scan layout (node axis sharded, pods replicated — the
+    scan is sequential over pods by construction, so only the node-side
+    reductions parallelize)."""
+    if mesh is not None:
+        from minisched_tpu.parallel.sharding import MeshPackedCaller
+
+        return MeshPackedCaller(consume, mesh, scan_layout=True)
+    from minisched_tpu.models.tables import PackedCaller
+
+    return PackedCaller(consume)
+
+
 class BlockedSequentialScheduler:
     """Compiled wrapper for ``blocked_scan_schedule`` — same calling
     surface as SequentialScheduler plus the returned ``accepted`` mask."""
@@ -631,6 +645,7 @@ class BlockedSequentialScheduler:
         score_plugins: Sequence[Any],
         weights: Optional[dict] = None,
         block_size: int = 32,
+        mesh: Any = None,
     ):
         from minisched_tpu.ops.fused import validate_batch_chains
 
@@ -642,6 +657,9 @@ class BlockedSequentialScheduler:
                         tuple(score_plugins))
         self._ctx = ctx
         self._block_size = block_size
+        #: jax.sharding.Mesh — packed chunks then run with the node axis
+        #: sharded (pods replicated; see sharded_scan_step's layout rule)
+        self._mesh = mesh
         self._packed_caller = None
         self._fn = jax.jit(
             partial(
@@ -665,8 +683,6 @@ class BlockedSequentialScheduler:
         extra_packed: Any,
     ):
         if self._packed_caller is None:
-            from minisched_tpu.models.tables import PackedCaller
-
             filters, pre_scores, scores = self._chains
             block_size = self._block_size
 
@@ -681,7 +697,7 @@ class BlockedSequentialScheduler:
                     block_size=block_size,
                 )
 
-            self._packed_caller = PackedCaller(consume)
+            self._packed_caller = _make_packed_caller(consume, self._mesh)
         return self._packed_caller(
             pod_packed, node_static, node_agg_packed, extra_packed
         )
@@ -696,6 +712,7 @@ class SequentialScheduler:
         pre_score_plugins: Sequence[Any],
         score_plugins: Sequence[Any],
         weights: Optional[dict] = None,
+        mesh: Any = None,
     ):
         from minisched_tpu.ops.fused import validate_batch_chains
 
@@ -706,6 +723,7 @@ class SequentialScheduler:
         self._chains = (tuple(filter_plugins), tuple(pre_score_plugins),
                         tuple(score_plugins))
         self._ctx = ctx
+        self._mesh = mesh
         self._packed_caller = None
         self._fn = jax.jit(
             partial(
@@ -734,10 +752,9 @@ class SequentialScheduler:
         """Single-program scan chunk: tables arrive as packed host flat
         buffers (+ device-resident static node columns) and are unpacked
         INSIDE the jitted program (models/tables.PackedCaller — same
-        rationale as RepairingEvaluator.call_packed)."""
+        rationale as RepairingEvaluator.call_packed).  Under a mesh the
+        chunk runs node-sharded (see _make_packed_caller)."""
         if self._packed_caller is None:
-            from minisched_tpu.models.tables import PackedCaller
-
             filters, pre_scores, scores = self._chains
 
             def consume(pods, nodes, extra):
@@ -750,7 +767,7 @@ class SequentialScheduler:
                     extra=extra,
                 )
 
-            self._packed_caller = PackedCaller(consume)
+            self._packed_caller = _make_packed_caller(consume, self._mesh)
         return self._packed_caller(
             pod_packed, node_static, node_agg_packed, extra_packed
         )

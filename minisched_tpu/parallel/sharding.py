@@ -23,7 +23,8 @@ sharded when the cluster (or the pod wave) outgrows one chip's HBM.
 from __future__ import annotations
 
 import math
-from typing import Any, Optional, Tuple
+import os
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -33,6 +34,58 @@ from minisched_tpu.models.tables import NodeTable, PodTable
 
 POD_AXIS = "pods"
 NODE_AXIS = "nodes"
+
+
+def mesh_shape_key(mesh: Optional[Mesh]) -> Tuple:
+    """Hashable (axis, size) signature of a mesh — folded into every
+    compile-cache key the mesh path touches (ISSUE 7 satellite: an
+    executable compiled for one mesh factoring must never be served to
+    another, even where the table shapes coincide)."""
+    if mesh is None:
+        return ()
+    return tuple((name, int(size)) for name, size in mesh.shape.items())
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh]) -> Tuple[int, int]:
+    """(pod-axis size, node-axis size); (1, 1) off-mesh."""
+    if mesh is None:
+        return 1, 1
+    return int(mesh.shape[POD_AXIS]), int(mesh.shape[NODE_AXIS])
+
+
+def cap_multiple(base: int, axis: int) -> int:
+    """Table-capacity quantum under a mesh axis: capacities must stay
+    lane-padded (multiples of ``base``) AND divide evenly across the
+    axis's shards — lcm covers non-power-of-two factorings (a 6-device
+    2×3 mesh) where 128 alone would leave a 3-shard axis with ragged
+    tiles."""
+    return base * axis // math.gcd(base, axis)
+
+
+def resolve_mesh(env: Optional[Dict[str, str]] = None) -> Optional[Mesh]:
+    """The live engine's startup mesh policy (ISSUE 7 tentpole):
+
+    * ``MINISCHED_MESH=0`` — never shard (the single-device packed path,
+      byte-for-byte the pre-mesh engine);
+    * ``MINISCHED_MESH=1`` — always build a mesh over every visible
+      device, even a degenerate 1-device one (same placements, exercises
+      the sharded program);
+    * unset — auto: a mesh exactly when ``jax.device_count() > 1``
+      (multi-chip hosts shard by default, laptops/CI keep the exact
+      single-device behavior).
+
+    ``MINISCHED_MESH_POD_SHARDS`` pins the pod-axis factoring (default:
+    hosts on the pod axis, chips on the node axis — see make_mesh)."""
+    env = env if env is not None else os.environ
+    flag = env.get("MINISCHED_MESH", "")
+    if flag == "0":
+        return None
+    if flag not in ("", "0", "1"):
+        raise ValueError(f"MINISCHED_MESH must be '', '0' or '1', got {flag!r}")
+    if flag != "1" and jax.device_count() <= 1:
+        return None
+    pod_shards = env.get("MINISCHED_MESH_POD_SHARDS", "")
+    return make_mesh(pod_shards=int(pod_shards) if pod_shards else None)
 
 
 def default_pod_shards(n_devices: int, n_processes: int = 1) -> int:
@@ -154,6 +207,44 @@ def constraint_sharding(mesh: Mesh, extra: Any) -> Any:
     return type(extra)(**specs)
 
 
+def static_col_shardings(mesh: Mesh, cols: Dict[str, Any]) -> Dict[str, Any]:
+    """NamedSharding per device-resident static node column: leading
+    node dim split on the node axis, the tiny per-profile label/taint
+    planes replicated (they must be whole on every shard — every node
+    row gathers through profile_id)."""
+    from minisched_tpu.models.tables import NODE_PROFILE_COLS
+
+    out = {}
+    for name, leaf in cols.items():
+        if name in NODE_PROFILE_COLS:
+            out[name] = NamedSharding(mesh, P())
+        else:
+            out[name] = NamedSharding(
+                mesh, P(NODE_AXIS, *((None,) * (leaf.ndim - 1)))
+            )
+    return out
+
+
+def scan_constraint_sharding(mesh: Mesh, extra: Any) -> Any:
+    """ConstraintTables shardings for the sequential-scan layout: the
+    node-axis planes split with the node table, everything pod-indexed
+    replicates (the scan walks pods one dynamic row slice at a time — a
+    pod-sharded layout would turn every step into a cross-shard
+    gather)."""
+    from dataclasses import fields as dc_fields
+
+    specs = {}
+    for f in dc_fields(type(extra)):
+        leaf = getattr(extra, f.name)
+        kind, _axis = _CONSTRAINT_AXES.get(f.name, ("first", POD_AXIS))
+        if kind == "last":
+            spec = P(*((None,) * (leaf.ndim - 1)), NODE_AXIS)
+        else:
+            spec = P()
+        specs[f.name] = NamedSharding(mesh, spec)
+    return type(extra)(**specs)
+
+
 def shard_tables(
     mesh: Mesh, pods: PodTable, nodes: NodeTable
 ) -> Tuple[PodTable, NodeTable]:
@@ -242,9 +333,12 @@ class _CompiledShardedStep:
                 print("[sharded-step] heal retry ok", flush=True)
             return out
 
-    @staticmethod
-    def _sig_key(nodes, pods, extra):
+    def _sig_key(self, nodes, pods, extra):
+        # the mesh factoring is part of the key: a multi-engine process
+        # can host differently-shaped meshes, and an executable compiled
+        # for one must never serve another even at equal table shapes
         return (
+            mesh_shape_key(self._mesh),
             extra is not None,
             tuple(
                 (l.shape, str(l.dtype))
@@ -288,9 +382,15 @@ class _CompiledShardedStep:
                 in_shardings=tuple(shardings),
                 keep_unused=True,
             )
-        if extra is not None:
-            return self._jitted[key](nodes, pods, extra)
-        return self._jitted[key](nodes, pods)
+        # trace-time Pallas guard (see MeshPackedCaller): the first call
+        # traces the sharded program; fast routes incompatible with GSPMD
+        # must take their XLA tails
+        from minisched_tpu.ops import fused as _fused
+
+        with _fused.mesh_trace_guard():
+            if extra is not None:
+                return self._jitted[key](nodes, pods, extra)
+            return self._jitted[key](nodes, pods)
 
 
 def sharded_repair_step(
@@ -342,7 +442,6 @@ def sharded_scan_step(
     reduces over node shards via XLA collectives; pod-axis inputs stay
     replicated — a pod-sharded layout would turn every step's dynamic
     row slice into a cross-shard gather for no compute win."""
-    from dataclasses import fields as dc_fields
     from functools import partial
 
     from minisched_tpu.ops.sequential import scan_schedule
@@ -367,18 +466,9 @@ def sharded_scan_step(
                 if extra is not None:
                     # node-axis planes shard with the node table; pod-axis
                     # rows replicate (see docstring)
-                    specs = {}
-                    for f in dc_fields(type(extra)):
-                        leaf = getattr(extra, f.name)
-                        kind, axis = _CONSTRAINT_AXES.get(
-                            f.name, ("first", POD_AXIS)
-                        )
-                        if kind == "last":
-                            spec = P(*((None,) * (leaf.ndim - 1)), axis)
-                        else:
-                            spec = P()
-                        specs[f.name] = NamedSharding(self._mesh, spec)
-                    shardings.append(type(extra)(**specs))
+                    shardings.append(
+                        scan_constraint_sharding(self._mesh, extra)
+                    )
 
                     def wrapped(nodes, pods, extra):
                         return self._fn(nodes, pods, extra=extra)
@@ -390,11 +480,14 @@ def sharded_scan_step(
                 self._jitted[key] = jax.jit(
                     wrapped, in_shardings=tuple(shardings)
                 )
-            if extra is not None:
-                # inputs re-placed per call (tables arrive host- or
-                # single-device-resident)
-                return self._jitted[key](nodes, pods, extra)
-            return self._jitted[key](nodes, pods)
+            from minisched_tpu.ops import fused as _fused
+
+            with _fused.mesh_trace_guard():
+                if extra is not None:
+                    # inputs re-placed per call (tables arrive host- or
+                    # single-device-resident)
+                    return self._jitted[key](nodes, pods, extra)
+                return self._jitted[key](nodes, pods)
 
     return _ScanStep(mesh, step)
 
@@ -427,3 +520,133 @@ def sharded_wave_step(
         return wave_step(nodes, pods, *chains, ctx, extra=extra)
 
     return _CompiledShardedStep(mesh, step)
+
+
+class MeshPackedCaller:
+    """The mesh-sharded twin of ``models.tables.PackedCaller`` — the live
+    engine's ISSUE 7 tentpole path.
+
+    Same single-program contract: the per-wave tables arrive as PACKED
+    host buffers plus the device-resident static node columns, and the
+    one jitted program unpacks them — but here the unpacked tables get
+    explicit sharding constraints so GSPMD partitions the whole wave over
+    the (pods × nodes) mesh: the flat buffers replicate (they are the
+    wire format, a few MB), the static columns arrive already node-
+    sharded, and XLA inserts the cross-shard argmax / tie-break-min /
+    scatter collectives exactly as the dryrun steps above prove.
+
+    ``scan_layout=True`` switches to the sequential-scan placement (pods
+    replicated, only the node axis parallel — see sharded_scan_step).
+
+    Inherits PackedCaller's dispatch-heal machinery; the jit-cache key
+    additionally carries the mesh factoring (and the layout flag), so an
+    executable compiled for one mesh never serves another."""
+
+    def __init__(self, consumer, mesh: Mesh, scan_layout: bool = False):
+        from minisched_tpu.models.tables import PackedCaller
+
+        self._mesh = mesh
+        self._scan_layout = scan_layout
+        # composition via a single-inheritance subclass built here keeps
+        # models/tables.py free of any jax.sharding import (host-build
+        # code must stay importable without a mesh in sight)
+        outer = self
+
+        class _Caller(PackedCaller):
+            def _key(self, pod_packed, node_static, node_agg_packed,
+                     ex_schema):
+                return (
+                    mesh_shape_key(outer._mesh),
+                    outer._scan_layout,
+                ) + super()._key(
+                    pod_packed, node_static, node_agg_packed, ex_schema
+                )
+
+            def _build_fn(self, key, pod_packed, node_static,
+                          node_agg_packed, extra_packed):
+                return outer._build_sharded_fn(
+                    pod_packed, node_static, node_agg_packed, extra_packed
+                )
+
+        self._inner = _Caller(consumer)
+
+    def __call__(self, pod_packed, node_static, node_agg_packed,
+                 extra_packed=None):
+        return self._inner(
+            pod_packed, node_static, node_agg_packed, extra_packed
+        )
+
+    def _build_sharded_fn(self, pod_packed, node_static, node_agg_packed,
+                          extra_packed):
+        from minisched_tpu.models.constraints import ConstraintTables
+        from minisched_tpu.models.tables import unpack_columns
+
+        mesh = self._mesh
+        scan_layout = self._scan_layout
+        ex_schema = extra_packed.schema if extra_packed is not None else None
+        pod_metas, pod_zeros = pod_packed.schema
+        agg_metas, agg_zeros = node_agg_packed.schema
+        consumer = self._inner._consumer
+        replicated = NamedSharding(mesh, P())
+        static_sh = static_col_shardings(mesh, node_static)
+        # trace-time guard: kernels with mesh-incompatible fast routes
+        # (the Pallas select_hosts tail cannot ride GSPMD partitioning
+        # without a shard_map) consult this while the sharded program
+        # traces — see ops.fused.tracing_under_mesh
+        from minisched_tpu.ops import fused as _fused
+
+        def run(pod_flat, agg_flat, ex_flat, static_cols):
+            from minisched_tpu.models.tables import NodeTable, PodTable
+
+            pods = PodTable(**unpack_columns(pod_flat, pod_metas, pod_zeros))
+            nodes = NodeTable(
+                **static_cols,
+                **unpack_columns(agg_flat, agg_metas, agg_zeros),
+            )
+            extra = (
+                ConstraintTables(**unpack_columns(ex_flat, *ex_schema))
+                if ex_schema is not None
+                else None
+            )
+            # the constraints are what make GSPMD split the compute: the
+            # node table on the node axis (profile planes whole), pods on
+            # the pod axis (or replicated for the scan layout), the
+            # constraint planes per the authoritative layout map
+            nodes = jax.lax.with_sharding_constraint(
+                nodes, node_sharding(mesh, nodes)
+            )
+            if scan_layout:
+                pods = jax.lax.with_sharding_constraint(
+                    pods,
+                    jax.tree_util.tree_map(lambda _a: replicated, pods),
+                )
+                if extra is not None:
+                    extra = jax.lax.with_sharding_constraint(
+                        extra, scan_constraint_sharding(mesh, extra)
+                    )
+            else:
+                pods = jax.lax.with_sharding_constraint(
+                    pods, pod_sharding(mesh, pods)
+                )
+                if extra is not None:
+                    extra = jax.lax.with_sharding_constraint(
+                        extra, constraint_sharding(mesh, extra)
+                    )
+            return consumer(pods, nodes, extra)
+
+        jitted = jax.jit(
+            run,
+            # flat wire buffers replicate; statics arrive pre-sharded.
+            # keep_unused: the compiled program and the dispatch fast
+            # path must count the same buffers (see _CompiledShardedStep)
+            in_shardings=(replicated, replicated, replicated, static_sh),
+            keep_unused=True,
+        )
+
+        def traced(pod_flat, agg_flat, ex_flat, static_cols):
+            with _fused.mesh_trace_guard():
+                return jitted(pod_flat, agg_flat, ex_flat, static_cols)
+
+        # expose clear_cache for the heal path
+        traced.clear_cache = getattr(jitted, "clear_cache", lambda: None)
+        return traced

@@ -16,11 +16,15 @@ Scoring rule (identical in the scalar and batch forms, pure ints):
 * warm gang (placed members exist, ``gang_n > 0``):
   ``SLICE_BONUS`` for nodes on the gang's majority slice, plus a torus
   proximity term ``clamp(TORUS_MAX - dist, 0, TORUS_MAX)`` where
-  ``dist`` is the Manhattan distance to the placed centroid, computed
-  scaled-by-n so the math stays integral:
-  ``dist = (|x·n − Σx| + |y·n − Σy| + |z·n − Σz|) // n``.
-  (Non-wrapping distance; torus wraparound needs the slice dims on
-  device and is left as a follow-up.)
+  ``dist`` is the RING distance to the placed centroid, computed
+  scaled-by-n so the math stays integral: per axis, with the node's
+  slice dimension ``D`` (NodeTable ``slice_dx/dy/dz`` — ISSUE 7
+  satellite closing the ISSUE 6 wraparound follow-up),
+  ``a = |x·n − Σx|``; ``ring = min(a mod n·D, n·D − a mod n·D)`` when
+  ``D > 0``, else ``a`` (identity: dim-less nodes keep the exact
+  non-wrapping Manhattan term, so placements without dims are
+  bit-identical to the pre-wraparound scorer);
+  ``dist = (ring_x + ring_y + ring_z) // n``.
 * cold gang (no member placed yet): a deterministic hash preference
   ``mix32(gang_id, slice_hash) >> 27`` (0..31) — every member of one
   gang ranks slices identically, so even the first wave packs the gang
@@ -55,18 +59,38 @@ TORUS_MAX = 32
 _M32 = 0xFFFFFFFF
 
 
+def _ring_scaled(delta: int, n: int, dim: int) -> int:
+    """Scaled-by-n ring distance along one torus axis: ``delta`` is
+    ``x·n − Σ``, ``dim`` the axis's ring size (0 = unknown → the
+    non-wrapping |delta| — the identity the parity rule pins).  Pure
+    ints; min(r, m−r) is symmetric, so |delta| mod m and delta mod m
+    give the same answer."""
+    a = abs(delta)
+    if dim <= 0:
+        return a
+    m = n * dim
+    r = a % m
+    return min(r, m - r)
+
+
 def _score_one(
-    gang_id: int, agg, slice_hash: int, x: int, y: int, z: int
+    gang_id: int, agg, slice_hash: int, x: int, y: int, z: int,
+    dims: tuple = (0, 0, 0),
 ) -> int:
     """The shared scalar rule (see module docstring); ``agg`` is the
-    gang aggregate tuple or None (cold)."""
+    gang aggregate tuple or None (cold), ``dims`` the node's slice
+    torus dimensions (engine/gang.node_dims)."""
     if gang_id == 0 or slice_hash == 0:
         return 0
     if agg is None or agg[4] <= 0:
         return mix32_py(gang_id & _M32, slice_hash & _M32) >> 27
     maj, sx, sy, sz, n = agg
     score = SLICE_BONUS if (maj and slice_hash == maj) else 0
-    dist = (abs(x * n - sx) + abs(y * n - sy) + abs(z * n - sz)) // n
+    dist = (
+        _ring_scaled(x * n - sx, n, dims[0])
+        + _ring_scaled(y * n - sy, n, dims[1])
+        + _ring_scaled(z * n - sz, n, dims[2])
+    ) // n
     prox = TORUS_MAX - dist
     if prox < 0:
         prox = 0
@@ -108,12 +132,12 @@ class GangTopology(Plugin, BatchEvaluable):
             agg = state.read(PRE_SCORE_STATE_KEY)
         except KeyError:
             agg = None
-        from minisched_tpu.engine.gang import node_topo
+        from minisched_tpu.engine.gang import node_dims, node_topo
 
         node = state.read("nodeinfo/" + node_name).node
         sh, x, y, z = node_topo(node)
         return (
-            _score_one(fnv1a32(key), agg, sh, x, y, z),
+            _score_one(fnv1a32(key), agg, sh, x, y, z, node_dims(node)),
             Status.success(),
         )
 
@@ -140,10 +164,20 @@ class GangTopology(Plugin, BatchEvaluable):
         match = (sh == pods.gang_slice[:, None]) & (
             pods.gang_slice[:, None] != 0
         )
+
+        def ring(coord, ssum, dim):
+            # scaled-by-n ring distance (== _ring_scaled): a mod m folded
+            # to the shorter way around; dim 0 (unknown) keeps the
+            # non-wrapping |a| — bit-identical to the pre-wraparound term
+            a = jnp.abs(coord[None, :] * n - ssum[:, None])  # (P, N)
+            m = jnp.maximum(nz * dim[None, :], 1)
+            r = a % m
+            return jnp.where(dim[None, :] > 0, jnp.minimum(r, m - r), a)
+
         dist = (
-            jnp.abs(nodes.torus_x[None, :] * n - pods.gang_sx[:, None])
-            + jnp.abs(nodes.torus_y[None, :] * n - pods.gang_sy[:, None])
-            + jnp.abs(nodes.torus_z[None, :] * n - pods.gang_sz[:, None])
+            ring(nodes.torus_x, pods.gang_sx, nodes.slice_dx)
+            + ring(nodes.torus_y, pods.gang_sy, nodes.slice_dy)
+            + ring(nodes.torus_z, pods.gang_sz, nodes.slice_dz)
         ) // nz
         prox = jnp.clip(TORUS_MAX - dist, 0, TORUS_MAX)
         warm = jnp.where(match, SLICE_BONUS, 0) + prox

@@ -53,6 +53,16 @@ class NodePorts(Plugin, BatchEvaluable):
         return [ClusterEvent(GVK.POD, ActionType.DELETE)]
 
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
+        # slot-unrolled over the packed pod-port axis (ISSUE 7 satellite):
+        # the old single expression broadcast a 4-D (P, N, Wp, Wn)
+        # predicate before its reduce — with the port columns riding as
+        # compile-time constants (the zero-elided packed schemas), XLA's
+        # constant folder evaluated that whole broadcast at COMPILE time
+        # and tripped the >2s slow-constant-folding alarm at bench scale.
+        # Reducing per pod-port slot keeps every intermediate at
+        # (P, N, Wn) — same boolean algebra (OR over slots ≡ any over the
+        # slot axis), bit-identical masks, and the folded constants stay
+        # small.  Wp is a static 8, so the unroll is fixed-size.
         want_in_range = (
             jnp.arange(pods.port.shape[1])[None, :] < pods.num_ports[:, None]
         )  # (P, Wp)
@@ -60,9 +70,14 @@ class NodePorts(Plugin, BatchEvaluable):
             jnp.arange(nodes.used_port.shape[1])[None, :]
             < nodes.num_used_ports[:, None]
         )  # (N, Wn)
-        clash = (
-            (pods.port[:, None, :, None] == nodes.used_port[None, :, None, :])
-            & want_in_range[:, None, :, None]
-            & used_in_range[None, :, None, :]
-        )  # (P, N, Wp, Wn)
-        return ~jnp.any(clash, axis=(2, 3))
+        P = pods.port.shape[0]
+        N = nodes.used_port.shape[0]
+        clash = jnp.zeros((P, N), bool)
+        for j in range(pods.port.shape[1]):
+            hit = jnp.any(
+                (pods.port[:, j][:, None, None] == nodes.used_port[None, :, :])
+                & used_in_range[None, :, :],
+                axis=2,
+            )  # (P, N)
+            clash = clash | (want_in_range[:, j][:, None] & hit)
+        return ~clash

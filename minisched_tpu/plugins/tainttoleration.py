@@ -107,29 +107,46 @@ class TaintToleration(Plugin, BatchEvaluable):
 
         tol_effect_ok: bool[P, Tp] — which toleration slots are eligible
         (filter vs score consider different effect classes).
+
+        Slot-unrolled over the packed toleration axis (ISSUE 7
+        satellite): the old single expression broadcast a 4-D
+        (P, Dp, Tn, Tp) predicate before its any-reduce — with the
+        toleration columns riding as compile-time constants (the packed
+        schemas' zero columns), XLA's constant folder evaluated the
+        whole broadcast at compile time and tripped the >2s
+        slow-constant-folding alarm.  OR-folding one (P, Dp, Tn) covers
+        plane per slot is the same boolean algebra, bit-identical, and
+        Tp is a static 8 so the unroll is fixed-size.
         """
         # shapes: pods.tol_* (P, Tp); nodes.prof_taint_* (Dp, Tn)
         tol_in_range = (
             jnp.arange(pods.tol_key.shape[1])[None, :] < pods.num_tols[:, None]
         )  # (P, Tp)
         tol_ok = tol_in_range & tol_effect_ok  # (P, Tp)
-        # effect compatibility: toleration effect "" matches all; else equal
-        eff_match = (pods.tol_effect[:, None, None, :] == tables.EFFECT_NONE) | (
-            pods.tol_effect[:, None, None, :]
-            == nodes.prof_taint_effect[None, :, :, None]
-        )  # (P, Dp, Tn, Tp)
-        exists = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE  # (P, Tp)
-        wildcard = (pods.tol_empty_key & exists)[:, None, None, :]
-        key_eq = (
-            pods.tol_key[:, None, None, :] == nodes.prof_taint_key[None, :, :, None]
-        )
-        val_eq = (
-            pods.tol_value[:, None, None, :]
-            == nodes.prof_taint_value[None, :, :, None]
-        )
-        value_ok = exists[:, None, None, :] | val_eq
-        covers = eff_match & (wildcard | (key_eq & value_ok))
-        return jnp.any(covers & tol_ok[:, None, None, :], axis=3)  # (P, Dp, Tn)
+        exists_all = pods.tol_op == tables.TOLERATION_OP_EXISTS_CODE  # (P, Tp)
+        P = pods.tol_key.shape[0]
+        out = jnp.zeros((P,) + nodes.prof_taint_key.shape, bool)  # (P, Dp, Tn)
+        for t in range(pods.tol_key.shape[1]):
+            # effect compatibility: toleration effect "" matches all;
+            # else equal
+            eff = pods.tol_effect[:, t][:, None, None]  # (P, 1, 1)
+            eff_match = (eff == tables.EFFECT_NONE) | (
+                eff == nodes.prof_taint_effect[None, :, :]
+            )  # (P, Dp, Tn)
+            exists = exists_all[:, t]  # (P,)
+            wildcard = (pods.tol_empty_key[:, t] & exists)[:, None, None]
+            key_eq = (
+                pods.tol_key[:, t][:, None, None]
+                == nodes.prof_taint_key[None, :, :]
+            )
+            val_eq = (
+                pods.tol_value[:, t][:, None, None]
+                == nodes.prof_taint_value[None, :, :]
+            )
+            value_ok = exists[:, None, None] | val_eq
+            covers = eff_match & (wildcard | (key_eq & value_ok))
+            out = out | (covers & tol_ok[:, t][:, None, None])
+        return out
 
     def batch_filter(self, ctx: Any, pods: Any, nodes: Any):
         taint_in_range = (

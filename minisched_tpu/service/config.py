@@ -77,6 +77,13 @@ class SchedulerConfig:
     plugin_args: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     queue_opts: Dict[str, Any] = field(default_factory=dict)
     time_scale: float = 1.0
+    #: device-mesh pinning for the wave engine (ISSUE 7): 0 devices =
+    #: defer to the startup policy (MINISCHED_MESH env / auto on >1
+    #: device — parallel/sharding.resolve_mesh); a nonzero device count
+    #: (and optional pod-axis factoring) builds exactly that mesh.
+    #: Ignored by the scalar engine.
+    mesh_devices: int = 0
+    mesh_pod_shards: Optional[int] = None
 
     def clone(self) -> "SchedulerConfig":
         return copy.deepcopy(self)

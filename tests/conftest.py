@@ -19,6 +19,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# the live engine auto-shards when >1 device is visible (ISSUE 7,
+# parallel/sharding.resolve_mesh) — on this 8-virtual-device test mesh
+# that would silently flip EVERY engine test to the sharded path (and
+# its compile bills).  Pin the default off; the mesh-live suite
+# (tests/test_mesh_live.py) opts in per test with an explicit mesh or
+# MINISCHED_MESH=1.
+os.environ.setdefault("MINISCHED_MESH", "0")
 
 import jax  # noqa: E402  (pre-imported by the environment anyway)
 

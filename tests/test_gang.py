@@ -223,6 +223,76 @@ def test_gang_topology_scalar_batch_parity_warm_gang():
     assert all(name.startswith("slice1-") for name in got[:3])
 
 
+def test_gang_topology_torus_wraparound():
+    """ISSUE 7 satellite (ISSUE 6 follow-up): with slice torus DIMS on
+    the nodes, the proximity term measures RING distance — the far end
+    of a ring is one hop, not dims-1 — while dims=0 keeps the exact
+    non-wrapping identity.  Scalar and batch agree bit-for-bit in both
+    modes."""
+    import numpy as np
+
+    from minisched_tpu.engine.gang import (
+        gang_view_from_infos,
+        node_dims,
+        node_topo,
+    )
+    from minisched_tpu.framework.nodeinfo import build_node_infos
+    from minisched_tpu.models.tables import (
+        build_node_table,
+        build_pod_table,
+        fnv1a32,
+    )
+    from minisched_tpu.ops.fused import BatchContext
+    from minisched_tpu.plugins.gangtopology import GangTopology, _score_one
+
+    def ring_nodes(dims):
+        return [
+            make_node(
+                f"ring-host{h}",
+                slice_id="ring",
+                torus=(h, 0, 0),
+                host_index=h,
+                slice_dims=dims,
+            )
+            for h in range(8)
+        ]
+
+    placed = make_pod("placed0", gang=GangSpec("g", 4), requests={"cpu": "1"})
+    placed.metadata.uid = "placed0"
+    placed.spec.node_name = "ring-host0"
+    member = make_pod("m0", gang=GangSpec("g", 4), requests={"cpu": "1"})
+    gt = GangTopology()
+    rows = {}
+    for dims in ((8, 0, 0), None):
+        nodes = sorted(ring_nodes(dims), key=lambda n: n.metadata.name)
+        infos = build_node_infos(nodes, [placed])
+        view = gang_view_from_infos(infos)
+        node_table, node_names = build_node_table(
+            nodes, {"ring-host0": [placed]}
+        )
+        pod_table, _ = build_pod_table([member], gang_view=view)
+        mat = np.asarray(gt.batch_score(BatchContext(), pod_table, node_table, {}))
+        row = dict(zip(node_names, mat[0][: len(node_names)].tolist()))
+        # scalar ≡ batch, per node
+        agg = view[gang_key(member)]
+        for node in nodes:
+            sh, x, y, z = node_topo(node)
+            want = _score_one(
+                fnv1a32(gang_key(member)), agg, sh, x, y, z, node_dims(node)
+            )
+            assert row[node.metadata.name] == want, (dims, node.metadata.name)
+        rows[dims] = row
+    # wraparound: host7 is ONE ring hop from the placed member at x=0 —
+    # as close as host1, strictly closer than mid-ring host4
+    wrap = rows[(8, 0, 0)]
+    assert wrap["ring-host7"] == wrap["ring-host1"] > wrap["ring-host4"]
+    # identity at dims=0: host7 stays 7 non-wrapping hops away
+    flat = rows[None]
+    assert flat["ring-host7"] < flat["ring-host4"] < flat["ring-host1"]
+    # and the shared rows (where wrap cannot matter) are unchanged
+    assert wrap["ring-host1"] == flat["ring-host1"]
+
+
 def test_gang_index_incremental_membership():
     from minisched_tpu.engine.gang import GangIndex, aggregate_coords
 
