@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang chaos chaos-proc chaos-ha chaos-disk docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn chaos chaos-proc chaos-ha chaos-disk docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -43,6 +43,17 @@ bench-mesh: native
 # gang, a deadlocked probe, an assume-ledger leak, or node overcommit
 bench-gang: native
 	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only gang
+
+# sustained-churn serving (ISSUE 8): Poisson arrivals/departures +
+# priority-preemption bursts over multi-tenant quota'd namespaces under a
+# fixed seed, env-reduced to a tier-1-safe smoke window by default
+# (scale up with BENCH_CHURN_WINDOW_S / _NODES / _ARRIVALS_PER_S).  FAILS
+# on p99 time-to-bind past BENCH_CHURN_P99_S, a stranded (partial) gang,
+# a namespace-quota violation, a quiet tail with zero zero-build waves,
+# per-watcher (unshared) fanout encoding, or any standing audit
+# (double-bind / node overcommit / assume-ledger leak)
+bench-churn: native
+	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only churn
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

@@ -2385,6 +2385,550 @@ def bench_gang() -> dict:
     }
 
 
+def _fanout_microbench() -> dict:
+    """Shared-payload watch fanout (ISSUE 8): N watcher streams
+    serializing one mutation must pay ONE encode — the framed wire chunk
+    memoizes on the event object the store fans out.  Runs the same
+    event volume at 1 watcher and at ≥100 watchers, consuming every
+    queue and encoding every delivery exactly as the HTTP streams do;
+    FAILS when the encode counter scales with watcher count (the shared
+    payload regressed to per-stream serialization) or any delivery is
+    lost.  Timing is recorded for the report; the GATE is the counter —
+    deterministic on a noisy 1-core box."""
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.httpserver import event_wire_chunk
+    from minisched_tpu.controlplane.store import ObjectStore
+    from minisched_tpu.observability import counters
+
+    n_events = int(os.environ.get("BENCH_CHURN_FANOUT_EVENTS", "300"))
+    big_w = max(int(os.environ.get("BENCH_CHURN_FANOUT_WATCHERS", "120")), 100)
+    out = {}
+    for W in (1, big_w):
+        store = ObjectStore()
+        pods = [
+            make_pod(f"f{i:05d}", requests={"cpu": "100m"})
+            for i in range(n_events)
+        ]
+        for p in pods:
+            store.create("Pod", p)
+        watchers = [
+            store.watch("Pod", send_initial=False)[0] for _ in range(W)
+        ]
+        enc0 = counters.get("watch.fanout.encoded")
+        t0 = time.perf_counter()
+        for p in pods:
+            store.mutate(
+                "Pod", p.metadata.namespace, p.metadata.name, lambda o: o
+            )
+        delivered = 0
+        for w in watchers:
+            got = 0
+            while got < n_events:
+                batch = w.next_batch(timeout=2.0)
+                if not batch:
+                    break
+                for ev in batch:
+                    event_wire_chunk(ev)
+                got += len(batch)
+            delivered += got
+        wall = time.perf_counter() - t0
+        encoded = counters.get("watch.fanout.encoded") - enc0
+        for w in watchers:
+            w.stop()
+        if delivered != W * n_events:
+            raise SystemExit(
+                f"[churn] FANOUT LOST EVENTS: {delivered}/{W * n_events} "
+                f"delivered at {W} watchers"
+            )
+        out[f"w{W}"] = {
+            "watchers": W,
+            "events": n_events,
+            "encoded": encoded,
+            "wall_s": round(wall, 3),
+            "encode_per_event": round(encoded / n_events, 3),
+        }
+    # the flatness claim: the encode count at ≥100 watchers is the same
+    # O(events) as at 1 (serial consumption here makes it exact; a tiny
+    # slack absorbs future concurrent-consumer variants)
+    if out[f"w{big_w}"]["encoded"] > n_events * 1.25:
+        raise SystemExit(
+            f"[churn] FANOUT ENCODE NOT SHARED: {out[f'w{big_w}']['encoded']} "
+            f"encodes for {n_events} events at {big_w} watchers"
+        )
+    return out
+
+
+def bench_churn() -> dict:
+    """``make bench-churn``: sustained-churn serving (ISSUE 8, the
+    "Priority Matters" regime) — Poisson pod arrivals and departures plus
+    priority-preemption bursts over an env-scalable window, multi-tenant
+    namespaces with per-namespace quota admission at the queue, and a
+    quiet tail proving the idle-wave gate.  Headline metric: **p99
+    time-to-bind** (arrival timestamp → bind decision), not drain
+    throughput.  FAILS on:
+
+    * p99 time-to-bind beyond ``BENCH_CHURN_P99_S``;
+    * a stranded partial gang (the resident low-priority gang must
+      survive every preemption burst WHOLE — the gang shield's claim —
+      and burst gangs must land all-or-nothing);
+    * any sampled tenant exceeding its namespace quota;
+    * a quiet tail with ZERO zero-build waves (``wave_build.skipped``
+      must move while nothing changes);
+    * the fanout microbench encoding per-watcher instead of per-event;
+    * the standing audits: double-bind, node over allocatable,
+      assume-ledger leak at quiesce.
+    """
+    import random
+    import threading
+    from collections import defaultdict
+
+    from minisched_tpu.api.objects import (
+        gang_key,
+        make_gang_pods,
+        make_node,
+        make_pod,
+    )
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.observability import counters
+    from minisched_tpu.observability.profiling import CycleMetrics
+    from minisched_tpu.service.config import gang_roster_config
+    from minisched_tpu.service.service import SchedulerService
+
+    n_nodes = int(os.environ.get("BENCH_CHURN_NODES", "48"))
+    window_s = float(os.environ.get("BENCH_CHURN_WINDOW_S", "12"))
+    rate = float(os.environ.get("BENCH_CHURN_ARRIVALS_PER_S", "30"))
+    lifetime_s = float(os.environ.get("BENCH_CHURN_LIFETIME_S", "6"))
+    tenants = int(os.environ.get("BENCH_CHURN_TENANTS", "3"))
+    # sized to BIND under the default smoke (tenant pending peaks ~5-6):
+    # holds must actually happen for the admission audit to mean anything
+    quota = int(os.environ.get("BENCH_CHURN_QUOTA", "4"))
+    bursts = int(os.environ.get("BENCH_CHURN_BURSTS", "2"))
+    burst_pods = int(os.environ.get("BENCH_CHURN_BURST_PODS", "16"))
+    gang_size = int(os.environ.get("BENCH_CHURN_GANG_SIZE", "4"))
+    max_wave = int(os.environ.get("BENCH_CHURN_WAVE", "256"))
+    p99_gate_s = float(os.environ.get("BENCH_CHURN_P99_S", "45"))
+    seed = int(os.environ.get("BENCH_CHURN_SEED", "1234"))
+    n_watchers = int(os.environ.get("BENCH_CHURN_WATCHERS", "16"))
+    quiet_s = float(os.environ.get("BENCH_CHURN_QUIET_S", "4"))
+    drain_s = float(os.environ.get("BENCH_CHURN_DRAIN_S", "120"))
+    fill_frac = float(os.environ.get("BENCH_CHURN_FILL", "0.8"))
+
+    rng = random.Random(seed)
+    fanout = _fanout_microbench()
+    big_key = max(fanout, key=lambda k: fanout[k]["watchers"])
+    log(
+        f"[churn] fanout microbench: encode_per_event "
+        f"{fanout['w1']['encode_per_event']} @1 watcher vs "
+        f"{fanout[big_key]['encode_per_event']} "
+        f"@{fanout[big_key]['watchers']} watchers"
+    )
+
+    client = Client()
+    client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:03d}",
+                capacity={"cpu": "8", "memory": "32Gi", "pods": 64},
+            )
+            for i in range(n_nodes)
+        ],
+        return_objects=False,
+    )
+
+    # -- observability hooks ------------------------------------------------
+    mu = threading.Lock()
+    arrival_ts: dict = {}  # pod name → monotonic arrival stamp
+    bind_ts: dict = {}  # pod name → monotonic bind stamp
+    bind_counts: dict = defaultdict(int)  # double-bind audit
+    bound_churn: dict = {}  # name → namespace, currently-bound churn pods
+
+    last_reject: dict = {}  # diagnostics: last non-bind decision per pod
+
+    def counting(pod, node_name, status):
+        t = time.monotonic()
+        name = pod.metadata.name
+        if not node_name:
+            if name.startswith("burst"):  # burst-audit diagnostics only
+                with mu:
+                    last_reject[name] = str(status)[:90]
+            return
+        with mu:
+            bind_counts[name] += 1
+            if name in arrival_ts and name not in bind_ts:
+                bind_ts[name] = t
+            if name.startswith("churn-"):
+                bound_churn[name] = pod.metadata.namespace
+
+    counters.reset()
+    metrics = CycleMetrics()
+    cfg = gang_roster_config()
+    tenant_ns = [f"ten-{i}" for i in range(tenants)]
+    cfg.queue_opts["namespace_quota"] = {ns: quota for ns in tenant_ns}
+    svc = SchedulerService(client)
+    sched = svc.start_scheduler(
+        cfg, device_mode=True, max_wave=max_wave, on_decision=counting,
+        metrics=metrics, prewarm=True, prewarm_scan=False,
+    )
+    sched.assume_ttl_s = 3.0
+
+    # staleness watchers: K live Pod streams consumed concurrently; the
+    # sampler reads how far the slowest lags the store's rv
+    watcher_rv = [0] * n_watchers
+    watcher_stop = threading.Event()
+    watchers = [
+        client.store.watch("Pod", send_initial=False)[0]
+        for _ in range(n_watchers)
+    ]
+
+    def _consume(i: int) -> None:
+        while not watcher_stop.is_set():
+            for ev in watchers[i].next_batch(timeout=0.2):
+                if ev.rv > watcher_rv[i]:
+                    watcher_rv[i] = ev.rv
+            if watchers[i].stopped:
+                return
+
+    watcher_threads = [
+        threading.Thread(target=_consume, args=(i,), daemon=True)
+        for i in range(n_watchers)
+    ]
+    for t in watcher_threads:
+        t.start()
+
+    t0 = time.monotonic()
+    try:
+        # -- prefill: drive occupancy to ~fill_frac so bursts must preempt
+        total_cpu = n_nodes * 8000
+        n_fill = max(int(total_cpu * fill_frac) // 2000 - gang_size, 0)
+        filler = [
+            make_pod(
+                f"fill-{i:04d}", namespace="resident",
+                requests={"cpu": "2", "memory": "64Mi"},
+            )
+            for i in range(n_fill)
+        ]
+        resident_gang = make_gang_pods(
+            "resident-gang", gang_size, namespace="resident",
+            ttl_s=10.0, requests={"cpu": "2", "memory": "64Mi"}, priority=0,
+        )
+        client.pods().create_many(
+            filler + resident_gang, return_objects=False
+        )
+        prefill_target = len(filler) + len(resident_gang)
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            with mu:
+                done = sum(
+                    1 for n in bind_counts if not n.startswith("churn-")
+                )
+            if done >= prefill_target:
+                break
+            time.sleep(0.1)
+        else:
+            raise SystemExit(
+                f"[churn] prefill never bound ({done}/{prefill_target})"
+            )
+        log(
+            f"[churn] prefill: {prefill_target} resident pods "
+            f"({fill_frac:.0%} cpu) bound at {time.monotonic() - t0:.1f}s"
+        )
+
+        # -- churn window ---------------------------------------------------
+        tick = 0.1
+        burst_at = [
+            window_s * (k + 1) / (bursts + 1) for k in range(bursts)
+        ]
+        fired = [False] * bursts
+        seq = 0
+        max_staleness_rv = 0
+        quota_peak: dict = defaultdict(int)
+        t_window = time.monotonic()
+        while (elapsed := time.monotonic() - t_window) < window_s:
+            # Poisson arrivals, spread across tenant namespaces
+            n_arr = sum(
+                1 for _ in range(int(rate * tick * 4))
+                if rng.random() < 0.25
+            )
+            if n_arr:
+                batch = []
+                now = time.monotonic()
+                for _ in range(n_arr):
+                    ns = tenant_ns[rng.randrange(tenants)]
+                    name = f"churn-{seq:06d}"
+                    seq += 1
+                    batch.append(
+                        make_pod(
+                            name, namespace=ns,
+                            requests={"cpu": "250m", "memory": "32Mi"},
+                        )
+                    )
+                    arrival_ts[name] = now
+                client.pods().create_many(batch, return_objects=False)
+            # Poisson departures over currently-bound churn pods
+            with mu:
+                bound_now = list(bound_churn.items())
+            for name, ns in bound_now:
+                if rng.random() < tick / lifetime_s:
+                    try:
+                        client.pods().delete(name, ns)
+                    except KeyError:
+                        pass
+                    with mu:
+                        bound_churn.pop(name, None)
+            # priority-preemption bursts: high-priority singles + a gang
+            for k, at in enumerate(burst_at):
+                if not fired[k] and elapsed >= at:
+                    fired[k] = True
+                    now = time.monotonic()
+                    burst = [
+                        make_pod(
+                            f"burst{k}-{i:03d}", namespace="burst",
+                            requests={"cpu": "2", "memory": "64Mi"},
+                            priority=100,
+                        )
+                        for i in range(burst_pods)
+                    ] + make_gang_pods(
+                        f"burst{k}-gang", gang_size, namespace="burst",
+                        ttl_s=10.0, requests={"cpu": "2", "memory": "64Mi"},
+                        priority=100,
+                    )
+                    for p in burst:
+                        arrival_ts[p.metadata.name] = now
+                    client.pods().create_many(burst, return_objects=False)
+                    log(f"[churn] burst {k + 1}/{bursts} injected at {at:.1f}s")
+            # samplers: watcher staleness + quota admission audit
+            rv = client.store.resource_version
+            lag = rv - min(watcher_rv)
+            if lag > max_staleness_rv and min(watcher_rv) > 0:
+                max_staleness_rv = lag
+            # peaks recorded only: admitted > limit alone is NOT a
+            # violation (requeues and gang members re-admit past the cap
+            # by contract), and a held pod under an open cap is a
+            # LEGITIMATE transient while a pop_batch gathers (promotions
+            # defer to the batch seal).  The hard gates are the queue's
+            # own tripwire counter (checked after shutdown) and the
+            # drain phase below requiring every hold to clear.
+            for ns, st in sched.queue.quota_stats().items():
+                quota_peak[ns] = max(quota_peak[ns], st["admitted"])
+            time.sleep(tick)
+        arrivals = seq
+        log(
+            f"[churn] window closed: {arrivals} arrivals over {window_s}s "
+            f"({len(bind_ts)} bound so far)"
+        )
+
+        # -- drain: bursts must land; then the quiet tail -------------------
+        burst_names = {n for n in arrival_ts if n.startswith("burst")}
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            with mu:
+                missing = [n for n in burst_names if n not in bind_ts]
+            qstats = sched.queue.stats()
+            # quota_held must clear too: a hold that never promotes once
+            # slots free is the stalled-promotion bug (deterministic
+            # here — arrivals stopped, so holds only ever drain)
+            if (
+                not missing
+                and qstats["active"] == 0
+                and qstats["backoff"] == 0
+                and qstats.get("quota_held", 0) == 0
+            ):
+                break
+            time.sleep(0.2)
+        qstats = sched.queue.stats()
+        if qstats.get("quota_held", 0):
+            raise SystemExit(
+                f"[churn] QUOTA HOLD STALLED at drain: {qstats} with "
+                f"arrivals stopped — held pods must promote as slots free"
+            )
+        with mu:
+            missing = [n for n in burst_names if n not in bind_ts]
+        if missing:
+            # diagnostics: where ARE they? (store state + engine ledgers)
+            sample = {}
+            for n in sorted(missing)[:4]:
+                try:
+                    p = client.pods().get(n, "burst")
+                    sample[n] = (
+                        p.spec.node_name or "-",
+                        p.status.nominated_node_name or "-",
+                    )
+                except KeyError:
+                    sample[n] = "GONE"
+            with sched._assumed_lock:
+                n_assumed = len(sched._assumed)
+            uid_of = {}
+            for n in sorted(missing)[:4]:
+                try:
+                    uid_of[n] = client.pods().get(n, "burst").metadata.uid
+                except KeyError:
+                    pass
+            with sched.queue._cond:
+                tracked = {
+                    n: (u in sched.queue._queued_uids,
+                        u in sched.queue._held_uids)
+                    for n, u in uid_of.items()
+                }
+            raise SystemExit(
+                f"[churn] PREEMPTION BURST NEVER LANDED: {len(missing)} "
+                f"high-priority pods unbound after {drain_s}s "
+                f"(e.g. {sample}); queue={sched.queue.stats()} "
+                f"assumed={n_assumed} backlog={len(sched._scan_backlog)} "
+                f"waiting={len(getattr(sched, '_waiting_pods', {}))} "
+                f"tracked(queued,held)={tracked} "
+                f"last_reject={ {n: last_reject.get(n) for n in sorted(missing)[:4]} }"
+            )
+
+        # quiet tail: rounds of infeasible probe pods — every pop makes a
+        # wave, nothing moves in the cluster, so from the second round on
+        # the builder must reuse tables wholesale (wave_build.skipped)
+        skipped_before = counters.get("wave_build.skipped")
+        rounds = max(int(quiet_s / 0.5), 3)
+        for r in range(rounds):
+            probes = [
+                make_pod(
+                    f"probe-{r}-{i}", namespace="probe",
+                    requests={"cpu": "64"},  # larger than any node
+                )
+                for i in range(8)
+            ]
+            client.pods().create_many(probes, return_objects=False)
+            time.sleep(0.5)
+        zero_build_tail = (
+            counters.get("wave_build.skipped") - skipped_before
+        )
+        if zero_build_tail == 0:
+            raise SystemExit(
+                "[churn] IDLE-WAVE GATE NEVER FIRED on the quiet tail "
+                f"(wave_build.skipped stayed {skipped_before} over "
+                f"{rounds} probe rounds)"
+            )
+        elapsed = time.monotonic() - t0
+
+        # -- quiesce: the assume ledger must drain --------------------------
+        drain_deadline = time.monotonic() + 30
+        leaked = True
+        while time.monotonic() < drain_deadline:
+            with sched._assumed_lock:
+                leaked = bool(sched._assumed)
+            if not leaked:
+                break
+            time.sleep(0.1)
+        snap = metrics.snapshot()
+    finally:
+        watcher_stop.set()
+        for w in watchers:
+            w.stop()
+        svc.shutdown_scheduler()
+
+    if leaked:
+        raise SystemExit("[churn] ASSUMED-CAPACITY LEAK at quiesce")
+    if counters.get("queue.quota_violation"):
+        raise SystemExit(
+            f"[churn] NAMESPACE QUOTA VIOLATED: "
+            f"{counters.get('queue.quota_violation')} non-gang arrivals "
+            f"admitted past their cap"
+        )
+
+    # -- audits ------------------------------------------------------------
+    # exactly-once: no pod ever received two successful bind decisions
+    doubles = {n: c for n, c in bind_counts.items() if c > 1}
+    if doubles:
+        raise SystemExit(f"[churn] DOUBLE BINDS: {doubles}")
+    # capacity: no node over allocatable
+    cpu = defaultdict(int)
+    cnt = defaultdict(int)
+    final_pods = client.pods().list()
+    for p in final_pods:
+        if p.spec.node_name:
+            cpu[p.spec.node_name] += p.resource_requests().milli_cpu
+            cnt[p.spec.node_name] += 1
+    for node in client.nodes().list():
+        alloc = node.status.allocatable
+        nm = node.metadata.name
+        if cpu[nm] > alloc.milli_cpu or cnt[nm] > alloc.pods:
+            raise SystemExit(f"[churn] NODE OVER ALLOCATABLE: {nm}")
+    # gang integrity: every gang all-or-nothing; the RESIDENT gang must
+    # have survived both preemption bursts fully bound (the shield)
+    members = defaultdict(list)
+    for p in final_pods:
+        k = gang_key(p)
+        if k is not None:
+            members[k].append(p)
+    partial = {
+        k: sum(1 for p in v if p.spec.node_name)
+        for k, v in members.items()
+        if sum(1 for p in v if p.spec.node_name) not in (0, len(v))
+    }
+    if partial:
+        raise SystemExit(f"[churn] PARTIAL GANGS BOUND: {partial}")
+    res = members.get("resident/resident-gang", [])
+    if len(res) != gang_size or not all(p.spec.node_name for p in res):
+        raise SystemExit(
+            f"[churn] RESIDENT GANG STRANDED by preemption: "
+            f"{sum(1 for p in res if p.spec.node_name)}/{gang_size} bound"
+        )
+
+    # -- headline: p99 time-to-bind over churn + burst arrivals ------------
+    ttbs = sorted(
+        bind_ts[n] - arrival_ts[n] for n in bind_ts if n in arrival_ts
+    )
+    if not ttbs:
+        raise SystemExit("[churn] no time-to-bind samples recorded")
+
+    def pct(p: float) -> float:
+        return round(ttbs[min(int(len(ttbs) * p), len(ttbs) - 1)], 3)
+
+    p50, p95, p99 = pct(0.50), pct(0.95), pct(0.99)
+    if p99 > p99_gate_s:
+        raise SystemExit(
+            f"[churn] P99 TIME-TO-BIND REGRESSED: {p99}s > gate "
+            f"{p99_gate_s}s (p50 {p50}s, {len(ttbs)} samples)"
+        )
+    waves = counters.get("wave_pipeline.waves") or 1
+    zero_ratio = round(counters.get("wave_build.skipped") / waves, 3)
+    csnap = counters.snapshot()
+    log(
+        f"[churn] p99 time-to-bind {p99}s (p50 {p50}s, p95 {p95}s, "
+        f"{len(ttbs)} binds) over {arrivals} arrivals; zero-build waves "
+        f"{counters.get('wave_build.skipped')}/{waves} "
+        f"(tail {zero_build_tail}); max watcher lag {max_staleness_rv} rv; "
+        f"preempt shielded {csnap.get('gang.preempt_shielded', 0)}; "
+        f"quota peaks {dict(quota_peak)}"
+    )
+    return {
+        "nodes": n_nodes,
+        "window_s": window_s,
+        "arrivals": arrivals,
+        "bound": len(ttbs),
+        "total_s": round(elapsed, 1),
+        "ttb_p50_s": p50,
+        "ttb_p95_s": p95,
+        "ttb_p99_s": p99,
+        "ttb_gate_s": p99_gate_s,
+        "zero_build_waves": counters.get("wave_build.skipped"),
+        "zero_build_tail": zero_build_tail,
+        "zero_build_ratio": zero_ratio,
+        "pipelined_waves": counters.get("wave_pipeline.waves"),
+        "max_watcher_staleness_rv": max_staleness_rv,
+        "watch_evictions": csnap.get("watch.fanout.evicted_slow", 0),
+        "preempt_shielded": csnap.get("gang.preempt_shielded", 0),
+        "quota_peaks": dict(quota_peak),
+        "quota_held_total": csnap.get("queue.quota_held", 0),
+        "quota_admitted": csnap.get("queue.quota_admitted", 0),
+        "gang_counters": {
+            k: v for k, v in csnap.items() if k.startswith("gang.")
+        },
+        "fanout_microbench": fanout,
+        "stall_total_s": round(
+            snap.get("wave_pipeline_stall", {}).get("total_s", 0.0), 3
+        ),
+        "build_total_s": round(
+            snap.get("wave_pipeline_build", {}).get("total_s", 0.0), 3
+        ),
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
@@ -2396,6 +2940,7 @@ ROLES = {
     "disk": bench_disk,
     "ha": bench_ha,
     "gang": bench_gang,
+    "churn": bench_churn,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -2556,6 +3101,11 @@ def main() -> None:
         # probe, audited for zero stranded partial gangs and
         # deadlock-freedom (ISSUE 6)
         optional.append(("gang_churn", "gang", None, "gang"))
+    if os.environ.get("BENCH_CHURN", "1") != "0":
+        # sustained-churn serving (ISSUE 8): Poisson arrivals/departures +
+        # priority-preemption bursts, p99 time-to-bind headline, idle-wave
+        # gate + shared-fanout + quota audits
+        optional.append(("churn_serving", "churn", None, "churn"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

@@ -852,8 +852,12 @@ def make_gang_pods(
     ttl_s: float = 30.0,
     requests: Optional[Dict[str, Any]] = None,
     labels: Optional[Dict[str, str]] = None,
+    priority: int = 0,
+    **spec_kwargs: Any,
 ) -> List[Pod]:
-    """``size`` member pods of one gang (bench/test convenience)."""
+    """``size`` member pods of one gang (bench/test convenience).
+    ``priority`` is the gang's priority CLASS — every member carries it,
+    so the gang preempts (and is shielded from preemption) as a unit."""
     return [
         make_pod(
             f"{gang_name}-{i}",
@@ -861,6 +865,8 @@ def make_gang_pods(
             requests=requests,
             labels=labels,
             gang=GangSpec(gang_name, size, ttl_s),
+            priority=priority,
+            **spec_kwargs,
         )
         for i in range(size)
     ]

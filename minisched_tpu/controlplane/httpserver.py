@@ -108,6 +108,42 @@ def _route(path: str):
 _ACK_REGISTRY_CAP = 65536
 
 
+def _chunk_frame(data: bytes) -> bytes:
+    """One chunked-transfer frame — the ONE definition of the watch
+    stream's wire framing (event chunks, keepalives, SYNC all use it;
+    the terminal ``0\\r\\n\\r\\n`` is the standard end marker)."""
+    return f"{len(data):X}\r\n".encode() + data + b"\r\n"
+
+
+def event_wire_chunk(ev: Any) -> bytes:
+    """The watch verb's framed wire bytes for one event — JSON line plus
+    the chunked-transfer framing — encoded ONCE and memoized on the event
+    object itself (store fanout hands every watcher the SAME WatchEvent
+    instance, so N streams serializing one mutation cost one encode, not
+    N; ISSUE 8).  The line carries no watcher-specific state by
+    construction: namespace filtering happens BEFORE this call, and the
+    payload is (type, object, rv) only.  ``watch.fanout.encoded`` counts
+    first encodes, ``watch.fanout.shared`` the reuses — the fanout
+    microbench gates on encoded staying O(events), not O(events ×
+    watchers).  (Two streams racing the first encode may both pay it —
+    benign: last write wins on identical bytes.)"""
+    from minisched_tpu.observability import counters
+
+    wire = ev.wire
+    if wire is None:
+        wire = _chunk_frame(
+            json.dumps(
+                {"type": ev.type.value, "object": _encode(ev.obj), "rv": ev.rv}
+            ).encode()
+            + b"\n"
+        )
+        ev.wire = wire
+        counters.inc("watch.fanout.encoded")
+    else:
+        counters.inc("watch.fanout.shared")
+    return wire
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: ObjectStore = None  # set by start_api_server
     active_watches = None  # set by start_api_server (set + lock)
@@ -248,7 +284,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
         def chunk(data: bytes) -> None:
-            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+            self.wfile.write(_chunk_frame(data))
             self.wfile.flush()
 
         try:
@@ -286,20 +322,23 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if ns and ev.obj.metadata.namespace != ns:
                     continue
-                line = json.dumps(
-                    {
-                        "type": ev.type.value,
-                        "object": _encode(ev.obj),
-                        "rv": ev.rv,
-                    }
-                ).encode() + b"\n"
-                chunk(line)
+                # shared-payload fanout: the framed bytes are encoded once
+                # per EVENT (memoized on it) and shared by every stream
+                self.wfile.write(event_wire_chunk(ev))
+                self.wfile.flush()
             # orderly end-of-stream: terminal chunk, then drop keep-alive so
             # neither side blocks waiting for the other
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        except OSError:
+            # client hung up mid-chunk (BrokenPipe/ConnectionReset/aborted
+            # socket): count it — these used to vanish silently — and fall
+            # through to the finally, which prunes the watcher from the
+            # store IMMEDIATELY instead of leaving a dead registration for
+            # the next fanout to trip over
+            from minisched_tpu.observability import counters
+
+            counters.inc("watch.disconnects")
         finally:
             self.close_connection = True
             watch.stop()

@@ -28,7 +28,7 @@ import enum
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
@@ -73,28 +73,90 @@ class WatchEvent:
     #: a consumer that saw rv N resumes with ``resume_rv=N`` and receives
     #: exactly the events with rv > N.
     rv: int = 0
+    #: memoized WIRE encoding (the HTTP watch verb's framed JSON-line
+    #: chunk), filled by the first stream that serializes this event and
+    #: shared by every other watcher's stream — the store fans the SAME
+    #: event object into every watcher queue, so under load the encode
+    #: cost is O(1) in watcher count instead of O(watchers)
+    #: (httpserver.event_wire_chunk; ISSUE 8).  Never part of
+    #: equality/repr; the wire line does not depend on the watcher.
+    wire: Any = field(default=None, repr=False, compare=False)
+
+
+#: per-watcher queue bound, in EVENTS.  The per-watch queues decouple
+#: delivery from consumption so a slow consumer can never stall a mutator
+#: — but UNBOUNDED they let one wedged stream pin every event object (and
+#: its pods) for the life of the process.  A watcher that falls this far
+#: behind is EVICTED instead: its watch dies exactly like a dropped
+#: stream (``watch.fanout.evicted_slow`` counts it), and the consumer
+#: recovers through the existing resume-or-410→relist reconnect path —
+#: degrade-the-laggard, never block-the-store-lock (ISSUE 8).  Sized well
+#: above a full wave's bind fanout (~16k events) so healthy informers
+#: draining in batches never come near it.
+DEFAULT_WATCH_QUEUE_EVENTS = 65536
 
 
 class Watch:
     """A subscription to one kind's event stream."""
 
-    def __init__(self, store: "ObjectStore", kind: str):
+    def __init__(
+        self,
+        store: "ObjectStore",
+        kind: str,
+        max_queued: int = DEFAULT_WATCH_QUEUE_EVENTS,
+    ):
         self._store = store
         self._kind = kind
         self._cond = threading.Condition()
         self._events: List[WatchEvent] = []
         self._stopped = False
+        self._max_queued = max(int(max_queued), 1)
+        #: set by the store once the watch is REGISTERED: the initial
+        #: snapshot / resume-history replay (delivered pre-registration,
+        #: possibly far larger than the live bound) is exempt from
+        #: slow-watcher eviction — only live fanout lag evicts
+        self._live = False
+        #: how many of the QUEUED events are still the pre-registration
+        #: replay (consumed FIFO, so the head of the queue drains it
+        #: first).  The eviction bound applies to len(queue) MINUS this:
+        #: a healthy watcher mid-way through a 100k-object snapshot must
+        #: not be evicted by its first live event (the replay is exempt
+        #: as a BACKLOG, not just at delivery time).
+        self._replay_pending = 0
         #: the store's resource_version at registration (for a full
         #: snapshot open: the version the snapshot reflects — the exact
         #: resume cursor once that snapshot is consumed; every queued
         #: event has a higher rv).  A resumed watch carries its resume_rv.
         self.start_rv = 0
 
+    def _evict_locked(self) -> None:
+        """Slow-watcher eviction (caller holds self._cond): die exactly
+        like a dropped stream — stop, free the queue, wake the consumer
+        with end-of-stream.  The consumer's reconnect resumes from its
+        last-seen rv (or relists on 410); the store's fanout prunes the
+        dead registration lazily, same as ``kill``."""
+        from minisched_tpu.observability import counters
+
+        self._stopped = True
+        self._events.clear()
+        self._replay_pending = 0
+        counters.inc("watch.fanout.evicted_slow")
+        self._cond.notify_all()
+
+    def _live_queued_locked(self) -> int:
+        """Queued LIVE events (caller holds self._cond): total queue
+        minus the not-yet-consumed replay backlog — the only population
+        the eviction bound measures."""
+        return len(self._events) - self._replay_pending
+
     # called by the store while it holds its lock; only touches this
     # watch's own condition/queue, so it cannot block on user code
     def _deliver(self, event: WatchEvent) -> None:
         with self._cond:
             if self._stopped:
+                return
+            if self._live and self._live_queued_locked() >= self._max_queued:
+                self._evict_locked()
                 return
             self._events.append(event)
             self._cond.notify_all()
@@ -107,6 +169,15 @@ class Watch:
             return
         with self._cond:
             if self._stopped:
+                return
+            # gate on EXISTING lag, not batch size: one oversized fanout
+            # batch (a >bound create_many) must not evict every
+            # caught-up watcher of the kind at once — only a consumer
+            # already at the bound is a laggard.  The bound is soft by
+            # one batch as a result; the next delivery evicts if the
+            # consumer still hasn't drained.
+            if self._live and self._live_queued_locked() >= self._max_queued:
+                self._evict_locked()
                 return
             self._events.extend(events)
             self._cond.notify_all()
@@ -126,6 +197,8 @@ class Watch:
                     if remaining <= 0 or not self._cond.wait(remaining):
                         break
             if self._events:
+                if self._replay_pending:
+                    self._replay_pending -= 1  # FIFO: replay drains first
                 return self._events.pop(0)
             return None
 
@@ -146,6 +219,7 @@ class Watch:
                     if remaining <= 0 or not self._cond.wait(remaining):
                         break
             out, self._events = self._events, []
+            self._replay_pending = 0  # FIFO: a full drain consumed it all
             return out
 
     def kill(self) -> None:
@@ -261,8 +335,11 @@ class ObjectStore:
         self,
         history_events: int = DEFAULT_HISTORY_EVENTS,
         history_bytes: int = DEFAULT_HISTORY_BYTES,
+        watch_queue_events: int = DEFAULT_WATCH_QUEUE_EVENTS,
     ) -> None:
         self._lock = threading.RLock()
+        #: per-watcher queue bound; see DEFAULT_WATCH_QUEUE_EVENTS
+        self._watch_queue_events = max(int(watch_queue_events), 1)
         self._objects: Dict[str, Dict[str, Any]] = {}  # kind -> key -> obj
         self._watches: Dict[str, List[Watch]] = {}
         self._rv = 0
@@ -360,13 +437,17 @@ class ObjectStore:
         ring = self._history.get(kind)
         if ring is None:
             ring = self._history[kind] = deque()
-        if event.old_obj is not None:
-            # retain WITHOUT old_obj: the replaced version is garbage the
-            # moment a newer event lands, and pinning it doubles the
-            # ring's footprint at wave scale.  Resume consumers re-derive
-            # 'old' from their own caches (the informer's normalization
-            # does exactly that), and the wire encoding never carried it.
-            event = WatchEvent(event.type, event.obj, rv=event.rv)
+        # retain a ring-private copy: WITHOUT old_obj (the replaced
+        # version is garbage the moment a newer event lands, and pinning
+        # it doubles the ring's footprint at wave scale — resume
+        # consumers re-derive 'old' from their own caches, and the wire
+        # encoding never carried it), and DISTINCT from the fanned-out
+        # object so a live HTTP stream's memoized wire bytes
+        # (event_wire_chunk) never pin into the ring past its byte
+        # budget.  Resume replays deliver their own per-resumer copies
+        # (see watch()), so nothing ever memoizes onto ring-resident
+        # events at all.
+        event = WatchEvent(event.type, event.obj, rv=event.rv)
         cost = approx_obj_bytes(event.obj) + 96  # + ring/event overhead
         used = self._history_bytes_used.get(kind, 0) + cost
         floors = self._history_floors
@@ -810,18 +891,29 @@ class ObjectStore:
                         f"server (at {self._rv}): recovered from older "
                         f"state; relist required"
                     )
-                w = Watch(self, kind)
+                w = Watch(self, kind, self._watch_queue_events)
                 w.start_rv = resume_rv
+                # COPIES, not the ring's own events: a resumed HTTP
+                # stream memoizes wire bytes onto whatever it serializes
+                # (event_wire_chunk), and memos on ring-resident events
+                # would pin past the ring's byte budget invisibly.  The
+                # copy costs one dataclass per replayed event per
+                # resumer — resumes are rare by design.
                 w._deliver_many(
                     [
-                        ev
+                        WatchEvent(ev.type, ev.obj, rv=ev.rv)
                         for ev, _cost in self._history.get(kind, ())
                         if ev.rv > resume_rv
                     ]
                 )
                 self._watches.setdefault(kind, []).append(w)
+                with w._cond:
+                    # the queued history replay stays exempt from the
+                    # live bound until the consumer drains it (FIFO)
+                    w._replay_pending = len(w._events)
+                    w._live = True
                 return w, []
-            w = Watch(self, kind)
+            w = Watch(self, kind, self._watch_queue_events)
             w.start_rv = self._rv
             snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
             if send_initial:
@@ -835,6 +927,11 @@ class ObjectStore:
                     ]
                 )
             self._watches.setdefault(kind, []).append(w)
+            with w._cond:
+                # the queued snapshot replay stays exempt from the live
+                # bound until the consumer drains it (FIFO)
+                w._replay_pending = len(w._events)
+                w._live = True
         return w, snapshot
 
     def _remove_watch(self, kind: str, w: Watch) -> None:

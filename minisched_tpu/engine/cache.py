@@ -40,6 +40,15 @@ class SchedulerCache:
         # Drained ONLY by snapshot_for_tables (the wave path); plain
         # snapshots leave it alone so the wave builder misses nothing.
         self._dirty: Optional[Set[str]] = None
+        # membership/content epoch: bumped on EVERY mutation that can
+        # change what a node-table build would produce (node add/update/
+        # delete, assigned-pod place/remove/refresh).  The wave builder
+        # uses it as the idle-wave gate (ISSUE 8): a snapshot whose epoch
+        # equals the last built one — with an unchanged assume-delta —
+        # can reuse the previous tables wholesale, no per-node signature
+        # walk needed.  Orphan staging does NOT bump (an orphan is
+        # invisible to builds until its node registers, which bumps).
+        self._epoch = 0
 
     # -- node events -------------------------------------------------------
     def _create_node(self, node: Any) -> None:
@@ -51,6 +60,7 @@ class SchedulerCache:
         self._nodes[node.metadata.name] = ni
         self._sorted = None
         self._dirty = None  # membership changed: row indices shifted
+        self._epoch += 1
         for uid, pod in list(self._orphans.items()):
             if pod.spec.node_name == node.metadata.name:
                 del self._orphans[uid]
@@ -64,12 +74,14 @@ class SchedulerCache:
                 self._create_node(node)
             else:
                 ni.node = node
+                self._epoch += 1
 
     def update_node(self, old: Any, new: Any) -> None:
         with self._mu:
             ni = self._nodes.get(new.metadata.name)
             if ni is not None:
                 ni.node = new
+                self._epoch += 1
             else:  # update for a node we never saw: treat as add
                 self._create_node(new)
 
@@ -81,6 +93,7 @@ class SchedulerCache:
         ni = self._nodes.pop(node.metadata.name, None)
         self._sorted = None
         self._dirty = None  # membership changed: row indices shifted
+        self._epoch += 1
         if ni is not None:
             # the pods are still bound in the cluster view and will
             # emit no further events — re-orphan them so a node
@@ -115,6 +128,7 @@ class SchedulerCache:
         self._place(new)
 
     def _mark_dirty(self, name: str) -> None:
+        self._epoch += 1  # every caller just changed a node's aggregates
         if self._dirty is not None:
             self._dirty.add(name)
 
@@ -163,16 +177,20 @@ class SchedulerCache:
             return [ni.clone() for ni in self._sorted], set(self._pod_node)
 
     def snapshot_for_tables(self):
-        """(snapshot, assigned-pod uids, dirty node names) from ONE locked
-        read — the wave table builder's entry point.  ``dirty`` is the set
-        of node names whose aggregates changed since the PREVIOUS drain
-        (None = full rebuild needed: first snapshot, or node membership
-        changed and row indices shifted); draining it here, atomically
-        with the snapshot, is what makes the incremental aggregate base
-        exact — the builder re-encodes exactly the rows this snapshot
-        changed, in snapshot order (the wave path is single-threaded).
-        Consumers that don't feed the builder use snapshot_with_assigned,
-        which leaves the dirty-set alone."""
+        """(snapshot, assigned-pod uids, dirty node names, epoch) from ONE
+        locked read — the wave table builder's entry point.  ``dirty`` is
+        the set of node names whose aggregates changed since the PREVIOUS
+        drain (None = full rebuild needed: first snapshot, or node
+        membership changed and row indices shifted); draining it here,
+        atomically with the snapshot, is what makes the incremental
+        aggregate base exact — the builder re-encodes exactly the rows
+        this snapshot changed, in snapshot order (the wave path is
+        single-threaded).  ``epoch`` is the cache's mutation counter AT
+        the snapshot — the idle-wave gate: a later snapshot with the same
+        epoch is guaranteed byte-identical, so the builder may reuse the
+        previous tables wholesale (ISSUE 8).  Consumers that don't feed
+        the builder use snapshot_with_assigned, which leaves the
+        dirty-set alone."""
         with self._mu:
             if self._sorted is None:
                 self._sorted = sorted(
@@ -184,7 +202,15 @@ class SchedulerCache:
                 [ni.clone() for ni in self._sorted],
                 set(self._pod_node),
                 dirty,
+                self._epoch,
             )
+
+    @property
+    def epoch(self) -> int:
+        """The mutation counter (see snapshot_for_tables) — observability
+        and tests; the wave path reads it atomically with its snapshot."""
+        with self._mu:
+            return self._epoch
 
     def capacity_view(
         self, names: Any

@@ -450,9 +450,10 @@ class DeviceScheduler(Scheduler):
             else:
                 # the bind never landed anywhere: release the capacity and
                 # put the pod back through the queue (deduped by uid, so a
-                # pod that somehow also sits in a queue segment is safe)
+                # pod that somehow also sits in a queue segment is safe;
+                # requeue: a retry must never be quota-held)
                 self._forget(uid)
-                self.queue.add(cur)
+                self.queue.add(cur, requeue=True)
                 counters.inc("assume.lease_requeued")
 
     def snapshot_nodes(self):
@@ -474,7 +475,7 @@ class DeviceScheduler(Scheduler):
         scan lanes' snapshot; see ``_snapshot_for_tables`` for the wave
         paths' dirty-tracking variant (this wrapper leaves the cache's
         dirty-set alone, so the wave builder misses nothing)."""
-        infos, delta, leftover, _ = self._snapshot_for_tables(
+        infos, delta, leftover, _, _ = self._snapshot_for_tables(
             want_dirty=False
         )
         return infos, delta, leftover
@@ -482,8 +483,8 @@ class DeviceScheduler(Scheduler):
     def _snapshot_for_tables(
         self, want_dirty: bool = True, expire_leases: bool = True
     ):
-        """(node infos, aggregate delta, surviving assumed pods, dirty) —
-        the wave path's snapshot.  Unlike ``snapshot_nodes`` the
+        """(node infos, aggregate delta, surviving assumed pods, dirty,
+        epoch) — the wave path's snapshot.  Unlike ``snapshot_nodes`` the
         assume-cache is NOT folded into the NodeInfos pod-by-pod; it
         comes back as a numeric per-node delta (see
         CachedNodeTableBuilder._apply_agg_delta) that the table build
@@ -505,14 +506,16 @@ class DeviceScheduler(Scheduler):
         if expire_leases:
             self._expire_assume_leases()
         if want_dirty:
-            infos, cache_assigned, dirty = self.cache.snapshot_for_tables()
+            infos, cache_assigned, dirty, epoch = (
+                self.cache.snapshot_for_tables()
+            )
         else:
             infos, cache_assigned = self.cache.snapshot_with_assigned()
-            dirty = DIRTY_UNTRACKED
+            dirty, epoch = DIRTY_UNTRACKED, None
         delta: dict = {}
         with self._assumed_lock:
             if not self._assumed:
-                return infos, delta, [], dirty
+                return infos, delta, [], dirty, epoch
             uids = list(self._assumed)
             keys = [self._assumed[u].metadata.key for u in uids]
         # one bulk cache read outside the assume lock (the informer lock is
@@ -544,7 +547,7 @@ class DeviceScheduler(Scheduler):
                 d[5] += agg[4]
                 if agg[5]:
                     d[6].extend(agg[5])
-        return infos, delta, leftover, dirty
+        return infos, delta, leftover, dirty, epoch
 
     def error_func(self, qpi: QueuedPodInfo, err, plugin: str = "") -> None:
         # a failed permit/bind releases the assumed capacity
@@ -1564,6 +1567,10 @@ class DeviceScheduler(Scheduler):
         # overlap window); the loop thread keeps the serial cadence
         self._expire_assume_leases()
         counters.inc("wave_pipeline.dirty_rows", prepared.dirty_rows)
+        if prepared.build_skipped:
+            # idle-wave gate fired: this wave reused the previous tables
+            # wholesale (zero node-table build work; ISSUE 8)
+            counters.inc("wave_pipeline.zero_build_waves")
         # gate opens for the device call: the previous wave's held bind
         # events drain against GIL-free device compute — and the build
         # worker gets the GIL for wave N+2's host stretch in this window
@@ -1614,8 +1621,9 @@ class DeviceScheduler(Scheduler):
                 # capacity the overlapped wave committed while this one
                 # was on device: the pod is feasible, it just raced —
                 # straight back through the active queue so the next
-                # wave's FRESH snapshot re-places it
-                self.queue.add(pod)
+                # wave's FRESH snapshot re-places it (requeue: never
+                # quota-held behind its tenant's newer arrivals)
+                self.queue.add(pod, requeue=True)
         self._commit_winners(winners)
         if losers:
             self._handle_wave_losers(
@@ -1920,9 +1928,9 @@ class DeviceScheduler(Scheduler):
                 node_infos, agg_delta, assumed_pods = (
                     self._snapshot_for_wave()
                 )
-                dirty = DIRTY_UNTRACKED
+                dirty, epoch = DIRTY_UNTRACKED, None
             else:
-                node_infos, agg_delta, assumed_pods, dirty = (
+                node_infos, agg_delta, assumed_pods, dirty, epoch = (
                     self._snapshot_for_tables()
                 )
         if not node_infos:
@@ -1944,7 +1952,8 @@ class DeviceScheduler(Scheduler):
         def build_and_evaluate(qpis_):
             with self.metrics.timed("wave_evaluate"):
                 return self._build_and_evaluate(
-                    qpis_, node_infos, nodes, assigned, agg_delta, dirty
+                    qpis_, node_infos, nodes, assigned, agg_delta, dirty,
+                    epoch,
                 )
 
         qpis, result = self._evaluate_or_park(qpis, build_and_evaluate)
@@ -1978,7 +1987,7 @@ class DeviceScheduler(Scheduler):
 
     def _build_and_evaluate(
         self, qpis_, node_infos, nodes, assigned, agg_delta=None,
-        dirty=DIRTY_UNTRACKED,
+        dirty=DIRTY_UNTRACKED, epoch=None,
     ):
         """One repair-wave evaluation: tables → fused repair evaluator →
         (node_names, placements, per-pod failing-plugin sets).
@@ -2000,7 +2009,8 @@ class DeviceScheduler(Scheduler):
             if packed_mode:
                 node_static, node_agg, node_names = (
                     self._table_builder.build_packed(
-                        node_infos, agg_delta=agg_delta, dirty=dirty
+                        node_infos, agg_delta=agg_delta, dirty=dirty,
+                        epoch=epoch,
                     )
                 )
                 node_capacity = node_agg.capacity
@@ -2010,7 +2020,8 @@ class DeviceScheduler(Scheduler):
                 )
             else:
                 node_table, node_names = self._table_builder.build(
-                    node_infos, agg_delta=agg_delta, dirty=dirty
+                    node_infos, agg_delta=agg_delta, dirty=dirty,
+                    epoch=epoch,
                 )
                 node_capacity = node_table.capacity
                 pod_table, _ = build_pod_table(

@@ -56,6 +56,7 @@ class PreparedWave:
         "extra",
         "build_s",
         "dirty_rows",
+        "build_skipped",
     )
 
     def __init__(self) -> None:
@@ -70,6 +71,10 @@ class PreparedWave:
         self.extra: Any = None
         self.build_s = 0.0
         self.dirty_rows = 0
+        #: the node-table build was skipped wholesale (idle-wave gate:
+        #: nothing dirty, roster epoch unchanged, same assume-delta —
+        #: ISSUE 8); the loop thread counts these per wave
+        self.build_skipped = False
 
 
 class _BuildFallback(Exception):
@@ -236,7 +241,7 @@ class WavePipeline:
         # the overlap window); the dirty-set drain is atomic with the
         # snapshot and this worker is the only wave-path snapshotter
         with sched.metrics.timed("wave_snapshot"):
-            node_infos, agg_delta, assumed_pods, dirty = (
+            node_infos, agg_delta, assumed_pods, dirty, epoch = (
                 sched._snapshot_for_tables(expire_leases=False)
             )
         if not node_infos:
@@ -259,10 +264,14 @@ class WavePipeline:
         with sched.metrics.timed("wave_build_tables"):
             node_static, node_agg, node_names = (
                 sched._table_builder.build_packed(
-                    node_infos, agg_delta=agg_delta, dirty=dirty
+                    node_infos, agg_delta=agg_delta, dirty=dirty,
+                    epoch=epoch,
                 )
             )
             prepared.dirty_rows = sched._table_builder.last_dirty_rows
+            prepared.build_skipped = (
+                sched._table_builder.last_build_skipped
+            )
             pod_table, _ = build_pod_table(
                 pods_, capacity=pod_capacity, device=False,
                 gang_view=gang_view,

@@ -747,7 +747,9 @@ class Scheduler:
                     from minisched_tpu.observability import counters
 
                     counters.inc("gang.ttl_requeued")
-                    self.queue.add(qpi.pod)
+                    # requeue: a TTL-released member retries promptly,
+                    # never quota-held behind its tenant's arrivals
+                    self.queue.add(qpi.pod, requeue=True)
                     if self.on_decision:
                         self.on_decision(pod, None, status)
                     return

@@ -116,7 +116,10 @@ def start_ha_engine(
             if pod.spec.node_name:
                 continue  # bound: not schedulable work for anyone
             if membership.owns_pod(pod):
-                sched.queue.add(pod)  # dedup: queued pods are a no-op
+                # dedup: queued pods are a no-op.  requeue: an adopted
+                # pod was already admitted on the dead peer — failover
+                # must not re-gate it behind its tenant's quota hold
+                sched.queue.add(pod, requeue=True)
                 adopted += 1
             else:
                 shed.append(pod)

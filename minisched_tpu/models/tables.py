@@ -863,6 +863,22 @@ _NODE_AGG_COLS = (
 DIRTY_UNTRACKED = object()
 
 
+def _agg_delta_fp(agg_delta) -> Tuple:
+    """Canonical fingerprint of a per-node assume-delta (see
+    CachedNodeTableBuilder._apply_agg_delta's row shape) — the idle-wave
+    gate compares THIS, not identity: two consecutive waves folding the
+    same surviving assumptions produce byte-identical aggregate columns,
+    so re-folding is pure waste.  O(len(delta)); () for no delta."""
+    if not agg_delta:
+        return ()
+    return tuple(
+        sorted(
+            (name, tuple(d[:6]), tuple(d[6]))
+            for name, d in agg_delta.items()
+        )
+    )
+
+
 class CachedNodeTableBuilder:
     """Per-wave NodeTable builds with the static columns cached.
 
@@ -917,6 +933,22 @@ class CachedNodeTableBuilder:
         #: dirty rows re-encoded by the last build (0 = full rebuild
         #: counted as len(nodes)); observability reads it per wave
         self.last_dirty_rows = 0
+        #: True when the last tracked build took the idle-wave skip path
+        #: (tables reused wholesale — no encode, no fold, no transfer);
+        #: the pipeline copies it onto the PreparedWave per wave
+        self.last_build_skipped = False
+        # idle-wave reuse cache (ISSUE 8): the last TRACKED build's
+        # output, reusable wholesale when a later snapshot proves nothing
+        # changed — dirty-set empty, same capacities, same assume-delta
+        # fingerprint, and the statics unchanged (cache epoch match, or
+        # the (name, rv) signature compare when the caller has no epoch).
+        # Invalidated whenever the statics re-encode or the aggregate
+        # base is touched; packing paths copy out of the scratch buffers,
+        # so the cached tables can never be mutated by later builds.
+        self._reuse_packed: Optional[Tuple] = None
+        self._reuse_table: Optional[Tuple] = None
+        self._reuse_key: Optional[Tuple] = None
+        self._reuse_epoch: Optional[int] = None
         # reusable per-wave aggregate scratch: the assume-delta folds
         # into a COPY of the base (never the base itself).  ONE buffer
         # suffices — what keeps an in-flight wave's tables safe from the
@@ -941,11 +973,9 @@ class CachedNodeTableBuilder:
         self._names: List[str] = []
         self._name_index: Dict[str, int] = {}
 
-    def _ensure_static(self, node_infos: Sequence[Any], cap: int,
-                       prof_capacity: int) -> None:
-        """Re-encode + (optionally) re-upload the static columns only when
-        the name-sorted (name, resource_version) signature changes."""
-        sig = (
+    def _static_sig(self, node_infos: Sequence[Any], cap: int,
+                    prof_capacity: int) -> Tuple:
+        return (
             cap,
             prof_capacity,
             tuple(
@@ -953,8 +983,23 @@ class CachedNodeTableBuilder:
                 for ni in node_infos
             ),
         )
+
+    def _drop_reuse(self) -> None:
+        """Invalidate the idle-wave reuse cache (statics about to
+        re-encode, aggregate base about to change, or a build failed)."""
+        self._reuse_packed = None
+        self._reuse_table = None
+        self._reuse_key = None
+        self._reuse_epoch = None
+
+    def _ensure_static(self, node_infos: Sequence[Any], cap: int,
+                       prof_capacity: int) -> None:
+        """Re-encode + (optionally) re-upload the static columns only when
+        the name-sorted (name, resource_version) signature changes."""
+        sig = self._static_sig(node_infos, cap, prof_capacity)
         if sig == self._sig:
             return
+        self._drop_reuse()  # statics changing: cached tables are stale
         if self._patch_rows(node_infos, sig):
             return
         reg = _ProfileRegistry()
@@ -1120,6 +1165,7 @@ class CachedNodeTableBuilder:
         into the next wave's increments."""
         names = tuple(ni.name for ni in node_infos)
         base = self._agg_base
+        self._drop_reuse()  # base about to change; caller re-caches
         try:
             if (
                 base is None
@@ -1164,15 +1210,90 @@ class CachedNodeTableBuilder:
             np.copyto(buf[k], v)
         return buf
 
+    def _try_reuse(
+        self, cached, node_infos: Sequence[Any], cap: int, prof_capacity,
+        dirty, agg_delta, epoch,
+    ):
+        """The idle-wave gate (ISSUE 8): return the previous build's
+        output wholesale — no static encode, no aggregate re-fold, no
+        packing, no device transfer — when this snapshot provably changes
+        nothing: the drained dirty-set is EMPTY (tracked), capacities
+        match, the assume-delta fingerprint matches, and the node objects
+        are unchanged (cache-epoch handshake; callers without an epoch
+        pay an O(nodes) signature compare, still zero build work).
+        Returns None when any condition fails — the caller builds."""
+        if dirty is DIRTY_UNTRACKED:
+            # untracked (scan-lane / prewarm) builds leave the wave
+            # stats ALONE: the pipeline's build worker reads
+            # last_build_skipped / last_dirty_rows after its tracked
+            # build returns, and a concurrent loop-thread scan flush
+            # through this same builder must not clobber them
+            return None
+        self.last_build_skipped = False
+        if (
+            dirty is None
+            or dirty
+            or cached is None
+            or self._agg_base is None
+            or self._reuse_key is None
+            or self._reuse_key[0] != cap
+            or self._reuse_key[1] != prof_capacity
+            or self._reuse_key[2] != _agg_delta_fp(agg_delta)
+        ):
+            return None
+        if epoch is not None and self._reuse_epoch is not None:
+            if epoch != self._reuse_epoch:
+                return None  # node objects (or aggregates) changed
+        elif self._static_sig(node_infos, cap, prof_capacity) != self._sig:
+            return None
+        from minisched_tpu.observability import counters
+
+        counters.inc("wave_build.skipped")
+        self.last_dirty_rows = 0
+        self.last_build_skipped = True
+        return cached
+
+    def _cache_reuse(
+        self, out, packed: bool, cap: int, prof_capacity, agg_delta, epoch
+    ):
+        """Record a TRACKED build's output for the idle-wave gate and
+        return it (possibly upgraded).  One key serves both modes; the
+        other mode's cached output is dropped so a mode switch can never
+        serve tables keyed for the other.
+
+        Packed single-device outputs get their aggregate flat buffer
+        committed to device HERE: the consumer jit then uses the
+        committed array directly — the wave that built it still pays its
+        one transfer (device_put instead of jit's implicit one), and
+        every SKIPPED wave after it ships zero bytes.  Under a mesh the
+        flat stays host-side (MeshPackedCaller owns placement there, and
+        the per-wave single-device fallback consumes the same buffer)."""
+        self._reuse_key = (cap, prof_capacity, _agg_delta_fp(agg_delta))
+        self._reuse_epoch = epoch
+        if packed:
+            if self._mesh is None:
+                static_dev, agg, names = out
+                agg = PackedTable(
+                    agg.metas, agg.zero_metas,
+                    jax.device_put(agg.flat), agg.capacity,
+                )
+                out = (static_dev, agg, names)
+            self._reuse_packed, self._reuse_table = out, None
+        else:
+            self._reuse_table, self._reuse_packed = out, None
+        return out
+
     def _aggregates_for(
         self, node_infos: Sequence[Any], cap: int, dirty, agg_delta
     ) -> Dict[str, Any]:
         if dirty is DIRTY_UNTRACKED:
             # caller outside the dirty protocol (scan lanes, prewarm,
             # one-shot builds): fresh fill, persistent base untouched —
-            # its undrained changes stay pending for the wave path
+            # its undrained changes stay pending for the wave path, and
+            # the wave stats (last_dirty_rows/last_build_skipped) stay
+            # the TRACKED builds' (see _try_reuse: the pipeline reads
+            # them cross-thread after its build)
             t = self._fill_aggregates(node_infos, cap)
-            self.last_dirty_rows = len(node_infos)
         else:
             base = self._update_agg_base(node_infos, cap, dirty)
             t = self._wave_agg_copy(base, cap)
@@ -1182,10 +1303,17 @@ class CachedNodeTableBuilder:
 
     def build(self, node_infos: Sequence[Any], capacity: int = None,
               prof_capacity: int = None, agg_delta=None,
-              dirty=DIRTY_UNTRACKED):
+              dirty=DIRTY_UNTRACKED, epoch=None):
         with self._build_lock:
             try:
                 cap = self._cap_for(node_infos, capacity)
+                reused = self._try_reuse(
+                    self._reuse_table, node_infos, cap, prof_capacity,
+                    dirty, agg_delta, epoch,
+                )
+                if reused is not None:
+                    table, names = reused
+                    return table, list(names)
                 self._ensure_static(node_infos, cap, prof_capacity)
                 t = self._aggregates_for(node_infos, cap, dirty, agg_delta)
                 if self._device_static:
@@ -1195,7 +1323,12 @@ class CachedNodeTableBuilder:
                     cols = dict(self._static)
                     cols.update(t)
                     cols = batched_device_put(cols)
-                return NodeTable(**cols), list(self._names)
+                out = NodeTable(**cols), list(self._names)
+                if dirty is not DIRTY_UNTRACKED:
+                    out = self._cache_reuse(
+                        out, False, cap, prof_capacity, agg_delta, epoch
+                    )
+                return out
             except Exception:
                 # a TRACKED build consumed its snapshot's drained dirty
                 # set the moment the snapshot was taken — failing at ANY
@@ -1204,11 +1337,12 @@ class CachedNodeTableBuilder:
                 # invalidate so the next tracked build refills fully
                 if dirty is not DIRTY_UNTRACKED:
                     self._agg_base = None
+                self._drop_reuse()
                 raise
 
     def build_packed(self, node_infos: Sequence[Any], capacity: int = None,
                      prof_capacity: int = None, agg_delta=None,
-                     dirty=DIRTY_UNTRACKED):
+                     dirty=DIRTY_UNTRACKED, epoch=None):
         """Single-program variant: (static device cols, PackedTable of the
         per-wave aggregate columns, names).  The consumer jit unpacks the
         aggregates and merges the device-resident statics inside its own
@@ -1219,25 +1353,43 @@ class CachedNodeTableBuilder:
         SchedulerCache.snapshot_for_tables) — the aggregate columns then
         re-encode only those rows into the persistent base instead of
         walking every NodeInfo.  Callers outside the dirty protocol leave
-        the default (full fresh fill, base untouched)."""
+        the default (full fresh fill, base untouched).
+
+        ``epoch``: the cache epoch the snapshot carried — with an EMPTY
+        drained dirty-set and an unchanged assume-delta it arms the
+        idle-wave gate (_try_reuse): the previous build's tables come
+        back wholesale and ``wave_build.skipped`` increments."""
         with self._build_lock:
             try:
                 assert self._device_static, (
                     "build_packed needs device-resident statics"
                 )
                 cap = self._cap_for(node_infos, capacity)
+                reused = self._try_reuse(
+                    self._reuse_packed, node_infos, cap, prof_capacity,
+                    dirty, agg_delta, epoch,
+                )
+                if reused is not None:
+                    static_dev, packed, names = reused
+                    return static_dev, packed, list(names)
                 self._ensure_static(node_infos, cap, prof_capacity)
                 t = self._aggregates_for(node_infos, cap, dirty, agg_delta)
-                return (
+                out = (
                     self._static_dev,
                     pack_table(t, (), cap),
                     list(self._names),
                 )
+                if dirty is not DIRTY_UNTRACKED:
+                    out = self._cache_reuse(
+                        out, True, cap, prof_capacity, agg_delta, epoch
+                    )
+                return out
             except Exception:
                 # see build(): a failed TRACKED build must not strand the
                 # drained dirty rows — invalidate, full refill next time
                 if dirty is not DIRTY_UNTRACKED:
                     self._agg_base = None
+                self._drop_reuse()
                 raise
 
 

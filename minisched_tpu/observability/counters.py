@@ -40,6 +40,47 @@ CycleMetrics, not here — counters are integers:
     wave_pipeline.dirty_rows
         — node aggregate rows re-encoded incrementally (vs a full
           O(all nodes) fill per wave); the bench divides by waves
+    wave_pipeline.zero_build_waves
+        — pipelined waves whose node-table build was skipped WHOLESALE
+          by the idle-wave gate (below); the churn bench's
+          zero-build-wave ratio divides this by wave_pipeline.waves
+
+The sustained-churn layer (ISSUE 8, DESIGN.md §22) records the
+cheap-when-quiet story — surfaced in the bench ``churn`` role's record:
+
+    wave_build.skipped
+        — CachedNodeTableBuilder builds answered from the idle-wave
+          reuse cache: empty dirty-set, unchanged cache epoch (or
+          (name, rv) signature), same capacities, byte-equal
+          assume-delta fingerprint → the previous tables returned
+          wholesale, zero encode/fold/pack/transfer.  Counted at the
+          builder, so serial and pipelined waves both land here.
+    watch.fanout.encoded / watch.fanout.shared
+        — HTTP watch streams serializing an event: first encode of the
+          framed wire chunk (memoized on the WatchEvent the store fans
+          out) vs. reuses by every other stream.  encoded staying
+          O(events) while shared grows O(events × watchers) IS the
+          shared-payload claim; the churn fanout microbench gates on it.
+    watch.fanout.evicted_slow
+        — watchers evicted because their queue exceeded the per-watch
+          bound (DEFAULT_WATCH_QUEUE_EVENTS): the stream dies like a
+          drop and the consumer recovers via resume/410→relist —
+          degrade-the-laggard, never block-the-store-lock.
+    watch.disconnects
+        — watch streams whose client hung up mid-chunk (previously a
+          silent exit); the handler prunes the registration immediately.
+    queue.quota_held / queue.quota_admitted / queue.quota_gang_bypass
+        — namespace-quota admission at the scheduling queue: arrivals
+          parked in the per-namespace hold FIFO, holds promoted into
+          freed slots (FIFO, deferred past a pop_batch so a tenant's
+          share of one wave stays at its cap), and gang members
+          admitted past the cap (an all-or-nothing gang is never split
+          across the quota boundary).
+    queue.quota_violation
+        — tripwire, not a code path: a non-gang NEW arrival admitted
+          past its namespace cap (requeues and gang bypass may exceed
+          by contract; this may not).  Any nonzero value is an
+          accounting bug; the churn bench fails on it.
 
 The multi-chip live wave engine (ISSUE 7: DeviceScheduler over a
 jax.sharding.Mesh, parallel/sharding.MeshPackedCaller) records under
@@ -112,6 +153,11 @@ The gang subsystem (plugins/coscheduling + engine/gang) records under
     gang.rearb_atomic_release
         — pipelined gang members released WITH a sibling that lost
           commit-time re-arbitration (a gang is kept or released whole)
+    gang.preempt_shielded
+        — lower-priority gang-member pods DefaultPreemption excluded
+          from a victim search (gang capacity is unpreemptable until
+          whole-gang eviction lands — evicting one member would strand
+          the rest as a partial gang; the churn bench audits this)
 """
 
 from __future__ import annotations

@@ -221,10 +221,30 @@ class DefaultPreemption(Plugin):
         node_infos: List[NodeInfo],
         shared_state: Optional[CycleState] = None,
     ) -> Optional[List[Any]]:
-        lower = [p for p in ni.pods if p.spec.priority < pod.spec.priority]
+        from minisched_tpu.api.objects import gang_key
+
+        # gang shield (ISSUE 8): a gang member is NEVER a victim — gangs
+        # are all-or-nothing, so evicting one member strands its bound
+        # siblings as a partial gang (the churn bench's preemption bursts
+        # audit exactly this).  Whole-gang eviction (weigh the entire
+        # gang as one victim set) is the ROADMAP follow-up; until then
+        # gang capacity is simply unpreemptable.
+        lower, shielded = [], 0
+        for p in ni.pods:
+            if p.spec.priority >= pod.spec.priority:
+                continue
+            if gang_key(p) is not None:
+                shielded += 1
+            else:
+                lower.append(p)
+        if shielded:
+            from minisched_tpu.observability import counters
+
+            counters.inc("gang.preempt_shielded", shielded)
         if not lower:
             return None
-        remaining = [p for p in ni.pods if p.spec.priority >= pod.spec.priority]
+        evictable = {id(p) for p in lower}
+        remaining = [p for p in ni.pods if id(p) not in evictable]
         if not self._feasible_after(pod, ni, remaining, node_infos, shared_state):
             return None  # even with every lower-priority pod gone, no fit
         # reprieve most-important first: higher priority, then earlier

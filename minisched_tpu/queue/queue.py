@@ -22,6 +22,21 @@ copy"):
 
 ``pop_batch`` is the TPU-native addition: the batch evaluator drains a whole
 wave of pods in one call instead of one pod per cycle.
+
+``namespace_quota`` is the multi-tenant admission gate (ISSUE 8, the
+churn-serving regime of "Priority Matters" arXiv:2511.08373): per-namespace
+caps on how many pods may be TRACKED by the queue at once (active + backoff
++ unschedulable — i.e. pending admission to a wave).  Over-cap adds park in
+a per-namespace FIFO and admit as tenants' earlier pods leave tracking
+(popped for a wave, or deleted) — bounding any one tenant's share of every
+wave without touching pop order for admitted pods.  Two deliberate
+carve-outs: REQUEUES (a popped pod failing back through add_unschedulable,
+or an engine retry via ``add(requeue=True)``) always re-admit — holding
+them would strand an in-flight attempt behind its own tenant's newer
+arrivals; and GANG members always admit
+(``queue.quota_gang_bypass``) — holding part of a gang would park the rest
+at Permit burning the gang TTL.  Opt-in: the default (None) changes no
+behavior at all.
 """
 
 from __future__ import annotations
@@ -30,9 +45,10 @@ import heapq
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional, Set
+from typing import Any, Callable, Deque, Dict, List, Optional, Set
 
 from minisched_tpu.api.objects import gang_key
+from minisched_tpu.observability import counters
 from minisched_tpu.framework.events import (
     GVK,
     ClusterEvent,
@@ -54,8 +70,26 @@ class SchedulingQueue:
         max_backoff_s: float = DEFAULT_MAX_BACKOFF_S,
         unschedulable_timeout_s: float = DEFAULT_UNSCHEDULABLE_TIMEOUT_S,
         clock: Callable[[], float] = time.monotonic,
+        namespace_quota: Optional[Dict[str, int]] = None,
     ):
         self._cond = threading.Condition()
+        # per-namespace admission quota (see module docstring).  The map
+        # is namespace → cap; "*" is the default cap for namespaces not
+        # named.  None (default) disables the gate entirely.
+        self._quota_limits: Optional[Dict[str, int]] = (
+            dict(namespace_quota) if namespace_quota else None
+        )
+        self._ns_admitted: Dict[str, int] = {}
+        self._quota_held: Dict[str, Deque] = {}  # ns → FIFO of held pods
+        self._held_uids: Set[str] = set()
+        # while a pop_batch gather is open, EVERY promotion defers here
+        # (not just the batch's own pops): a delete_many landing in the
+        # gather's cond-wait window would otherwise promote straight
+        # into the activeQ the drain loop is consuming — held pods in
+        # the very wave whose cap they were held for.  None = no gather
+        # open, promotions run inline.  Single-consumer queues make this
+        # safe: only pop_batch opens/seals it.
+        self._deferred_promos: Optional[List[str]] = None
         self._active: Deque[QueuedPodInfo] = deque()
         # heap of (ready_time, seq, QueuedPodInfo)
         self._backoff: List[tuple] = []
@@ -148,19 +182,124 @@ class SchedulingQueue:
         # earliest backoff expiry, which this push may have just moved up
         self._cond.notify_all()
 
-    # -- producer side -----------------------------------------------------
-    def _add_locked(self, pod) -> None:
-        """Caller holds self._cond and notifies afterwards."""
+    # -- namespace quota admission (see module docstring) ------------------
+    def _quota_limit(self, ns: str) -> Optional[int]:
+        if self._quota_limits is None:
+            return None
+        return self._quota_limits.get(ns, self._quota_limits.get("*"))
+
+    def _track_locked(self, pod) -> None:
+        """uid enters queue tracking: count it against its namespace."""
+        self._queued_uids.add(self._uid(pod))
+        if self._quota_limits is not None:
+            ns = pod.metadata.namespace
+            self._ns_admitted[ns] = self._ns_admitted.get(ns, 0) + 1
+
+    def _untrack_locked(self, pod, promote: bool = True) -> Optional[str]:
+        """uid leaves tracking (popped for a wave, or deleted): release
+        its namespace's quota slot and promote held arrivals into it.
+        ``promote=False`` defers the promotion (callers iterating the
+        activeQ must not have it appended to under them) and returns the
+        released namespace for a later _promote_held_locked."""
         uid = self._uid(pod)
-        if uid in self._queued_uids:
+        if uid not in self._queued_uids:
+            return None
+        self._queued_uids.discard(uid)
+        if self._quota_limits is None:
+            return None
+        ns = pod.metadata.namespace
+        n = self._ns_admitted.get(ns, 0) - 1
+        if n > 0:
+            self._ns_admitted[ns] = n
+        else:
+            self._ns_admitted.pop(ns, None)
+        if promote:
+            self._promote_held_locked(ns)
+            return None
+        return ns
+
+    def _promote_held_locked(self, ns: str) -> None:
+        """FIFO-admit held pods of ``ns`` into freed quota slots."""
+        if self._deferred_promos is not None:
+            # a pop_batch gather is open: promote at its seal (see
+            # _deferred_promos) so no held pod rides the current wave
+            self._deferred_promos.append(ns)
             return
-        self._queued_uids.add(uid)
+        held = self._quota_held.get(ns)
+        if not held:
+            return
+        limit = self._quota_limit(ns)
+        promoted = False
+        while held and (
+            limit is None or self._ns_admitted.get(ns, 0) < limit
+        ):
+            pod = held.popleft()
+            self._held_uids.discard(self._uid(pod))
+            self._track_locked(pod)
+            if (
+                limit is not None
+                and self._ns_admitted.get(ns, 0) > limit
+            ):
+                # can't happen by construction (the loop guard admits
+                # strictly under the cap) — a nonzero count here is a
+                # quota-accounting BUG, and the churn bench gates on it
+                counters.inc("queue.quota_violation")
+            self._active.append(QueuedPodInfo(PodInfo(pod)))
+            counters.inc("queue.quota_admitted")
+            promoted = True
+        if not held:
+            self._quota_held.pop(ns, None)
+        if promoted:
+            self._cond.notify_all()
+
+    # -- producer side -----------------------------------------------------
+    def _add_locked(self, pod, requeue: bool = False) -> None:
+        """Caller holds self._cond and notifies afterwards.  ``requeue``
+        marks a pod an ENGINE is putting back (re-arbitration reject,
+        expired assume lease, gang-TTL release): it re-admits past any
+        quota cap — the hold gates NEW arrivals only (module docstring);
+        holding an in-flight retry behind its own tenant's newer
+        arrivals could defer it indefinitely while admitted pods pin
+        the cap."""
+        uid = self._uid(pod)
+        if uid in self._queued_uids or uid in self._held_uids:
+            return
+        if self._quota_limits is not None and not requeue:
+            ns = pod.metadata.namespace
+            limit = self._quota_limit(ns)
+            if limit is not None and self._ns_admitted.get(ns, 0) >= limit:
+                if gang_key(pod) is not None:
+                    # all-or-nothing gangs never split across the quota
+                    # boundary: holding part of one parks the rest at
+                    # Permit burning the gang TTL (module docstring)
+                    counters.inc("queue.quota_gang_bypass")
+                else:
+                    self._quota_held.setdefault(ns, deque()).append(pod)
+                    self._held_uids.add(uid)
+                    counters.inc("queue.quota_held")
+                    return
+            self._track_locked(pod)
+            if (
+                limit is not None
+                and self._ns_admitted.get(ns, 0) > limit
+                and gang_key(pod) is None
+            ):
+                # tripwire, not a code path: a non-gang NEW arrival must
+                # never land past the cap (the hold above gates >= limit;
+                # only requeues and gang bypass may exceed).  The churn
+                # bench gates on this staying zero.
+                counters.inc("queue.quota_violation")
+            self._active.append(QueuedPodInfo(PodInfo(pod)))
+            return
+        self._track_locked(pod)
         self._active.append(QueuedPodInfo(PodInfo(pod)))
 
-    def add(self, pod) -> None:
-        """New pending pod → activeQ (queue.go:35-43)."""
+    def add(self, pod, requeue: bool = False) -> None:
+        """New pending pod → activeQ (queue.go:35-43).  ``requeue=True``
+        bypasses quota holds (see _add_locked) — engine retry paths pass
+        it; informer arrival paths never do."""
         with self._cond:
-            self._add_locked(pod)
+            self._add_locked(pod, requeue=requeue)
             self._cond.notify_all()
 
     def add_batch(self, pods) -> None:
@@ -205,15 +344,20 @@ class SchedulingQueue:
         overlapping event, helping or not — see _move_events)."""
         with self._cond:
             uid = self._uid(qpi.pod)
-            if uid in self._queued_uids:
+            if uid in self._queued_uids or uid in self._held_uids:
                 # upstream's IfNotPresent: the pod is already in some
                 # queue segment — a second routing (e.g. a failed scan
                 # lane re-parking a chunk loser it already error_func'd)
                 # must not insert a duplicate entry that would be popped
-                # and scheduled twice
+                # and scheduled twice.  The held FIFO counts as presence
+                # too: tracking a second copy while one sits held would
+                # double-count the namespace at promotion and let the
+                # pod schedule twice.
                 return
             qpi.timestamp = self._clock()
-            self._queued_uids.add(uid)
+            # requeues re-admit unconditionally (quota counts them; the
+            # hold only ever gates NEW arrivals — module docstring)
+            self._track_locked(qpi.pod)
             helped = any(
                 cycle >= qpi.scheduling_cycle
                 and (
@@ -241,6 +385,15 @@ class SchedulingQueue:
         through backoff gating).  Implements queue.go:109-112's panic."""
         with self._cond:
             uid = self._uid(new_pod)
+            if uid in self._held_uids:
+                # quota-held arrivals track object refreshes too (they
+                # re-enter the active queue with whatever spec is current)
+                held = self._quota_held.get(new_pod.metadata.namespace)
+                if held is not None:
+                    for i, p in enumerate(held):
+                        if self._uid(p) == uid:
+                            held[i] = new_pod
+                            return
             for qpi in self._active:
                 if self._uid(qpi.pod) == uid:
                     qpi.pod_info.pod = new_pod
@@ -274,7 +427,26 @@ class SchedulingQueue:
         thousands), and per-event delete() would rescan the queue each
         time to remove nothing."""
         with self._cond:
-            uids = {self._uid(p) for p in pods} & self._queued_uids
+            all_uids = {self._uid(p) for p in pods}
+            held_hits = all_uids & self._held_uids
+            if held_hits:
+                # deleted while quota-held: drop from the hold FIFO too
+                for ns in {
+                    p.metadata.namespace
+                    for p in pods
+                    if self._uid(p) in held_hits
+                }:
+                    held = self._quota_held.get(ns)
+                    if held is not None:
+                        kept = deque(
+                            p for p in held if self._uid(p) not in held_hits
+                        )
+                        if kept:
+                            self._quota_held[ns] = kept
+                        else:
+                            self._quota_held.pop(ns, None)
+                self._held_uids -= held_hits
+            uids = all_uids & self._queued_uids
             if not uids:
                 return
             self._active = deque(
@@ -289,7 +461,7 @@ class SchedulingQueue:
                     key = self._key(pod)
                     if self._unschedulable.pop(key, None) is not None:
                         self._unindex_unschedulable(key)
-            self._queued_uids -= uids
+                    self._untrack_locked(pod)
 
     # -- event-driven requeue ---------------------------------------------
     def note_move_request(self, event: Optional[ClusterEvent] = None) -> None:
@@ -391,11 +563,20 @@ class SchedulingQueue:
                     self._push_active(qpi)
 
     # -- consumer side -----------------------------------------------------
-    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+    def pop(
+        self,
+        timeout: Optional[float] = None,
+        _released: Optional[List[str]] = None,
+    ) -> Optional[QueuedPodInfo]:
         """Blocking NextPod (replaces the busy-spin at queue.go:86-91).
 
         Increments ``attempts`` on the way out, as upstream does when a pod
         leaves the queue for a scheduling attempt.
+
+        ``_released`` (internal, pop_batch): collect the freed quota
+        namespace instead of promoting held pods inline — a promotion
+        here would land at the activeQ tail and be drained into the SAME
+        wave, defeating the per-wave tenant share the quota promises.
         """
         # NOTE: the wait deadline is wall-clock (condition waits are real
         # time) even when a fake clock drives backoff math in tests.
@@ -425,7 +606,9 @@ class SchedulingQueue:
             qpi.attempts += 1
             self._scheduling_cycle += 1
             qpi.scheduling_cycle = self._scheduling_cycle
-            self._queued_uids.discard(self._uid(qpi.pod))
+            ns = self._untrack_locked(qpi.pod, promote=_released is None)
+            if ns is not None and _released is not None:
+                _released.append(ns)
             return qpi
 
     #: pop_batch holds the wave boundary while an event storm that just
@@ -458,8 +641,43 @@ class SchedulingQueue:
         (queue.go:218-235 semantics) before a second wave.  While same-GVK
         events are still arriving (see move_all_to_active_or_backoff), the
         wave boundary holds until STORM_DEBOUNCE_S passes without one,
-        capped at STORM_MAX_GATHER_S."""
-        first = self.pop(timeout)
+        capped at STORM_MAX_GATHER_S.
+
+        Quota promotions are DEFERRED to the end of the batch: every pop
+        here frees a quota slot, and an inline promotion would append the
+        held pod to the activeQ this very loop is draining — the whole
+        hold FIFO would cascade into one wave.  Collecting the freed
+        namespaces and promoting once the batch is sealed keeps a
+        tenant's share of any single wave at its cap (gang bypass
+        aside); the promoted pods lead the NEXT wave."""
+        released: List[str] = []
+        with self._cond:
+            # open the gather: promotions from ANY thread (a delete_many
+            # on the dispatch thread included) defer to the seal below —
+            # a promotion landing mid-gather would ride this very wave
+            self._deferred_promos = []
+        try:
+            batch = self._pop_batch_gather(
+                max_pods, timeout, gather_backoff_s, released
+            )
+        finally:
+            with self._cond:
+                pending = self._deferred_promos or []
+                self._deferred_promos = None
+                for ns in dict.fromkeys(pending + released):
+                    self._promote_held_locked(ns)
+        if batch:
+            _sort_gangs_adjacent(batch)
+        return batch
+
+    def _pop_batch_gather(
+        self,
+        max_pods: int,
+        timeout: Optional[float],
+        gather_backoff_s: float,
+        released: List[str],
+    ) -> List[QueuedPodInfo]:
+        first = self.pop(timeout, _released=released)
         if first is None:
             return []
         batch = [first]
@@ -471,7 +689,9 @@ class SchedulingQueue:
                     qpi.attempts += 1
                     self._scheduling_cycle += 1
                     qpi.scheduling_cycle = self._scheduling_cycle
-                    self._queued_uids.discard(self._uid(qpi.pod))
+                    ns = self._untrack_locked(qpi.pod, promote=False)
+                    if ns is not None:
+                        released.append(ns)
                     batch.append(qpi)
                 if len(batch) >= max_pods:
                     break
@@ -500,11 +720,14 @@ class SchedulingQueue:
                 # releases the lock; producers/events can land meanwhile
                 self._cond.wait(wait + 0.001)
                 self.flush_backoff_completed_locked()
-            self._complete_gangs_locked(batch)
-        _sort_gangs_adjacent(batch)
+            self._complete_gangs_locked(batch, released)
+        # promotions happen at the caller's seal (pop_batch's finally):
+        # the admitted pods then lead the NEXT wave
         return batch
 
-    def _complete_gangs_locked(self, batch: List[QueuedPodInfo]) -> None:
+    def _complete_gangs_locked(
+        self, batch: List[QueuedPodInfo], released: List[str]
+    ) -> None:
         """Pull every still-queued member of a gang already in ``batch``
         out of the activeQ and into the batch — even past ``max_pods``:
         one wave must see the WHOLE gang, or its tail waits a full wave
@@ -521,7 +744,11 @@ class SchedulingQueue:
                 qpi.attempts += 1
                 self._scheduling_cycle += 1
                 qpi.scheduling_cycle = self._scheduling_cycle
-                self._queued_uids.discard(self._uid(qpi.pod))
+                # promotion deferred to pop_batch's seal (and because it
+                # would append to the activeQ this loop is iterating)
+                ns = self._untrack_locked(qpi.pod, promote=False)
+                if ns is not None:
+                    released.append(ns)
                 batch.append(qpi)
             else:
                 kept.append(qpi)
@@ -542,10 +769,36 @@ class SchedulingQueue:
     # -- introspection (tests / observability) -----------------------------
     def stats(self) -> Dict[str, int]:
         with self._cond:
-            return {
+            out = {
                 "active": len(self._active),
                 "backoff": len(self._backoff),
                 "unschedulable": len(self._unschedulable),
+            }
+            if self._quota_limits is not None:
+                out["quota_held"] = sum(
+                    len(d) for d in self._quota_held.values()
+                )
+            return out
+
+    def quota_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-namespace {admitted, held, limit} under one lock hold —
+        the churn bench samples this to audit that no tenant ever
+        exceeds its cap (gang bypass aside, which has its own counter)."""
+        with self._cond:
+            if self._quota_limits is None:
+                return {}
+            spaces = (
+                set(self._ns_admitted)
+                | set(self._quota_held)
+                | {k for k in self._quota_limits if k != "*"}
+            )
+            return {
+                ns: {
+                    "admitted": self._ns_admitted.get(ns, 0),
+                    "held": len(self._quota_held.get(ns, ())),
+                    "limit": self._quota_limit(ns),
+                }
+                for ns in spaces
             }
 
     def pending_unschedulable(self) -> List[QueuedPodInfo]:

@@ -327,7 +327,7 @@ def test_cache_dirty_tracking():
     cache = SchedulerCache()
     cache.add_node(make_node("a"))
     cache.add_node(make_node("b"))
-    infos, _assigned, dirty = cache.snapshot_for_tables()
+    infos, _assigned, dirty, _epoch = cache.snapshot_for_tables()
     assert dirty is None  # first drain: everything
     p = make_pod("p1", requests={"cpu": "1"})
     p.metadata.uid = "u1"
@@ -335,13 +335,13 @@ def test_cache_dirty_tracking():
     cache.add_pod(p)
     # a plain snapshot must NOT drain
     cache.snapshot_with_assigned()
-    _, _, dirty = cache.snapshot_for_tables()
+    _, _, dirty, _ = cache.snapshot_for_tables()
     assert dirty == {"a"}
-    _, _, dirty = cache.snapshot_for_tables()
+    _, _, dirty, _ = cache.snapshot_for_tables()
     assert dirty == set()
     cache.delete_pod(p)
-    _, _, dirty = cache.snapshot_for_tables()
+    _, _, dirty, _ = cache.snapshot_for_tables()
     assert dirty == {"a"}
     cache.add_node(make_node("c"))  # membership: full rebuild again
-    _, _, dirty = cache.snapshot_for_tables()
+    _, _, dirty, _ = cache.snapshot_for_tables()
     assert dirty is None
