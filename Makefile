@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn chaos chaos-proc chaos-ha chaos-disk docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire chaos chaos-proc chaos-ha chaos-disk docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -54,6 +54,19 @@ bench-gang: native
 # (double-bind / node overcommit / assume-ledger leak)
 bench-churn: native
 	JAX_PLATFORMS=cpu MINISCHED_PIPELINE=1 python bench.py --only churn
+
+# wire-scale watch fanout (ISSUE 9): ≥1000 concurrent REAL HTTP watch
+# streams through the selector stream loop with a mutating store behind
+# them and deliberately-wedged slow watchers.  FAILS when server thread
+# count scales with watcher count (thread-per-watcher regressed), on
+# per-watcher (unshared) event encoding, when no slow watcher gets
+# evicted, on any missed/duplicated event across an eviction's
+# resume/410→relist reconnect, or on p99 delivery latency past
+# BENCH_WIRE_P99_S.  Scale with BENCH_WIRE_WATCHERS / _EVENTS_PER_S /
+# _WINDOW_S; MINISCHED_STREAMLOOP=0 skips (kill-switch restores the
+# thread-per-watcher path)
+bench-wire: native
+	JAX_PLATFORMS=cpu python bench.py --only wirefan
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

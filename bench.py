@@ -35,6 +35,18 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _pct(samples, p: float, digits: int = 3) -> float:
+    """Nearest-rank percentile over SORTED samples — the one definition
+    both latency-headline roles (churn time-to-bind, wirefan delivery)
+    gate on.  ceil(p·n)−1, NOT int(p·n): the latter is one rank high
+    and makes a small-sample p99 gate on the MAXIMUM, failing a run on
+    a single straggler."""
+    import math
+
+    idx = min(max(math.ceil(p * len(samples)) - 1, 0), len(samples) - 1)
+    return round(samples[idx], digits)
+
+
 def bench_skip(reason: str) -> None:
     """Abort THIS role as 'skipped' rather than failed: the child prints
     a ``{"skipped": reason}`` record and exits 0, so the merged artifact
@@ -1313,6 +1325,9 @@ def bench_wire() -> dict:
             f"→ {n_pods/elapsed:,.0f} pods/s e2e (informers + binds on "
             f"the wire)"
         )
+        from minisched_tpu.observability import counters as _counters
+
+        csnap = _counters.snapshot()
         return {
             "pods_per_sec_e2e": round(n_pods / elapsed, 1),
             "total_s": round(elapsed, 1),
@@ -1320,8 +1335,436 @@ def bench_wire() -> dict:
             "pods": n_pods,
             "crosspod_pods": n_crosspod,
             "setup_s": round(setup_dt, 1),
+            # pooled keep-alive transport evidence (ISSUE 9): reuses must
+            # dwarf opens once the pool is warm, and stale reopens stay
+            # incidental
+            "wire_counters": {
+                k: v for k, v in csnap.items()
+                if k.startswith("wire.") or k == "watch.disconnects"
+            },
         }
     finally:
+        shutdown()
+
+
+class _WireWatcher:
+    """Client half of one raw HTTP watch stream for the wire-fanout
+    bench: incremental header + chunked-transfer + JSON-line parsing
+    with an O(1) rv extractor (full json.loads per delivery would make
+    the CLIENT the bottleneck at 1k watchers on one core)."""
+
+    __slots__ = (
+        "sock", "idx", "slow", "buf", "payload", "headers_done", "synced",
+        "start_rv", "rvs", "eof", "reading", "resumed_from",
+    )
+
+    def __init__(self, sock, idx: int, slow: bool, resumed_from=None):
+        self.sock = sock
+        self.idx = idx
+        self.slow = slow
+        self.buf = bytearray()
+        self.payload = bytearray()
+        self.headers_done = False
+        self.synced = False
+        self.start_rv = 0
+        self.rvs: list = []
+        self.eof = False
+        self.reading = True
+        #: rv this stream resumed from (None = original stream)
+        self.resumed_from = resumed_from
+
+    @staticmethod
+    def _line_rv(line: bytes) -> int:
+        # every event line ends ... "rv": N}\n — "rv" is the last key by
+        # construction (httpserver SYNC + event_wire_chunk)
+        return int(line[line.rfind(b":") + 1:line.rfind(b"}")])
+
+    def feed(self, data: bytes, now: float, on_event) -> None:
+        self.buf += data
+        if not self.headers_done:
+            end = self.buf.find(b"\r\n\r\n")
+            if end < 0:
+                return
+            head = bytes(self.buf[:end])
+            status = head.split(b"\r\n", 1)[0]
+            if b"200" not in status:
+                # surfaced by the establishment/drain gates (a raise here
+                # would only kill the reader thread silently)
+                log(f"[wirefan] watcher {self.idx}: bad status {status!r}")
+                self.eof = True
+                return
+            del self.buf[: end + 4]
+            self.headers_done = True
+        # de-chunk
+        while True:
+            nl = self.buf.find(b"\r\n")
+            if nl < 0:
+                break
+            size = int(bytes(self.buf[:nl]), 16)
+            if size == 0:
+                self.eof = True
+                break
+            if len(self.buf) < nl + 2 + size + 2:
+                break
+            self.payload += self.buf[nl + 2 : nl + 2 + size]
+            del self.buf[: nl + 2 + size + 2]
+        # JSON lines (keepalive = blank)
+        while True:
+            nl = self.payload.find(b"\n")
+            if nl < 0:
+                break
+            line = bytes(self.payload[:nl]).strip()
+            del self.payload[: nl + 1]
+            if not line:
+                continue
+            if not self.synced:
+                # first line is the SYNC marker: its rv is the resume
+                # cursor should we be evicted before any event lands
+                self.synced = True
+                self.start_rv = self._line_rv(line)
+                continue
+            self.rvs.append(self._line_rv(line))
+            on_event(self, now)
+
+    def last_rv(self) -> int:
+        return self.rvs[-1] if self.rvs else self.start_rv
+
+
+def bench_wire_fanout() -> dict:
+    """``make bench-wire``: the 1k-watcher wire regime (ISSUE 9, ROADMAP
+    churn follow-up 3) — ≥1000 concurrent REAL HTTP watch streams served
+    by the selector stream loop while the store mutates behind them, with
+    deliberately-wedged slow watchers driving the wire-level eviction +
+    resume path.  Headline: **p99 event-delivery latency** (store commit
+    → parsed on a live client stream).  FAILS on:
+
+    * server thread count above ``watchers × BENCH_WIRE_THREAD_FRAC``
+      (thread-per-watcher would be ~1000; the loop keeps it ~flat);
+    * per-watcher encoding (``watch.fanout.encoded`` not ≪ ``shared``);
+    * ZERO evictions (the laggard path never exercised), or an evicted
+      watcher that misses or duplicates an event across its
+      resume/410→relist reconnect;
+    * any live watcher missing any event at drain;
+    * p99 delivery latency beyond ``BENCH_WIRE_P99_S``.
+    """
+    import selectors
+    import socket
+    import threading
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.store import ObjectStore
+    from minisched_tpu.observability import counters
+
+    if os.environ.get("MINISCHED_STREAMLOOP", "1") == "0":
+        bench_skip("MINISCHED_STREAMLOOP=0: stream loop disabled by env")
+
+    n_watchers = int(os.environ.get("BENCH_WIRE_WATCHERS", "1000"))
+    n_slow = min(int(os.environ.get("BENCH_WIRE_SLOW", "10")), n_watchers)
+    rate = float(os.environ.get("BENCH_WIRE_EVENTS_PER_S", "25"))
+    window_s = float(os.environ.get("BENCH_WIRE_WINDOW_S", "8"))
+    pad_bytes = int(os.environ.get("BENCH_WIRE_PAD", "1024"))
+    outbuf = int(os.environ.get("BENCH_WIRE_OUTBUF", str(64 * 1024)))
+    sndbuf = int(os.environ.get("BENCH_WIRE_SNDBUF", str(32 * 1024)))
+    p99_gate_s = float(os.environ.get("BENCH_WIRE_P99_S", "5.0"))
+    thread_frac = float(os.environ.get("BENCH_WIRE_THREAD_FRAC", "0.1"))
+    drain_s = float(os.environ.get("BENCH_WIRE_DRAIN_S", "120"))
+    slow_read_events = 3  # a slow watcher parses this many, then wedges
+
+    counters.reset()
+    store = ObjectStore()
+    server, base, shutdown = start_api_server(
+        store, stream_buffer_bytes=outbuf, stream_sndbuf_bytes=sndbuf
+    )
+    host, port = base.split("//")[1].split(":")
+    port = int(port)
+
+    sel = selectors.DefaultSelector()
+    stop = threading.Event()
+    t_send: dict = {}  # rv → pre-commit stamp (see the window loop)
+    # raw (rv, parse stamp) pairs from LIVE original consumers — slow/
+    # resumed streams would pollute p99 with their own wedge time.
+    # Latencies resolve AFTER the run: a delivery can beat the bench
+    # thread's own return from store.create, so a live t_send lookup
+    # here would silently drop exactly the fastest samples.
+    recv_log: list = []
+    watchers: list = []
+    drain_mode = threading.Event()
+
+    def on_event(w: _WireWatcher, now: float) -> None:
+        if not w.slow and w.resumed_from is None:
+            recv_log.append((w.rvs[-1], now))
+        if (
+            w.slow
+            and not drain_mode.is_set()
+            and len(w.rvs) >= slow_read_events
+            and w.reading
+        ):
+            # wedge: stop consuming entirely — the server's out-buffer
+            # bound must eventually evict us
+            w.reading = False
+            sel.unregister(w.sock)
+
+    def connect_watcher(
+        idx: int, slow: bool, resume_rv=None
+    ) -> _WireWatcher:
+        s = None
+        for attempt in range(20):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            if slow:
+                # tiny receive window: the kernel can't absorb the
+                # backlog for us, so the server-side out-buffer fills
+                # honestly
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            try:
+                s.connect((host, port))
+                break
+            except OSError:
+                s.close()
+                s = None
+                time.sleep(0.05)  # accept backlog burst: retry
+        if s is None:
+            raise SystemExit(f"[wirefan] watcher {idx} could not connect")
+        path = "/api/v1/pods?watch=true"
+        if resume_rv is not None:
+            path += f"&resource_version={resume_rv}"
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        s.setblocking(False)
+        w = _WireWatcher(s, idx, slow, resumed_from=resume_rv)
+        sel.register(s, selectors.EVENT_READ, w)
+        return w
+
+    def client_loop() -> None:
+        while not stop.is_set():
+            for key, _mask in sel.select(0.2):
+                w: _WireWatcher = key.data
+                try:
+                    data = w.sock.recv(262144)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if not data:
+                    w.eof = True
+                    try:
+                        sel.unregister(w.sock)
+                    except (KeyError, ValueError):
+                        pass
+                    continue
+                w.feed(data, time.monotonic(), on_event)
+
+    reader = threading.Thread(target=client_loop, daemon=True)
+    reader.start()
+    t0 = time.monotonic()
+    try:
+        # -- establish the fleet -------------------------------------------
+        for i in range(n_watchers):
+            watchers.append(connect_watcher(i, slow=i < n_slow))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(w.synced for w in watchers):
+                break
+            time.sleep(0.05)
+        unsynced = sum(1 for w in watchers if not w.synced)
+        if unsynced:
+            raise SystemExit(
+                f"[wirefan] {unsynced}/{n_watchers} streams never SYNCed"
+            )
+        setup_s = time.monotonic() - t0
+        base_threads = threading.active_count()
+        log(
+            f"[wirefan] {n_watchers} live HTTP watch streams established "
+            f"in {setup_s:.1f}s ({base_threads} process threads)"
+        )
+
+        # -- mutation window ------------------------------------------------
+        pad = "w" * pad_bytes
+        all_rvs: list = []
+        enc0 = counters.get("watch.fanout.encoded")
+        shr0 = counters.get("watch.fanout.shared")
+        thread_peak = 0
+        tick = 1.0 / rate
+        t_window = time.monotonic()
+        i = 0
+        while time.monotonic() - t_window < window_s:
+            p = make_pod(f"ev{i:06d}", labels={"pad": pad})
+            # stamp BEFORE the commit: fanout runs inside store.create,
+            # so a post-return stamp would measure from after the
+            # earliest possible delivery and bias the headline low
+            t0_ev = time.monotonic()
+            created = store.create("Pod", p)
+            rv = created.metadata.resource_version
+            t_send[rv] = t0_ev
+            all_rvs.append(rv)
+            i += 1
+            thread_peak = max(thread_peak, threading.active_count())
+            time.sleep(tick)
+        n_events = len(all_rvs)
+        log(
+            f"[wirefan] window closed: {n_events} mutations over "
+            f"{window_s}s; thread peak {thread_peak}"
+        )
+
+        # -- thread-count gate ---------------------------------------------
+        thread_gate = max(int(n_watchers * thread_frac), 8)
+        if thread_peak > thread_gate:
+            raise SystemExit(
+                f"[wirefan] SERVER THREAD COUNT UNBOUNDED: {thread_peak} "
+                f"threads at {n_watchers} watchers (gate {thread_gate} — "
+                f"thread-per-watcher is back?)"
+            )
+
+        # -- drain: every live watcher must see every event ----------------
+        drain_mode.set()
+        deadline = time.monotonic() + drain_s
+        pending = [w for w in watchers if not w.slow]
+        while time.monotonic() < deadline:
+            if all(len(w.rvs) >= n_events for w in pending):
+                break
+            if any(w.eof for w in pending):
+                break
+            time.sleep(0.1)
+        incomplete = [
+            w.idx for w in pending if len(w.rvs) != n_events or w.eof
+        ]
+        if incomplete:
+            raise SystemExit(
+                f"[wirefan] {len(incomplete)} live watchers missed events "
+                f"(e.g. #{incomplete[:4]}: "
+                f"{[len(watchers[j].rvs) for j in incomplete[:4]]}/"
+                f"{n_events})"
+            )
+        # exactness (not just count): FIFO order, no gaps, no dups
+        for w in pending[:: max(len(pending) // 50, 1)]:
+            if w.rvs != all_rvs:
+                raise SystemExit(
+                    f"[wirefan] watcher {w.idx} event sequence DIVERGED"
+                )
+
+        # -- eviction + resume parity --------------------------------------
+        # wedged watchers: wait for the server to evict them (socket
+        # death), then resume each from its last parsed rv and require
+        # exactly-once across the seam
+        for w in watchers[:n_slow]:
+            if not w.reading:
+                sel.register(w.sock, selectors.EVENT_READ, w)
+                w.reading = True
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            slows = watchers[:n_slow]
+            if all(w.eof or len(w.rvs) >= n_events for w in slows):
+                break
+            time.sleep(0.1)
+        evictions = counters.get("wire.evicted_outbuf") + counters.get(
+            "watch.fanout.evicted_slow"
+        )
+        if evictions == 0:
+            raise SystemExit(
+                "[wirefan] NO EVICTION: the slow-watcher path was never "
+                "exercised (grow BENCH_WIRE_PAD / shrink BENCH_WIRE_OUTBUF)"
+            )
+        resumed_ok = 0
+        for w in watchers[:n_slow]:
+            if not w.eof and len(w.rvs) >= n_events:
+                if w.rvs != all_rvs:
+                    raise SystemExit(
+                        f"[wirefan] surviving slow watcher {w.idx} "
+                        f"sequence diverged"
+                    )
+                continue  # laggard survived (buffers absorbed it)
+            last = w.last_rv()
+            prefix = [rv for rv in all_rvs if rv <= last]
+            if w.rvs != prefix:
+                raise SystemExit(
+                    f"[wirefan] evicted watcher {w.idx} pre-eviction "
+                    f"sequence not a clean prefix"
+                )
+            w2 = connect_watcher(10_000 + w.idx, slow=False, resume_rv=last)
+            watchers.append(w2)  # cleanup in finally
+            expect = [rv for rv in all_rvs if rv > last]
+            deadline2 = time.monotonic() + drain_s
+            while (
+                len(w2.rvs) < len(expect)
+                and not w2.eof
+                and time.monotonic() < deadline2
+            ):
+                time.sleep(0.05)
+            if w2.rvs != expect:
+                raise SystemExit(
+                    f"[wirefan] RESUME PARITY BROKEN for watcher {w.idx}: "
+                    f"{len(w2.rvs)}/{len(expect)} after resume from "
+                    f"rv {last} (missed or duplicated events)"
+                )
+            resumed_ok += 1
+
+        # -- encode-once gate ----------------------------------------------
+        encoded = counters.get("watch.fanout.encoded") - enc0
+        shared = counters.get("watch.fanout.shared") - shr0
+        if encoded * 10 > shared:
+            raise SystemExit(
+                f"[wirefan] ENCODE-ONCE REGRESSED: {encoded} encodes vs "
+                f"{shared} shared reuses at {n_watchers} watchers"
+            )
+
+        # -- headline: p99 delivery latency --------------------------------
+        samples = sorted(
+            t_recv - t_send[rv]
+            for rv, t_recv in recv_log
+            if rv in t_send
+        )
+        if not samples:
+            raise SystemExit("[wirefan] no delivery-latency samples")
+        p50 = _pct(samples, 0.50, 4)
+        p95 = _pct(samples, 0.95, 4)
+        p99 = _pct(samples, 0.99, 4)
+        if p99 > p99_gate_s:
+            raise SystemExit(
+                f"[wirefan] P99 DELIVERY LATENCY REGRESSED: {p99}s > "
+                f"gate {p99_gate_s}s (p50 {p50}s, {len(samples)} samples)"
+            )
+        csnap = counters.snapshot()
+        log(
+            f"[wirefan] p99 delivery {p99}s (p50 {p50}s, p95 {p95}s) over "
+            f"{len(samples)} deliveries to {n_watchers} watchers; "
+            f"threads peak {thread_peak} (gate {thread_gate}); "
+            f"encoded {encoded} vs shared {shared}; evictions {evictions} "
+            f"({resumed_ok} resumed exactly-once)"
+        )
+        return {
+            "watchers": n_watchers,
+            "slow_watchers": n_slow,
+            "events": n_events,
+            "window_s": window_s,
+            "setup_s": round(setup_s, 1),
+            "delivery_p50_s": p50,
+            "delivery_p95_s": p95,
+            "delivery_p99_s": p99,
+            "delivery_gate_s": p99_gate_s,
+            "delivery_samples": len(samples),
+            "thread_peak": thread_peak,
+            "thread_gate": thread_gate,
+            "fanout_encoded": encoded,
+            "fanout_shared": shared,
+            "evictions": evictions,
+            "resumed_exactly_once": resumed_ok,
+            "total_s": round(time.monotonic() - t0, 1),
+            "wire_counters": {
+                k: v for k, v in csnap.items()
+                if k.startswith("wire.") or k.startswith("watch.")
+            },
+        }
+    finally:
+        stop.set()
+        reader.join(timeout=5.0)
+        for w in watchers:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+        try:
+            sel.close()
+        except Exception:
+            pass
         shutdown()
 
 
@@ -2876,10 +3319,7 @@ def bench_churn() -> dict:
     if not ttbs:
         raise SystemExit("[churn] no time-to-bind samples recorded")
 
-    def pct(p: float) -> float:
-        return round(ttbs[min(int(len(ttbs) * p), len(ttbs) - 1)], 3)
-
-    p50, p95, p99 = pct(0.50), pct(0.95), pct(0.99)
+    p50, p95, p99 = _pct(ttbs, 0.50), _pct(ttbs, 0.95), _pct(ttbs, 0.99)
     if p99 > p99_gate_s:
         raise SystemExit(
             f"[churn] P99 TIME-TO-BIND REGRESSED: {p99}s > gate "
@@ -2912,6 +3352,8 @@ def bench_churn() -> dict:
         "pipelined_waves": counters.get("wave_pipeline.waves"),
         "max_watcher_staleness_rv": max_staleness_rv,
         "watch_evictions": csnap.get("watch.fanout.evicted_slow", 0),
+        "fanout_encoded": csnap.get("watch.fanout.encoded", 0),
+        "fanout_shared": csnap.get("watch.fanout.shared", 0),
         "preempt_shielded": csnap.get("gang.preempt_shielded", 0),
         "quota_peaks": dict(quota_peak),
         "quota_held_total": csnap.get("queue.quota_held", 0),
@@ -2934,6 +3376,7 @@ ROLES = {
     "c5": bench_config5_fullchain,
     "fullchain_parity": bench_fullchain_parity,
     "wire": bench_wire,
+    "wirefan": bench_wire_fanout,
     "wave": bench_wave_pipeline,
     "mesh": bench_mesh,
     "chaos": bench_chaos,
@@ -3070,6 +3513,10 @@ def main() -> None:
                 "wire-crosspod",
             )
         )
+        # 1k-watcher wire fanout (ISSUE 9): selector stream loop at real
+        # HTTP scale — thread-count / encode-once / eviction-resume
+        # gates + the p99 delivery-latency headline
+        optional.append(("wire_fanout", "wirefan", None, "wirefan"))
     if os.environ.get("BENCH_CHAOS", "1") != "0":
         # degraded-mode soak: convergence + leak/double-bind audits under
         # a seeded fault schedule (BENCH_CHAOS_SEED reproduces it)

@@ -27,9 +27,9 @@ facade as the in-process Client, over the wire.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
-import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional, Tuple
@@ -144,6 +144,43 @@ def event_wire_chunk(ev: Any) -> bytes:
     return wire
 
 
+class _WatchHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can DETACH a request socket: a watch
+    handler hands its connection to the selector stream loop (ISSUE 9)
+    and returns, so ``shutdown_request`` must skip sockets the loop now
+    owns — the default would send FIN and close the stream under it."""
+
+    #: socketserver's default listen backlog is 5: a 1k-watcher connect
+    #: burst overflows it, the kernel drops SYNs, and every affected
+    #: client pays a ≥1s retransmission before the accept loop (which
+    #: drains fine) ever sees it — measured 150ms MEAN establishment at
+    #: 120 serial connects.  A plane built for thousands of watchers
+    #: queues the burst instead.
+    request_queue_size = 1024
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._detach_lock = threading.Lock()
+        self._detached: set = set()
+
+    def detach_socket(self, sock) -> None:
+        with self._detach_lock:
+            self._detached.add(sock)
+
+    def undetach_socket(self, sock) -> None:
+        """Give a socket back to normal teardown (adopt raced a loop
+        shutdown)."""
+        with self._detach_lock:
+            self._detached.discard(sock)
+
+    def shutdown_request(self, request) -> None:
+        with self._detach_lock:
+            if request in self._detached:
+                self._detached.discard(request)
+                return  # the stream loop owns this socket now
+        super().shutdown_request(request)
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: ObjectStore = None  # set by start_api_server
     active_watches = None  # set by start_api_server (set + lock)
@@ -152,6 +189,9 @@ class _Handler(BaseHTTPRequestHandler):
     ack_registry = None  # set by start_api_server: ack id → response entry
     ack_order = None  # FIFO of ack ids for eviction
     ack_lock = None
+    #: streamloop.StreamLoop when the selector fanout path is on (set by
+    #: start_api_server; None = thread-per-watcher, the exact old path)
+    stream_loop = None
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:  # quiet
@@ -287,32 +327,80 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(_chunk_frame(data))
             self.wfile.flush()
 
+        # first line: how many snapshot events this stream will replay
+        # (ns-filtered), taken ATOMICALLY with the watch registration —
+        # a client-side LIST-then-watch can't get this count right (a
+        # delete in the gap strands its sync barrier forever).  A
+        # resumed stream replays history, not the snapshot: count 0.
+        n_initial = sum(
+            1
+            for o in snapshot
+            if not ns or o.metadata.namespace == ns
+        )
+        sync_line = (
+            json.dumps(
+                {
+                    "type": "SYNC",
+                    "count": n_initial,
+                    # the rv this stream's snapshot reflects, taken
+                    # atomically with the watch registration — the
+                    # consumer's resume cursor once it has consumed
+                    # the snapshot (a max over object rvs under-counts
+                    # deletes and replays already-folded history)
+                    "rv": watch.start_rv,
+                }
+            ).encode()
+            + b"\n"
+        )
+        loop = self.stream_loop
+        if loop is not None:
+            # selector fanout path (ISSUE 9): handshake + snapshot/resume
+            # replay on THIS thread (blocking writes are right for a
+            # possibly-huge backlog), then DETACH the socket into the
+            # one-thread stream loop and return this thread to the pool.
+            # Wire bytes are identical to the thread path below.
+            handed_off = False
+            try:
+                chunk(sync_line)
+                for ev in watch.next_batch(timeout=0):
+                    if ns and ev.obj.metadata.namespace != ns:
+                        continue
+                    self.wfile.write(event_wire_chunk(ev))
+                self.wfile.flush()
+                handed_off = True
+            except OSError:
+                from minisched_tpu.observability import counters
+
+                counters.inc("watch.disconnects")
+            finally:
+                # like the thread path's finally: ANY failure before the
+                # handoff (client hangup is the common OSError; anything
+                # else propagates to the handler's logging) must not
+                # leave a consumer-less registration for fanout to feed
+                if not handed_off:
+                    self.close_connection = True
+                    watch.stop()
+                    with self.watch_lock:
+                        self.active_watches.discard(watch)
+            if not handed_off:
+                return
+            self.close_connection = True
+            with self.watch_lock:
+                # the loop owns the lifecycle now; shutdown reaches this
+                # watch through StreamLoop.stop, not active_watches
+                self.active_watches.discard(watch)
+            sock = self.connection
+            self.server.detach_socket(sock)
+            try:
+                loop.adopt(sock, watch, ns)
+            except RuntimeError:
+                # adopt raced a loop shutdown: give the socket back to
+                # the server's normal teardown
+                self.server.undetach_socket(sock)
+                watch.stop()
+            return
         try:
-            # first line: how many snapshot events this stream will replay
-            # (ns-filtered), taken ATOMICALLY with the watch registration —
-            # a client-side LIST-then-watch can't get this count right (a
-            # delete in the gap strands its sync barrier forever).  A
-            # resumed stream replays history, not the snapshot: count 0.
-            n_initial = sum(
-                1
-                for o in snapshot
-                if not ns or o.metadata.namespace == ns
-            )
-            chunk(
-                json.dumps(
-                    {
-                        "type": "SYNC",
-                        "count": n_initial,
-                        # the rv this stream's snapshot reflects, taken
-                        # atomically with the watch registration — the
-                        # consumer's resume cursor once it has consumed
-                        # the snapshot (a max over object rvs under-counts
-                        # deletes and replays already-folded history)
-                        "rv": watch.start_rv,
-                    }
-                ).encode()
-                + b"\n"
-            )
+            chunk(sync_line)
             while True:
                 ev = watch.next(timeout=0.5)
                 if ev is None:
@@ -370,7 +458,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 self._send(201, _encode(pod))
             except AlreadyBound as e:
-                self._error(409, str(e))
+                self._send(
+                    409,
+                    self._already_bound_entry(e, ns or "default", name),
+                )
             except (Conflict, OutOfCapacity) as e:
                 self._error(409, str(e))
             except StorageDegraded as e:
@@ -408,6 +499,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(507, str(e))
         except KeyError as e:
             self._error(409, str(e))
+
+    def _already_bound_entry(
+        self, err: BaseException, namespace: str, name: str
+    ) -> dict:
+        """409 AlreadyBound body with the CURRENT bound node as a
+        structured field — the ONE builder for the single-bind and
+        batch-bind responses: the client's idempotent-retry dedup
+        compares ``node`` to the node it asked for, and string-matching
+        the prose message would couple the wire contract to an
+        f-string."""
+        entry = {"error": str(err), "type": "AlreadyBound"}
+        try:
+            entry["node"] = self.store.get(
+                "Pod", namespace, name
+            ).spec.node_name
+        except Exception:
+            pass  # pod vanished between bind and lookup
+        return entry
 
     def _create_many(
         self, kind: str, ns: str, items: list, return_objects: bool = True
@@ -515,17 +624,9 @@ class _Handler(BaseHTTPRequestHandler):
         for i, res in zip(todo, results):
             b = bindings[i]
             if isinstance(res, AlreadyBound):
-                # carry the CURRENT bound node as a structured field: the
-                # remote client's idempotent-retry dedup compares it to
-                # the node it asked for — string-matching the prose
-                # message would couple the wire contract to an f-string
-                entry = {"error": str(res), "type": "AlreadyBound"}
-                try:
-                    entry["node"] = self.store.get(
-                        "Pod", b.pod_namespace, b.pod_name
-                    ).spec.node_name
-                except Exception:
-                    pass  # pod vanished between bind and lookup
+                entry = self._already_bound_entry(
+                    res, b.pod_namespace, b.pod_name
+                )
             elif isinstance(res, Conflict):
                 entry = {"error": str(res), "type": "Conflict"}
             elif isinstance(res, OutOfCapacity):
@@ -642,16 +743,39 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def start_api_server(
-    store: Optional[ObjectStore] = None, port: int = 0, faults: Any = None
+    store: Optional[ObjectStore] = None,
+    port: int = 0,
+    faults: Any = None,
+    stream_buffer_bytes: Optional[int] = None,
+    stream_sndbuf_bytes: Optional[int] = None,
 ) -> Tuple[ThreadingHTTPServer, str, Callable[[], None]]:
     """Boot the REST façade on an ephemeral port and poll /healthz until it
     answers (k8sapiserver.go:231-249's readiness loop).  Returns
     (server, base_url, shutdown_fn).  ``faults``: a faults.FaultFabric
     armed with http.500 / http.reset makes this server lossy on purpose
-    (see _Handler._inject_fault)."""
+    (see _Handler._inject_fault).
+
+    Watch streams detach into a selector stream loop (ISSUE 9): N
+    watchers cost N sockets + ONE thread instead of N handler threads.
+    ``MINISCHED_STREAMLOOP=0`` kills the switch and restores the
+    thread-per-watcher path exactly.  ``stream_buffer_bytes`` overrides
+    the loop's per-stream out-buffer eviction bound (benches shrink it
+    to exercise the wire-level laggard path)."""
     store = store or ObjectStore()
     from collections import deque as _deque
 
+    stream_loop = None
+    if os.environ.get("MINISCHED_STREAMLOOP", "1") != "0":
+        from minisched_tpu.controlplane.streamloop import (
+            DEFAULT_MAX_BUFFER_BYTES,
+            DEFAULT_STREAM_SNDBUF_BYTES,
+            StreamLoop,
+        )
+
+        stream_loop = StreamLoop(
+            max_buffer_bytes=stream_buffer_bytes or DEFAULT_MAX_BUFFER_BYTES,
+            sndbuf_bytes=stream_sndbuf_bytes or DEFAULT_STREAM_SNDBUF_BYTES,
+        )
     # seed the binding-ack registry from WAL ``ack`` records (durable
     # stores replay them): a batch retried across a server RESTART then
     # answers from the recovered outcomes instead of re-executing —
@@ -664,9 +788,9 @@ def start_api_server(
         {"store": store, "active_watches": set(),
          "watch_lock": threading.Lock(), "faults": faults,
          "ack_registry": acks, "ack_order": _deque(acks),
-         "ack_lock": threading.Lock()},
+         "ack_lock": threading.Lock(), "stream_loop": stream_loop},
     )
-    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    server = _WatchHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     base = f"http://127.0.0.1:{server.server_address[1]}"
@@ -684,11 +808,15 @@ def start_api_server(
 
     def shutdown() -> None:
         # stop active watch streams first: their handler threads would
-        # otherwise loop (and hold store watch registrations) forever
+        # otherwise loop (and hold store watch registrations) forever.
+        # Detached streams are the loop's: StreamLoop.stop ends each with
+        # the terminal chunk and closes its socket.
         with handler.watch_lock:
             watches = list(handler.active_watches)
         for w in watches:
             w.stop()
+        if stream_loop is not None:
+            stream_loop.stop()
         server.shutdown()
         server.server_close()
         thread.join(timeout=2.0)
@@ -698,35 +826,54 @@ def start_api_server(
 
 class HTTPClient:
     """The Client facade over the wire — what the reference's scenario
-    does with client-go against the httptest server (sched.go:70-143)."""
+    does with client-go against the httptest server (sched.go:70-143).
+    Requests ride a small keep-alive pool (ISSUE 9): no per-call TCP
+    handshake, stale idle sockets reopened retry-safely inside it."""
 
     def __init__(self, base_url: str):
         self._base = base_url.rstrip("/")
+        from minisched_tpu.controlplane.httppool import HTTPConnectionPool
+
+        self._pool = HTTPConnectionPool(self._base, timeout_s=10.0)
 
     def _req(self, method: str, path: str, payload: Any = None) -> Any:
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            self._base + path, data=data, method=method,
-            headers={"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=10.0) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            body = e.read().decode(errors="replace")
-            if e.code == 409 and "already bound" in body:
-                raise AlreadyBound(body)
-            if e.code == 409 and "stale resource_version" in body:
-                raise Conflict(body)  # == in-process update(expected_rv)
-            if e.code == 409 and "out of capacity" in body:
-                raise OutOfCapacity(body)  # == in-process bind semantics
-            if e.code == 409 and "already exists" in body:
-                raise KeyError(body)  # == in-process store.create semantics
-            if e.code == 404:
-                raise KeyError(body)
-            if e.code == 507:
-                raise StorageDegraded(body)  # == in-process WAL refusal
-            raise RuntimeError(f"HTTP {e.code}: {body}")
+        status, raw, replayed = self._pool.request(method, path, body=data)
+        if status < 400:
+            return json.loads(raw)
+        body = raw.decode(errors="replace")
+        # every wire error carries whether the pool RETRANSMITTED the
+        # request (stale keep-alive socket): a 409 answering a replay may
+        # be the caller's own first attempt having landed — bind() below
+        # needs the flag to tell the two apart
+        if status == 409 and "already bound" in body:
+            raise self._mark(AlreadyBound(body), replayed)
+        if status == 409 and "stale resource_version" in body:
+            # == in-process update(expected_rv)
+            raise self._mark(Conflict(body), replayed)
+        if status == 409 and "out of capacity" in body:
+            # == in-process bind semantics
+            raise self._mark(OutOfCapacity(body), replayed)
+        if status == 409 and "already exists" in body:
+            # == in-process store.create semantics
+            raise self._mark(KeyError(body), replayed)
+        if status == 404:
+            raise self._mark(KeyError(body), replayed)
+        if status == 507:
+            # == in-process WAL refusal
+            raise self._mark(StorageDegraded(body), replayed)
+        raise RuntimeError(f"HTTP {status}: {body}")
+
+    @staticmethod
+    def _mark(err: BaseException, replayed: bool) -> BaseException:
+        err.replayed = replayed
+        return err
+
+    def close(self) -> None:
+        """Drop the pool's idle keep-alive sockets (RemoteStore.close's
+        twin — clients created per bench role/chaos round must not leak
+        CLOSE_WAIT fds for their GC lifetime)."""
+        self._pool.close()
 
     class _Nodes:
         def __init__(self, c: "HTTPClient"):
@@ -775,14 +922,56 @@ class HTTPClient:
             self._c._req("DELETE", self._path(name, namespace))
 
         def bind(self, binding: Binding) -> Pod:
-            return _decode(
-                Pod,
-                self._c._req(
-                    "POST",
-                    self._path(binding.pod_name) + "/binding",
-                    {"node_name": binding.node_name},
-                ),
-            )
+            try:
+                return _decode(
+                    Pod,
+                    self._c._req(
+                        "POST",
+                        self._path(binding.pod_name) + "/binding",
+                        {"node_name": binding.node_name},
+                    ),
+                )
+            except AlreadyBound as e:
+                # idempotent-retry dedup: an AlreadyBound answering a
+                # pool RETRANSMISSION, naming the node we asked for, is
+                # our own first attempt having committed before its
+                # socket died — success, not error.  A genuine conflict
+                # names a different node, or arrives on a non-replayed
+                # response, and stays an error.  ONE rule shared with
+                # bind_many_remote: httppool.bind_already_ours.
+                if getattr(e, "replayed", False):
+                    from minisched_tpu.controlplane.httppool import (
+                        bind_already_ours,
+                    )
+
+                    try:
+                        doc = json.loads(str(e))
+                    except Exception:
+                        doc = {}
+                    if bind_already_ours(
+                        doc.get("node") or "",
+                        doc.get("error") or str(e),
+                        binding.node_name,
+                    ):
+                        try:
+                            return self.get(
+                                binding.pod_name, binding.pod_namespace
+                            )
+                        except KeyError:
+                            # pod since deleted: the bind LANDED (the
+                            # 409 named our node) — answer like the
+                            # server's ack replay does when the object
+                            # is gone, with a synthesized bound pod,
+                            # never an error for a committed bind
+                            from minisched_tpu.api.objects import make_pod
+
+                            p = make_pod(
+                                binding.pod_name,
+                                namespace=binding.pod_namespace,
+                            )
+                            p.spec.node_name = binding.node_name
+                            return p
+                raise
 
     def nodes(self) -> "_Nodes":
         return HTTPClient._Nodes(self)

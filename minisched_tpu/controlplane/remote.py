@@ -17,6 +17,14 @@ commits thousands of placements per cycle; one HTTP round-trip per bind
 would serialize the wave).  The per-item semantics equal the in-process
 ``bind_many``: AlreadyBound / missing-pod errors are returned per entry,
 never aborting the rest.
+
+Transport (ISSUE 9): every request rides a small keep-alive connection
+pool (``controlplane/httppool.HTTPConnectionPool``) instead of a
+per-call ``urlopen`` — request latency decouples from TCP connection
+setup, and a stale pooled socket (server closed it while idle) is
+reopened retry-safely without burning the caller's backoff budget.
+Watch streams share the pool's socket setup on dedicated connections;
+their read timeout is ``RemoteStore(watch_read_timeout_s=)``.
 """
 
 from __future__ import annotations
@@ -27,11 +35,15 @@ import random
 import threading
 import time
 import urllib.error
-import urllib.request
 from typing import Any, List, Optional, Tuple
 
 from minisched_tpu.api.objects import Binding
 from minisched_tpu.controlplane.checkpoint import _decode, _encode
+from minisched_tpu.controlplane.httppool import (
+    DEFAULT_MAX_IDLE,
+    HTTPConnectionPool,
+    bind_already_ours,
+)
 from minisched_tpu.controlplane.client import (
     AlreadyBound,
     OutOfCapacity,
@@ -72,7 +84,13 @@ class RemoteWatch:
     ``next_batch`` / ``stop`` match the in-process Watch surface the
     informer dispatch thread drives."""
 
-    def __init__(self, url: str, kind: str):
+    def __init__(
+        self,
+        pool: HTTPConnectionPool,
+        path: str,
+        kind: str,
+        read_timeout_s: float = 3600.0,
+    ):
         self._cond = threading.Condition()
         self._events: List[WatchEvent] = []
         self._stopped = False
@@ -86,15 +104,20 @@ class RemoteWatch:
         #: the store rv this stream's snapshot reflects (SYNC line) —
         #: same role as the in-process Watch.start_rv
         self.start_rv = 0
-        try:
-            self._resp = urllib.request.urlopen(url, timeout=3600.0)
-        except urllib.error.HTTPError as e:
-            body = e.read().decode(errors="replace")
-            if e.code == 410:
+        # the pool builds the connection (same host/port/timeout
+        # plumbing as request traffic) but the stream OWNS it: a watch
+        # monopolizes its socket until death, never the idle stack.
+        # ``read_timeout_s`` bounds each blocking read (the old
+        # hard-coded 3600.0 — RemoteStore(watch_read_timeout_s=)).
+        self._conn, self._resp = pool.open_stream(path, read_timeout_s)
+        if self._resp.status != 200:
+            body = self._resp.read().decode(errors="replace")
+            self._conn.close()
+            if self._resp.status == 410:
                 # resume asked for compacted history: the caller must
                 # relist (HistoryCompacted == the in-process store's)
                 raise HistoryCompacted(body)
-            raise
+            raise RuntimeError(f"HTTP {self._resp.status}: {body}")
         self._thread = threading.Thread(
             target=self._read, name=f"remote-watch-{kind}", daemon=True
         )
@@ -102,8 +125,8 @@ class RemoteWatch:
 
     def _read(self) -> None:
         try:
-            # urllib de-chunks HTTP/1.1 transfer-encoding; readline gives
-            # one JSON event (or a bare keepalive newline) per line
+            # http.client de-chunks HTTP/1.1 transfer-encoding; readline
+            # gives one JSON event (or a bare keepalive newline) per line
             for raw in self._resp:
                 line = raw.strip()
                 if not line:
@@ -188,6 +211,10 @@ class RemoteWatch:
             self._resp.close()  # unblocks the reader thread
         except Exception:
             pass
+        try:
+            self._conn.close()
+        except Exception:
+            pass
 
     @property
     def stopped(self) -> bool:
@@ -237,6 +264,8 @@ class RemoteStore:
         backoff_jitter: float = 0.2,
         retry_seed: Optional[int] = None,
         faults: Any = None,
+        watch_read_timeout_s: float = 3600.0,
+        pool_max_idle: int = DEFAULT_MAX_IDLE,
     ):
         self._base = base_url.rstrip("/")
         self._timeout_s = timeout_s
@@ -248,6 +277,17 @@ class RemoteStore:
         #: faults.FaultFabric consulted at ``remote.request`` before each
         #: attempt leaves the process (client-side connection reset)
         self._faults = faults
+        #: per-read timeout on watch STREAMS (was hard-coded 3600.0): an
+        #: informer behind a proxy that kills idle flows sooner can now
+        #: match it and ride the reconnect/resume path instead of
+        #: stalling a full hour
+        self._watch_read_timeout_s = watch_read_timeout_s
+        #: keep-alive transport: every request checks a connection out of
+        #: this pool; watch streams use its socket setup on dedicated
+        #: connections (see RemoteWatch)
+        self._pool = HTTPConnectionPool(
+            self._base, max_idle=pool_max_idle, timeout_s=timeout_s
+        )
 
     # -- plumbing -----------------------------------------------------------
     def _path(self, kind: str, namespace: str = "", name: str = "") -> str:
@@ -277,28 +317,37 @@ class RemoteStore:
         )
         last_err: Optional[BaseException] = None
         for attempt in range(self._retries + 1):
+            status = None
             try:
                 if self._faults is not None:
                     self._faults.check("remote.request", path)
-                req = urllib.request.Request(
-                    self._base + path, data=data, method=method,
-                    headers={"Content-Type": "application/json"},
+                # pooled keep-alive transport: reuses an idle socket when
+                # one exists; a stale reuse is reopened inside the pool
+                # without consuming one of OUR backoff attempts — but it
+                # IS a retransmission, so it must count toward the
+                # attempts bind_many_remote's idempotency dedup reasons
+                # about (the first wire attempt may have committed
+                # before the socket died)
+                status, raw, replayed = self._pool.request(
+                    method, path, body=data
                 )
-                with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
-                    return json.loads(r.read()), attempt
-            except urllib.error.HTTPError as e:
-                body = e.read().decode(errors="replace")
-                if e.code == 409 and "already bound" in body:
+            except _TRANSIENT_ERRORS as e:
+                last_err = e
+            if status is not None:
+                if status < 400:
+                    return json.loads(raw), attempt + (1 if replayed else 0)
+                body = raw.decode(errors="replace")
+                if status == 409 and "already bound" in body:
                     raise AlreadyBound(body)
-                if e.code == 409 and "stale resource_version" in body:
+                if status == 409 and "stale resource_version" in body:
                     # semantic, never blindly retried: the caller must
                     # re-read before re-applying (see mutate)
                     raise Conflict(body)
-                if e.code == 409 and "out of capacity" in body:
+                if status == 409 and "out of capacity" in body:
                     raise OutOfCapacity(body)
-                if e.code in (404, 409):
+                if status in (404, 409):
                     raise KeyError(body)
-                if e.code == 507:
+                if status == 507:
                     # Insufficient Storage: the server's WAL is degraded
                     # (ENOSPC/EIO latch).  In the backoff set on purpose —
                     # the store probes its own recovery, so a later retry
@@ -307,12 +356,10 @@ class RemoteStore:
                     # treating it as an unknown 5xx
                     counters.inc("storage.remote_degraded_retry")
                     last_err = StorageDegraded(body)
-                elif e.code < 500:
-                    raise RuntimeError(f"HTTP {e.code}: {body}")
+                elif status < 500:
+                    raise RuntimeError(f"HTTP {status}: {body}")
                 else:
-                    last_err = RuntimeError(f"HTTP {e.code}: {body}")
-            except _TRANSIENT_ERRORS as e:
-                last_err = e
+                    last_err = RuntimeError(f"HTTP {status}: {body}")
             if attempt < self._retries:
                 counters.inc("remote.retry")
                 time.sleep(next(delays))
@@ -346,10 +393,13 @@ class RemoteStore:
         full snapshot replay (``?resource_version=N`` on the wire) —
         SYNC count 0, history events stream in as live events.  Raises
         HistoryCompacted (the server's 410) when the tail is gone."""
-        url = f"{self._base}{self._path(kind)}?watch=true"
+        path = f"{self._path(kind)}?watch=true"
         if resume_rv is not None:
-            url += f"&resource_version={int(resume_rv)}"
-        w = RemoteWatch(url, kind)
+            path += f"&resource_version={int(resume_rv)}"
+        w = RemoteWatch(
+            self._pool, path, kind,
+            read_timeout_s=self._watch_read_timeout_s,
+        )
         return w, [None] * w.initial_count()
 
     def list(self, kind: str) -> List[Any]:
@@ -465,6 +515,11 @@ class RemoteStore:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._req("DELETE", self._path(kind, namespace, name))
 
+    def close(self) -> None:
+        """Drop the pool's idle keep-alive sockets (open watch streams
+        own their connections and are unaffected)."""
+        self._pool.close()
+
     def bind_many_remote(
         self, bindings: List[Binding], return_objects: bool = True
     ) -> List[Any]:
@@ -526,14 +581,10 @@ class RemoteStore:
                     # subresource's unset-node_name precondition is what
                     # makes this conversion safe (a genuine conflict names
                     # a different node, or fires on the un-retried first
-                    # attempt and stays an error).  The server reports the
-                    # bound node as a structured field; the message-suffix
-                    # check is the fallback for servers predating it.
-                    bound_node = item.get("node") or ""
-                    ours = (
-                        bound_node == b.node_name
-                        if bound_node
-                        else err.endswith(f"already bound to {b.node_name}")
+                    # attempt and stays an error).  One shared rule with
+                    # HTTPClient.bind: httppool.bind_already_ours.
+                    ours = bind_already_ours(
+                        item.get("node") or "", err, b.node_name
                     )
                     if attempts > 0 and ours:
                         counters.inc("remote.bind_retry_dedup")

@@ -128,6 +128,13 @@ class Watch:
         #: resume cursor once that snapshot is consumed; every queued
         #: event has a higher rv).  A resumed watch carries its resume_rv.
         self.start_rv = 0
+        #: edge-trigger hook for consumers that can't block in next():
+        #: fired (under this watch's condition — it must only do O(1)
+        #: lock-free work, e.g. write a wakeup byte) whenever events are
+        #: queued OR the watch stops/evicts.  The selector stream loop
+        #: (controlplane/streamloop) registers here; condvar consumers
+        #: never need it.
+        self._notify_cb: Optional[Callable[[], None]] = None
 
     def _evict_locked(self) -> None:
         """Slow-watcher eviction (caller holds self._cond): die exactly
@@ -142,6 +149,8 @@ class Watch:
         self._replay_pending = 0
         counters.inc("watch.fanout.evicted_slow")
         self._cond.notify_all()
+        if self._notify_cb is not None:
+            self._notify_cb()
 
     def _live_queued_locked(self) -> int:
         """Queued LIVE events (caller holds self._cond): total queue
@@ -160,6 +169,8 @@ class Watch:
                 return
             self._events.append(event)
             self._cond.notify_all()
+            if self._notify_cb is not None:
+                self._notify_cb()
 
     def _deliver_many(self, events: List[WatchEvent]) -> None:
         """Batch delivery: ONE condvar hold + notify for the whole list.
@@ -181,6 +192,8 @@ class Watch:
                 return
             self._events.extend(events)
             self._cond.notify_all()
+            if self._notify_cb is not None:
+                self._notify_cb()
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         import time as _time
@@ -231,10 +244,23 @@ class Watch:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
+            if self._notify_cb is not None:
+                self._notify_cb()
 
     def stop(self) -> None:
         self.kill()
         self._store._remove_watch(self._kind, self)
+
+    def set_notify(self, cb: Optional[Callable[[], None]]) -> None:
+        """Install the edge-trigger hook (see ``_notify_cb``).  Fires
+        once immediately when events are already queued or the watch is
+        already stopped, so a registration can never miss the edge that
+        happened just before it."""
+        with self._cond:
+            self._notify_cb = cb
+            pending = bool(self._events) or self._stopped
+            if pending and cb is not None:
+                cb()
 
     @property
     def stopped(self) -> bool:

@@ -82,6 +82,42 @@ cheap-when-quiet story — surfaced in the bench ``churn`` role's record:
           by contract; this may not).  Any nonzero value is an
           accounting bug; the churn bench fails on it.
 
+The wire layer (ISSUE 9: the selector stream fanout in
+controlplane/streamloop and the pooled keep-alive client in
+controlplane/httppool) records under ``wire.`` — surfaced in the wire
+bench records (``scheduler_over_http`` + ``wire_fanout``) alongside the
+``watch.fanout.*`` family above:
+
+    wire.streams_adopted
+        — watch streams DETACHED from their handler thread into the
+          selector loop after handshake + snapshot/resume replay (the
+          thread returns to the pool: N watchers cost N sockets + ONE
+          thread; MINISCHED_STREAMLOOP=0 keeps this at zero)
+    wire.streams_active
+        — gauge: streams the loop currently owns
+    wire.evicted_outbuf
+        — streams evicted because their per-socket out-buffer exceeded
+          its byte bound: the SOCKET-level laggard (the kernel refused
+          the bytes), distinct from the store-queue eviction counted by
+          watch.fanout.evicted_slow.  Both die like a dropped stream
+          and the consumer recovers via resume/410→relist; the wire
+          bench requires the recovery to be exactly-once.
+    wire.partial_writes
+        — non-blocking sends the kernel truncated (backpressure
+          evidence: the loop parked the remainder in the out-buffer)
+    wire.keepalives
+        — idle keepalive chunks written by the loop (same 0.5s cadence
+          and bytes as the thread path)
+    wire.pool_open / wire.pool_reuse
+        — keep-alive client connections freshly opened vs checked out
+          warm (reuse ≫ open is the pooled-transport claim; every
+          RemoteStore/HTTPClient request rides one of these)
+    wire.pool_stale_retry
+        — requests replayed ONCE on a fresh connection after a REUSED
+          socket turned out dead (the server closed it while idle —
+          keep-alive timeout, injected http.500, restart); internal to
+          the pool, never consumes the caller's backoff budget
+
 The multi-chip live wave engine (ISSUE 7: DeviceScheduler over a
 jax.sharding.Mesh, parallel/sharding.MeshPackedCaller) records under
 ``wave_mesh.`` — surfaced in the bench ``mesh`` child and the c5
