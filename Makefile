@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire chaos chaos-proc chaos-ha chaos-disk docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -95,6 +95,14 @@ chaos-ha: native
 chaos-disk: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_disk_chaos.py -q
+
+# live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
+# 100 pods to bind, then validate ONLY through the wire — /metrics must
+# parse as Prometheus exposition with a non-empty time-to-bind histogram
+# covering every bind, /debug/trace must hold complete enqueue→bind span
+# chains, and the scrape-side p99 must equal the live registry's
+metrics-smoke: native
+	JAX_PLATFORMS=cpu python metrics_smoke.py
 
 # native host-table kernels (auto-built on first import too; this target
 # is for explicit/offline builds)

@@ -47,6 +47,36 @@ def _pct(samples, p: float, digits: int = 3) -> float:
     return round(samples[idx], digits)
 
 
+def _crosscheck_live_p99(name: str, sampled_p99: float, role: str) -> dict:
+    """Compare a role's OFFLINE sampled p99 against the LIVE histogram's
+    p99 bucket (observability/hist) and fail when they disagree beyond
+    bucket resolution — the live plane and the bench must tell the same
+    story or one of them is lying.  The two measurements bracket
+    slightly different windows (e.g. client-create→watch-observed bind
+    vs queue-admission→bind-ack), so one factor-2 bucket of slack is
+    allowed on each side of the live bucket's bounds."""
+    from minisched_tpu.observability import hist
+
+    bounds = hist.quantile_bounds(name, 0.99)
+    if bounds is None:
+        raise SystemExit(
+            f"[{role}] LIVE HISTOGRAM {name!r} IS EMPTY — the telemetry "
+            f"instrumentation regressed (sampled p99 {sampled_p99}s exists)"
+        )
+    lo, hi = bounds
+    if not (lo / 2.0 <= sampled_p99 <= hi * 2.0):
+        raise SystemExit(
+            f"[{role}] LIVE/SAMPLED P99 DISAGREE beyond bucket "
+            f"resolution for {name}: sampled {sampled_p99}s vs live "
+            f"bucket ({lo}, {hi}]s"
+        )
+    log(
+        f"[{role}] live {name} p99 bucket ({lo}, {hi}]s agrees with "
+        f"sampled {sampled_p99}s"
+    )
+    return {"lo_s": lo, "le_s": hi}
+
+
 def bench_skip(reason: str) -> None:
     """Abort THIS role as 'skipped' rather than failed: the child prints
     a ``{"skipped": reason}`` record and exits 0, so the merged artifact
@@ -1722,6 +1752,11 @@ def bench_wire_fanout() -> dict:
                 f"[wirefan] P99 DELIVERY LATENCY REGRESSED: {p99}s > "
                 f"gate {p99_gate_s}s (p50 {p50}s, {len(samples)} samples)"
             )
+        from minisched_tpu.observability import hist
+
+        live_p99 = _crosscheck_live_p99(
+            "watch.delivery_lag_s", p99, "wirefan"
+        )
         csnap = counters.snapshot()
         log(
             f"[wirefan] p99 delivery {p99}s (p50 {p50}s, p95 {p95}s) over "
@@ -1739,7 +1774,9 @@ def bench_wire_fanout() -> dict:
             "delivery_p50_s": p50,
             "delivery_p95_s": p95,
             "delivery_p99_s": p99,
+            "delivery_p99_live_bucket_s": live_p99,
             "delivery_gate_s": p99_gate_s,
+            "metrics_snapshot": hist.snapshot(),
             "delivery_samples": len(samples),
             "thread_peak": thread_peak,
             "thread_gate": thread_gate,
@@ -3325,6 +3362,9 @@ def bench_churn() -> dict:
             f"[churn] P99 TIME-TO-BIND REGRESSED: {p99}s > gate "
             f"{p99_gate_s}s (p50 {p50}s, {len(ttbs)} samples)"
         )
+    from minisched_tpu.observability import hist
+
+    live_p99 = _crosscheck_live_p99("sched.time_to_bind_s", p99, "churn")
     waves = counters.get("wave_pipeline.waves") or 1
     zero_ratio = round(counters.get("wave_build.skipped") / waves, 3)
     csnap = counters.snapshot()
@@ -3345,7 +3385,9 @@ def bench_churn() -> dict:
         "ttb_p50_s": p50,
         "ttb_p95_s": p95,
         "ttb_p99_s": p99,
+        "ttb_p99_live_bucket_s": live_p99,
         "ttb_gate_s": p99_gate_s,
+        "metrics_snapshot": hist.snapshot(),
         "zero_build_waves": counters.get("wave_build.skipped"),
         "zero_build_tail": zero_build_tail,
         "zero_build_ratio": zero_ratio,

@@ -1,0 +1,126 @@
+"""End-to-end smoke for the live telemetry plane (`make metrics-smoke`).
+
+Boots the REST façade + a live scheduler in one process, drives 100 pods
+to bind, then validates the OBSERVER's view only through the wire:
+
+* ``GET /metrics`` parses as Prometheus text exposition (the repo's own
+  minimal parser, hist.parse_prometheus) and carries a NON-EMPTY
+  ``sched_time_to_bind_seconds`` histogram covering every bind;
+* ``GET /debug/trace`` returns JSONL spans with a complete
+  enqueue→pop→bind→ack chain for bound pods;
+* the scrape-side p99 (parsed buckets) matches the live registry's.
+
+Exit 0 on success, 1 with a reason on any failure — CI-shaped.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+N_PODS = 100
+N_NODES = 4
+
+
+def fail(msg: str) -> None:
+    print(f"[metrics-smoke] FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def main() -> int:
+    from minisched_tpu.api.objects import make_node, make_pod
+    from minisched_tpu.controlplane.client import Client
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.observability import hist
+    from minisched_tpu.service.config import default_scheduler_config
+    from minisched_tpu.service.service import SchedulerService
+
+    client = Client()
+    raw = getattr(client.store, "_store", client.store)
+    server, base, shutdown = start_api_server(raw, port=0)
+    svc = SchedulerService(client)
+    svc.start_scheduler(default_scheduler_config(time_scale=0.01))
+    try:
+        for i in range(N_NODES):
+            client.nodes().create(
+                make_node(f"node{i}", capacity={"cpu": "64", "memory": "256Gi",
+                                                "pods": 110})
+            )
+        for i in range(N_PODS):
+            client.pods().create(make_pod(f"smoke-{i:03d}"))
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            bound = [p for p in client.pods().list() if p.spec.node_name]
+            if len(bound) == N_PODS:
+                break
+            time.sleep(0.1)
+        else:
+            fail(f"only {len(bound)}/{N_PODS} pods bound within 120s")
+        print(f"[metrics-smoke] {N_PODS} pods bound on {N_NODES} nodes")
+
+        # -- /metrics: valid exposition, non-empty time-to-bind ------------
+        with urllib.request.urlopen(base + "/metrics", timeout=10.0) as r:
+            if r.status != 200:
+                fail(f"/metrics answered {r.status}")
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        if "version=0.0.4" not in ctype:
+            fail(f"/metrics content-type {ctype!r} is not text exposition")
+        types, samples = hist.parse_prometheus(text)
+        if types.get("sched_time_to_bind_seconds") != "histogram":
+            fail("sched_time_to_bind_seconds missing or not histogram-typed")
+        ttb_count = sum(
+            v for n, _l, v in samples if n == "sched_time_to_bind_seconds_count"
+        )
+        if ttb_count < N_PODS:
+            fail(
+                f"time-to-bind histogram has {int(ttb_count)} samples, "
+                f"want >= {N_PODS}"
+            )
+        scraped_p99 = hist.parsed_histogram_quantile(
+            samples, "sched_time_to_bind_seconds", 0.99
+        )
+        live_p99 = hist.quantile_bounds("sched.time_to_bind_s", 0.99)
+        if scraped_p99 != live_p99:
+            fail(
+                f"scrape-side p99 {scraped_p99} != live registry {live_p99}"
+            )
+        print(
+            f"[metrics-smoke] /metrics: {len(samples)} samples, "
+            f"{len(types)} metrics; time_to_bind count {int(ttb_count)}, "
+            f"p99 bucket ({live_p99[0]}, {live_p99[1]}]s"
+        )
+
+        # -- /debug/trace: complete span chains ----------------------------
+        with urllib.request.urlopen(base + "/debug/trace", timeout=10.0) as r:
+            if r.status != 200:
+                fail(f"/debug/trace answered {r.status}")
+            lines = r.read().decode().strip().splitlines()
+        spans = [json.loads(ln) for ln in lines]
+        if not spans:
+            fail("/debug/trace is empty")
+        by_pod: dict = {}
+        for s in spans:
+            if "pod" in s:
+                by_pod.setdefault(s["pod"], []).append(s["stage"])
+        complete = 0
+        for pod, stages in by_pod.items():
+            if {"enqueue", "pop", "bind", "bind_ack"} <= set(stages):
+                complete += 1
+        if complete == 0:
+            fail("no pod has a complete enqueue→pop→bind→bind_ack chain")
+        print(
+            f"[metrics-smoke] /debug/trace: {len(spans)} spans, "
+            f"{complete} pods with complete enqueue→bind chains"
+        )
+        print("[metrics-smoke] OK")
+        return 0
+    finally:
+        svc.shutdown_scheduler()
+        shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

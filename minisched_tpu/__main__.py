@@ -18,6 +18,12 @@ Subcommands:
         per-node aggregate index, and the exactly-once bind audit.
         Prints a JSON report; exit 1 on any integrity error.
 
+    python -m minisched_tpu metrics <url>
+
+        scrape ``<url>/metrics`` (the REST façade or an engine's
+        metricsd sidecar) and pretty-print the snapshot: counters,
+        gauges, and per-histogram count/p50/p99/max bucket bounds.
+
 Optional env:
 
     MINISCHED_TPU_STORE_URL=file:///tmp/cluster.wal   durable WAL store
@@ -101,6 +107,12 @@ def main() -> int:
         from minisched_tpu.controlplane.fsck import main as fsck_main
 
         return fsck_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "metrics":
+        # scrape CLI: like fsck, must not boot JAX or the scheduler —
+        # it only fetches and parses another process's exposition
+        from minisched_tpu.observability.metricsd import scrape_main
+
+        return scrape_main(sys.argv[2:])
     cfg = ProcessConfig.from_env()
     device_mode = os.environ.get("MINISCHED_DEVICE_MODE", "0") == "1"
     mesh_devices = int(os.environ.get("MINISCHED_MESH_DEVICES", "0"))

@@ -79,7 +79,7 @@ from minisched_tpu.controlplane.walio import (
     encode_frame,
     resync_scan,
 )
-from minisched_tpu.observability import counters
+from minisched_tpu.observability import counters, hist
 
 
 class CheckpointCorrupt(Exception):
@@ -321,6 +321,7 @@ class DurableObjectStore(ObjectStore):
         except OSError:
             pre_end = None
         try:
+            t0 = time.monotonic()
             n = self._log.write(frame)
             if n is not None and n != len(frame):
                 # a SHORT raw write is how a filling disk often says
@@ -333,6 +334,7 @@ class DurableObjectStore(ObjectStore):
                 )
             if not self._defer_flush and self._fsync:
                 os.fsync(self._log.fileno())
+            hist.observe("storage.wal_append_s", time.monotonic() - t0)
         except OSError as e:
             if pre_end is not None:
                 # a failed/short write may have left a PARTIAL frame at
@@ -381,7 +383,9 @@ class DurableObjectStore(ObjectStore):
         disk refused to make durable."""
         if self._log is not None and self._fsync:
             try:
+                t0 = time.monotonic()
                 os.fsync(self._log.fileno())
+                hist.observe("storage.wal_fsync_s", time.monotonic() - t0)
             except OSError as e:
                 self._enter_degraded(e)
                 counters.inc("storage.append_error")

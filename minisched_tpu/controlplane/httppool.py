@@ -96,6 +96,11 @@ class HTTPConnectionPool:
         self._lock = threading.Lock()
         self._idle: list = []  # LIFO: the warmest socket first
         self._closed = False
+        #: >0 marks a pool handed out by shared_pool(): close() then
+        #: decrements and only latches _closed when the LAST sharer
+        #: leaves.  Direct-constructed pools (refs stays 0) close on the
+        #: first call exactly as before.
+        self._refs = 0
 
     # -- connection lifecycle ----------------------------------------------
     def _new_conn(
@@ -206,13 +211,71 @@ class HTTPConnectionPool:
 
     def close(self) -> None:
         """Drop every idle connection (in-flight requests finish on
-        their own sockets and find the pool closed at check-in)."""
+        their own sockets and find the pool closed at check-in).  A
+        pool obtained through :func:`shared_pool` is refcounted: each
+        sharer's close() drops the idle sockets it may have warmed, but
+        the pool only latches closed — and leaves the shared registry —
+        when the last sharer hangs up."""
         with self._lock:
             idle, self._idle = self._idle, []
-            self._closed = True
+            if self._refs > 0:
+                self._refs -= 1
+            if self._refs == 0:
+                self._closed = True
         for c in idle:
             c.close()
+        if self._closed:
+            _forget_shared(self)
 
     def idle_count(self) -> int:
         with self._lock:
             return len(self._idle)
+
+
+# -- shared per-endpoint pools (ISSUE 11 satellite; ROADMAP crumb from
+#    ISSUE 9) ---------------------------------------------------------------
+#
+# RemoteStore and HTTPClient used to each build a private pool, so one
+# process talking to one apiserver through both facades kept two idle
+# stacks and paid two warmups.  shared_pool() hands every same-endpoint
+# caller the SAME pool, keyed by (host, port, timeout_s) — timeout is
+# part of the key because it is baked into each pooled socket at connect
+# (EngineSupervisor's 5s RemoteStore must not share sockets with a 30s
+# default client).
+
+_SHARED: Dict[Tuple[str, int, float], HTTPConnectionPool] = {}
+_SHARED_MU = threading.Lock()
+
+
+def shared_pool(
+    base_url: str,
+    max_idle: int = DEFAULT_MAX_IDLE,
+    timeout_s: float = 30.0,
+) -> HTTPConnectionPool:
+    """The process-wide pool for ``base_url``'s endpoint, created on
+    first use.  Each call takes a reference; callers still call
+    ``close()`` exactly as if the pool were private — the refcount makes
+    the last close the real one.  ``max_idle`` ratchets UP only (two
+    sharers asking 4 and 8 get one pool retaining 8)."""
+    probe = HTTPConnectionPool(base_url, max_idle=0, timeout_s=timeout_s)
+    key = (probe._host, probe._port, float(timeout_s))
+    with _SHARED_MU:
+        pool = _SHARED.get(key)
+        if pool is None or pool._closed:
+            pool = HTTPConnectionPool(
+                base_url, max_idle=max_idle, timeout_s=timeout_s
+            )
+            _SHARED[key] = pool
+        with pool._lock:
+            pool._refs += 1
+            pool._max_idle = max(pool._max_idle, int(max_idle))
+        return pool
+
+
+def _forget_shared(pool: HTTPConnectionPool) -> None:
+    """Drop a fully-closed pool from the registry (so a later
+    shared_pool() for the endpoint builds a fresh one)."""
+    with _SHARED_MU:
+        key = (pool._host, pool._port, float(pool._timeout_s))
+        if _SHARED.get(key) is pool:
+            del _SHARED[key]

@@ -83,6 +83,27 @@ def _fixup_namespace(kind: str, ns: str, obj: Any) -> None:
         obj.metadata.namespace = "default"
 
 
+def _route_label(path: str) -> str:
+    """Low-cardinality route label for the ``http.request_s`` histogram:
+    the SHAPE of the path (collection + name/subresource markers), never
+    raw object names — a million pods must not mint a million label
+    children."""
+    if not path.startswith("/api/"):
+        return path if path in (
+            "/healthz", "/metrics", "/debug/trace"
+        ) else "other"
+    try:
+        kind, _ns, name, sub = _route(path)
+    except (KeyError, ValueError):
+        return "unroutable"
+    label = kind.lower()
+    if name:
+        label += "/{name}"
+    if sub:
+        label += "/" + sub
+    return label
+
+
 def _route(path: str):
     """→ (kind, namespace, name, subresource) — name/sub may be ''."""
     parts = [p for p in path.split("/") if p]
@@ -258,12 +279,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"{name} must be an integer")
             raise
 
+    def _observe_request(self, verb: str, path: str, t0: float) -> None:
+        from minisched_tpu.observability import hist
+
+        hist.observe(
+            "http.request_s", time.monotonic() - t0,
+            verb=verb, route=_route_label(path),
+        )
+
     def do_GET(self) -> None:
+        t0 = time.monotonic()
+        path, _, query = self.path.partition("?")
+        try:
+            self._handle_get(path, query)
+        finally:
+            # long-lived watch streams are not requests; their latency
+            # story is watch.delivery_lag_s, not http.request_s
+            if "watch=true" not in query:
+                self._observe_request("GET", path, t0)
+
+    def _handle_get(self, path: str, query: str) -> None:
         if self._inject_fault():
             return
-        path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._send(200, "ok")
+            return
+        if path == "/metrics":
+            # Prometheus text exposition of the process-global registries
+            # (counters + gauges + histograms; observability/hist)
+            from minisched_tpu.observability import hist
+
+            body = hist.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/debug/trace":
+            # flight-recorder dump: the bounded span ring as JSONL
+            from minisched_tpu.observability import trace
+
+            body = trace.dump_jsonl().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         try:
             kind, ns, name, _ = _route(path)
@@ -414,6 +476,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # per EVENT (memoized on it) and shared by every stream
                 self.wfile.write(event_wire_chunk(ev))
                 self.wfile.flush()
+                if ev.born:
+                    from minisched_tpu.observability import hist
+
+                    hist.observe(
+                        "watch.delivery_lag_s",
+                        max(time.monotonic() - ev.born, 0.0),
+                    )
             # orderly end-of-stream: terminal chunk, then drop keep-alive so
             # neither side blocks waiting for the other
             self.wfile.write(b"0\r\n\r\n")
@@ -434,6 +503,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self.active_watches.discard(watch)
 
     def do_POST(self) -> None:
+        t0 = time.monotonic()
+        try:
+            self._handle_post()
+        finally:
+            self._observe_request(
+                "POST", self.path.partition("?")[0], t0
+            )
+
+    def _handle_post(self) -> None:
         if self._inject_fault():
             return
         if self.path.partition("?")[0] == "/api/v1/bindings":
@@ -690,6 +768,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, {"items": out})
 
     def do_PUT(self) -> None:
+        t0 = time.monotonic()
+        try:
+            self._handle_put()
+        finally:
+            self._observe_request(
+                "PUT", self.path.partition("?")[0], t0
+            )
+
+    def _handle_put(self) -> None:
         if self._inject_fault():
             return
         path, _, query = self.path.partition("?")
@@ -730,6 +817,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, str(e))
 
     def do_DELETE(self) -> None:
+        t0 = time.monotonic()
+        try:
+            self._handle_delete()
+        finally:
+            self._observe_request(
+                "DELETE", self.path.partition("?")[0], t0
+            )
+
+    def _handle_delete(self) -> None:
         if self._inject_fault():
             return
         try:
@@ -832,9 +928,12 @@ class HTTPClient:
 
     def __init__(self, base_url: str):
         self._base = base_url.rstrip("/")
-        from minisched_tpu.controlplane.httppool import HTTPConnectionPool
+        from minisched_tpu.controlplane.httppool import shared_pool
 
-        self._pool = HTTPConnectionPool(self._base, timeout_s=10.0)
+        # the default timeout matches RemoteStore's so both facades land
+        # on the SAME shared per-endpoint pool (timeout is part of the
+        # sharing key — it is baked into each socket at connect)
+        self._pool = shared_pool(self._base)
 
     def _req(self, method: str, path: str, payload: Any = None) -> Any:
         data = json.dumps(payload).encode() if payload is not None else None

@@ -43,6 +43,7 @@ from minisched_tpu.controlplane.httppool import (
     DEFAULT_MAX_IDLE,
     HTTPConnectionPool,
     bind_already_ours,
+    shared_pool,
 )
 from minisched_tpu.controlplane.client import (
     AlreadyBound,
@@ -284,8 +285,10 @@ class RemoteStore:
         self._watch_read_timeout_s = watch_read_timeout_s
         #: keep-alive transport: every request checks a connection out of
         #: this pool; watch streams use its socket setup on dedicated
-        #: connections (see RemoteWatch)
-        self._pool = HTTPConnectionPool(
+        #: connections (see RemoteWatch).  The pool is SHARED per
+        #: (host, port, timeout) across every RemoteStore/HTTPClient in
+        #: the process — close() drops only our reference.
+        self._pool = shared_pool(
             self._base, max_idle=pool_max_idle, timeout_s=timeout_s
         )
 

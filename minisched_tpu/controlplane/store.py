@@ -81,6 +81,16 @@ class WatchEvent:
     #: (httpserver.event_wire_chunk; ISSUE 8).  Never part of
     #: equality/repr; the wire line does not depend on the watcher.
     wire: Any = field(default=None, repr=False, compare=False)
+    #: monotonic birth stamp (fanout time at the store), consumed by the
+    #: delivery paths to observe ``watch.delivery_lag_s`` — the
+    #: store-mutation→socket-write lag per watcher (ISSUE 11).  Stamped
+    #: in __post_init__ so every producer site gets it for free; never
+    #: part of equality/repr (tests compare reconstructed events).
+    born: float = field(default=0.0, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        if not self.born:
+            self.born = time.monotonic()
 
 
 #: per-watcher queue bound, in EVENTS.  The per-watch queues decouple

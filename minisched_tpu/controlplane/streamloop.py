@@ -335,11 +335,19 @@ class StreamLoop:
         watch = stream.watch
         events = watch.next_batch(timeout=0)
         if events:
+            from minisched_tpu.observability import hist
+
+            now = time.monotonic()
             ns = stream.ns
             for ev in events:
                 if ns and ev.obj.metadata.namespace != ns:
                     continue
                 stream.buf += event_wire_chunk(ev)
+                if ev.born:
+                    # store-fanout→socket-write lag for THIS stream
+                    hist.observe(
+                        "watch.delivery_lag_s", max(now - ev.born, 0.0)
+                    )
         if watch.stopped and not stream.closing:
             # store-side end of stream: eviction, server shutdown, or an
             # explicit stop — orderly terminal chunk, then close, exactly

@@ -118,6 +118,11 @@ class DeviceScheduler(Scheduler):
         #: failure re-runs THAT wave on one device, later waves retry
         #: the mesh (see _eval_packed_wave)
         self._mesh_fallback_evaluator: Any = None
+        #: monotonic wave id stamped on trace spans (observability/trace)
+        #: so a pod's enqueue→bind chain joins its wave's build/evaluate
+        #: spans; (pod_shards, node_shards) rides along in mesh mode
+        self._wave_seq = 0
+        self._mesh_shards: Any = None
         if mesh is not None:
             from minisched_tpu.observability import counters
             from minisched_tpu.parallel.sharding import (
@@ -127,6 +132,7 @@ class DeviceScheduler(Scheduler):
 
             pod_ax, node_ax = mesh_axis_sizes(mesh)
             self._pod_cap_mult = cap_multiple(128, pod_ax)
+            self._mesh_shards = (pod_ax, node_ax)
             # gauges, not counters: the factoring is state — restarts and
             # multi-engine processes must not sum 2x4 into 4x8
             counters.set_gauge("wave_mesh.pod_shards", pod_ax)
@@ -1560,7 +1566,7 @@ class DeviceScheduler(Scheduler):
         backstop at the store)."""
         import jax
 
-        from minisched_tpu.observability import counters
+        from minisched_tpu.observability import counters, trace
 
         qpis = prepared.qpis
         # the worker skips lease expiry (store probes would stall its
@@ -1571,6 +1577,15 @@ class DeviceScheduler(Scheduler):
             # idle-wave gate fired: this wave reused the previous tables
             # wholesale (zero node-table build work; ISSUE 8)
             counters.inc("wave_pipeline.zero_build_waves")
+        self._wave_seq += 1
+        wave_id = self._wave_seq
+        trace.span(
+            "wave_build", wave=wave_id, size=len(qpis),
+            build_s=round(prepared.build_s, 6),
+            skipped=prepared.build_skipped or None,
+            dirty_rows=prepared.dirty_rows or None,
+            mesh=self._mesh_shards,
+        )
         # gate opens for the device call: the previous wave's held bind
         # events drain against GIL-free device compute — and the build
         # worker gets the GIL for wave N+2's host stretch in this window
@@ -1602,9 +1617,16 @@ class DeviceScheduler(Scheduler):
         except Exception as err:
             # tables were already built, so no encode retry applies here
             # — park the batch exactly like the serial exception path
+            trace.span(
+                "wave_park", wave=wave_id, size=len(qpis),
+                cause=type(err).__name__, error=str(err)[:200],
+            )
+            trace.flight_dump("wave-park")
             for qpi in qpis:
                 self.error_func(qpi, err)
             return
+        trace.span("wave_evaluate", wave=wave_id, size=len(qpis),
+                   mesh=self._mesh_shards)
         node_names = prepared.node_names
         losers: List[Any] = []
         winners: List[Any] = []
@@ -1623,6 +1645,10 @@ class DeviceScheduler(Scheduler):
                 # straight back through the active queue so the next
                 # wave's FRESH snapshot re-places it (requeue: never
                 # quota-held behind its tenant's newer arrivals)
+                trace.span_pod(
+                    "rearb_requeue", pod, wave=wave_id,
+                    cause="capacity_raced",
+                )
                 self.queue.add(pod, requeue=True)
         self._commit_winners(winners)
         if losers:
@@ -1867,6 +1893,13 @@ class DeviceScheduler(Scheduler):
         # e2e accounting asserts pop+wave+scan_flush+gc sums to the loop
         # wall, and an invisible exit breaks the invariant (advisor r5)
         t_wave = time.monotonic()
+        self._wave_seq += 1
+        from minisched_tpu.observability import trace
+
+        trace.span(
+            "wave_build", wave=self._wave_seq, size=len(qpis),
+            serial=True, mesh=self._mesh_shards,
+        )
         self.metrics.observe("wave_size", float(len(qpis)))
         try:
             self._schedule_wave_inner(qpis, t_wave)
@@ -2307,6 +2340,12 @@ class DeviceScheduler(Scheduler):
                     self.on_decision(pod, None, status)
                 continue
             if status.is_wait():
+                from minisched_tpu.observability import trace
+
+                trace.span_pod(
+                    "permit_wait", pod, wave=self._wave_seq,
+                    node=node_name, plugin=status.plugin,
+                )
                 t = threading.Thread(
                     target=self._binding_cycle,
                     args=(qpi, pod, node_name, state),
@@ -2388,10 +2427,17 @@ class DeviceScheduler(Scheduler):
         from minisched_tpu.framework.events import ActionType, ClusterEvent, GVK
 
         self.queue.note_move_request(ClusterEvent(GVK.POD, ActionType.UPDATE))
+        from minisched_tpu.observability import trace
+
+        degraded_dumped = False
         for (qpi, pod, node_name, state), res in zip(ready, results):
             if isinstance(res, BaseException):
                 from minisched_tpu.controlplane.store import StorageDegraded
 
+                trace.span_pod(
+                    "bind_failed", pod, wave=self._wave_seq,
+                    node=node_name, cause=type(res).__name__,
+                )
                 if isinstance(res, StorageDegraded):
                     # the control plane's DISK gave out (ENOSPC/EIO, or
                     # HTTP 507 outlasting the remote client's backoff):
@@ -2402,6 +2448,9 @@ class DeviceScheduler(Scheduler):
                     from minisched_tpu.observability import counters
 
                     counters.inc("storage.degraded_parks")
+                    if not degraded_dumped:
+                        degraded_dumped = True
+                        trace.flight_dump("storage-degraded-park")
                 self.run_unreserve_plugins(state, pod, node_name)
                 if self._is_bind_race(res) and self._bind_race_refresh(qpi):
                     # bound by a peer / deleted while in-flight: drop
@@ -2415,8 +2464,13 @@ class DeviceScheduler(Scheduler):
                 self.error_func(qpi, res)
                 if self.on_decision:
                     self.on_decision(pod, None, Status.from_error(res))
-            elif self.on_decision:
-                self.on_decision(pod, node_name, Status.success())
+            else:
+                trace.span_pod(
+                    "bind", pod, wave=self._wave_seq, node=node_name,
+                )
+                self.queue.observe_bind(pod, node_name)
+                if self.on_decision:
+                    self.on_decision(pod, node_name, Status.success())
 
 
 def new_device_scheduler(

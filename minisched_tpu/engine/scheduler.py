@@ -314,12 +314,14 @@ class Scheduler:
         self._bind_lock = threading.Lock()
         self._bind_threads: set = set()
         # observability hooks: fn(pod, node_name_or_None, status), and
-        # per-phase timing — assign a profiling.CycleMetrics to collect
-        # (the default is a no-op null object)
+        # per-phase timing — a REAL CycleMetrics by default (ISSUE 11):
+        # the wave phases it forwards into observability/hist are what
+        # /metrics serves, and live telemetry must not depend on a bench
+        # attaching a collector.  Assign NULL_METRICS to opt out.
         self.on_decision: Optional[Callable[[Any, Optional[str], Status], None]] = None
-        from minisched_tpu.observability.profiling import NULL_METRICS
+        from minisched_tpu.observability.profiling import CycleMetrics
 
-        self.metrics: Any = NULL_METRICS
+        self.metrics: Any = CycleMetrics()
 
         # incremental NodeInfo cache (upstream cache.Cache analog) — wired
         # BEFORE the queue handlers so a requeued pod's next snapshot
@@ -759,6 +761,13 @@ class Scheduler:
                 return
             with self.metrics.timed("bind"):
                 self.bind(pod, node_name)
+            from minisched_tpu.observability import trace
+
+            trace.span_pod(
+                "bind", pod, node=node_name,
+                wave=getattr(self, "_wave_seq", None),
+            )
+            self.queue.observe_bind(pod, node_name)
             if self.on_decision:
                 self.on_decision(pod, node_name, Status.success())
         except Exception as err:

@@ -165,6 +165,14 @@ class ServerSupervisor:
         return f"http://127.0.0.1:{self._port}"
 
     @property
+    def metrics_url(self) -> str:
+        """Where to scrape THIS child's telemetry: the façade itself
+        serves ``/metrics`` (Prometheus text) and ``/debug/trace``
+        (JSONL spans), so the supervised process is scrapeable on the
+        same fixed port clients already know."""
+        return self.base_url + "/metrics"
+
+    @property
     def wal_path(self) -> str:
         return self._wal
 

@@ -35,14 +35,26 @@ def _engine_child_main(
     device_mode: bool = False,
     max_wave: int = 64,
     parent_pid: Optional[int] = None,
+    metrics_port: Optional[int] = None,
 ) -> None:
     """The child's whole life: join the plane over the wire, schedule,
-    park until SIGKILL.  Runs in a fresh interpreter — import inside."""
+    park until SIGKILL.  Runs in a fresh interpreter — import inside.
+
+    ``metrics_port`` arms the sidecar telemetry listener
+    (observability.metricsd): THIS engine process's histograms, counters
+    and trace ring become scrapeable at ``/metrics`` / ``/debug/trace``
+    — the engine has no façade of its own, so without the sidecar its
+    telemetry dies with it."""
     from hashlib import blake2s
 
     from minisched_tpu.controlplane.remote import RemoteClient
     from minisched_tpu.ha.plane import start_ha_engine
     from minisched_tpu.service.config import default_full_roster_config
+
+    if metrics_port is not None:
+        from minisched_tpu.observability.metricsd import start_metrics_server
+
+        start_metrics_server(port=metrics_port)
 
     # per-engine deterministic retry jitter (hash() is salted per process)
     seed = int.from_bytes(
@@ -90,6 +102,7 @@ class EngineSupervisor:
         max_wave: int = 64,
         boot_timeout_s: float = 90.0,
         jax_platforms: str = "cpu",
+        metrics_port: Optional[int] = None,
     ):
         self._base = base_url
         self.engine_id = engine_id
@@ -98,12 +111,28 @@ class EngineSupervisor:
         self._max_wave = max_wave
         self._boot_timeout_s = boot_timeout_s
         self._jax_platforms = jax_platforms
+        # metrics_port=0 asks for an ephemeral one picked NOW (the
+        # parent must know the port to build metrics_url; the same port
+        # is reused across restarts, like the server supervisor's)
+        if metrics_port == 0:
+            from minisched_tpu.faults.proc import _free_port
+
+            metrics_port = _free_port()
+        self._metrics_port = metrics_port
         self._proc: Any = None
         self.kills = 0
 
     @property
     def pid(self) -> Optional[int]:
         return self._proc.pid if self._proc is not None else None
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """Scrape URL of the child's telemetry sidecar, or None when the
+        supervisor was built without ``metrics_port``."""
+        if self._metrics_port is None:
+            return None
+        return f"http://127.0.0.1:{self._metrics_port}/metrics"
 
     def alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
@@ -136,6 +165,7 @@ class EngineSupervisor:
             "device_mode": self._device_mode,
             "max_wave": self._max_wave,
             "parent_pid": os.getpid(),
+            "metrics_port": self._metrics_port,
         }
         env = dict(os.environ)
         repo_root = os.path.dirname(

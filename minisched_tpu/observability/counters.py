@@ -173,6 +173,40 @@ storage-integrity story under ``storage.`` — surfaced in the bench
         — the fault fabric's lying-disk evidence (what was WRITTEN
           corrupt; the detection counters above are the other half)
 
+The robustness layer (PR 1: retry.py, informer reconnects, assume
+leases) records the recovery evidence the chaos soaks assert on:
+
+    remote.retry / remote.conflict_retry
+        — remote-store requests replayed after a transient transport
+          error / after a CAS Conflict the caller asked to retry
+    remote.bind_retry_dedup / remote.bind_ack_replayed
+        — AlreadyBound-to-our-node answers converted to success after a
+          retransmission (the first attempt committed before its socket
+          died); the HTTPClient facade's mirror of the same dedup
+    informer.reconnect / informer.resume / informer.relist_on_410 /
+    informer.open_retry
+        — watch streams re-opened after a drop, resumed from the last
+          seen rv, relisted after the history floor answered 410, and
+          initial opens retried at boot instead of crashing the service
+    assume.lease_confirmed / assume.lease_expired /
+    assume.lease_renewed_bound / assume.lease_renewed_unreachable /
+    assume.lease_requeued / assume.lease_probe_deferred /
+    assume.revalidate_on_reconnect
+        — assume-lease lifecycle: confirmations by observed bind,
+          TTL expiries, renewals for already-bound pods, renewals
+          granted while the plane was unreachable (never expire on a
+          blind spot), capacity released + pod requeued on a lost bind,
+          probes deferred while the plane was unreachable, and
+          post-reconnect revalidation
+    engine.bind_batch_failed
+        — bind transactions that failed per-item instead of stranding
+          their wave
+
+TIMERS live next door: observability/hist.py holds the live latency
+histograms (time-to-bind, wave phases, HTTP request latency, watch
+delivery lag, WAL append/fsync) under the same global-registry
+convention, rendered together with these counters by ``/metrics``.
+
 The gang subsystem (plugins/coscheduling + engine/gang) records under
 ``gang.`` — surfaced in the bench ``gang`` role's record:
 
@@ -199,13 +233,14 @@ The gang subsystem (plugins/coscheduling + engine/gang) records under
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Set
 
 
 class Counters:
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self._counts: Dict[str, int] = {}
+        self._gauge_names: Set[str] = set()
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._mu:
@@ -214,9 +249,16 @@ class Counters:
     def set_gauge(self, name: str, n: int) -> None:
         """Last-write-wins value for state-shaped entries (a mesh
         factoring, a shard count) — engine restarts and multi-engine
-        processes must not sum them into nonsense."""
+        processes must not sum them into nonsense.  The name is
+        remembered as gauge-typed so the Prometheus exposition
+        (observability/hist.render_prometheus) emits the right # TYPE."""
         with self._mu:
             self._counts[name] = n
+            self._gauge_names.add(name)
+
+    def gauge_names(self) -> Set[str]:
+        with self._mu:
+            return set(self._gauge_names)
 
     def get(self, name: str) -> int:
         with self._mu:
@@ -229,6 +271,7 @@ class Counters:
     def reset(self) -> None:
         with self._mu:
             self._counts.clear()
+            self._gauge_names.clear()
 
 
 GLOBAL = Counters()

@@ -1,0 +1,414 @@
+"""Live latency histograms + Prometheus text exposition (ISSUE 11).
+
+`observability/counters.py` records what the system DID as integers;
+this module records how LONG it took, live, without a bench.  One
+process-global registry (``GLOBAL``) of fixed-log-bucket histograms
+keeps call sites one-liners — ``hist.observe("sched.time_to_bind_s",
+dt)`` — and `/metrics` on the REST façade (or the supervisors' child
+metrics listeners) renders the whole registry, counters and gauges
+included, as Prometheus text exposition.
+
+**Buckets are fixed, not configurable**: every histogram shares one
+geometric ladder, ``100µs · 2^k`` for k in 0..25 (upper bound ≈ 56 min)
+plus +Inf overflow.  Fixed buckets mean (a) zero per-histogram config to
+drift, (b) any two histograms (or the same one before/after a restart)
+are mergeable bucket-by-bucket, and (c) "agrees within bucket
+resolution" is a well-defined cross-check the bench roles enforce
+against their offline sampled percentiles.  Factor-2 resolution is
+coarse for a single sample and plenty for an SLO percentile.
+
+The histogram registry documented here (the lint test in
+tests/test_observability.py greps call sites against THIS docstring,
+same contract as counters.py):
+
+    sched.time_to_bind_s
+        — arrival→bind per pod: stamped once at queue admission (the
+          stamp survives requeues; the queue owns it, not the
+          QueuedPodInfo), observed at bind ack, labeled
+          ``priority=<pod priority>`` — the per-priority-class latency
+          breakdown of "Priority Matters"
+    sched.wave_build_s / sched.wave_device_s / sched.wave_commit_s /
+    sched.wave_stall_s
+        — the wave pipeline's phase timers (CycleMetrics forwards these
+          phases here, so any engine with metrics attached feeds the
+          live plane; the engine now defaults to a real CycleMetrics)
+    http.request_s
+        — REST façade request latency, labeled ``verb=``/``route=``
+          (route is the low-cardinality shape of the path — kind +
+          name/subresource markers — never raw names); long-lived watch
+          streams are excluded
+    watch.delivery_lag_s
+        — store-fanout→socket-write lag per watch event, observed in
+          BOTH delivery paths (selector stream loop and the legacy
+          thread path) against the WatchEvent's birth stamp
+    storage.wal_append_s / storage.wal_fsync_s
+        — durable-store WAL frame append (write + inline fsync when
+          armed) and deferred batch-barrier fsync times
+
+Pretty-print a live process: ``python -m minisched_tpu metrics <url>``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: first bucket upper bound: 100µs (below the cheapest observed seam)
+BUCKET_BASE_S = 1e-4
+#: finite buckets: 1e-4 · 2^k, k ∈ [0, 26); last finite bound ≈ 3355s
+NBUCKETS = 26
+
+#: the shared ladder of finite upper bounds, low→high
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    BUCKET_BASE_S * (1 << k) for k in range(NBUCKETS)
+)
+
+
+def bucket_index(v: float) -> int:
+    """Index of the finite bucket whose upper bound first covers ``v``,
+    or ``NBUCKETS`` for overflow (+Inf only).  Exact at power-of-two
+    boundaries (frexp, not float log2): a value equal to a bound lands
+    IN that bucket, matching Prometheus ``le`` semantics."""
+    if v <= BUCKET_BASE_S:
+        return 0
+    m, e = math.frexp(v / BUCKET_BASE_S)  # v/base = m·2^e, m ∈ [0.5, 1)
+    idx = e - 1 if m == 0.5 else e
+    return idx if idx < NBUCKETS else NBUCKETS
+
+
+class Histogram:
+    """One label-child: fixed log2 buckets + sum + count.
+
+    Lock-cheap: one uncontended Lock per child, three integer bumps and
+    a float add inside it — no allocation, no sorting, no sample list."""
+
+    __slots__ = ("_mu", "counts", "overflow", "sum", "count")
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.counts = [0] * NBUCKETS
+        self.overflow = 0
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        i = bucket_index(v)
+        with self._mu:
+            if i < NBUCKETS:
+                self.counts[i] += 1
+            else:
+                self.overflow += 1
+            self.sum += v
+            self.count += 1
+
+    def merge_into(self, counts: List[int]) -> Tuple[int, float, int]:
+        """Add this child's buckets into ``counts`` (len NBUCKETS);
+        returns (overflow, sum, count) deltas — the registry's
+        cross-label aggregation primitive."""
+        with self._mu:
+            for i, c in enumerate(self.counts):
+                counts[i] += c
+            return self.overflow, self.sum, self.count
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "counts": list(self.counts),
+                "overflow": self.overflow,
+                "sum": self.sum,
+                "count": self.count,
+            }
+
+
+LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+class Histograms:
+    """The registry: (name, sorted label items) → Histogram child."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._hists: Dict[Tuple[str, LabelsKey], Histogram] = {}
+
+    def _child(self, name: str, labels: Dict[str, str]) -> Histogram:
+        key = (name, tuple(sorted(labels.items())))
+        with self._mu:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram()
+        return h
+
+    def observe(self, name: str, v: float, **labels: str) -> None:
+        self._child(name, labels).observe(v)
+
+    def get(self, name: str, **labels: str) -> Optional[Histogram]:
+        key = (name, tuple(sorted(labels.items())))
+        with self._mu:
+            return self._hists.get(key)
+
+    def children(self, name: str) -> List[Tuple[LabelsKey, Histogram]]:
+        with self._mu:
+            return [
+                (k[1], h) for k, h in self._hists.items() if k[0] == name
+            ]
+
+    def names(self) -> List[str]:
+        with self._mu:
+            return sorted({k[0] for k in self._hists})
+
+    def merged(self, name: str) -> Tuple[List[int], int, float, int]:
+        """(bucket counts, overflow, sum, count) aggregated across every
+        label child of ``name`` — mergeable because buckets are fixed."""
+        counts = [0] * NBUCKETS
+        overflow, total, n = 0, 0.0, 0
+        for _labels, h in self.children(name):
+            o, s, c = h.merge_into(counts)
+            overflow += o
+            total += s
+            n += c
+        return counts, overflow, total, n
+
+    def quantile_bounds(
+        self, name: str, q: float
+    ) -> Optional[Tuple[float, float]]:
+        """[lower, upper) bounds of the bucket holding the q-quantile
+        across all label children, or None when empty.  The upper bound
+        is the conservative point estimate; "agrees within bucket
+        resolution" means a sampled quantile falls inside (or within one
+        bucket of) these bounds."""
+        counts, overflow, _s, n = self.merged(name)
+        if n == 0:
+            return None
+        rank = max(1, math.ceil(q * n))  # nearest-rank, 1-based
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                return lo, BUCKET_BOUNDS[i]
+        return BUCKET_BOUNDS[-1], math.inf
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """name → {count, sum, p50, p99} (bucket-upper estimates) —
+        the compact block bench records embed as ``metrics_snapshot``."""
+        out: Dict[str, Dict[str, object]] = {}
+        for name in self.names():
+            _counts, _ovf, total, n = self.merged(name)
+            p50 = self.quantile_bounds(name, 0.50)
+            p99 = self.quantile_bounds(name, 0.99)
+            out[name] = {
+                "count": n,
+                "sum_s": total,
+                "p50_le_s": p50[1] if p50 else None,
+                "p99_le_s": p99[1] if p99 else None,
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self._hists.clear()
+
+
+GLOBAL = Histograms()
+
+
+def observe(name: str, v: float, **labels: str) -> None:
+    GLOBAL.observe(name, v, **labels)
+
+
+def quantile_bounds(name: str, q: float) -> Optional[Tuple[float, float]]:
+    return GLOBAL.quantile_bounds(name, q)
+
+
+def snapshot() -> Dict[str, Dict[str, object]]:
+    return GLOBAL.snapshot()
+
+
+def reset() -> None:
+    GLOBAL.reset()
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+def _metric_name(name: str) -> str:
+    """``sched.time_to_bind_s`` → ``sched_time_to_bind_seconds``: dots
+    (and any other illegal rune) become underscores, a trailing ``_s``
+    unit spells out per Prometheus naming convention."""
+    out = "".join(
+        ch if (ch.isascii() and (ch.isalnum() or ch in "_:")) else "_"
+        for ch in name
+    )
+    if out.endswith("_s"):
+        out = out[:-2] + "_seconds"
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(items: Iterable[Tuple[str, str]], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"' for k, v in items]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    return repr(float(v))
+
+
+def render_prometheus(
+    counters_obj=None, hists: Optional[Histograms] = None
+) -> str:
+    """The whole registry — counters, gauges, histograms — as Prometheus
+    text exposition (version 0.0.4).  Deterministic ordering so the
+    golden-file test is byte-stable."""
+    from minisched_tpu.observability import counters as counters_mod
+
+    c = counters_obj if counters_obj is not None else counters_mod.GLOBAL
+    h = hists if hists is not None else GLOBAL
+    gauges = c.gauge_names()
+    lines: List[str] = []
+    for name, val in sorted(c.snapshot().items()):
+        mname = _metric_name(name)
+        kind = "gauge" if name in gauges else "counter"
+        lines.append(f"# TYPE {mname} {kind}")
+        lines.append(f"{mname} {val}")
+    with h._mu:
+        keys = sorted(h._hists.keys())
+        children = [(k, h._hists[k]) for k in keys]
+    seen_type = set()
+    for (name, labels), child in children:
+        mname = _metric_name(name)
+        if mname not in seen_type:
+            seen_type.add(mname)
+            lines.append(f"# TYPE {mname} histogram")
+        snap = child.snapshot()
+        cum = 0
+        for i, n in enumerate(snap["counts"]):
+            cum += n
+            le = 'le="%s"' % _fmt_float(BUCKET_BOUNDS[i])
+            lines.append(
+                f"{mname}_bucket{_fmt_labels(labels, extra=le)} {cum}"
+            )
+        cum += snap["overflow"]
+        inf_le = 'le="+Inf"'
+        lines.append(
+            f"{mname}_bucket{_fmt_labels(labels, extra=inf_le)} {cum}"
+        )
+        lines.append(
+            f"{mname}_sum{_fmt_labels(labels)} {_fmt_float(snap['sum'])}"
+        )
+        lines.append(f"{mname}_count{_fmt_labels(labels)} {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# -- minimal parser (the scrape consumer's half) ----------------------------
+
+def _parse_labels(s: str) -> Dict[str, str]:
+    """Parse ``k="v",k2="v2"`` honoring \\\\, \\" and \\n escapes."""
+    out: Dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        j = s.index("=", i)
+        key = s[i:j].strip().lstrip(",").strip()
+        assert s[j + 1] == '"', f"unquoted label value at {s[j:]}"
+        i = j + 2
+        buf: List[str] = []
+        while s[i] != '"':
+            if s[i] == "\\":
+                nxt = s[i + 1]
+                buf.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+            else:
+                buf.append(s[i])
+                i += 1
+        out[key] = "".join(buf)
+        i += 1  # closing quote
+        while i < n and s[i] in ", ":
+            i += 1
+    return out
+
+
+def parse_prometheus(
+    text: str,
+) -> Tuple[Dict[str, str], List[Tuple[str, Dict[str, str], float]]]:
+    """Minimal exposition parser: returns ``(types, samples)`` where
+    types maps metric name → counter|gauge|histogram and samples is
+    ``[(name, labels, value)]`` in document order.  Enough to validate
+    a scrape, pretty-print a snapshot, and round-trip the golden file —
+    deliberately not a full OpenMetrics implementation."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        if "{" in line:
+            name = line[: line.index("{")]
+            rest = line[line.index("{") + 1 :]
+            # the label block may contain escaped quotes; find the real
+            # closing brace by scanning quoted regions
+            i, depth_in_quote = 0, False
+            while i < len(rest):
+                ch = rest[i]
+                if depth_in_quote:
+                    if ch == "\\":
+                        i += 1
+                    elif ch == '"':
+                        depth_in_quote = False
+                elif ch == '"':
+                    depth_in_quote = True
+                elif ch == "}":
+                    break
+                i += 1
+            labels = _parse_labels(rest[:i])
+            val = rest[i + 1 :].strip()
+        else:
+            name, val = line.split(None, 1)
+            labels = {}
+        samples.append((name, labels, float(val)))
+    return types, samples
+
+
+def parsed_histogram_quantile(
+    samples: List[Tuple[str, Dict[str, str], float]],
+    metric: str,
+    q: float,
+) -> Optional[Tuple[float, float]]:
+    """Quantile bounds recomputed from PARSED ``_bucket`` samples —
+    the scrape-side mirror of :meth:`Histograms.quantile_bounds`, used
+    by the smoke tool and the CLI pretty-printer."""
+    # merge cumulative buckets across label children: le → summed count
+    by_le: Dict[float, float] = {}
+    for name, labels, val in samples:
+        if name != metric + "_bucket":
+            continue
+        le = labels.get("le", "")
+        by_le[math.inf if le == "+Inf" else float(le)] = (
+            by_le.get(math.inf if le == "+Inf" else float(le), 0.0) + val
+        )
+    if not by_le:
+        return None
+    bounds = sorted(by_le)
+    total = by_le[bounds[-1]]
+    if total <= 0:
+        return None
+    rank = max(1.0, math.ceil(q * total))
+    lo = 0.0
+    for b in bounds:
+        if by_le[b] >= rank:
+            return lo, b
+        lo = b
+    return lo, math.inf

@@ -1,0 +1,119 @@
+"""Tiny standalone metrics listener for processes without a REST façade.
+
+The control-plane façade serves ``/metrics`` and ``/debug/trace``
+itself; an HA ENGINE child (ha/proc.EngineSupervisor) has no HTTP
+server at all, so its histograms and trace ring would die unscraped
+with the process.  ``start_metrics_server`` is the smallest possible
+fix: a daemon ThreadingHTTPServer serving exactly those two read-only
+endpoints off the process-global registries.  The supervisors thread a
+``metrics_port`` through to their children so the parent (or a real
+Prometheus) can scrape every process of the plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Tuple
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        from minisched_tpu.observability import hist, trace
+
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = hist.render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4"
+        elif path == "/debug/trace":
+            body = trace.dump_jsonl().encode()
+            ctype = "application/x-ndjson"
+        elif path == "/healthz":
+            body = b"ok"
+            ctype = "text/plain"
+        elif path == "/debug/metrics.json":
+            body = json.dumps(hist.snapshot(), default=str).encode()
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def start_metrics_server(
+    port: int = 0, host: str = "127.0.0.1"
+) -> Tuple[ThreadingHTTPServer, int, Callable[[], None]]:
+    """Serve /metrics + /debug/trace (+ /healthz) on ``host:port``
+    (port 0 → ephemeral).  Returns (server, bound port, shutdown)."""
+    srv = ThreadingHTTPServer((host, port), _MetricsHandler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="metricsd")
+    t.start()
+
+    def shutdown() -> None:
+        srv.shutdown()
+        srv.server_close()
+
+    return srv, srv.server_address[1], shutdown
+
+
+def scrape_main(argv) -> int:
+    """``python -m minisched_tpu metrics <url>``: fetch ``<url>/metrics``
+    and pretty-print the snapshot — counters and gauges as name/value
+    lines, histograms as count + p50/p99 bucket upper bounds.  Pure
+    scrape consumer: works against the REST façade, a metricsd sidecar,
+    or any Prometheus 0.0.4 exposition."""
+    import urllib.request
+
+    from minisched_tpu.observability.hist import (
+        parse_prometheus,
+        parsed_histogram_quantile,
+    )
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m minisched_tpu metrics <url>")
+        return 0 if argv else 2
+    url = argv[0].rstrip("/")
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            text = r.read().decode()
+    except OSError as e:
+        print(f"metrics: scrape of {url} failed: {e}", file=__import__("sys").stderr)
+        return 1
+    types, samples = parse_prometheus(text)
+    hist_names = sorted(n for n, t in types.items() if t == "histogram")
+    scalar = [
+        (n, v) for n, labels, v in samples
+        if types.get(n) in ("counter", "gauge") and not labels
+    ]
+    for name, val in scalar:
+        print(f"{types[name]:9s} {name} = {int(val) if val == int(val) else val}")
+    for name in hist_names:
+        count = sum(
+            v for n, labels, v in samples if n == name + "_count"
+        )
+        p50 = parsed_histogram_quantile(samples, name, 0.50)
+        p99 = parsed_histogram_quantile(samples, name, 0.99)
+        fmt = lambda b: "-" if b is None else f"<={b[1]:.6g}s"
+        print(
+            f"histogram {name}: count={int(count)} "
+            f"p50{fmt(p50)} p99{fmt(p99)}"
+        )
+    if not samples:
+        print("(empty exposition)")
+    return 0

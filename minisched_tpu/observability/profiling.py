@@ -34,6 +34,18 @@ class PhaseStats:
         return self.total_s / self.count if self.count else 0.0
 
 
+#: CycleMetrics phases forwarded into the live histogram plane
+#: (observability/hist): any engine with metrics attached — and the
+#: engine now defaults to a real CycleMetrics — feeds /metrics without
+#: a bench in the loop.  Names are documented in hist.py's registry.
+_PHASE_HISTS: Dict[str, str] = {
+    "wave_pipeline_build": "sched.wave_build_s",
+    "wave_device": "sched.wave_device_s",
+    "commit": "sched.wave_commit_s",
+    "wave_pipeline_stall": "sched.wave_stall_s",
+}
+
+
 class CycleMetrics:
     """Per-phase wall-clock aggregates for the scheduling loop.
 
@@ -48,6 +60,11 @@ class CycleMetrics:
     def observe(self, phase: str, dt: float) -> None:
         with self._mu:
             self._phases.setdefault(phase, PhaseStats()).observe(dt)
+        hname = _PHASE_HISTS.get(phase)
+        if hname is not None:
+            from minisched_tpu.observability import hist
+
+            hist.observe(hname, dt)
 
     @contextlib.contextmanager
     def timed(self, phase: str) -> Iterator[None]:

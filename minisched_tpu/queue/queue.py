@@ -48,7 +48,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Set
 
 from minisched_tpu.api.objects import gang_key
-from minisched_tpu.observability import counters
+from minisched_tpu.observability import counters, hist, trace
 from minisched_tpu.framework.events import (
     GVK,
     ClusterEvent,
@@ -143,6 +143,14 @@ class SchedulingQueue:
         self._storm_gvk: Optional[GVK] = None
         self._last_move_walltime = 0.0
         self._storm_open_walltime = 0.0
+        # arrival stamps for the live time-to-bind histogram: uid → first
+        # admission time.  QUEUE-owned, not QueuedPodInfo-owned, because
+        # engine requeues (re-arbitration rejects, expired assume leases,
+        # gang-TTL releases) build FRESH QueuedPodInfos — a per-QPI stamp
+        # would reset the clock on every retry and flatter the tail.
+        # Consumed at bind ack (observe_bind), purged on delete_many
+        # (bound-by-peer / removed pods must not pin entries forever).
+        self._arrival_ts: Dict[str, float] = {}
 
     @staticmethod
     def _uid(pod) -> str:
@@ -191,9 +199,21 @@ class SchedulingQueue:
     def _track_locked(self, pod) -> None:
         """uid enters queue tracking: count it against its namespace."""
         self._queued_uids.add(self._uid(pod))
+        self._stamp_arrival_locked(pod)
         if self._quota_limits is not None:
             ns = pod.metadata.namespace
             self._ns_admitted[ns] = self._ns_admitted.get(ns, 0) + 1
+
+    def _stamp_arrival_locked(self, pod, held: bool = False) -> None:
+        """First admission (quota-held arrivals included — their wait in
+        the hold FIFO IS part of time-to-bind): stamp the arrival clock
+        and record the enqueue trace span.  Idempotent per uid, so
+        requeues and promotions never reset the clock."""
+        uid = self._uid(pod)
+        if uid in self._arrival_ts:
+            return
+        self._arrival_ts[uid] = self._clock()
+        trace.span_pod("enqueue", pod, held=held or None)
 
     def _untrack_locked(self, pod, promote: bool = True) -> Optional[str]:
         """uid leaves tracking (popped for a wave, or deleted): release
@@ -276,6 +296,7 @@ class SchedulingQueue:
                 else:
                     self._quota_held.setdefault(ns, deque()).append(pod)
                     self._held_uids.add(uid)
+                    self._stamp_arrival_locked(pod, held=True)
                     counters.inc("queue.quota_held")
                     return
             self._track_locked(pod)
@@ -419,6 +440,25 @@ class SchedulingQueue:
         (queue.go:113-116's panic).  One implementation: delete_many."""
         self.delete_many([pod])
 
+    def observe_bind(self, pod, node_name: Optional[str] = None) -> None:
+        """Bind ack: consume the arrival stamp into the live
+        ``sched.time_to_bind_s`` histogram (per priority-class label)
+        and close the pod's trace chain.  Called by BOTH bind paths —
+        the device engine's batch binder and the scalar/Wait-permit
+        binding cycle.  A missing stamp (the informer's bind event
+        already routed the pod through delete_many, or the pod bound
+        before this queue existed) is silently skipped — the histogram
+        records latencies, not population."""
+        uid = self._uid(pod)
+        with self._cond:
+            t0 = self._arrival_ts.pop(uid, None)
+        if t0 is None:
+            return
+        dt = max(self._clock() - t0, 0.0)
+        prio = getattr(pod.spec, "priority", 0) or 0
+        hist.observe("sched.time_to_bind_s", dt, priority=str(prio))
+        trace.span_pod("bind_ack", pod, node=node_name, ttb_s=dt)
+
     def delete_many(self, pods) -> None:
         """Batch delete under ONE lock hold, with a set-intersection fast
         path for pods not queued at all.  The HA event handlers route
@@ -428,6 +468,25 @@ class SchedulingQueue:
         time to remove nothing."""
         with self._cond:
             all_uids = {self._uid(p) for p in pods}
+            # arrival stamps die with the pod — but a departing pod that
+            # is BOUND is a bind ack arriving via the EVENT path: the HA
+            # handlers route every bind MODIFIED through here, and on
+            # the dispatch thread it can beat the binding thread's own
+            # observe_bind (the stamp pop is atomic, so exactly one of
+            # the two paths records the sample).  Unbound departures
+            # (true deletes, bound-elsewhere races that lost the
+            # node_name) still just drop — latencies, not population.
+            for p in pods:
+                t0 = self._arrival_ts.pop(self._uid(p), None)
+                if t0 is not None and getattr(p.spec, "node_name", None):
+                    dt = max(self._clock() - t0, 0.0)
+                    prio = getattr(p.spec, "priority", 0) or 0
+                    hist.observe(
+                        "sched.time_to_bind_s", dt, priority=str(prio)
+                    )
+                    trace.span_pod(
+                        "bind_ack", p, node=p.spec.node_name, ttb_s=dt
+                    )
             held_hits = all_uids & self._held_uids
             if held_hits:
                 # deleted while quota-held: drop from the hold FIFO too
@@ -609,6 +668,10 @@ class SchedulingQueue:
             ns = self._untrack_locked(qpi.pod, promote=_released is None)
             if ns is not None and _released is not None:
                 _released.append(ns)
+            trace.span_pod(
+                "pop", qpi.pod,
+                attempts=qpi.attempts, cycle=qpi.scheduling_cycle,
+            )
             return qpi
 
     #: pop_batch holds the wave boundary while an event storm that just
@@ -692,6 +755,10 @@ class SchedulingQueue:
                     ns = self._untrack_locked(qpi.pod, promote=False)
                     if ns is not None:
                         released.append(ns)
+                    trace.span_pod(
+                        "pop", qpi.pod,
+                        attempts=qpi.attempts, cycle=qpi.scheduling_cycle,
+                    )
                     batch.append(qpi)
                 if len(batch) >= max_pods:
                     break

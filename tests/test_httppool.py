@@ -283,6 +283,74 @@ def test_pool_composes_with_http_reset_fault_retries(api):
         shutdown()
 
 
+def test_shared_pool_one_endpoint_one_pool(api):
+    """ISSUE 11 satellite (ROADMAP crumb from ISSUE 9): RemoteStore and
+    HTTPClient facades at the same endpoint share ONE pool — the second
+    client's first request checks out the socket the first client
+    warmed (wire.pool_reuse), instead of opening its own."""
+    from minisched_tpu.controlplane.httpserver import HTTPClient
+
+    _store, base = api
+    client = RemoteClient(base, retries=0)
+    http = HTTPClient(base)
+    assert client.store._pool is http._pool  # literally the same object
+    open0 = counters.get("wire.pool_open")
+    reuse0 = counters.get("wire.pool_reuse")
+    client.nodes().create(make_node("shared-n1"))
+    got = http.nodes().list()
+    assert [n.metadata.name for n in got] == ["shared-n1"]
+    # cross-facade reuse: the HTTPClient call rode the RemoteStore's
+    # warm socket — one open total, at least one reuse
+    assert counters.get("wire.pool_open") == open0 + 1
+    assert counters.get("wire.pool_reuse") >= reuse0 + 1
+    # refcounted close: the first sharer leaving drops idles but keeps
+    # the pool open for the survivor...
+    client.store.close()
+    assert not http._pool._closed
+    status, _body, _r = http._pool.request("GET", "/healthz")
+    assert status == 200
+    # ...and the LAST close latches it and leaves the shared registry
+    http.close()
+    assert http._pool._closed
+    from minisched_tpu.controlplane import httppool
+
+    assert http._pool not in httppool._SHARED.values()
+
+
+def test_shared_pool_keyed_by_timeout(api):
+    """Sockets bake their timeout at connect, so a 5s client must not
+    share with a 30s one — the registry keys on (host, port, timeout)."""
+    from minisched_tpu.controlplane.httppool import shared_pool
+
+    _store, base = api
+    a = shared_pool(base, timeout_s=30.0)
+    b = shared_pool(base, timeout_s=30.0)
+    c = shared_pool(base, timeout_s=5.0)
+    try:
+        assert a is b and a is not c
+        # max_idle ratchets UP across sharers, never down
+        d = shared_pool(base, max_idle=8, timeout_s=30.0)
+        assert d is a and a._max_idle == 8
+        e = shared_pool(base, max_idle=2, timeout_s=30.0)
+        assert e is a and a._max_idle == 8
+    finally:
+        for _ in range(4):
+            a.close()
+        c.close()
+    assert a._closed and c._closed
+
+
+def test_direct_pool_close_unchanged(api):
+    """A pool built directly (no shared_pool) still closes on the FIRST
+    close() — the refcount only engages for registry-handed pools."""
+    _store, base = api
+    pool = HTTPConnectionPool(base)
+    status, _b, _r = pool.request("GET", "/healthz")
+    assert status == 200
+    pool.close()
+    assert pool._closed and pool.idle_count() == 0
+
+
 def test_watch_read_timeout_is_configurable(api):
     """The stream read timeout (hard-coded 3600.0 before ISSUE 9) comes
     from RemoteStore(watch_read_timeout_s=): a server gone silent past
