@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -67,6 +67,12 @@ bench-churn: native
 # thread-per-watcher path)
 bench-wire: native
 	JAX_PLATFORMS=cpu python bench.py --only wirefan
+
+# group-commit WAL (ISSUE 13): concurrent HTTP writers over fsync=True,
+# kill-switch baseline vs pipeline on the same box — fsyncs must
+# coalesce and throughput must clear 3x under a real durability barrier
+bench-wal: native
+	JAX_PLATFORMS=cpu python bench.py --only wal
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

@@ -2502,6 +2502,11 @@ def bench_disk() -> dict:
         "scrub_findings": scrub["findings"],
         "fsck_errors": report["errors"],
         "bitflips_detected": crc_findings,
+        "group_commit": {
+            "groups": counters.get("storage.group_commit.groups"),
+            "records": counters.get("storage.group_commit.records"),
+            "fsyncs_saved": counters.get("storage.group_commit.fsyncs_saved"),
+        },
         "recovered": {
             k: v
             for k, v in counters.snapshot().items()
@@ -2509,6 +2514,150 @@ def bench_disk() -> dict:
         },
         "leak": False,
         "double_bind": False,
+    }
+
+
+def bench_wal() -> dict:
+    """Group-commit WAL (ISSUE 13): N concurrent HTTP writers over a
+    ``file://`` WAL with fsync=True, run twice on the same box — once
+    with the MINISCHED_GROUP_COMMIT=0 kill-switch (today's per-mutation
+    fsync) and once with the pipeline — gating (a) fsyncs ≪ mutations
+    (coalescing ratio recorded), (b) throughput ≥3× the kill-switch
+    baseline, (c) post-run fsck clean (which includes rv monotonicity)
+    and full replay.  Both phases arm the same MINISCHED_FSYNC_FLOOR_US
+    durability-barrier floor (default 50ms, a rotational/cloud disk's
+    flush): tmpfs/virtio fsyncs are near-free, which would hide the
+    coalescing win this role exists to measure — the floor is recorded
+    in the result, and BENCH_WAL_FSYNC_FLOOR_US=0 measures the raw
+    device instead."""
+    import tempfile
+    import threading
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import fsck
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.remote import RemoteClient
+    from minisched_tpu.observability import counters, hist
+
+    n_writers = int(os.environ.get("BENCH_WAL_WRITERS", "12"))
+    per_writer = int(os.environ.get("BENCH_WAL_PODS_PER_WRITER", "15"))
+    floor_us = int(os.environ.get("BENCH_WAL_FSYNC_FLOOR_US", "50000"))
+    n_muts = n_writers * per_writer
+
+    def phase(group_on: bool) -> dict:
+        wal = os.path.join(tempfile.mkdtemp(prefix="minisched-wal-"), "w.wal")
+        saved = {
+            k: os.environ.get(k)
+            for k in ("MINISCHED_GROUP_COMMIT", "MINISCHED_FSYNC_FLOOR_US")
+        }
+        os.environ["MINISCHED_GROUP_COMMIT"] = "1" if group_on else "0"
+        os.environ["MINISCHED_FSYNC_FLOOR_US"] = str(floor_us)
+        try:  # both knobs are read once, at store construction
+            store = DurableObjectStore(wal, fsync=True)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        server, base, shutdown = start_api_server(store, port=0)
+        counters.reset()
+        errs: list = []
+
+        def writer(w: int) -> None:
+            client = RemoteClient(base)
+            try:
+                for i in range(per_writer):
+                    client.pods().create(
+                        make_pod(
+                            f"wp{w:02d}-{i:04d}",
+                            requests={"cpu": "100m", "memory": "64Mi"},
+                        )
+                    )
+            except Exception as e:
+                errs.append(f"writer {w}: {e!r}")
+
+        threads = [
+            threading.Thread(target=writer, args=(w,), name=f"wal-writer-{w}")
+            for w in range(n_writers)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        shutdown()
+        store.close()
+        if errs:
+            raise SystemExit(f"[wal] WRITER FAILED (group={group_on}): {errs[:3]}")
+        records = counters.get("storage.group_commit.records")
+        saved_fsyncs = counters.get("storage.group_commit.fsyncs_saved")
+        groups = counters.get("storage.group_commit.groups")
+        # fsync=True: the kill-switch path fsyncs once per append, the
+        # pipeline once per fsync-armed group == records - fsyncs_saved
+        fsyncs = (records - saved_fsyncs) if group_on else n_muts
+        re = DurableObjectStore(wal)
+        replayed = sum(1 for _ in re.list("Pod"))
+        max_rv = re.resource_version
+        re.close()
+        report = fsck(wal)
+        if report["errors"]:
+            raise SystemExit(
+                f"[wal] FSCK DIRTY (group={group_on}): {report['errors'][:5]}"
+            )
+        if replayed != n_muts or max_rv != n_muts:
+            raise SystemExit(
+                f"[wal] REPLAY LOST ACKED MUTATIONS (group={group_on}): "
+                f"{replayed}/{n_muts} pods, max rv {max_rv}"
+            )
+        return {
+            "throughput_per_s": round(n_muts / elapsed, 1),
+            "total_s": round(elapsed, 2),
+            "fsyncs": fsyncs,
+            "groups": groups,
+            "records": records,
+            "group_wait_p99_s": (
+                hist.quantile_bounds("storage.group_wait_s", 0.99) or
+                (None, None)
+            )[1],
+        }
+
+    baseline = phase(False)
+    grouped = phase(True)
+    ratio = grouped["throughput_per_s"] / max(
+        baseline["throughput_per_s"], 1e-9
+    )
+    coalesce = grouped["records"] / max(grouped["fsyncs"], 1)
+    if grouped["fsyncs"] * 2 > n_muts:
+        raise SystemExit(
+            f"[wal] NO COALESCING: {grouped['fsyncs']} fsyncs for "
+            f"{n_muts} mutations under {n_writers} writers"
+        )
+    if ratio < 3.0:
+        raise SystemExit(
+            f"[wal] GROUP COMMIT NOT ≥3× KILL-SWITCH: "
+            f"{grouped['throughput_per_s']}/s vs "
+            f"{baseline['throughput_per_s']}/s ({ratio:.2f}x) at "
+            f"fsync floor {floor_us}µs"
+        )
+    log(
+        f"[wal] {n_writers} writers × {per_writer} pods, fsync floor "
+        f"{floor_us}µs: {grouped['throughput_per_s']}/s grouped vs "
+        f"{baseline['throughput_per_s']}/s kill-switch ({ratio:.1f}x); "
+        f"{grouped['fsyncs']} fsyncs for {n_muts} mutations "
+        f"({coalesce:.1f} records/fsync); fsck clean, rv dense both ways"
+    )
+    return {
+        "writers": n_writers,
+        "mutations": n_muts,
+        "fsync_floor_us": floor_us,
+        "baseline": baseline,
+        "group_commit": grouped,
+        "speedup": round(ratio, 2),
+        "coalescing_records_per_fsync": round(coalesce, 2),
+        "fsck_clean": True,
     }
 
 
@@ -3423,6 +3572,7 @@ ROLES = {
     "mesh": bench_mesh,
     "chaos": bench_chaos,
     "disk": bench_disk,
+    "wal": bench_wal,
     "ha": bench_ha,
     "gang": bench_gang,
     "churn": bench_churn,

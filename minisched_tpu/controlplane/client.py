@@ -277,12 +277,18 @@ class _PodAPI:
         exception (AlreadyBound, missing-pod KeyError, stale-rv Conflict,
         OutOfCapacity) for that entry.
 
-        The whole batch runs under ONE store lock hold: the per-node
+        The budgets and the commits share ONE lock hold: the per-node
         capacity budgets are computed from exactly the state the commits
-        apply against, and each successful bind debits them — so
-        concurrent binders (N HA engines racing the same node) serialize
-        through the lock and the LATER transaction sees the earlier one's
-        placements (see OutOfCapacity)."""
+        apply against (mutate_many's ``prepare`` hook runs under the
+        store lock, immediately before the item loop), and each
+        successful bind debits them — so concurrent binders (N HA
+        engines racing the same node) serialize through the lock and the
+        LATER transaction sees the earlier one's placements (see
+        OutOfCapacity).  The hook — not an outer ``locked()`` wrap — is
+        load-bearing: the group-commit durable store parks the caller on
+        a commit barrier AFTER releasing the lock, and a binder that
+        still held it would deadlock the group leader (and every other
+        mutator) behind its own wait."""
 
         def apply_for(binding: Binding, budgets: Dict[str, list]):
             def apply(pod: Pod) -> Pod:
@@ -343,37 +349,46 @@ class _PodAPI:
 
             return apply
 
-        # one lock hold for budgets + commits (RLock: mutate_many's own
-        # acquire nests).  The rate-limit token (one per batch, matching
-        # _ThrottledStore) is taken BEFORE the lock — TokenBucket.acquire
-        # can sleep, and sleeping while holding the store lock would
-        # stall every other client, informer fanout, and lease heartbeat
-        # behind this binder's throttle.  Inside the lock everything runs
-        # against the RAW store.  Stores without a lock surface (no
-        # in-process transaction view — never the case for the facades
-        # this client fronts) skip the capacity gate rather than fake it.
-        import contextlib
-
+        # The rate-limit token (one per batch, matching _ThrottledStore)
+        # is taken BEFORE the transaction — TokenBucket.acquire can
+        # sleep, and sleeping while holding the store lock would stall
+        # every other client, informer fanout, and lease heartbeat
+        # behind this binder's throttle.  Everything runs against the
+        # RAW store.  Stores without a lock surface (no in-process
+        # transaction view — never the case for the facades this client
+        # fronts) skip the capacity gate rather than fake it.
         limiter = getattr(self._store, "_limiter", None)
         if limiter is not None:
             limiter.acquire()
         raw = getattr(self._store, "_store", self._store)
         locked = getattr(raw, "locked", None)
-        with locked() if callable(locked) else contextlib.nullcontext():
-            budgets = (
-                self._node_budgets(raw, {b.node_name for b in bindings})
-                if callable(locked)
-                else {}
-            )
+        # budgets fill in under the lock (prepare), and the apply
+        # closures — which also run under that same hold — read them
+        budgets: Dict[str, list] = {}
+        items = [
+            (b.pod_namespace, b.pod_name, apply_for(b, budgets))
+            for b in bindings
+        ]
+        if not callable(locked):
             return raw.mutate_many(
                 KIND_POD,
-                [
-                    (b.pod_namespace, b.pod_name, apply_for(b, budgets))
-                    for b in bindings
-                ],
+                items,
                 return_objects=return_objects,
                 clone_for_write=False,
             )
+
+        def prepare(store) -> None:
+            budgets.update(
+                self._node_budgets(store, {b.node_name for b in bindings})
+            )
+
+        return raw.mutate_many(
+            KIND_POD,
+            items,
+            return_objects=return_objects,
+            clone_for_write=False,
+            prepare=prepare,
+        )
 
 
 class Client:

@@ -55,6 +55,7 @@ import json
 import os
 import threading
 import time
+from contextlib import nullcontext as _null_ctx
 from typing import Any, Dict, Optional
 
 from minisched_tpu.controlplane.checkpoint import (
@@ -67,6 +68,7 @@ from minisched_tpu.controlplane.checkpoint import (
 from minisched_tpu.controlplane.store import (
     DEFAULT_HISTORY_BYTES,
     DEFAULT_HISTORY_EVENTS,
+    Conflict,
     EventType,
     ObjectStore,
     StorageDegraded,
@@ -96,6 +98,32 @@ ACK_REPLAY_CAP = 65536
 
 #: sha256 sidecar suffix for checkpoint files
 CKPT_DIGEST_SUFFIX = ".sha256"
+
+#: overlay marker for a staged-but-unpublished DELETE (see _gc_pending)
+_GC_TOMB = object()
+
+
+class _GroupEntry:
+    """One staged mutation (or one staged batch) awaiting its group's
+    commit barrier.  ``frames`` is the already-encoded WAL byte stream
+    for the entry — (frame bytes, payload length) pairs, the length kept
+    so the leader can mirror ``_append_raw``'s fault-injection offsets.
+    ``publish``/``undo`` run under the store lock: publish applies the
+    in-memory commit + watch fanout after the group's IO landed; undo
+    reverts the reservation-time effects (overlay entry, node-aggregate
+    deltas) when the group's IO failed.  ``done``/``err`` are guarded by
+    the store's group-commit condition."""
+
+    __slots__ = ("frames", "publish", "undo", "result", "key", "done", "err")
+
+    def __init__(self, frames, publish, undo, result, key=""):
+        self.frames = frames
+        self.publish = publish
+        self.undo = undo
+        self.result = result
+        self.key = key
+        self.done = False
+        self.err = None
 
 
 def _sha256_hex(data: bytes) -> str:
@@ -165,6 +193,18 @@ class DurableObjectStore(ObjectStore):
         self._ckpt_path = checkpoint_path or path + ".ckpt"
         self._archive = archive_compacted
         self._fsync = fsync
+        # slow-disk emulation: a FLOOR on every fsync's duration, in
+        # microseconds (MINISCHED_FSYNC_FLOOR_US; 0 = real device).
+        # The bench `wal` role arms it for BOTH its phases so the
+        # group-commit comparison models a disk whose durability
+        # barrier actually costs something — tmpfs/virtio fsyncs are
+        # near-free, which would hide any fsync-coalescing win.
+        try:
+            self._fsync_floor_s = (
+                float(os.environ.get("MINISCHED_FSYNC_FLOOR_US", "0")) / 1e6
+            )
+        except ValueError:
+            self._fsync_floor_s = 0.0
         self._salvage = salvage
         self._readonly = readonly
         self._closed = False
@@ -186,7 +226,35 @@ class DurableObjectStore(ObjectStore):
         self._last_probe = 0.0
         self._scrub_stop: Optional[threading.Event] = None
         self._scrub_thread: Optional[threading.Thread] = None
+        # -- group commit (off-lock durability pipeline) ----------------
+        # A mutation validates + reserves its rv under a short store-lock
+        # hold, stages its framed record, releases the lock, and blocks
+        # on the commit barrier: a leader-elected caller drains the
+        # stage under _io_lock, writes every pending frame in ONE
+        # buffered write (+ one fsync when armed), then publishes the
+        # group — in-memory apply + watch fanout in strict rv order —
+        # and only then are the waiters acked.  Lock order everywhere:
+        # _io_lock → store lock → _gc_cond.  MINISCHED_GROUP_COMMIT=0
+        # is the kill-switch restoring the exact per-mutation path.
+        self._gc_enabled = (not readonly) and os.environ.get(
+            "MINISCHED_GROUP_COMMIT", "1"
+        ) != "0"
+        self._io_lock = threading.Lock()  # physical WAL IO (leader, acks,
+        # compaction, recovery probes) — NEVER taken while holding the
+        # store lock, except non-blocking (probe)
+        self._gc_cond = threading.Condition()
+        self._gc_stage: list = []  # staged _GroupEntry, rv order
+        self._gc_leading = False  # exactly one leader at a time
+        #: (kind, key) → (token, staged object | _GC_TOMB): the state a
+        #: reservation produced but the barrier has not published yet.
+        #: Validators resolve "current" through this overlay so two
+        #: concurrent creates of one key (or a CAS against a staged rv)
+        #: are decided under the reservation lock, not at the barrier.
+        self._gc_pending: Dict[tuple, tuple] = {}
+        self._gc_token = 0
+        self._gc_visible_rv = 0  # highest PUBLISHED rv (≤ _rv while staged)
         self._replay()
+        self._gc_visible_rv = self._rv
         if readonly:
             self._closed = True  # mutations refused; reads keep serving
         else:
@@ -261,6 +329,21 @@ class DurableObjectStore(ObjectStore):
         now = time.monotonic()
         if self._log is None or now - self._last_probe < self._probe_interval_s:
             return
+        if self._gc_enabled:
+            # lock order is io → store and the caller already holds the
+            # store lock: probe only when the IO lock is FREE (non-
+            # blocking try) — a busy leader's own append outcome re-arms
+            # or re-stamps the latch anyway, so a skipped tick is safe
+            if not self._io_lock.acquire(blocking=False):
+                return
+            try:
+                self._probe_once(now)
+            finally:
+                self._io_lock.release()
+        else:
+            self._probe_once(now)
+
+    def _probe_once(self, now: float) -> None:
         self._last_probe = now
         counters.inc("storage.recovery_probe")
         try:
@@ -333,7 +416,7 @@ class DurableObjectStore(ObjectStore):
                     f"short WAL write ({n}/{len(frame)} bytes)",
                 )
             if not self._defer_flush and self._fsync:
-                os.fsync(self._log.fileno())
+                self._fsync_now()
             hist.observe("storage.wal_append_s", time.monotonic() - t0)
         except OSError as e:
             if pre_end is not None:
@@ -355,27 +438,333 @@ class DurableObjectStore(ObjectStore):
             # — the gate refuses first — but never strand the latch)
             self._exit_degraded()
 
-    def mutate_many(self, kind: str, items, return_objects: bool = True,
-                    clone_for_write: bool = True) -> list:
-        """Batch read-modify-write with ONE fsync: every record is
-        written (durability order preserved — same lock, same order via
-        the _on_batch_commit hook, each an immediate unbuffered write),
-        but the fsync is paid once per batch instead of per bind."""
+    # -- group commit (the off-lock durability pipeline) -------------------
+    def _visible_rv(self) -> int:
+        """Published rv for snapshot stamps (caller holds the store
+        lock): while mutations are staged, ``_rv`` runs ahead of what
+        the maps (and any watcher) can see — stamping it on a watch or
+        list_with_rv would promise events that were never delivered."""
+        if self._gc_enabled:
+            return self._gc_visible_rv
+        return self._rv
+
+    def _gc_frame(self, rec: dict) -> tuple:
+        payload = json.dumps(rec).encode()
+        return (encode_frame(payload), len(payload))
+
+    def _gc_frame_put(self, kind: str, stored: Any) -> tuple:
+        if self._loggable(kind):
+            return self._gc_frame(
+                {"op": "put", "kind": kind, "obj": _encode(stored)}
+            )
+        # volatile kinds stage a bare rv watermark (see
+        # _append_rv_watermark) so the replayed counter stays exact
+        return self._gc_frame(
+            {"op": "rv", "rv": stored.metadata.resource_version}
+        )
+
+    def _gc_frame_del(self, kind: str, obj: Any, rv: int) -> tuple:
+        if self._loggable(kind):
+            return self._gc_frame(
+                {"op": "del", "kind": kind, "key": obj.metadata.key, "rv": rv}
+            )
+        return self._gc_frame({"op": "rv", "rv": rv})
+
+    def _gc_current(self, kind: str, key: str) -> Any:
+        """Reservation-visible state of one key (caller holds the store
+        lock): the staged overlay wins over the published maps, so
+        validation against concurrent in-flight mutations is decided
+        here — under the reservation lock — never at the barrier.
+        Returns None for absent OR staged-deleted."""
+        pend = self._gc_pending.get((kind, key))
+        if pend is not None:
+            return None if pend[1] is _GC_TOMB else pend[1]
+        return self._objects.get(kind, {}).get(key)
+
+    def _gc_reserve(self, kind: str, key: str, val: Any) -> int:
+        self._gc_token += 1
+        self._gc_pending[(kind, key)] = (self._gc_token, val)
+        return self._gc_token
+
+    def _gc_release(self, kind: str, key: str, token: int) -> None:
+        # token-guarded: a LATER reservation on the same key must not be
+        # clobbered by an earlier entry's publish/undo
+        cur = self._gc_pending.get((kind, key))
+        if cur is not None and cur[0] == token:
+            del self._gc_pending[(kind, key)]
+
+    def _gc_run(self, kind: str, build) -> Any:
+        """One mutation through the pipeline: the short lock hold
+        (gate + validate + reserve + stage via ``build``), then the
+        off-lock barrier wait.  ``build`` raises to refuse (Conflict,
+        KeyError, fault injection) with nothing staged."""
         with self._lock:
             self._check_open()
             self._check_wal_writable(kind)
-            self._defer_flush = True
+            entry = build()
+            if not entry.frames:
+                # nothing durable to write (every batch item failed
+                # validation): publish is a no-op fanout — return now
+                entry.publish()
+                return entry.result
+            with self._gc_cond:
+                self._gc_stage.append(entry)
+        return self._gc_await(entry)
+
+    def _gc_await(self, entry: _GroupEntry) -> Any:
+        """Block until the entry's group commits (or fails).  MySQL-style
+        leader election: the first waiter that finds no leader becomes
+        it and commits the whole stage; everyone else parks on the
+        condition and is acked by the leader's publish."""
+        t0 = time.monotonic()
+        while True:
+            with self._gc_cond:
+                while not entry.done and self._gc_leading:
+                    self._gc_cond.wait()
+                if entry.done:
+                    break
+                self._gc_leading = True
             try:
-                # the batched fsync is the base class's _flush_log call,
-                # which lands BEFORE the fanout and RAISES on failure —
-                # an un-fsynced batch must not be acknowledged or fanned
-                # out (with fsync=True that is the whole durability
-                # promise); the finally only clears the defer flag
-                return super().mutate_many(
-                    kind, items, return_objects, clone_for_write
-                )
+                self._gc_lead()
             finally:
-                self._defer_flush = False
+                with self._gc_cond:
+                    self._gc_leading = False
+                    self._gc_cond.notify_all()
+        hist.observe(
+            "storage.group_wait_s", time.monotonic() - t0, exemplar=entry.key
+        )
+        if entry.err is not None:
+            raise entry.err
+        return entry.result
+
+    def _gc_lead(self) -> None:
+        """Leader turn: drain the stage UNDER the IO lock (drain order ==
+        rv order == WAL byte order — a drain outside it could be
+        overtaken by a concurrent drainer and write groups out of
+        order), commit the group, publish, ack.  One group per turn:
+        entries staged during our IO elect their own leader."""
+        with self._io_lock:
+            with self._gc_cond:
+                group, self._gc_stage = self._gc_stage, []
+            if group:
+                self._gc_commit_group(group)
+
+    def _gc_commit_group(self, group: list) -> None:
+        """Write one group's frames in a single buffered write + at most
+        one fsync, then publish in rv order.  Caller holds _io_lock
+        (store lock NOT held — that is the whole point).  Failure
+        (ENOSPC/EIO, injected or real) fails the WHOLE group typed with
+        nothing published — see _gc_fail."""
+        faults = self.faults
+        err: Optional[OSError] = None
+        parts: list = []
+        nrecords = 0
+        for entry in group:
+            for frame, plen in entry.frames:
+                # mirror _append_raw's injection points per record, so
+                # fault schedules key on real appends in either mode
+                if faults is not None and faults.should_fire(
+                    "disk.enospc", self._path
+                ):
+                    err = OSError(
+                        errno.ENOSPC, "injected: no space left on device"
+                    )
+                    break
+                if faults is not None:
+                    if faults.should_fire("wal.bitflip", self._path):
+                        buf = bytearray(frame)
+                        buf[HEADER_SIZE + plen // 2] ^= 0x01
+                        frame = bytes(buf)
+                        counters.inc("storage.bitflip_injected")
+                    elif faults.should_fire("wal.torn_mid", self._path):
+                        frame = frame[: HEADER_SIZE + max(plen // 2, 1)]
+                        counters.inc("storage.torn_injected")
+                parts.append(frame)
+                nrecords += 1
+            if err is not None:
+                break
+        if err is None and self._log is None:
+            err = OSError(errno.EIO, "WAL log unavailable")
+        if err is None:
+            buf = b"".join(parts)
+            try:
+                pre_end = self._log.tell()  # append mode: current EOF
+            except OSError:
+                pre_end = None
+            try:
+                t0 = time.monotonic()
+                n = self._log.write(buf)
+                if n is not None and n != len(buf):
+                    raise OSError(
+                        errno.ENOSPC,
+                        f"short WAL write ({n}/{len(buf)} bytes)",
+                    )
+                hist.observe("storage.wal_append_s", time.monotonic() - t0)
+                if self._fsync:
+                    t0 = time.monotonic()
+                    self._fsync_now()
+                    hist.observe(
+                        "storage.wal_fsync_s", time.monotonic() - t0
+                    )
+            except OSError as e:
+                if pre_end is not None:
+                    # cut any partial frame back off the tail (see
+                    # _append_raw: truncate-to-smaller works on a full
+                    # disk) so probes never append after garbage
+                    try:
+                        self._log.truncate(pre_end)
+                    except OSError:
+                        pass
+                err = e
+        if err is not None:
+            self._gc_fail(group, err)
+            return
+        with self._lock:
+            # publish in strict rv order: maps apply + history + fanout,
+            # exactly the visibility step the per-mutation path ran
+            # under its (much longer) lock hold
+            for entry in group:
+                entry.publish()
+            if self._degraded:
+                self._exit_degraded()  # never strand the latch
+        counters.inc("storage.group_commit.groups")
+        counters.inc("storage.group_commit.records", nrecords)
+        if self._fsync and len(group) > 1:
+            counters.inc("storage.group_commit.fsyncs_saved", len(group) - 1)
+        with self._gc_cond:
+            for entry in group:
+                entry.done = True
+            self._gc_cond.notify_all()
+
+    def _gc_fail(self, group: list, err: OSError) -> None:
+        """A failed group never happened: latch degraded, revert every
+        reservation-time effect (newest first), and fail EVERY waiter
+        typed — including entries staged after the drain, which were
+        validated against reservations this failure just reverted.
+        Caller holds _io_lock."""
+        with self._lock:
+            self._enter_degraded(err)
+            counters.inc("storage.append_error")
+            with self._gc_cond:
+                tail, self._gc_stage = self._gc_stage, []
+            doomed = group + tail
+            for entry in reversed(doomed):
+                entry.undo()
+            with self._gc_cond:
+                for entry in doomed:
+                    failure = StorageDegraded(f"WAL append failed: {err}")
+                    failure.__cause__ = err
+                    entry.err = failure
+                    entry.done = True
+                self._gc_cond.notify_all()
+
+    def _gc_drain_commit_locked(self) -> None:
+        """Commit whatever is staged, inline, as one final group — for
+        callers that already hold _io_lock + the store lock (compaction,
+        close) and must leave the stage empty before proceeding.  The
+        store lock being held keeps new entries from staging underneath
+        (lock order forbids staging without it)."""
+        with self._gc_cond:
+            group, self._gc_stage = self._gc_stage, []
+        if group:
+            self._gc_commit_group(group)
+
+    def mutate_many(self, kind: str, items, return_objects: bool = True,
+                    clone_for_write: bool = True, prepare=None) -> list:
+        """Batch read-modify-write.  Group-commit mode stages the whole
+        batch as ONE entry (per-item validation errors stay per-entry in
+        the returned list; an IO failure fails the whole call typed) and
+        parks on the barrier off-lock.  Kill-switch mode is the original
+        deferred-fsync path: every record an immediate unbuffered write
+        under the lock, one fsync per batch."""
+        if not self._gc_enabled:
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                self._defer_flush = True
+                try:
+                    # the batched fsync is the base class's _flush_log
+                    # call, which lands BEFORE the fanout and RAISES on
+                    # failure — an un-fsynced batch must not be
+                    # acknowledged or fanned out (with fsync=True that
+                    # is the whole durability promise); the finally
+                    # only clears the defer flag
+                    return super().mutate_many(
+                        kind, items, return_objects, clone_for_write,
+                        prepare=prepare,
+                    )
+                finally:
+                    self._defer_flush = False
+
+        def build():
+            if prepare is not None:
+                prepare(self)
+            out: list = []
+            frames: list = []
+            events: list = []
+            staged: list = []  # (key, token, old, work)
+            for namespace, name, fn in items:
+                key = f"{namespace}/{name}"
+                try:
+                    self._maybe_fault("update", kind, key)
+                    old = self._gc_current(kind, key)
+                    if old is None:
+                        raise KeyError(f"{kind} {key!r} not found")
+                    if clone_for_write:
+                        work = old.clone()
+                        work = fn(work) or work
+                    else:
+                        work = fn(old)
+                    work.metadata.uid = old.metadata.uid
+                    work.metadata.creation_timestamp = (
+                        old.metadata.creation_timestamp
+                    )
+                    rv = work.metadata.resource_version = self._bump()
+                    frames.append(self._gc_frame_put(kind, work))
+                    token = self._gc_reserve(kind, key, work)
+                    self._node_agg_track(kind, old, work)
+                    staged.append((key, token, old, work))
+                    out.append(work.clone() if return_objects else None)
+                    events.append(
+                        WatchEvent(EventType.MODIFIED, work, old, rv=rv)
+                    )
+                except Exception as err:  # noqa: BLE001 — returned, not lost
+                    out.append(err)
+
+            def publish():
+                objs = self._objects.setdefault(kind, {})
+                for key, token, _old, work in staged:
+                    objs[key] = work
+                    self._gc_release(kind, key, token)
+                if events:
+                    self._gc_visible_rv = max(
+                        self._gc_visible_rv, events[-1].rv
+                    )
+                self._fanout_many(kind, events)
+
+            def undo():
+                for key, token, old, work in reversed(staged):
+                    self._gc_release(kind, key, token)
+                    self._node_agg_track(kind, work, old)
+
+            return _GroupEntry(
+                frames, publish, undo, out,
+                staged[0][0] if staged else "",
+            )
+
+        return self._gc_run(kind, build)
+
+    def _fsync_now(self) -> None:
+        """``os.fsync`` with the optional emulated duration floor
+        (MINISCHED_FSYNC_FLOOR_US — see __init__): when the real device
+        answers faster than the floor, sleep the remainder.  Never
+        swallows the OSError — the floor only stretches successes."""
+        t0 = time.monotonic()
+        os.fsync(self._log.fileno())
+        if self._fsync_floor_s > 0.0:
+            rem = self._fsync_floor_s - (time.monotonic() - t0)
+            if rem > 0.0:
+                time.sleep(rem)
 
     def _fsync_log(self) -> None:
         """The deferred-batch fsync barrier: raises StorageDegraded on
@@ -384,7 +773,7 @@ class DurableObjectStore(ObjectStore):
         if self._log is not None and self._fsync:
             try:
                 t0 = time.monotonic()
-                os.fsync(self._log.fileno())
+                self._fsync_now()
                 hist.observe("storage.wal_fsync_s", time.monotonic() - t0)
             except OSError as e:
                 self._enter_degraded(e)
@@ -441,53 +830,253 @@ class DurableObjectStore(ObjectStore):
         self._fsync_log()
 
     def create(self, kind: str, obj: Any) -> Any:
-        with self._lock:
-            self._check_open()
-            self._check_wal_writable(kind)
-            return super().create(kind, obj)
+        if not self._gc_enabled:
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                return super().create(kind, obj)
+
+        def build():
+            from minisched_tpu.api.objects import new_uid
+
+            key = self._key(obj)
+            self._maybe_fault("create", kind, key)
+            if self._gc_current(kind, key) is not None:
+                raise KeyError(f"{kind} {key!r} already exists")
+            stored = obj.clone()
+            if not stored.metadata.uid:
+                stored.metadata.uid = new_uid(kind.lower())
+            rv = stored.metadata.resource_version = self._bump()
+            if not stored.metadata.creation_timestamp:
+                stored.metadata.creation_timestamp = time.time()
+            token = self._gc_reserve(kind, key, stored)
+            self._node_agg_track(kind, None, stored)
+
+            def publish():
+                self._objects.setdefault(kind, {})[key] = stored
+                self._gc_release(kind, key, token)
+                self._gc_visible_rv = max(self._gc_visible_rv, rv)
+                self._fanout(
+                    kind, WatchEvent(EventType.ADDED, stored, rv=rv)
+                )
+
+            def undo():
+                self._gc_release(kind, key, token)
+                self._node_agg_track(kind, stored, None)
+
+            return _GroupEntry(
+                [self._gc_frame_put(kind, stored)],
+                publish, undo, stored.clone(), key,
+            )
+
+        return self._gc_run(kind, build)
 
     def create_many(
         self, kind: str, objs: list, return_objects: bool = True
     ) -> list:
-        """Batch create with ONE fsync — same deferred-fsync contract
-        as mutate_many (records append in commit order via
+        """Batch create: one staged entry through the group barrier (one
+        buffered write + one fsync for the batch AND any concurrent
+        mutations it groups with).  Kill-switch mode is the original
+        deferred-fsync contract (records append in commit order via
         _on_batch_commit, the barrier lands before the batched fanout)."""
-        with self._lock:
-            self._check_open()
-            self._check_wal_writable(kind)
-            self._defer_flush = True
-            try:
-                # fsync rides the base class's pre-fanout _flush_log
-                # barrier and raises on failure (see mutate_many)
-                return super().create_many(kind, objs, return_objects)
-            finally:
-                self._defer_flush = False
+        if not self._gc_enabled:
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                self._defer_flush = True
+                try:
+                    # fsync rides the base class's pre-fanout _flush_log
+                    # barrier and raises on failure (see mutate_many)
+                    return super().create_many(kind, objs, return_objects)
+                finally:
+                    self._defer_flush = False
+
+        def build():
+            from minisched_tpu.api.objects import new_uid
+
+            out: list = []
+            frames: list = []
+            events: list = []
+            staged: list = []  # (key, token, stored)
+            for obj in objs:
+                key = self._key(obj)
+                try:
+                    self._maybe_fault("create", kind, key)
+                    if self._gc_current(kind, key) is not None:
+                        raise KeyError(f"{kind} {key!r} already exists")
+                    stored = obj.clone()
+                    if not stored.metadata.uid:
+                        stored.metadata.uid = new_uid(kind.lower())
+                    rv = stored.metadata.resource_version = self._bump()
+                    if not stored.metadata.creation_timestamp:
+                        stored.metadata.creation_timestamp = time.time()
+                    frames.append(self._gc_frame_put(kind, stored))
+                    token = self._gc_reserve(kind, key, stored)
+                    self._node_agg_track(kind, None, stored)
+                    staged.append((key, token, stored))
+                    out.append(stored.clone() if return_objects else None)
+                    events.append(
+                        WatchEvent(EventType.ADDED, stored, rv=rv)
+                    )
+                except Exception as err:  # noqa: BLE001 — returned, not lost
+                    out.append(err)
+
+            def publish():
+                objs_map = self._objects.setdefault(kind, {})
+                for key, token, stored in staged:
+                    objs_map[key] = stored
+                    self._gc_release(kind, key, token)
+                if events:
+                    self._gc_visible_rv = max(
+                        self._gc_visible_rv, events[-1].rv
+                    )
+                self._fanout_many(kind, events)
+
+            def undo():
+                for key, token, stored in reversed(staged):
+                    self._gc_release(kind, key, token)
+                    self._node_agg_track(kind, stored, None)
+
+            return _GroupEntry(
+                frames, publish, undo, out,
+                staged[0][0] if staged else "",
+            )
+
+        return self._gc_run(kind, build)
 
     def update(self, kind: str, obj: Any, expected_rv: Optional[int] = None) -> Any:
-        with self._lock:
-            self._check_open()
-            self._check_wal_writable(kind)
-            return super().update(kind, obj, expected_rv=expected_rv)
+        if not self._gc_enabled:
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                return super().update(kind, obj, expected_rv=expected_rv)
+        return self._gc_run(
+            kind, lambda: self._gc_build_update(kind, obj, expected_rv)
+        )
+
+    def _gc_build_update(
+        self, kind: str, obj: Any, expected_rv: Optional[int]
+    ) -> _GroupEntry:
+        """Stage one update (caller holds the store lock): the
+        ``expected_rv`` CAS is decided HERE, against the reservation-
+        visible state (staged overlay wins), never at the barrier."""
+        key = self._key(obj)
+        self._maybe_fault("update", kind, key)
+        old = self._gc_current(kind, key)
+        if old is None:
+            raise KeyError(f"{kind} {key!r} not found")
+        if (
+            expected_rv is not None
+            and old.metadata.resource_version != expected_rv
+        ):
+            raise Conflict(
+                f"stale resource_version for {kind} {key}: expected "
+                f"{expected_rv}, have {old.metadata.resource_version}"
+            )
+        stored = obj.clone()
+        stored.metadata.uid = old.metadata.uid
+        stored.metadata.creation_timestamp = old.metadata.creation_timestamp
+        rv = stored.metadata.resource_version = self._bump()
+        token = self._gc_reserve(kind, key, stored)
+        self._node_agg_track(kind, old, stored)
+
+        def publish():
+            self._objects.setdefault(kind, {})[key] = stored
+            self._gc_release(kind, key, token)
+            self._gc_visible_rv = max(self._gc_visible_rv, rv)
+            self._fanout(
+                kind, WatchEvent(EventType.MODIFIED, stored, old, rv=rv)
+            )
+
+        def undo():
+            self._gc_release(kind, key, token)
+            self._node_agg_track(kind, stored, old)
+
+        return _GroupEntry(
+            [self._gc_frame_put(kind, stored)],
+            publish, undo, stored.clone(), key,
+        )
+
+    def mutate(
+        self, kind: str, namespace: str, name: str, fn
+    ) -> Any:
+        """Read-modify-write.  The base implementation holds the store
+        lock across get+update — in group-commit mode that would park
+        on the barrier still owning the lock, so the RMW is restaged
+        here: read + fn + reserve under ONE short hold, wait off-lock."""
+        if not self._gc_enabled:
+            return super().mutate(kind, namespace, name, fn)
+
+        def build():
+            key = f"{namespace}/{name}"
+            self._maybe_fault("get", kind, key)
+            cur = self._gc_current(kind, key)
+            if cur is None:
+                raise KeyError(f"{kind} {namespace}/{name} not found")
+            work = cur.clone()
+            work = fn(work) or work
+            return self._gc_build_update(kind, work, None)
+
+        return self._gc_run(kind, build)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        with self._lock:
-            self._check_open()
-            self._check_wal_writable(kind)
-            super().delete(kind, namespace, name)
+        if not self._gc_enabled:
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                super().delete(kind, namespace, name)
+            return
+
+        def build():
+            key = f"{namespace}/{name}"
+            self._maybe_fault("delete", kind, key)
+            old = self._gc_current(kind, key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            rv = self._bump()
+            token = self._gc_reserve(kind, key, _GC_TOMB)
+            self._node_agg_track(kind, old, None)
+
+            def publish():
+                self._objects.get(kind, {}).pop(key, None)
+                self._gc_release(kind, key, token)
+                self._gc_visible_rv = max(self._gc_visible_rv, rv)
+                self._fanout(kind, WatchEvent(EventType.DELETED, old, rv=rv))
+
+            def undo():
+                self._gc_release(kind, key, token)
+                self._node_agg_track(kind, None, old)
+
+            return _GroupEntry(
+                [self._gc_frame_del(kind, old, rv)], publish, undo, None, key
+            )
+
+        return self._gc_run(kind, build)
 
     def restore_object(self, kind: str, obj: Any) -> None:
-        with self._lock:
-            self._check_open()
-            self._check_wal_writable(kind)
-            super().restore_object(kind, obj)
+        # rare recovery/restore path with no concurrent traffic by
+        # contract: a direct append under the IO lock (order io → store)
+        # rather than the stage — its rv is the object's own, not a
+        # fresh reservation, so barrier ordering does not apply
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                self._check_open()
+                self._check_wal_writable(kind)
+                super().restore_object(kind, obj)
+                if self._gc_enabled:
+                    self._gc_visible_rv = max(self._gc_visible_rv, self._rv)
 
     def set_resource_version(self, rv: int) -> None:
-        with self._lock:
-            super().set_resource_version(rv)
-            # checkpoint restores fast-forward past the max object rv (e.g.
-            # trailing deletes before the snapshot) — persist the watermark
-            # or reopened stores would re-issue observed versions
-            self._append({"op": "rv", "rv": self.resource_version})
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                super().set_resource_version(rv)
+                # checkpoint restores fast-forward past the max object rv
+                # (e.g. trailing deletes before the snapshot) — persist
+                # the watermark or reopened stores would re-issue
+                # observed versions
+                self._append({"op": "rv", "rv": self.resource_version})
+                if self._gc_enabled:
+                    self._gc_visible_rv = max(self._gc_visible_rv, self._rv)
 
     # -- binding-ack persistence (WAL-backed retry idempotency) ------------
     def record_acks(self, entries: Dict[str, dict]) -> None:
@@ -501,23 +1090,27 @@ class DurableObjectStore(ObjectStore):
         than failing the bind response that already committed."""
         if not entries:
             return
-        with self._lock:
-            if self._closed or self._degraded or self._log is None:
-                return
-            self._defer_flush = True
-            try:
-                for ack_id, entry in entries.items():
-                    self._append_raw(
-                        {"op": "ack", "id": str(ack_id), "entry": entry}
-                    )
-                    self._acks[str(ack_id)] = entry
-                    while len(self._acks) > ACK_REPLAY_CAP:
-                        self._acks.pop(next(iter(self._acks)))
-                self._fsync_log()
-            except StorageDegraded:
-                pass  # latched; the in-memory registry still answers
-            finally:
-                self._defer_flush = False
+        # ack records are volatile (no rv, no publish ordering), so they
+        # bypass the group stage — but the physical appends still
+        # serialize with the group leader's IO (lock order io → store)
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                if self._closed or self._degraded or self._log is None:
+                    return
+                self._defer_flush = True
+                try:
+                    for ack_id, entry in entries.items():
+                        self._append_raw(
+                            {"op": "ack", "id": str(ack_id), "entry": entry}
+                        )
+                        self._acks[str(ack_id)] = entry
+                        while len(self._acks) > ACK_REPLAY_CAP:
+                            self._acks.pop(next(iter(self._acks)))
+                    self._fsync_log()
+                except StorageDegraded:
+                    pass  # latched; the in-memory registry still answers
+                finally:
+                    self._defer_flush = False
 
     def recovered_acks(self) -> Dict[str, dict]:
         """Ack outcomes replayed from the WAL, in append order (the HTTP
@@ -847,7 +1440,23 @@ class DurableObjectStore(ObjectStore):
         WAL truncation only ever happens after BOTH renames, so the prev
         arm always has the full tail it needs.  ``archive_compacted``
         appends the truncated records to ``<path>.history`` first so the
-        full mutation history stays auditable."""
+        full mutation history stays auditable.
+
+        Group-commit mode: the pending stage is committed — as one final
+        group — under the SAME io+store hold that takes the snapshot.
+        Without that, ``_ckpt_rv = _rv`` would cover reserved rvs whose
+        frames were still unwritten, and replay's rv-skip would drop
+        mutations whose waiters were (about to be) acked.  Holding the
+        store lock throughout keeps anything new from staging, and
+        holding the IO lock keeps the leader out of the log while it is
+        closed/truncated/reopened."""
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                if self._gc_enabled:
+                    self._gc_drain_commit_locked()
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
         with self._lock:
             doc = build_snapshot_doc(self._objects, self._rv)
             if self._acks:
@@ -961,15 +1570,24 @@ class DurableObjectStore(ObjectStore):
                 self._maybe_probe_recovery()
             from minisched_tpu.controlplane.store import compute_node_agg
 
-            agg_live = {k: list(v) for k, v in self._pod_node_agg.items()}
-            recompute = compute_node_agg(
-                self._objects.get("Pod", {}).values()
-            )
-            if agg_live != recompute:
-                findings.append(
-                    "node aggregate index diverged from live objects: "
-                    f"{sorted(set(agg_live) ^ set(recompute))[:5]}"
+            if not self._gc_pending:
+                # staged-but-unpublished reservations debit the index
+                # EAGERLY (that is what keeps concurrent binders from
+                # overcommitting a node), so while anything is staged
+                # the index legitimately runs ahead of the published
+                # maps — skip the comparison for this pass rather than
+                # report design as divergence
+                agg_live = {
+                    k: list(v) for k, v in self._pod_node_agg.items()
+                }
+                recompute = compute_node_agg(
+                    self._objects.get("Pod", {}).values()
                 )
+                if agg_live != recompute:
+                    findings.append(
+                        "node aggregate index diverged from live objects: "
+                        f"{sorted(set(agg_live) ^ set(recompute))[:5]}"
+                    )
             max_obj_rv = max(
                 (
                     o.metadata.resource_version
@@ -1040,6 +1658,14 @@ class DurableObjectStore(ObjectStore):
             }
 
     def close(self) -> None:
+        if getattr(self, "_gc_enabled", False):
+            # commit whatever is staged first so no waiter hangs on a
+            # barrier that will never run (waiters are acked or failed
+            # typed before the log handle goes away)
+            with self._io_lock:
+                with self._lock:
+                    if not self._closed:
+                        self._gc_drain_commit_locked()
         if self._scrub_stop is not None:
             self._scrub_stop.set()
         if self._scrub_thread is not None:
@@ -1050,6 +1676,19 @@ class DurableObjectStore(ObjectStore):
             if self._log is not None:
                 self._log.close()
                 self._log = None
+        if getattr(self, "_gc_enabled", False):
+            # anything that slipped into the stage between the drain and
+            # the close latch: fail it loudly, never strand its waiter
+            with self._gc_cond:
+                leftover, self._gc_stage = self._gc_stage, []
+                for entry in leftover:
+                    entry.err = RuntimeError(
+                        f"durable store {self._path!r} closed before the "
+                        f"commit barrier ran"
+                    )
+                    entry.done = True
+                if leftover:
+                    self._gc_cond.notify_all()
 
 
 def store_from_url(url: str) -> Optional[ObjectStore]:

@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent import futures
 from typing import Any, Callable, Optional, Tuple
 
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
+from minisched_tpu.observability import hist
 
 SERVICE = "minisched.Evaluator"
 
@@ -195,9 +197,16 @@ def _handlers():
     import grpc
 
     def health(request_bytes: bytes, context) -> bytes:
-        return _wrap_json(json.dumps({"ok": True}).encode())
+        t0 = time.monotonic()
+        try:
+            return _wrap_json(json.dumps({"ok": True}).encode())
+        finally:
+            hist.observe(
+                "grpc.request_s", time.monotonic() - t0, method="Health"
+            )
 
     def evaluate(request_bytes: bytes, context) -> bytes:
+        t0 = time.monotonic()
         try:
             request = json.loads(_unwrap_json(request_bytes).decode("utf-8"))
             return _wrap_json(json.dumps(evaluate_cluster(request)).encode())
@@ -206,6 +215,12 @@ def _handlers():
             # ValueError; evaluator bugs deliberately fall through as
             # server errors
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        finally:
+            # aborts and evaluator crashes are observed too: latency of
+            # the ANSWER, whatever the answer was
+            hist.observe(
+                "grpc.request_s", time.monotonic() - t0, method="Evaluate"
+            )
 
     rpcs = {
         "Health": grpc.unary_unary_rpc_method_handler(

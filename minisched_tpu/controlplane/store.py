@@ -547,6 +547,22 @@ class ObjectStore:
                 continue
             w._deliver(event)
 
+    def _fanout_many(self, kind: str, events: List[WatchEvent]) -> None:
+        """Batched fanout (caller holds the lock): history append per
+        event, then ONE _deliver_many per watcher — the shared tail of
+        create_many/mutate_many and the group-commit publish path."""
+        for ev in events:
+            self._record_history(kind, ev)
+        faults = self.faults
+        for w in list(self._watches.get(kind, ())):
+            if w.stopped:
+                self._remove_watch(kind, w)  # see _fanout
+                continue
+            if faults is not None and faults.should_fire("watch.drop", kind):
+                w.kill()  # the whole batch is lost to this stream
+                continue
+            w._deliver_many(events)
+
     # -- CRUD --------------------------------------------------------------
     def create(self, kind: str, obj: Any) -> Any:
         with self._lock:
@@ -632,19 +648,7 @@ class ObjectStore:
                 except Exception as err:  # noqa: BLE001 — returned, not lost
                     out.append(err)
             self._flush_log()
-            for ev in events:
-                self._record_history(kind, ev)
-            faults = self.faults
-            for w in list(self._watches.get(kind, ())):
-                if w.stopped:
-                    self._remove_watch(kind, w)  # see _fanout
-                    continue
-                if faults is not None and faults.should_fire(
-                    "watch.drop", kind
-                ):
-                    w.kill()
-                    continue
-                w._deliver_many(events)
+            self._fanout_many(kind, events)
         return out
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -671,7 +675,7 @@ class ObjectStore:
             self._maybe_fault("list", kind, "")
             return (
                 [o.clone() for o in self._objects.get(kind, {}).values()],
-                self._rv,
+                self._visible_rv(),
             )
 
     def update(
@@ -747,6 +751,7 @@ class ObjectStore:
         items: List[Tuple[str, str, Callable[[Any], Any]]],
         return_objects: bool = True,
         clone_for_write: bool = True,
+        prepare: Optional[Callable[["ObjectStore"], None]] = None,
     ) -> List[Any]:
         """Apply many read-modify-writes under ONE lock hold — the wave
         engine's batch bind (a wave commits thousands of placements; a
@@ -773,10 +778,21 @@ class ObjectStore:
         affinity/volumes for 16k pods was ~0.5s per wave).  The returned
         object must carry its OWN metadata instance (the store restamps
         resource_version on it).
+
+        ``prepare`` runs under the store lock BEFORE the item loop,
+        receiving this store: a caller that must derive shared state
+        atomically with the batch (the capacity-validated bind path
+        computes per-node budgets) hooks it here instead of wrapping
+        the whole call in ``locked()`` — the group-commit durable store
+        must NOT be entered with the lock already held (the caller
+        would then sleep on the commit barrier still owning the lock
+        every other mutator and the group leader need).
         """
         out: List[Any] = []
         events: List[WatchEvent] = []
         with self._lock:
+            if prepare is not None:
+                prepare(self)
             objs = self._objects.setdefault(kind, {})
             for namespace, name, fn in items:
                 key = f"{namespace}/{name}"
@@ -811,23 +827,11 @@ class ObjectStore:
                     out.append(err)
             # durability before visibility for the batch too: every item's
             # record was appended by _on_batch_commit; force it to disk
-            # BEFORE the events fan out (base store: no-op)
+            # BEFORE the events fan out (base store: no-op).  ONE batched
+            # fanout per watcher, still under the store lock so queue
+            # order equals mutation order across concurrent mutators.
             self._flush_log()
-            for ev in events:
-                self._record_history(kind, ev)
-            # ONE batched fanout per watcher, still under the store lock so
-            # queue order equals mutation order across concurrent mutators
-            faults = self.faults
-            for w in list(self._watches.get(kind, ())):
-                if w.stopped:
-                    self._remove_watch(kind, w)  # see _fanout
-                    continue
-                if faults is not None and faults.should_fire(
-                    "watch.drop", kind
-                ):
-                    w.kill()  # the whole batch is lost to this stream
-                    continue
-                w._deliver_many(events)
+            self._fanout_many(kind, events)
         return out
 
     def _on_batch_commit(self, kind: str, obj: Any) -> None:
@@ -846,6 +850,17 @@ class ObjectStore:
     def _flush_log(self) -> None:
         """Batch-path durability barrier (see mutate_many): force pending
         WAL records to disk before their events become visible."""
+
+    def _visible_rv(self) -> int:
+        """The resource_version the PUBLISHED state reflects (caller holds
+        the lock).  In the base store that is simply ``_rv``; the
+        group-commit durable store reserves rvs under a short lock hold
+        and publishes them only after the durability barrier, so its
+        visible rv lags the reserved counter while mutations are staged.
+        Snapshot stamps (``watch`` start_rv, ``list_with_rv``) must use
+        THIS — stamping a reserved-but-unpublished rv would promise
+        watchers that events at or below it were already delivered."""
+        return self._rv
 
     @property
     def resource_version(self) -> int:
@@ -950,7 +965,7 @@ class ObjectStore:
                     w._live = True
                 return w, []
             w = Watch(self, kind, self._watch_queue_events)
-            w.start_rv = self._rv
+            w.start_rv = self._visible_rv()
             snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
             if send_initial:
                 w._deliver_many(

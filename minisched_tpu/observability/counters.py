@@ -172,6 +172,14 @@ storage-integrity story under ``storage.`` — surfaced in the bench
     storage.ckpt_corrupt_injected
         — the fault fabric's lying-disk evidence (what was WRITTEN
           corrupt; the detection counters above are the other half)
+    storage.group_commit.groups / storage.group_commit.records
+        — commit-barrier turns the group-commit pipeline ran, and the
+          mutations they carried; records/groups is the live
+          coalescing ratio (1.0 = no concurrency, nothing batched)
+    storage.group_commit.fsyncs_saved
+        — fsyncs the barrier avoided versus the per-mutation path
+          (len(group)−1 per fsync-armed group): the entire point of
+          group commit, and the bench `wal` role's headline gate
 
 The robustness layer (PR 1: retry.py, informer reconnects, assume
 leases) records the recovery evidence the chaos soaks assert on:

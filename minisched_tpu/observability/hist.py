@@ -44,6 +44,22 @@ same contract as counters.py):
     storage.wal_append_s / storage.wal_fsync_s
         — durable-store WAL frame append (write + inline fsync when
           armed) and deferred batch-barrier fsync times
+    storage.group_wait_s
+        — time a mutation spends parked on the group-commit barrier
+          (stage → its group's fsync completing), observed by every
+          waiter including the self-elected leader; the exemplar
+          carries the object key of the waiter
+    grpc.request_s
+        — gRPC facade request latency, labeled ``method=`` (Health /
+          Evaluate) — the wire-RPC mirror of ``http.request_s``
+
+**Exemplars**: ``observe(..., exemplar="default/pod-1")`` stamps the
+bucket the sample lands in with that string (last writer wins, one per
+bucket — bounded state, no sample log).  The exposition renders them as
+OpenMetrics exemplars — `` # {key="default/pod-1"} 0.043`` appended to
+the owning ``_bucket`` line — so "what was the pod in the p99 bucket?"
+is answerable straight off a scrape; exemplar-free histograms render
+byte-identically to before.
 
 Pretty-print a live process: ``python -m minisched_tpu metrics <url>``.
 """
@@ -83,7 +99,7 @@ class Histogram:
     Lock-cheap: one uncontended Lock per child, three integer bumps and
     a float add inside it — no allocation, no sorting, no sample list."""
 
-    __slots__ = ("_mu", "counts", "overflow", "sum", "count")
+    __slots__ = ("_mu", "counts", "overflow", "sum", "count", "exemplars")
 
     def __init__(self) -> None:
         self._mu = threading.Lock()
@@ -91,8 +107,11 @@ class Histogram:
         self.overflow = 0
         self.sum = 0.0
         self.count = 0
+        #: bucket index (NBUCKETS = +Inf) → (exemplar string, value);
+        #: last writer wins, so state stays O(buckets) forever
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bucket_index(v)
         with self._mu:
             if i < NBUCKETS:
@@ -101,6 +120,8 @@ class Histogram:
                 self.overflow += 1
             self.sum += v
             self.count += 1
+            if exemplar is not None:
+                self.exemplars[i] = (str(exemplar), v)
 
     def merge_into(self, counts: List[int]) -> Tuple[int, float, int]:
         """Add this child's buckets into ``counts`` (len NBUCKETS);
@@ -118,6 +139,7 @@ class Histogram:
                 "overflow": self.overflow,
                 "sum": self.sum,
                 "count": self.count,
+                "exemplars": dict(self.exemplars),
             }
 
 
@@ -139,8 +161,14 @@ class Histograms:
                 h = self._hists[key] = Histogram()
         return h
 
-    def observe(self, name: str, v: float, **labels: str) -> None:
-        self._child(name, labels).observe(v)
+    def observe(
+        self,
+        name: str,
+        v: float,
+        exemplar: Optional[str] = None,
+        **labels: str,
+    ) -> None:
+        self._child(name, labels).observe(v, exemplar=exemplar)
 
     def get(self, name: str, **labels: str) -> Optional[Histogram]:
         key = (name, tuple(sorted(labels.items())))
@@ -213,8 +241,10 @@ class Histograms:
 GLOBAL = Histograms()
 
 
-def observe(name: str, v: float, **labels: str) -> None:
-    GLOBAL.observe(name, v, **labels)
+def observe(
+    name: str, v: float, exemplar: Optional[str] = None, **labels: str
+) -> None:
+    GLOBAL.observe(name, v, exemplar=exemplar, **labels)
 
 
 def quantile_bounds(name: str, q: float) -> Optional[Tuple[float, float]]:
@@ -263,6 +293,17 @@ def _fmt_float(v: float) -> str:
     return repr(float(v))
 
 
+def _fmt_exemplar(ex: Optional[Tuple[str, float]]) -> str:
+    """OpenMetrics exemplar suffix for a ``_bucket`` line, or "" when
+    the bucket never saw an exemplar-carrying observation — keeping
+    exemplar-free expositions byte-identical to the pre-exemplar
+    format (the golden file relies on this)."""
+    if ex is None:
+        return ""
+    key, v = ex
+    return f' # {{key="{_escape_label(key)}"}} {_fmt_float(v)}'
+
+
 def render_prometheus(
     counters_obj=None, hists: Optional[Histograms] = None
 ) -> str:
@@ -290,17 +331,20 @@ def render_prometheus(
             seen_type.add(mname)
             lines.append(f"# TYPE {mname} histogram")
         snap = child.snapshot()
+        exemplars = snap["exemplars"]
         cum = 0
         for i, n in enumerate(snap["counts"]):
             cum += n
             le = 'le="%s"' % _fmt_float(BUCKET_BOUNDS[i])
             lines.append(
                 f"{mname}_bucket{_fmt_labels(labels, extra=le)} {cum}"
+                + _fmt_exemplar(exemplars.get(i))
             )
         cum += snap["overflow"]
         inf_le = 'le="+Inf"'
         lines.append(
             f"{mname}_bucket{_fmt_labels(labels, extra=inf_le)} {cum}"
+            + _fmt_exemplar(exemplars.get(NBUCKETS))
         )
         lines.append(
             f"{mname}_sum{_fmt_labels(labels)} {_fmt_float(snap['sum'])}"
@@ -310,6 +354,26 @@ def render_prometheus(
 
 
 # -- minimal parser (the scrape consumer's half) ----------------------------
+
+def _label_block_end(s: str) -> int:
+    """Index of the ``}`` closing a label block that starts at ``s[0]``'s
+    level — quote-aware, so escaped quotes and braces inside label
+    values don't end the block early."""
+    i, in_quote = 0, False
+    while i < len(s):
+        ch = s[i]
+        if in_quote:
+            if ch == "\\":
+                i += 1
+            elif ch == '"':
+                in_quote = False
+        elif ch == '"':
+            in_quote = True
+        elif ch == "}":
+            return i
+        i += 1
+    return len(s)
+
 
 def _parse_labels(s: str) -> Dict[str, str]:
     """Parse ``k="v",k2="v2"`` honoring \\\\, \\" and \\n escapes."""
@@ -358,28 +422,46 @@ def parse_prometheus(
         if "{" in line:
             name = line[: line.index("{")]
             rest = line[line.index("{") + 1 :]
-            # the label block may contain escaped quotes; find the real
-            # closing brace by scanning quoted regions
-            i, depth_in_quote = 0, False
-            while i < len(rest):
-                ch = rest[i]
-                if depth_in_quote:
-                    if ch == "\\":
-                        i += 1
-                    elif ch == '"':
-                        depth_in_quote = False
-                elif ch == '"':
-                    depth_in_quote = True
-                elif ch == "}":
-                    break
-                i += 1
+            i = _label_block_end(rest)
             labels = _parse_labels(rest[:i])
             val = rest[i + 1 :].strip()
         else:
             name, val = line.split(None, 1)
             labels = {}
+        # an OpenMetrics exemplar (`` # {…} v``) may trail a _bucket
+        # sample; it is annotation, not part of the sample value
+        if " # " in val:
+            val = val.split(" # ", 1)[0].strip()
         samples.append((name, labels, float(val)))
     return types, samples
+
+
+def parse_exemplars(
+    text: str,
+) -> List[Tuple[str, Dict[str, str], Dict[str, str], float]]:
+    """OpenMetrics exemplars from an exposition, in document order:
+    ``[(sample name, sample labels, exemplar labels, exemplar value)]``.
+    Kept separate from :func:`parse_prometheus` so its (types, samples)
+    contract — and every existing consumer — stays untouched."""
+    out: List[Tuple[str, Dict[str, str], Dict[str, str], float]] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or " # {" not in line:
+            continue
+        sample, ex = line.split(" # {", 1)
+        j = _label_block_end(ex)
+        ex_labels = _parse_labels(ex[:j])
+        ex_val = float(ex[j + 1 :].strip().split()[0])
+        if "{" in sample:
+            name = sample[: sample.index("{")]
+            rest = sample[sample.index("{") + 1 :]
+            k = _label_block_end(rest)
+            labels = _parse_labels(rest[:k])
+        else:
+            name = sample.split()[0]
+            labels = {}
+        out.append((name, labels, ex_labels, ex_val))
+    return out
 
 
 def parsed_histogram_quantile(

@@ -79,6 +79,7 @@ def scrape_main(argv) -> int:
     import urllib.request
 
     from minisched_tpu.observability.hist import (
+        parse_exemplars,
         parse_prometheus,
         parsed_histogram_quantile,
     )
@@ -96,6 +97,7 @@ def scrape_main(argv) -> int:
         print(f"metrics: scrape of {url} failed: {e}", file=__import__("sys").stderr)
         return 1
     types, samples = parse_prometheus(text)
+    exemplars = parse_exemplars(text)
     hist_names = sorted(n for n, t in types.items() if t == "histogram")
     scalar = [
         (n, v) for n, labels, v in samples
@@ -114,6 +116,14 @@ def scrape_main(argv) -> int:
             f"histogram {name}: count={int(count)} "
             f"p50{fmt(p50)} p99{fmt(p99)}"
         )
+        # buckets render low→high, so the LAST exemplar-carrying
+        # bucket line is the slowest sample stamped — the "who was
+        # in the p99 bucket" answer, straight off the scrape
+        exs = [e for e in exemplars if e[0] == name + "_bucket"]
+        if exs:
+            _n, _sl, ex_labels, ex_val = exs[-1]
+            who = ex_labels.get("key", "?")
+            print(f"          exemplar(slowest bucket): {who} ({ex_val:.6g}s)")
     if not samples:
         print("(empty exposition)")
     return 0

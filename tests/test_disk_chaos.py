@@ -434,6 +434,76 @@ def test_enospc_degraded_mode_and_recovery(tmp_path):
     re.close()
 
 
+def test_enospc_mid_group_fails_every_waiter_typed(tmp_path):
+    """ISSUE 13: an ENOSPC landing inside a group-commit barrier fails
+    EVERY mutation in that group (and the tail staged behind it) typed
+    StorageDegraded — nothing published, no phantom in-memory state,
+    watch order intact — and the degraded latch + recovery probe behave
+    exactly like the per-mutation path: dwell recorded, probe re-arms
+    once the episode's fires burn, and the reopened WAL agrees with
+    exactly the acked mutations (the failed group's reserved rvs are a
+    legal gap)."""
+    import threading
+
+    path = str(tmp_path / "store.wal")
+    store = DurableObjectStore(path, fsync=True, probe_interval_s=0.05)
+    store.create("Node", make_node("n1"))
+    # a sustained episode: however the 8 concurrent creates split into
+    # groups (one barrier turn or several), every turn's first frame
+    # refuses, and the probes burn the remainder afterwards
+    store.faults = FaultFabric(SEED).on(
+        "disk.enospc", rate=1.0, after=0, max_fires=20
+    )
+    n_w = 8
+    results: list = [None] * n_w
+    gate = threading.Barrier(n_w)
+
+    def worker(i: int) -> None:
+        try:
+            gate.wait()
+            results[i] = store.create("Pod", make_pod(f"gp{i}"))
+        except BaseException as e:
+            results[i] = e
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_w)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(isinstance(r, StorageDegraded) for r in results), results
+    assert store.storage_stats()["degraded"]
+    assert counters.get("storage.append_error") >= 1
+    # no phantom state: the group never published, reads still serve
+    assert {n.metadata.name for n in store.list("Node")} == {"n1"}
+    assert store.list("Pod") == []
+    # probe re-arm: once the schedule's fires burn, writes recover
+    deadline = time.monotonic() + 15
+    recovered = None
+    while time.monotonic() < deadline:
+        try:
+            recovered = store.create("Pod", make_pod("post-episode"))
+            break
+        except StorageDegraded:
+            time.sleep(0.05)
+    assert recovered is not None, "degraded mode never recovered"
+    stats = store.storage_stats()
+    assert not stats["degraded"]
+    assert stats["degraded_dwell_s"] > 0
+    assert counters.get("storage.degraded_enter") >= 1
+    assert counters.get("storage.degraded_recovered") >= 1
+    store.faults = None
+    store.close()
+    # the reopened WAL holds exactly the ACKED mutations; the failed
+    # group's reserved rvs never hit the file (gaps are legal, order is)
+    re = DurableObjectStore(path)
+    assert [p.metadata.name for p in re.list("Pod")] == ["post-episode"]
+    assert re.resource_version == recovered.metadata.resource_version
+    re.close()
+    assert fsck(path)["ok"]
+
+
 def test_degraded_mode_is_507_on_the_wire_and_retried(tmp_path):
     """HTTP façade answers 507 for a degraded store; the remote client
     keeps it in the backoff set and succeeds once the probe re-arms —

@@ -114,6 +114,109 @@ def test_proc_kill_smoke(tmp_path):
     re.close()
 
 
+def test_proc_kill_ack_window_bind_batches(tmp_path):
+    """ISSUE 13: SIGKILL the control-plane child (fsync=True, group
+    commit ON) while concurrent bind batches are mid-flight — including
+    the window between a group's fsync barrier and the HTTP acks going
+    out.  The remote clients retry across the restart; a batch whose
+    first attempt committed before the kill must be DEDUPED on replay
+    (the WAL-backed ack registry and the bind subresource's
+    unset-node_name precondition), so recovery shows every pod bound
+    exactly once to the node its writer asked for — never twice, never
+    to a retry's re-execution."""
+    import threading
+
+    from minisched_tpu.api.objects import Binding
+
+    wal = str(tmp_path / "ackwin.wal")
+    sup = ServerSupervisor(
+        wal, compact_every_s=0.25, archive_history=True, fsync=True
+    )
+    base = sup.start()
+    n_nodes = 8
+    n_writers, batches_per, batch_sz = 8, 6, 3
+    n_pods = n_writers * batches_per * batch_sz
+    seed_client = RemoteClient(
+        base, retries=10, backoff_initial_s=0.05, retry_seed=SEED
+    )
+    seed_client.nodes().create_many(
+        [
+            make_node(
+                f"node{i:03d}",
+                capacity={"cpu": "64", "memory": "64Gi", "pods": 110},
+            )
+            for i in range(n_nodes)
+        ]
+    )
+    seed_client.pods().create_many(
+        [
+            make_pod(
+                f"ak{w}-{b}-{j}", requests={"cpu": "100m", "memory": "64Mi"}
+            )
+            for w in range(n_writers)
+            for b in range(batches_per)
+            for j in range(batch_sz)
+        ]
+    )
+    counters.reset()
+    errs: list = []
+    want: dict = {}  # pod name → node its writer bound it to
+
+    def writer(w: int) -> None:
+        client = RemoteClient(
+            base, retries=12, backoff_initial_s=0.05, retry_seed=SEED + w
+        )
+        try:
+            for b in range(batches_per):
+                node = f"node{(w * batches_per + b) % n_nodes:03d}"
+                binds = [
+                    Binding(f"ak{w}-{b}-{j}", "default", node)
+                    for j in range(batch_sz)
+                ]
+                for bind, res in zip(binds, client.pods().bind_many(binds)):
+                    if isinstance(res, BaseException):
+                        errs.append(f"{bind.pod_name}: {res!r}")
+                    else:
+                        want[bind.pod_name] = node
+        except Exception as e:
+            errs.append(f"writer {w}: {e!r}")
+
+    threads = [
+        threading.Thread(target=writer, args=(w,), name=f"ackwin-{w}")
+        for w in range(n_writers)
+    ]
+    for t in threads:
+        t.start()
+    # kill once the batches are in flight (some committed, some staged,
+    # some acked), restart on the same port, let the retries carry it
+    time.sleep(0.3)
+    sup.kill_and_restart()
+    assert sup.kills == 1
+    for t in threads:
+        t.join()
+    try:
+        assert not errs, errs[:5]
+        assert len(want) == n_pods
+        # the live plane agrees with what the writers were acked
+        live = {
+            p.metadata.name: p.spec.node_name
+            for p in seed_client.pods().list()
+        }
+        assert live == want
+    finally:
+        sup.stop()
+    # exactly-once across the FULL archived history: a deduped retry
+    # left ONE bind record per pod, a re-executed one would show two
+    assert wal_double_binds(wal) == []
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+
+    re = DurableObjectStore(wal, archive_compacted=True)
+    assert {
+        p.metadata.name: p.spec.node_name for p in re.list("Pod")
+    } == want
+    re.close()
+
+
 @pytest.mark.slow
 def test_proc_kill_soak(tmp_path):
     """The acceptance soak: ≥3 fabric-scheduled SIGKILL/restart cycles of
