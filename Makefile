@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -73,6 +73,17 @@ bench-wire: native
 # coalesce and throughput must clear 3x under a real durability barrier
 bench-wal: native
 	JAX_PLATFORMS=cpu python bench.py --only wal
+
+# relist storm (ISSUE 14): the COW read plane under a thundering herd —
+# a SIGKILL-free 410 mass eviction (history-ring compaction) and a
+# cold-boot storm of ≥200 simultaneous lists over real HTTP.  FAILS on
+# encodes NOT ≪ requests (the memoized list cache regressed), p99 list
+# latency past BENCH_RELIST_P99_S, write-path stalls during the storm
+# (reads holding the write lock), or any byte difference between the
+# MINISCHED_COW_READS=0 locked path and the COW cached/chunked path.
+# Scale with BENCH_RELIST_WATCHERS / _OBJECTS
+bench-relist: native
+	JAX_PLATFORMS=cpu python bench.py --only relist
 
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.

@@ -3562,6 +3562,305 @@ def bench_churn() -> dict:
     }
 
 
+def bench_relist() -> dict:
+    """``make bench-relist``: the relist-storm regime (ISSUE 14) — the
+    COW read plane serving a thundering herd of full state reads.  Two
+    storms over a REAL HTTP façade plus a byte-parity audit:
+
+    * **410 storm** — W clients hold a resume cursor the history ring
+      has compacted away, every watch-open answers 410 Gone at once
+      (SIGKILL-free eviction: ring compaction, not process death), and
+      all W relist simultaneously while a writer keeps mutating.
+      Gates: p99 list latency, and ZERO write-path stalls (storm write
+      p99 within a factor of the quiet baseline — reads never hold the
+      write lock).
+    * **cold-boot storm** — W informer-boot lists at one quiet rv.
+      Gate: encode-once (`store.list_cache.encodes` delta ≤ a few
+      benign double-encode races, the rest `hits` streaming shared
+      bytes).
+    * **kill-switch parity** — identical seeded stores under
+      MINISCHED_COW_READS=1 and =0 answer byte-identical list bodies,
+      full and namespace-filtered.
+
+    FAILS on: encodes NOT ≪ requests, sampled p99 over the gate, the
+    live ``http.list_s`` histogram disagreeing with the sampled p99
+    beyond bucket resolution, write-path stalls during the storm, or
+    any parity break."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.store import ObjectStore
+    from minisched_tpu.observability import counters
+
+    W = int(os.environ.get("BENCH_RELIST_WATCHERS", "220"))
+    n_obj = int(os.environ.get("BENCH_RELIST_OBJECTS", "300"))
+    p99_gate_s = float(os.environ.get("BENCH_RELIST_P99_S", "1.0"))
+    stall_factor = float(os.environ.get("BENCH_RELIST_STALL_FACTOR", "30"))
+    stall_floor_s = float(os.environ.get("BENCH_RELIST_STALL_FLOOR_S", "0.25"))
+
+    counters.reset()
+    store = ObjectStore(history_events=64)
+    if store.read_plane() is None:
+        bench_skip("MINISCHED_COW_READS=0: the relist role benches the COW plane")
+    server, base, shutdown = start_api_server(store)
+
+    def get_raw(path: str) -> bytes:
+        with urllib.request.urlopen(f"{base}{path}") as r:
+            return r.read()
+
+    list_lat: list = []
+    lat_mu = threading.Lock()
+
+    def timed_list() -> bytes:
+        t0 = time.monotonic()
+        body = get_raw("/api/v1/pods")
+        dt = time.monotonic() - t0
+        with lat_mu:
+            list_lat.append(dt)
+        return body
+
+    try:
+        seeds = [make_pod(f"seed-{i:04d}") for i in range(n_obj)]
+        for p in seeds:
+            store.create("Pod", p)
+        stale_rv = store.resource_version
+
+        def touch(i: int) -> None:
+            # rv churn WITHOUT set growth (an update, not a create): the
+            # list body stays n_obj pods, so the storm measures serving,
+            # not an ever-fatter payload
+            p = store.get("Pod", "default", seeds[i % n_obj].metadata.name)
+            p.metadata.labels["touched"] = str(i)
+            store.update("Pod", p)
+
+        # quiet write baseline: per-mutation latency with no storm around
+        quiet_w: list = []
+        for i in range(200):
+            t0 = time.monotonic()
+            touch(i)
+            quiet_w.append(time.monotonic() - t0)
+        quiet_w.sort()
+        quiet_write_p99 = _pct(quiet_w, 0.99, 6)
+
+        # churn past the 64-event history ring so the stale cursor is
+        # compacted: every resume below answers 410 (the SIGKILL-free
+        # mass eviction)
+        for i in range(120):
+            touch(i)
+
+        log(f"[relist] 410 storm: {W} watchers resuming at rv {stale_rv}")
+        storm_gate = threading.Barrier(W + 1)
+        got_410 = [0]
+        errs: list = []
+
+        def storm_client(idx: int) -> None:
+            try:
+                try:
+                    with urllib.request.urlopen(
+                        f"{base}/api/v1/pods?watch=true"
+                        f"&resource_version={stale_rv}"
+                    ) as r:
+                        r.read(1)
+                    raise AssertionError("stale resume was not evicted")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 410, f"expected 410, got {e.code}"
+                    e.read()
+                with lat_mu:
+                    got_410[0] += 1
+                storm_gate.wait()  # ... and everyone relists AT ONCE
+                timed_list()
+            except BaseException as e:  # surfaced by the gate below
+                errs.append(e)
+                try:
+                    storm_gate.abort()
+                except BaseException:
+                    pass
+
+        writer_stop = threading.Event()
+        storm_w: list = []
+
+        def storm_writer() -> None:
+            # ~30 writes/s: every write swaps the snapshot (invalidating
+            # the list cache wholesale), so the write cadence bounds how
+            # many distinct payloads the storm can possibly encode.  A
+            # writer whose period is at or below the single-encode cost
+            # (~4ms for a few hundred pods under the GIL) would force
+            # EVERY list onto a fresh snapshot — a treadmill no cache
+            # can win — without resembling any real plane, where relist
+            # bursts are orders of magnitude denser than mutations.
+            i = 0
+            while not writer_stop.is_set():
+                t0 = time.monotonic()
+                touch(i)
+                storm_w.append(time.monotonic() - t0)
+                i += 1
+                time.sleep(0.03)
+
+        threads = [
+            threading.Thread(target=storm_client, args=(i,)) for i in range(W)
+        ]
+        wt = threading.Thread(target=storm_writer)
+        for t in threads:
+            t.start()
+        wt.start()
+        try:
+            storm_gate.wait()
+        except threading.BrokenBarrierError:
+            pass  # a client failed pre-barrier; surfaced via errs below
+        t_storm0 = time.monotonic()
+        for t in threads:
+            t.join(timeout=60)
+        storm_s = time.monotonic() - t_storm0
+        writer_stop.set()
+        wt.join(timeout=10)
+        if errs:
+            raise SystemExit(f"[relist] STORM CLIENT FAILED: {errs[0]!r}")
+        if got_410[0] != W:
+            raise SystemExit(
+                f"[relist] EVICTION INCOMPLETE: {got_410[0]}/{W} saw 410"
+            )
+        storm_w.sort()
+        storm_write_p99 = _pct(storm_w, 0.99, 6) if storm_w else 0.0
+        write_stall_gate_s = max(stall_floor_s, quiet_write_p99 * stall_factor)
+        if storm_w and storm_write_p99 > write_stall_gate_s:
+            raise SystemExit(
+                f"[relist] WRITE PATH STALLED DURING STORM: p99 "
+                f"{storm_write_p99}s vs quiet {quiet_write_p99}s "
+                f"(gate {write_stall_gate_s:.4f}s) — reads are holding "
+                f"the write lock"
+            )
+
+        # cold-boot storm: W informer-boot lists at ONE quiet rv —
+        # the encode-once regime the cache exists for
+        log(f"[relist] cold-boot storm: {W} lists at one rv")
+        enc_before = counters.get("store.list_cache.encodes")
+        boot_gate = threading.Barrier(W)
+        bodies: dict = {}
+
+        def boot_client(idx: int) -> None:
+            try:
+                boot_gate.wait()
+                bodies[idx] = timed_list()
+            except BaseException as e:
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=boot_client, args=(i,)) for i in range(W)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if errs:
+            raise SystemExit(f"[relist] BOOT CLIENT FAILED: {errs[0]!r}")
+        if len({bodies[i] for i in bodies}) != 1:
+            raise SystemExit(
+                "[relist] COLD-BOOT BODIES DIVERGED at one rv"
+            )
+        boot_encodes = counters.get("store.list_cache.encodes") - enc_before
+        if boot_encodes > 1:  # misses serialize: one build per (ns, rv)
+            raise SystemExit(
+                f"[relist] ENCODE-ONCE BROKEN: {boot_encodes} encodes "
+                f"for {W} cold-boot lists at one rv"
+            )
+
+        encodes = counters.get("store.list_cache.encodes")
+        hits = counters.get("store.list_cache.hits")
+        requests = counters.get("wire.relist_requests")
+        if encodes > 0.25 * requests:
+            raise SystemExit(
+                f"[relist] ENCODES NOT ≪ REQUESTS: {encodes} encodes "
+                f"for {requests} list requests"
+            )
+        list_lat.sort()
+        sampled_p99 = _pct(list_lat, 0.99, 4)
+        if sampled_p99 > p99_gate_s:
+            raise SystemExit(
+                f"[relist] LIST P99 {sampled_p99}s OVER GATE {p99_gate_s}s"
+            )
+        # live/sampled crosscheck on a QUIET sequential probe: the storm
+        # samples above are client end-to-end and include the 220-thread
+        # client's own GIL queuing, which the server-side ``http.list_s``
+        # observation can never contain — comparing those two windows
+        # would gate on the bench client, not the plane.  A single probe
+        # client makes the windows coincide.
+        from minisched_tpu.observability import hist as _hist
+
+        _hist.reset()
+        probe: list = []
+        for _ in range(80):
+            t0 = time.monotonic()
+            get_raw("/api/v1/pods")
+            probe.append(time.monotonic() - t0)
+        probe.sort()
+        probe_p99 = _pct(probe, 0.99, 4)
+        live = _crosscheck_live_p99("http.list_s", probe_p99, "relist")
+    finally:
+        shutdown()
+
+    # kill-switch byte parity: the COW cached/chunked path and the
+    # locked re-encode path must answer the SAME bytes — uid and
+    # creation_timestamp pinned so both stores hold identical content
+    def seeded(cow: str):
+        os.environ["MINISCHED_COW_READS"] = cow
+        try:
+            st = ObjectStore()
+        finally:
+            os.environ.pop("MINISCHED_COW_READS", None)
+        for i in range(40):
+            p = make_pod(
+                f"par-{i:03d}",
+                namespace="default" if i % 4 else "kube-system",
+            )
+            p.metadata.uid = f"uid-{i:03d}"
+            p.metadata.creation_timestamp = 1700000000.0 + i
+            st.create("Pod", p)
+        return st
+
+    parity: dict = {}
+    for cow in ("1", "0"):
+        st = seeded(cow)
+        srv, b2, shut2 = start_api_server(st)
+        try:
+            with urllib.request.urlopen(f"{b2}/api/v1/pods") as r:
+                full = r.read()
+            with urllib.request.urlopen(
+                f"{b2}/api/v1/namespaces/kube-system/pods"
+            ) as r:
+                ns = r.read()
+            parity[cow] = (full, ns)
+        finally:
+            shut2()
+    if parity["1"] != parity["0"]:
+        raise SystemExit(
+            "[relist] KILL-SWITCH PARITY BROKEN: MINISCHED_COW_READS=0 "
+            "and =1 answered different list bytes"
+        )
+    log("[relist] kill-switch parity: list bodies byte-identical")
+
+    return {
+        "watchers": W,
+        "objects": n_obj,
+        "storm_410_s": round(storm_s, 3),
+        "list_requests": requests,
+        "list_cache_encodes": encodes,
+        "list_cache_hits": hits,
+        "cold_boot_encodes": boot_encodes,
+        "relist_bytes_shared": counters.get("wire.relist_bytes_shared"),
+        "list_p50_s": _pct(list_lat, 0.50, 4),
+        "list_p99_s": sampled_p99,
+        "probe_list_p99_s": probe_p99,
+        "live_list_p99_bucket": live,
+        "quiet_write_p99_s": quiet_write_p99,
+        "storm_write_p99_s": storm_write_p99,
+        "write_stall_gate_s": round(write_stall_gate_s, 4),
+        "parity_bytes": len(parity["1"][0]) + len(parity["1"][1]),
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
@@ -3576,6 +3875,7 @@ ROLES = {
     "ha": bench_ha,
     "gang": bench_gang,
     "churn": bench_churn,
+    "relist": bench_relist,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -3745,6 +4045,11 @@ def main() -> None:
         # priority-preemption bursts, p99 time-to-bind headline, idle-wave
         # gate + shared-fanout + quota audits
         optional.append(("churn_serving", "churn", None, "churn"))
+    if os.environ.get("BENCH_RELIST", "1") != "0":
+        # relist storm (ISSUE 14): 410 mass-eviction + cold-boot list
+        # storms off the COW read plane — encode-once, p99 list latency,
+        # zero write stalls, kill-switch byte parity
+        optional.append(("relist_storm", "relist", None, "relist"))
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         optional += [
             ("config1", "c1", None, "c1"), ("config2", "c2", None, "c2"),

@@ -114,14 +114,19 @@ class _GroupEntry:
     deltas) when the group's IO failed.  ``done``/``err`` are guarded by
     the store's group-commit condition."""
 
-    __slots__ = ("frames", "publish", "undo", "result", "key", "done", "err")
+    __slots__ = (
+        "frames", "publish", "undo", "result", "key", "kind", "done", "err"
+    )
 
-    def __init__(self, frames, publish, undo, result, key=""):
+    def __init__(self, frames, publish, undo, result, key="", kind=""):
         self.frames = frames
         self.publish = publish
         self.undo = undo
         self.result = result
         self.key = key
+        #: the object kind this entry mutates — the group's publish loop
+        #: swaps the COW read snapshot once per distinct kind (ISSUE 14)
+        self.kind = kind
         self.done = False
         self.err = None
 
@@ -255,6 +260,9 @@ class DurableObjectStore(ObjectStore):
         self._gc_visible_rv = 0  # highest PUBLISHED rv (≤ _rv while staged)
         self._replay()
         self._gc_visible_rv = self._rv
+        # the replay wrote _objects directly: publish the recovered state
+        # to the COW read plane (all kinds, correct rv in either mode)
+        self._cow_publish(tuple(self._objects))
         if readonly:
             self._closed = True  # mutations refused; reads keep serving
         else:
@@ -625,6 +633,12 @@ class DurableObjectStore(ObjectStore):
             # under its (much longer) lock hold
             for entry in group:
                 entry.publish()
+            # ONE read-plane swap for the whole group — this is the
+            # publish point the COW snapshot is defined by (ISSUE 14):
+            # the maps and the visible rv move together, so lock-free
+            # readers see a group whole or not at all, and a publisher's
+            # own mutations are readable before its ack below
+            self._cow_publish({e.kind for e in group if e.kind})
             if self._degraded:
                 self._exit_degraded()  # never strand the latch
         counters.inc("storage.group_commit.groups")
@@ -749,7 +763,7 @@ class DurableObjectStore(ObjectStore):
 
             return _GroupEntry(
                 frames, publish, undo, out,
-                staged[0][0] if staged else "",
+                staged[0][0] if staged else "", kind,
             )
 
         return self._gc_run(kind, build)
@@ -866,7 +880,7 @@ class DurableObjectStore(ObjectStore):
 
             return _GroupEntry(
                 [self._gc_frame_put(kind, stored)],
-                publish, undo, stored.clone(), key,
+                publish, undo, stored.clone(), key, kind,
             )
 
         return self._gc_run(kind, build)
@@ -939,7 +953,7 @@ class DurableObjectStore(ObjectStore):
 
             return _GroupEntry(
                 frames, publish, undo, out,
-                staged[0][0] if staged else "",
+                staged[0][0] if staged else "", kind,
             )
 
         return self._gc_run(kind, build)
@@ -994,7 +1008,7 @@ class DurableObjectStore(ObjectStore):
 
         return _GroupEntry(
             [self._gc_frame_put(kind, stored)],
-            publish, undo, stored.clone(), key,
+            publish, undo, stored.clone(), key, kind,
         )
 
     def mutate(
@@ -1048,7 +1062,8 @@ class DurableObjectStore(ObjectStore):
                 self._node_agg_track(kind, None, old)
 
             return _GroupEntry(
-                [self._gc_frame_del(kind, old, rv)], publish, undo, None, key
+                [self._gc_frame_del(kind, old, rv)],
+                publish, undo, None, key, kind,
             )
 
         return self._gc_run(kind, build)
@@ -1062,6 +1077,16 @@ class DurableObjectStore(ObjectStore):
             with self._lock:
                 self._check_open()
                 self._check_wal_writable(kind)
+                if self._gc_enabled:
+                    # raise the published watermark FIRST (same lock
+                    # hold, nothing staged on this path by contract) so
+                    # the base class's COW swap stamps the restored rv,
+                    # not the pre-restore one
+                    self._gc_visible_rv = max(
+                        self._gc_visible_rv,
+                        self._rv,
+                        obj.metadata.resource_version,
+                    )
                 super().restore_object(kind, obj)
                 if self._gc_enabled:
                     self._gc_visible_rv = max(self._gc_visible_rv, self._rv)
@@ -1069,6 +1094,12 @@ class DurableObjectStore(ObjectStore):
     def set_resource_version(self, rv: int) -> None:
         with self._io_lock if self._gc_enabled else _null_ctx():
             with self._lock:
+                if self._gc_enabled:
+                    # watermark first: the base class's COW swap must
+                    # stamp the fast-forwarded rv (see restore_object)
+                    self._gc_visible_rv = max(
+                        self._gc_visible_rv, self._rv, rv
+                    )
                 super().set_resource_version(rv)
                 # checkpoint restores fast-forward past the max object rv
                 # (e.g. trailing deletes before the snapshot) — persist

@@ -128,6 +128,11 @@ def _route(path: str):
 #: enough that a soak never grows without bound
 _ACK_REGISTRY_CAP = 65536
 
+#: chunk size for list bodies streamed from the shared COW cache — big
+#: enough that the framing overhead is noise, small enough that a slice
+#: of a multi-MB payload never parks one writev for seconds
+_LIST_CHUNK_BYTES = 256 * 1024
+
 
 def _chunk_frame(data: bytes) -> bytes:
     """One chunked-transfer frame — the ONE definition of the watch
@@ -344,10 +349,50 @@ class _Handler(BaseHTTPRequestHandler):
                 obj = self.store.get(kind, ns, name)
                 self._send(200, _encode(obj))
             else:
-                # epoch-consistent list: the rv is taken ATOMICALLY with
-                # the snapshot (one store lock hold) so consumers deriving
-                # versioned state from a listing (HA membership) can trust
-                # it reflects exactly these items
+                self._list(kind, ns)
+        except KeyError as e:
+            self._error(404, str(e))
+
+    def _list(self, kind: str, ns: str) -> None:
+        """Epoch-consistent list: the rv reflects exactly these items.
+
+        COW mode serves the memoized body straight off the read-plane
+        snapshot — a relist storm of N informers pays ONE encode per
+        (kind, namespace, rv), the rest stream the shared bytes chunked
+        (mirroring ``event_wire_chunk``).  Kill-switch mode
+        (``MINISCHED_COW_READS=0``) takes the locked ``list_with_rv``
+        path and re-encodes per request; the decoded bodies are
+        byte-identical (same payload shape, same iteration order)."""
+        from minisched_tpu.observability import counters, hist
+
+        t0 = time.monotonic()
+        counters.inc("wire.relist_requests")
+        try:
+            snap = self.store.read_plane()
+            if snap is not None:
+                # same fault hook the locked list path fires, off-lock
+                self.store._maybe_fault("list", kind, "")
+
+                def build() -> bytes:
+                    objs = snap.maps.get(kind, {}).values()
+                    items = [
+                        o for o in objs
+                        if not ns or o.metadata.namespace == ns
+                    ]
+                    return json.dumps(
+                        {
+                            "items": [_encode(o) for o in items],
+                            "resource_version": snap.rv,
+                        }
+                    ).encode()
+
+                body = snap.list_body(kind, ns, build)
+                counters.inc("wire.relist_bytes_shared", len(body))
+                self._send_shared_body(200, body)
+            else:
+                # the rv is taken ATOMICALLY with the snapshot (one
+                # store lock hold) so consumers deriving versioned
+                # state from a listing (HA membership) can trust it
                 items, rv = self.store.list_with_rv(kind)
                 if ns:  # namespaced list filters, matching the watch verb
                     items = [o for o in items if o.metadata.namespace == ns]
@@ -358,8 +403,28 @@ class _Handler(BaseHTTPRequestHandler):
                         "resource_version": rv,
                     },
                 )
-        except KeyError as e:
-            self._error(404, str(e))
+        finally:
+            hist.observe(
+                "http.list_s", time.monotonic() - t0, kind=kind.lower()
+            )
+
+    def _send_shared_body(self, code: int, body: bytes) -> None:
+        """Stream shared cached bytes chunked WITHOUT copying the whole
+        payload per response — memoryview slices of the one cached body
+        go straight to the socket.  ``http.client`` dechunks
+        transparently, so clients see the exact bytes ``_send`` would
+        have produced for the same payload."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        mv = memoryview(body)
+        for off in range(0, len(mv), _LIST_CHUNK_BYTES):
+            piece = mv[off : off + _LIST_CHUNK_BYTES]
+            self.wfile.write(f"{len(piece):X}\r\n".encode())
+            self.wfile.write(piece)
+            self.wfile.write(b"\r\n")
+        self.wfile.write(b"0\r\n\r\n")
 
     def _watch(self, kind: str, ns: str, resume_rv: Optional[int] = None) -> None:
         """JSON-lines event stream (chunked) until the client hangs up or
@@ -372,8 +437,15 @@ class _Handler(BaseHTTPRequestHandler):
         through N).  History compacted past N → 410 Gone, and the
         consumer must relist."""
         try:
+            # clone_snapshot=False: the snapshot is only counted for the
+            # SYNC line, never mutated or re-serialized here — skipping
+            # the per-watcher deep copy is what makes storm registration
+            # O(1) off the COW read plane
             watch, snapshot = self.store.watch(
-                kind, send_initial=resume_rv is None, resume_rv=resume_rv
+                kind,
+                send_initial=resume_rv is None,
+                resume_rv=resume_rv,
+                clone_snapshot=False,
             )
         except HistoryCompacted as e:
             self._error(410, str(e))

@@ -14,9 +14,12 @@ and always observe events in cache order.
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass
+from hashlib import blake2s
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from minisched_tpu.controlplane.store import (
@@ -54,10 +57,23 @@ class ResourceEventHandlers:
     on_batch: Optional[Callable[[List["WatchEvent"]], None]] = None
 
 
+#: per-process informer construction ordinal — the jitter salt that
+#: spreads a mass 410 across informers of the SAME kind (one per
+#: factory, many factories per storm) while staying deterministic for
+#: a fixed construction order
+_instance_ids = itertools.count()
+
+
 class Informer:
     def __init__(self, store: ObjectStore, kind: str):
         self._store = store
         self._kind = kind
+        # fabric-deterministic relist jitter (see _relist_jitter): the
+        # schedule is a blake2s hash of (fault seed, kind, instance,
+        # ordinal), FaultFabric style — byte-for-byte reproducible for a
+        # fixed seed, no shared RNG to race on
+        self._instance = next(_instance_ids)
+        self._jitter_n = 0
         self._handlers: List[ResourceEventHandlers] = []
         self._lock = threading.Lock()
         self._cache: Dict[str, Any] = {}
@@ -132,35 +148,43 @@ class Informer:
 
     def _open_watch(
         self, backoff: float, resume_rv: Optional[int] = None
-    ) -> Optional[Tuple[List[Any], bool]]:
+    ) -> Optional[Tuple[List[Any], str]]:
         """Open a watch (initial or reconnect) with bounded backoff — a
         watch open is one HTTP request on the remote store, exactly as
         droppable as the stream it starts.  Assigns ``self._watch`` and
-        returns (snapshot, resumed), or None only on shutdown.
+        returns ``(payload, mode)``, or None only on shutdown:
 
-        ``resume_rv``: try to RESUME from that resource_version first —
-        the server replays only the missed tail and the cache needs no
-        replay-diff.  When the history is compacted away (410 /
-        HistoryCompacted) fall back to the full list+watch, once, without
-        burning a backoff interval — the server is demonstrably up."""
+        * ``([], "resume")`` — resumed from ``resume_rv``; the server
+          replays only the missed tail and the cache needs no diffing.
+        * ``(items, "list")`` — relisted through the LIST verb (the
+          memoized COW payload: a storm of these costs the server ONE
+          encode) and the watch resumes from the list's rv, so the
+          stream carries only events after it — no snapshot replay.
+        * ``(snapshot, "stream")`` — full snapshot replay on the stream,
+          the pre-COW relist; kept as the never-410 fallback when the
+          history floor has been raised past the list's own rv.
+
+        A 410 on the resume path jitters (``_relist_jitter``) before
+        relisting so a mass eviction spreads instead of stampeding, then
+        relists without burning a backoff interval — the server is
+        demonstrably up."""
         while not self._stop.is_set():
             try:
                 if resume_rv is not None:
                     try:
-                        watch, snapshot = self._store.watch(
+                        watch, _ = self._store.watch(
                             self._kind, send_initial=False,
                             resume_rv=resume_rv,
                         )
-                        resumed = True
+                        payload: List[Any] = []
+                        mode = "resume"
                     except HistoryCompacted:
                         counters.inc("informer.relist_on_410")
+                        self._relist_jitter()
                         resume_rv = None
                         continue
                 else:
-                    watch, snapshot = self._store.watch(
-                        self._kind, send_initial=True
-                    )
-                    resumed = False
+                    watch, payload, mode = self._open_relist()
             except Exception as err:
                 print(
                     f"informer-{self._kind}: watch open failed ({err!r});"
@@ -178,16 +202,63 @@ class Informer:
                 # idempotent) so no orphan registration accretes events
                 watch.stop()
                 return None
-            return snapshot, resumed
+            return payload, mode
         return None
+
+    def _open_relist(self) -> Tuple[Any, List[Any], str]:
+        """One relist, list+watch style: LIST (epoch-consistent items +
+        rv, served from the shared COW payload cache) then a watch
+        RESUMING from that rv — the stream replays exactly the events
+        after the list, deletes included, so there is no gap and no
+        double-delivery.  Only when the history floor has been raised
+        past the list's rv with no write since (410 on a just-listed rv)
+        fall back to the full snapshot replay on the stream, which never
+        410s."""
+        items, rv = self._store.list_with_rv(self._kind)
+        try:
+            watch, _ = self._store.watch(
+                self._kind, send_initial=False, resume_rv=rv
+            )
+            return watch, items, "list"
+        except HistoryCompacted:
+            watch, snapshot = self._store.watch(
+                self._kind, send_initial=True
+            )
+            return watch, snapshot, "stream"
+
+    def _relist_jitter(self) -> None:
+        """Deterministic pre-relist sleep in ``[0, MINISCHED_RELIST_JITTER_S)``
+        — a mass 410 (ring compaction evicting a crowd at once) otherwise
+        has every informer relist on the same tick.  The delay is a
+        blake2s hash of (fault-fabric seed, kind, instance, ordinal), so
+        a chaos run replays the exact same spread."""
+        max_s = float(os.environ.get("MINISCHED_RELIST_JITTER_S", "0.2"))
+        if max_s <= 0.0:
+            return
+        fabric = getattr(self._store, "faults", None)
+        seed = getattr(fabric, "seed", 0) or 0
+        self._jitter_n += 1
+        h = blake2s(
+            f"{seed}:informer.relist_jitter:{self._kind}"
+            f":{self._instance}:{self._jitter_n}".encode(),
+            digest_size=4,
+        ).digest()
+        counters.inc("informer.relist_jitter_s")  # sleeps taken, not seconds
+        self._stop.wait(int.from_bytes(h, "big") / 2**32 * max_s)
 
     def _open_initial(self) -> bool:
         opened = self._open_watch(backoff=0.1)
         if opened is None:
             return False
-        snapshot, _ = opened
-        self._initial = len(snapshot)
+        payload, mode = opened
         self._advance_cursor_to_snapshot()
+        if mode == "list":
+            # cache is current the moment the list payload is folded in;
+            # the stream owes us nothing before sync
+            self._initial = 0
+            self._apply_relist(payload)
+        else:
+            self._initial = len(payload)
         return True
 
     def _advance_cursor_to_snapshot(self) -> None:
@@ -304,6 +375,38 @@ class Informer:
         self._replay_seen = set()
         return out
 
+    def _apply_relist(self, items: List[Any]) -> None:
+        """Fold a LIST payload into the cache and dispatch the normalized
+        diff — the synchronous twin of the stream replay-diff in _run
+        (unchanged objects suppressed, changed delivered as MODIFIED,
+        vanished as DELETED).  Runs on the dispatch thread only, so
+        handler ordering is preserved."""
+        with self._lock:
+            seen: set = set()
+            normalized: List[WatchEvent] = []
+            for obj in items:
+                key = obj.metadata.key
+                seen.add(key)
+                old = self._cache.get(key)
+                self._cache[key] = obj
+                if old is None:
+                    normalized.append(WatchEvent(EventType.ADDED, obj))
+                elif (
+                    old.metadata.resource_version
+                    != obj.metadata.resource_version
+                ):
+                    normalized.append(
+                        WatchEvent(EventType.MODIFIED, obj, old)
+                    )
+                # unchanged: consumers already saw this state
+            for key in [k for k in self._cache if k not in seen]:
+                normalized.append(
+                    WatchEvent(EventType.DELETED, self._cache.pop(key))
+                )
+            handlers = list(self._handlers)
+        for h in handlers:
+            self._invoke(h, normalized)
+
     def _reconnect(self) -> bool:
         """The watch died underneath us (remote stream failure — the
         in-process store's watch only stops via Informer.stop): re-open
@@ -332,10 +435,10 @@ class Informer:
         opened = self._open_watch(backoff=0.5, resume_rv=resume_rv)
         if opened is None:
             return False
-        snapshot, resumed = opened
+        payload, mode = opened
         self.reconnects += 1
         counters.inc("informer.reconnect")
-        if resumed:
+        if mode == "resume":
             self.resumes += 1
             counters.inc("informer.resume")
             with self._lock:
@@ -343,10 +446,21 @@ class Informer:
                 self._replay_seen = set()
             self._notify_reconnect()
             return True
-        stale: List[WatchEvent] = []
         self._advance_cursor_to_snapshot()
+        if mode == "list":
+            # list+watch relist: the diff lands synchronously here, and
+            # the resumed stream carries only events AFTER the list's rv
+            # — nothing on the stream is a replay, so the replay-diff
+            # machinery stays disarmed
+            with self._lock:
+                self._replay_pending = 0
+                self._replay_seen = set()
+            self._apply_relist(payload)
+            self._notify_reconnect()
+            return True
+        stale: List[WatchEvent] = []
         with self._lock:
-            self._replay_pending = len(snapshot)
+            self._replay_pending = len(payload)
             self._replay_seen = set()
             if self._replay_pending == 0:
                 # empty server: everything we cached is gone

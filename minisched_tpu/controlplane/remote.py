@@ -411,9 +411,13 @@ class RemoteStore:
         return [_decode(typ, o) for o in out["items"]]
 
     def list_with_rv(self, kind: str) -> Tuple[List[Any], int]:
-        """(items, store resource_version) — the server takes both under
-        one lock hold, so the rv is exactly the version the snapshot
-        reflects (== ObjectStore.list_with_rv over the wire)."""
+        """(items, store resource_version) — the rv is exactly the
+        version the snapshot reflects (== ObjectStore.list_with_rv over
+        the wire: epoch-consistent off the COW read plane, one lock hold
+        in kill-switch mode).  The server may stream the body chunked
+        from its shared list-payload cache (a relist storm costs it one
+        encode); ``http.client`` dechunks transparently, so the decoded
+        payload is byte-identical either way."""
         typ = _kind_types()[kind]
         out = self._req("GET", self._path(kind))
         return (

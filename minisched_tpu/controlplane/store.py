@@ -25,6 +25,7 @@ per-watcher queues, so a slow consumer still cannot stall a mutator
 from __future__ import annotations
 
 import enum
+import os
 import threading
 import time
 from collections import deque
@@ -364,6 +365,88 @@ def compute_node_agg(pods) -> Dict[str, List[int]]:
     return agg
 
 
+class _ReadSnapshot:
+    """One immutable copy-on-write view of the PUBLISHED store state:
+    ``maps`` (kind → {key → stored object}) plus the ``_visible_rv``
+    those maps reflect, swapped in as ONE reference assignment at every
+    publish point — the mutation tail in the base store, the group's
+    publish loop in the durable store (ISSUE 14).  Lock-free readers
+    grab ``store._snap`` once and hold a frozen epoch: get/list/
+    list_with_rv and full-snapshot watch registration never touch the
+    store lock.  Sharing the stored objects is safe for the same reason
+    fanout shares them (see _fanout): the store never mutates an object
+    in place — updates replace dict entries wholesale.
+
+    Two memo fields ride the snapshot and die with it at the next swap,
+    both filled lazily OFF the store lock.  Misses serialize on the
+    snapshot-private ``_mu`` — NOT the event_wire_chunk benign-race
+    idiom: a relist storm means hundreds of threads missing the same
+    (kind, ns) at once, and letting them all encode a multi-hundred-KB
+    body redundantly is exactly the stampede this cache exists to kill.
+    Hits stay lock-free dict reads; ``_mu`` never contends with writers.
+
+    ``list_bodies``: (kind, namespace) → the encoded HTTP list body.
+    One snapshot is one rv, so the effective cache key is (kind,
+    namespace, rv) and the swap itself is the invalidation — a relist
+    storm of N informers costs ONE encode (``store.list_cache.*``).
+
+    ``replay_events``: kind → the shared ADDED-event list a full-
+    snapshot watch registration replays.  Every registering watcher
+    queues the SAME WatchEvent objects, so the wire memo
+    (event_wire_chunk) makes a storm of watch opens encode each object
+    once instead of once per stream.  ``born`` is zeroed: a replay is
+    not live fanout, so the delivery-lag observers skip it.
+    """
+
+    __slots__ = ("maps", "rv", "list_bodies", "replay_events", "_mu")
+
+    def __init__(self, maps: Dict[str, Dict[str, Any]], rv: int) -> None:
+        self.maps = maps
+        self.rv = rv
+        self.list_bodies: Dict[Tuple[str, str], bytes] = {}
+        self.replay_events: Dict[str, List[WatchEvent]] = {}
+        self._mu = threading.Lock()
+
+    def list_body(
+        self, kind: str, ns: str, build: Callable[[], bytes]
+    ) -> bytes:
+        """Memoized encoded list payload for (kind, namespace):
+        ``store.list_cache.encodes`` counts first builds,
+        ``store.list_cache.hits`` the shared reuses the façade streams
+        from the same bytes."""
+        from minisched_tpu.observability import counters
+
+        body = self.list_bodies.get((kind, ns))
+        if body is None:
+            with self._mu:
+                body = self.list_bodies.get((kind, ns))
+                if body is None:
+                    body = build()
+                    self.list_bodies[(kind, ns)] = body
+                    counters.inc("store.list_cache.encodes")
+                    return body
+        counters.inc("store.list_cache.hits")
+        return body
+
+    def replay_events_for(self, kind: str) -> List[WatchEvent]:
+        evs = self.replay_events.get(kind)
+        if evs is None:
+            with self._mu:
+                evs = self.replay_events.get(kind)
+                if evs is None:
+                    evs = []
+                    for obj in self.maps.get(kind, {}).values():
+                        ev = WatchEvent(
+                            EventType.ADDED, obj,
+                            rv=obj.metadata.resource_version,
+                        )
+                        # replay, not fanout: lag observers skip born=0
+                        ev.born = 0.0
+                        evs.append(ev)
+                    self.replay_events[kind] = evs
+        return evs
+
+
 class ObjectStore:
     """Versioned multi-kind object store + watch hub."""
 
@@ -414,6 +497,16 @@ class ObjectStore:
         #: triggering event is lost with it — the informer's reconnect +
         #: snapshot-replay diff is what recovers the gap.
         self.faults: Any = None
+        #: copy-on-write read plane (ISSUE 14): the immutable published
+        #: view lock-free readers serve from, swapped (never mutated) by
+        #: _cow_publish at every publish point.  MINISCHED_COW_READS=0
+        #: is the kill-switch restoring the exact locked read paths
+        #: (None = disabled; byte parity pinned in tests/test_cow_reads).
+        self._snap: Optional[_ReadSnapshot] = (
+            _ReadSnapshot({}, 0)
+            if os.environ.get("MINISCHED_COW_READS", "1") != "0"
+            else None
+        )
 
     # -- helpers -----------------------------------------------------------
     def _maybe_fault(self, op: str, kind: str, key: str) -> None:
@@ -428,6 +521,36 @@ class ObjectStore:
     def _bump(self) -> int:
         self._rv += 1
         return self._rv
+
+    # -- copy-on-write read plane ------------------------------------------
+    def _cow_publish(self, kinds) -> None:
+        """Swap the read-plane snapshot (caller holds the lock, AFTER
+        the in-memory apply + fanout): rebuild the per-kind maps named
+        in ``kinds`` as fresh dict copies of the live maps, reuse every
+        other kind's frozen map, stamp the published rv, and install
+        the new view as ONE reference assignment.  Readers holding the
+        old snapshot keep a consistent pre-mutation epoch; new readers
+        see this one.  Runs at exactly the seams that already order
+        apply/fanout by rv, so read-your-writes holds: a publisher's
+        own mutation is in the snapshot before its call returns (base
+        store) or acks (group commit).  An empty ``kinds`` refreshes
+        the rv only (checkpoint fast-forward), reusing every map."""
+        snap = self._snap
+        if snap is None:
+            return  # kill-switch: locked reads serve the live maps
+        if kinds:
+            maps = dict(snap.maps)
+            for kind in kinds:
+                maps[kind] = dict(self._objects.get(kind, ()))
+        else:
+            maps = snap.maps
+        self._snap = _ReadSnapshot(maps, self._visible_rv())
+
+    def read_plane(self) -> Optional[_ReadSnapshot]:
+        """The current immutable read snapshot (None when the COW plane
+        is kill-switched off) — the HTTP façade serves list payloads
+        straight from it (see _ReadSnapshot.list_body)."""
+        return self._snap
 
     # -- per-node aggregate index ------------------------------------------
     def _node_agg_track(self, kind: str, old: Any, new: Any) -> None:
@@ -601,6 +724,7 @@ class ObjectStore:
                     rv=stored.metadata.resource_version,
                 ),
             )
+            self._cow_publish((kind,))
         return out
 
     def create_many(
@@ -649,9 +773,19 @@ class ObjectStore:
                     out.append(err)
             self._flush_log()
             self._fanout_many(kind, events)
+            self._cow_publish((kind,))
         return out
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
+        snap = self._snap
+        if snap is not None:
+            # lock-free: one reference grab is the whole read (the fault
+            # hook is internally locked, safe to consult off-lock)
+            self._maybe_fault("get", kind, f"{namespace}/{name}")
+            obj = snap.maps.get(kind, {}).get(f"{namespace}/{name}")
+            if obj is None:
+                raise KeyError(f"{kind} {namespace}/{name} not found")
+            return obj.clone()
         with self._lock:
             self._maybe_fault("get", kind, f"{namespace}/{name}")
             obj = self._objects.get(kind, {}).get(f"{namespace}/{name}")
@@ -660,17 +794,31 @@ class ObjectStore:
             return obj.clone()
 
     def list(self, kind: str) -> List[Any]:
+        snap = self._snap
+        if snap is not None:
+            self._maybe_fault("list", kind, "")
+            return [o.clone() for o in snap.maps.get(kind, {}).values()]
         with self._lock:
             self._maybe_fault("list", kind, "")
             return [o.clone() for o in self._objects.get(kind, {}).values()]
 
     def list_with_rv(self, kind: str) -> Tuple[List[Any], int]:
         """Epoch-consistent list: (snapshot, the store resource_version it
-        reflects), taken under ONE lock hold.  A consumer deriving
-        versioned state from a listing (the HA membership layer's shard
-        map) needs the rv ATOMIC with the items — list() then
-        resource_version can interleave a mutation and stamp the snapshot
-        with a version it does not reflect."""
+        reflects).  COW mode serves it lock-free — the snapshot's maps
+        and rv were published together, so the pair is atomic by
+        construction; the kill-switch path takes the items and the rv
+        under ONE lock hold.  A consumer deriving versioned state from a
+        listing (the HA membership layer's shard map) needs the rv
+        ATOMIC with the items — list() then resource_version can
+        interleave a mutation and stamp the snapshot with a version it
+        does not reflect."""
+        snap = self._snap
+        if snap is not None:
+            self._maybe_fault("list", kind, "")
+            return (
+                [o.clone() for o in snap.maps.get(kind, {}).values()],
+                snap.rv,
+            )
         with self._lock:
             self._maybe_fault("list", kind, "")
             return (
@@ -718,6 +866,7 @@ class ObjectStore:
                     rv=stored.metadata.resource_version,
                 ),
             )
+            self._cow_publish((kind,))
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -734,6 +883,7 @@ class ObjectStore:
             objs.pop(key, None)
             self._node_agg_track(kind, old, None)
             self._fanout(kind, WatchEvent(EventType.DELETED, old, rv=rv))
+            self._cow_publish((kind,))
 
     def mutate(
         self, kind: str, namespace: str, name: str, fn: Callable[[Any], Any]
@@ -832,6 +982,7 @@ class ObjectStore:
             # order equals mutation order across concurrent mutators.
             self._flush_log()
             self._fanout_many(kind, events)
+            self._cow_publish((kind,))
         return out
 
     def _on_batch_commit(self, kind: str, obj: Any) -> None:
@@ -896,12 +1047,14 @@ class ObjectStore:
                     rv=stored.metadata.resource_version,
                 ),
             )
+            self._cow_publish((kind,))
 
     def set_resource_version(self, rv: int) -> None:
         """Fast-forward the version counter (checkpoint restore) — never
         backwards, so bookmarks taken before a resume stay monotonic."""
         with self._lock:
             self._rv = max(self._rv, rv)
+            self._cow_publish(())
 
     # -- watch -------------------------------------------------------------
     def watch(
@@ -909,6 +1062,7 @@ class ObjectStore:
         kind: str,
         send_initial: bool = True,
         resume_rv: Optional[int] = None,
+        clone_snapshot: bool = True,
     ) -> Tuple[Watch, List[Any]]:
         """Open a watch; returns (watch, current snapshot).
 
@@ -922,7 +1076,17 @@ class ObjectStore:
         HistoryCompacted when the tail from resume_rv is no longer
         retained (ring overflow / checkpoint compaction): the consumer
         must fall back to a full list+watch.
+
+        ``clone_snapshot=False`` returns the stored objects themselves in
+        the snapshot instead of per-caller clones — for consumers that
+        only INSPECT it (the HTTP façade counts namespaces for its SYNC
+        line); the immutability contract (see _fanout) makes the shared
+        references safe, and a watch-open storm skips O(objects) clones
+        per stream.
         """
+        snap = self._snap
+        if snap is not None and resume_rv is None:
+            return self._watch_cow(kind, snap, send_initial, clone_snapshot)
         with self._lock:
             if resume_rv is not None:
                 floor = self._floor_for(kind)
@@ -966,7 +1130,8 @@ class ObjectStore:
                 return w, []
             w = Watch(self, kind, self._watch_queue_events)
             w.start_rv = self._visible_rv()
-            snapshot = [o.clone() for o in self._objects.get(kind, {}).values()]
+            objs = list(self._objects.get(kind, {}).values())
+            snapshot = [o.clone() for o in objs] if clone_snapshot else objs
             if send_initial:
                 w._deliver_many(
                     [
@@ -974,7 +1139,7 @@ class ObjectStore:
                             EventType.ADDED, obj.clone(),
                             rv=obj.metadata.resource_version,
                         )
-                        for obj in snapshot
+                        for obj in objs
                     ]
                 )
             self._watches.setdefault(kind, []).append(w)
@@ -984,6 +1149,43 @@ class ObjectStore:
                 w._replay_pending = len(w._events)
                 w._live = True
         return w, snapshot
+
+    def _watch_cow(
+        self,
+        kind: str,
+        snap: _ReadSnapshot,
+        send_initial: bool,
+        clone_snapshot: bool,
+    ) -> Tuple[Watch, List[Any]]:
+        """Full-snapshot watch registration off the read plane (ISSUE
+        14): the replay events (shared per snapshot, wire-memoizable —
+        a relist storm's N registrations encode each object once) and
+        the returned snapshot are built from the immutable COW view
+        OFF the lock; only the registration itself takes it, re-checking
+        that no publish swapped the snapshot underneath (a swap means
+        events fanned out that this replay does not contain — rebuild
+        from the fresh view; each retry races exactly one publish, so
+        the loop converges under any finite write rate)."""
+        w = Watch(self, kind, self._watch_queue_events)
+        while True:
+            events = snap.replay_events_for(kind) if send_initial else None
+            with self._lock:
+                if self._snap is not snap:
+                    snap = self._snap
+                    continue  # lost the race with a publish; rebuild
+                w.start_rv = snap.rv
+                if events:
+                    w._deliver_many(events)
+                self._watches.setdefault(kind, []).append(w)
+                with w._cond:
+                    # the queued snapshot replay stays exempt from the
+                    # live bound until the consumer drains it (FIFO)
+                    w._replay_pending = len(w._events)
+                    w._live = True
+            objs = snap.maps.get(kind, {}).values()
+            if clone_snapshot:
+                return w, [o.clone() for o in objs]
+            return w, list(objs)
 
     def _remove_watch(self, kind: str, w: Watch) -> None:
         with self._lock:

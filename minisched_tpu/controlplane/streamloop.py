@@ -26,6 +26,15 @@ decouples watcher count from thread count (ISSUE 9):
   same ``watch.disconnects`` the thread path uses and pruned
   immediately.
 
+Since ISSUE 14 the handshake's registration snapshot comes off the COW
+read plane (``store._watch_cow``): registration is a lock-free reference
+grab, and the snapshot-replay events a cold-boot storm writes inline are
+SHARED ``WatchEvent`` objects — ``event_wire_chunk`` memoizes their wire
+bytes on first use, so N watchers replaying the same snapshot cost one
+encode per object, not N (``watch.fanout.shared``).  Shared replay
+events carry ``born == 0.0`` and are skipped by the delivery-lag
+observation below — replay is catch-up, not fanout.
+
 ``MINISCHED_STREAMLOOP=0`` disables adoption entirely and restores the
 thread-per-watcher path exactly (see ``start_api_server``).
 """

@@ -117,6 +117,18 @@ bench records (``scheduler_over_http`` + ``wire_fanout``) alongside the
           socket turned out dead (the server closed it while idle —
           keep-alive timeout, injected http.500, restart); internal to
           the pool, never consumes the caller's backoff budget
+    wire.relist_requests / wire.relist_bytes_shared
+        — LIST verbs served by the REST façade, and the payload bytes
+          answered from the COW read plane's memoized list cache
+          (shared bytes streamed chunked, not re-encoded; ISSUE 14):
+          bytes_shared / requests ≈ mean list size once the cache is
+          warm, and the relist bench gates encodes ≪ requests
+    store.list_cache.encodes / store.list_cache.hits
+        — memoized list-payload cache outcomes keyed
+          (kind, namespace, rv)-via-snapshot: a relist storm of N
+          informers at one rv costs ONE encode (plus benign
+          double-encode races) and N−1 hits; every snapshot swap
+          invalidates wholesale by replacing the cache's owner
 
 The multi-chip live wave engine (ISSUE 7: DeviceScheduler over a
 jax.sharding.Mesh, parallel/sharding.MeshPackedCaller) records under
@@ -196,6 +208,11 @@ leases) records the recovery evidence the chaos soaks assert on:
         — watch streams re-opened after a drop, resumed from the last
           seen rv, relisted after the history floor answered 410, and
           initial opens retried at boot instead of crashing the service
+    informer.relist_jitter_s
+        — jitter SLEEPS taken before a 410-triggered relist (a count,
+          not seconds: each is a fabric-deterministic draw from
+          [0, MINISCHED_RELIST_JITTER_S)) — the spread that keeps a
+          mass eviction from relisting on one tick
     assume.lease_confirmed / assume.lease_expired /
     assume.lease_renewed_bound / assume.lease_renewed_unreachable /
     assume.lease_requeued / assume.lease_probe_deferred /

@@ -37,6 +37,12 @@ same contract as counters.py):
           (route is the low-cardinality shape of the path — kind +
           name/subresource markers — never raw names); long-lived watch
           streams are excluded
+    http.list_s
+        — LIST verb latency on the REST façade, labeled ``kind=`` (a
+          handful of kinds, low cardinality), observed in BOTH read
+          modes: the lock-free COW path serving the memoized shared
+          payload and the ``MINISCHED_COW_READS=0`` locked re-encode
+          path — the relist-storm p99 the ``relist`` bench gates
     watch.delivery_lag_s
         — store-fanout→socket-write lag per watch event, observed in
           BOTH delivery paths (selector stream loop and the legacy

@@ -456,7 +456,11 @@ class SchedulingQueue:
             return
         dt = max(self._clock() - t0, 0.0)
         prio = getattr(pod.spec, "priority", 0) or 0
-        hist.observe("sched.time_to_bind_s", dt, priority=str(prio))
+        # exemplar: the p99 bucket on /metrics names the slow pod
+        hist.observe(
+            "sched.time_to_bind_s", dt,
+            exemplar=pod.metadata.key, priority=str(prio),
+        )
         trace.span_pod("bind_ack", pod, node=node_name, ttb_s=dt)
 
     def delete_many(self, pods) -> None:
@@ -482,7 +486,8 @@ class SchedulingQueue:
                     dt = max(self._clock() - t0, 0.0)
                     prio = getattr(p.spec, "priority", 0) or 0
                     hist.observe(
-                        "sched.time_to_bind_s", dt, priority=str(prio)
+                        "sched.time_to_bind_s", dt,
+                        exemplar=p.metadata.key, priority=str(prio),
                     )
                     trace.span_pod(
                         "bind_ack", p, node=p.spec.node_name, ttb_s=dt

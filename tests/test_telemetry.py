@@ -106,9 +106,14 @@ def _golden_registries():
     c.set_gauge("wire.streams_active", 2)
     h = hist.Histograms()
     h.observe("sched.time_to_bind_s", 1e-4, priority="0")
-    h.observe("sched.time_to_bind_s", 0.5, priority="0")
+    # production stamps the pod key as an exemplar (queue.observe_bind):
+    # the p99 bucket on a scrape names the slow pod
+    h.observe(
+        "sched.time_to_bind_s", 0.5, exemplar="default/slow-pod", priority="0"
+    )
     h.observe("sched.time_to_bind_s", 1e9, priority='we"ird\\l\nbl')
     h.observe("http.request_s", 0.02, verb="GET", route="pods/{name}")
+    h.observe("http.list_s", 0.003, kind="pods")
     return c, h
 
 
