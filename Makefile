@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist chaos chaos-proc chaos-ha chaos-disk metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl chaos chaos-proc chaos-ha chaos-disk chaos-repl metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -74,6 +74,16 @@ bench-wire: native
 bench-wal: native
 	JAX_PLATFORMS=cpu python bench.py --only wal
 
+# replicated control plane (ISSUE 15): one leader + two followers
+# tailing the group-commit WAL stream over real HTTP, quorum-ack armed
+# at the barrier, versus the MINISCHED_REPL=0 kill-switch on the same
+# box.  FAILS on any acked mutation missing from a follower, follower
+# WALs diverging from the leader's bytes (fsck --compare), or quorum
+# timeouts on a healthy local plane; the record carries the mutate
+# p50/p99 replication tax and the storage.quorum_wait_s histogram
+bench-repl: native
+	JAX_PLATFORMS=cpu BENCH_REPL=1 python bench.py --only repl
+
 # relist storm (ISSUE 14): the COW read plane under a thundering herd —
 # a SIGKILL-free 410 mass eviction (history-ring compaction) and a
 # cold-boot storm of ≥200 simultaneous lists over real HTTP.  FAILS on
@@ -112,6 +122,17 @@ chaos-ha: native
 chaos-disk: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_disk_chaos.py -q
+
+# replicated-plane chaos (ISSUE 15): a 3-replica plane (separate OS
+# processes, each WAL fsync-armed) under client load; the LEADER gets
+# SIGKILLed mid-workload and a follower must win the arbiter-majority
+# election within ~2 lease TTLs with ZERO acked-write loss, the deposed
+# ex-leader rejoining fenced.  Runs BOTH the tier-1 smoke (in-process
+# quorum/fencing/resync paths) and the slow process-level soak — the
+# soak ends in the exactly-once bind + WAL-divergence audits
+chaos-repl: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_repl.py tests/test_repl_chaos.py -q
 
 # live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
 # 100 pods to bind, then validate ONLY through the wire — /metrics must

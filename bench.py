@@ -2661,6 +2661,169 @@ def bench_wal() -> dict:
     }
 
 
+def bench_repl() -> dict:
+    """Replicated control plane (ISSUE 15, DESIGN.md §27): one leader
+    plus two followers tailing the WAL stream over real HTTP, quorum
+    (1 follower ack) armed at the group-commit barrier, versus the same
+    writer load with ``MINISCHED_REPL=0`` semantics (no hub — today's
+    single-store plane).  The record carries the replication tax (mutate
+    p50/p99 + ``storage.quorum_wait_s``) and the correctness evidence:
+    every acked mutation on BOTH followers and follower WALs
+    byte-identical to the leader's (``fsck.wal_compare``).  Opt-in via
+    ``BENCH_REPL=1`` — the role boots four HTTP servers and three
+    fsync-armed stores, which is chaos-tier cost, not headline-tier."""
+    import tempfile
+    import threading
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.fsck import wal_compare
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.remote import RemoteClient
+    from minisched_tpu.controlplane.repl import ReplRuntime, WalFollower
+    from minisched_tpu.observability import counters, hist
+
+    if os.environ.get("BENCH_REPL", "0") == "0":
+        bench_skip("BENCH_REPL unset: replicated-plane role is opt-in")
+
+    n_writers = int(os.environ.get("BENCH_REPL_WRITERS", "8"))
+    per_writer = int(os.environ.get("BENCH_REPL_PODS_PER_WRITER", "25"))
+    n_muts = n_writers * per_writer
+
+    def run_writers(base: str) -> list:
+        lat: list = []
+        errs: list = []
+        mu = threading.Lock()
+
+        def writer(w: int) -> None:
+            client = RemoteClient(base)
+            mine = []
+            try:
+                for i in range(per_writer):
+                    t0 = time.monotonic()
+                    client.pods().create(
+                        make_pod(
+                            f"rp{w:02d}-{i:04d}",
+                            requests={"cpu": "100m", "memory": "64Mi"},
+                        )
+                    )
+                    mine.append(time.monotonic() - t0)
+            except Exception as e:
+                errs.append(f"writer {w}: {e!r}")
+            with mu:
+                lat.extend(mine)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,), name=f"repl-w{w}")
+            for w in range(n_writers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise SystemExit(f"[repl] WRITER FAILED: {errs[:3]}")
+        return sorted(lat)
+
+    # -- phase 1: kill-switch baseline (no hub, single store) ---------------
+    base_dir = tempfile.mkdtemp(prefix="minisched-repl-")
+    base_wal = os.path.join(base_dir, "baseline.wal")
+    store_b = DurableObjectStore(base_wal, fsync=True)
+    server_b, url_b, shutdown_b = start_api_server(store_b, port=0)
+    t0 = time.monotonic()
+    lat_b = run_writers(url_b)
+    elapsed_b = time.monotonic() - t0
+    shutdown_b()
+    store_b.close()
+
+    # -- phase 2: 3-replica plane, quorum armed -----------------------------
+    counters.reset()
+    leader_wal = os.path.join(base_dir, "leader.wal")
+    leader = DurableObjectStore(leader_wal, fsync=True)
+    runtime = ReplRuntime(
+        leader, "r0", peers=[], cluster_size=3, ack_timeout_s=15.0
+    )
+    runtime.promote()
+    server_l, url_l, shutdown_l = start_api_server(
+        leader, port=0, repl=runtime
+    )
+    followers = []
+    for fid in ("r1", "r2"):
+        fstore = DurableObjectStore(
+            os.path.join(base_dir, f"{fid}.wal"), fsync=True
+        )
+        fstore.fence("r0")
+        tail = WalFollower(fstore, url_l, fid)
+        tail.start()
+        followers.append((fid, fstore, tail))
+    t0 = time.monotonic()
+    lat_r = run_writers(url_l)
+    elapsed_r = time.monotonic() - t0
+    # quorum means ONE follower proved durability per group; wait for
+    # both to finish catching up before auditing the full copies
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and any(
+        f[1].resource_version < leader.resource_version for f in followers
+    ):
+        time.sleep(0.05)
+    qp = hist.quantile_bounds("storage.quorum_wait_s", 0.99) or (None, None)
+    shutdown_l()
+    for _fid, fstore, tail in followers:
+        tail.stop()
+        fstore.close()
+    leader.close()
+    runtime.close()
+
+    # -- audits -------------------------------------------------------------
+    lost = []
+    for fid, fstore, _tail in followers:
+        replayed = DurableObjectStore(fstore._path)
+        n = sum(1 for _ in replayed.list("Pod"))
+        replayed.close()
+        if n != n_muts:
+            lost.append(f"{fid}: {n}/{n_muts} pods")
+        cmp = wal_compare(leader_wal, fstore._path)
+        if not (cmp.get("identical") or cmp.get("prefix")):
+            lost.append(f"{fid}: WAL diverged {cmp.get('diverged')}")
+    if lost:
+        raise SystemExit(f"[repl] ACKED WRITES MISSING ON FOLLOWERS: {lost}")
+    if counters.get("storage.repl.quorum_timeouts"):
+        raise SystemExit("[repl] QUORUM TIMEOUTS on a healthy local plane")
+
+    def _p(lat: list, q: float) -> float:
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 4)
+
+    tax = _p(lat_r, 0.50) - _p(lat_b, 0.50)
+    log(
+        f"[repl] {n_writers} writers × {per_writer} pods: quorum plane "
+        f"{n_muts / elapsed_r:.0f}/s (p50 {_p(lat_r, 0.50)}s, p99 "
+        f"{_p(lat_r, 0.99)}s) vs kill-switch {n_muts / elapsed_b:.0f}/s "
+        f"(p50 {_p(lat_b, 0.50)}s); quorum-wait p99 ≤ {qp[1]}s; both "
+        f"followers byte-identical, zero acked writes lost"
+    )
+    return {
+        "writers": n_writers,
+        "mutations": n_muts,
+        "baseline": {
+            "throughput_per_s": round(n_muts / elapsed_b, 1),
+            "mutate_p50_s": _p(lat_b, 0.50),
+            "mutate_p99_s": _p(lat_b, 0.99),
+        },
+        "replicated": {
+            "throughput_per_s": round(n_muts / elapsed_r, 1),
+            "mutate_p50_s": _p(lat_r, 0.50),
+            "mutate_p99_s": _p(lat_r, 0.99),
+            "quorum_wait_p99_bucket_s": qp[1],
+            "groups": counters.get("storage.repl.groups"),
+            "acks": counters.get("storage.repl.acks"),
+            "resyncs": counters.get("storage.repl.resyncs"),
+        },
+        "replication_tax_p50_s": round(tax, 4),
+        "followers_identical": True,
+        "acked_writes_lost": 0,
+    }
+
+
 def bench_ha() -> dict:
     """HA plane at bench scale: N active-active sharded engines over one
     WAL store, one engine hard-killed mid-run (lease abandoned — peers
@@ -3872,6 +4035,7 @@ ROLES = {
     "chaos": bench_chaos,
     "disk": bench_disk,
     "wal": bench_wal,
+    "repl": bench_repl,
     "ha": bench_ha,
     "gang": bench_gang,
     "churn": bench_churn,
@@ -4021,6 +4185,11 @@ def main() -> None:
         # HA plane: sharded active-active engines, one hard kill, with
         # TTL-bounded rebalance + exactly-once audits in the record
         optional.append(("ha_plane", "ha", None, "ha"))
+    if os.environ.get("BENCH_REPL", "0") != "0":
+        # replicated plane (ISSUE 15, opt-in): quorum-ack WAL shipping —
+        # mutate p50/p99 tax vs the MINISCHED_REPL=0 kill-switch, plus
+        # zero-acked-loss + byte-identical-follower audits
+        optional.append(("repl_plane", "repl", None, "repl"))
     if os.environ.get("BENCH_MESH", "1") != "0":
         # multi-chip live wave engine (ISSUE 7): sharded vs single-device
         # on the same workload, parity-pinned, device_total_s gated.

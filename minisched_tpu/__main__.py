@@ -11,12 +11,17 @@ then serve until interrupted.
 Subcommands:
 
     python -m minisched_tpu fsck <wal> [--checkpoint PATH]
+                                       [--digests] [--compare OTHER]
 
         offline storage-integrity check (controlplane/fsck): WAL frame
         CRCs, checkpoint sha256 sidecars (both generations), replay
         through the real recovery path, rv/uid monotonicity, the
         per-node aggregate index, and the exactly-once bind audit.
         Prints a JSON report; exit 1 on any integrity error.
+        ``--digests`` emits per-frame CRC32C digests (the offline half
+        of the replicated plane's digest gossip); ``--compare OTHER``
+        diffs two replica WALs — exit 1 iff the histories diverged
+        (one being a prefix of the other is a follower catching up).
 
     python -m minisched_tpu metrics <url>
 

@@ -70,6 +70,7 @@ from minisched_tpu.controlplane.store import (
     DEFAULT_HISTORY_EVENTS,
     Conflict,
     EventType,
+    NotLeader,
     ObjectStore,
     StorageDegraded,
     WatchEvent,
@@ -78,7 +79,9 @@ from minisched_tpu.controlplane.walio import (
     HEADER_SIZE,
     WalCorrupt,
     WalReader,
+    decode_group,
     encode_frame,
+    group_crc32c,
     resync_scan,
 )
 from minisched_tpu.observability import counters, hist
@@ -258,6 +261,15 @@ class DurableObjectStore(ObjectStore):
         self._gc_pending: Dict[tuple, tuple] = {}
         self._gc_token = 0
         self._gc_visible_rv = 0  # highest PUBLISHED rv (≤ _rv while staged)
+        # -- replication (DESIGN.md §27) -----------------------------------
+        # When a ReplicationHub is attached (controlplane/repl.py, gated
+        # by MINISCHED_REPL), the group-commit barrier ALSO waits for a
+        # follower quorum between its fsync and its publish; a fenced
+        # replica (follower / demoted ex-leader) refuses mutations typed
+        # (NotLeader) so only one history can ever accept acks.
+        self._repl_hub = None
+        self._fenced = False
+        self._leader_hint = ""
         self._replay()
         self._gc_visible_rv = self._rv
         # the replay wrote _objects directly: publish the recovered state
@@ -298,7 +310,21 @@ class DurableObjectStore(ObjectStore):
         ``wal.append`` injection point (faults.FaultFabric), which
         surfaces as a failed API call.  Both fire BEFORE the in-memory
         commit; the append itself is ALSO pre-commit (store.py), so even
-        a first-time disk failure never leaves memory ahead of disk."""
+        a first-time disk failure never leaves memory ahead of disk.
+
+        A third layer when replication is wired: a FENCED replica (one
+        consuming the leader's stream, or an ex-leader that lost its
+        arbiter majority) refuses every client mutation typed — its WAL
+        belongs to the leader's byte sequence and a local write would
+        fork it.  Reads keep serving (stale-bounded by replication
+        lag)."""
+        if self._fenced:
+            counters.inc("storage.repl.fenced_writes")
+            hint = f" (leader: {self._leader_hint})" if self._leader_hint \
+                else ""
+            raise NotLeader(
+                f"store {self._path!r} is not leader{hint}; write refused"
+            )
         if self._degraded:
             self._maybe_probe_recovery()
             if self._degraded:
@@ -441,6 +467,16 @@ class DurableObjectStore(ObjectStore):
             self._enter_degraded(e)
             counters.inc("storage.append_error")
             raise StorageDegraded(f"WAL append failed: {e}") from e
+        hub = self._repl_hub
+        if hub is not None:
+            # non-group bytes (rv watermarks, ack records, recovery
+            # probes) advance the shippable horizon too — followers tail
+            # them as raw catch-up chunks; they carry no client-visible
+            # promise, so no quorum is owed on them
+            try:
+                hub.advance(self._log.tell())
+            except OSError:
+                pass
         if self._degraded and probing is False:
             # an organic append succeeded while latched (shouldn't happen
             # — the gate refuses first — but never strand the latch)
@@ -624,6 +660,37 @@ class DurableObjectStore(ObjectStore):
                     except OSError:
                         pass
                 err = e
+        hub = self._repl_hub
+        if err is None and hub is not None and parts:
+            # -- the quorum-ack await (DESIGN.md §27) ----------------------
+            # The group is durable HERE but not yet published: this is
+            # the only point where holding it costs nothing visible.
+            # Ship it (note_group wakes every follower stream), then
+            # park until a follower quorum has it durable too.  A quorum
+            # that never forms fails the WHOLE group typed — the bytes
+            # are truncated back off (an unacked group may not survive,
+            # exactly like a torn tail) and the stream epoch bumps so
+            # followers that buffered it resync.
+            start = pre_end if pre_end is not None else hub.durable_end
+            hub.note_group(start, buf)
+            t0 = time.monotonic()
+            ok = hub.wait_quorum(
+                start + len(buf), timeout=hub.ack_timeout_s
+            )
+            hist.observe("storage.quorum_wait_s", time.monotonic() - t0)
+            if not ok:
+                counters.inc("storage.repl.quorum_timeouts")
+                try:
+                    self._log.truncate(start)
+                except OSError:
+                    pass
+                hub.retract(start)
+                err = OSError(
+                    errno.ETIMEDOUT,
+                    f"replication quorum not reached within "
+                    f"{hub.ack_timeout_s}s "
+                    f"(need {hub.quorum_followers} follower acks)",
+                )
         if err is not None:
             self._gc_fail(group, err)
             return
@@ -1480,7 +1547,15 @@ class DurableObjectStore(ObjectStore):
         mutations whose waiters were (about to be) acked.  Holding the
         store lock throughout keeps anything new from staging, and
         holding the IO lock keeps the leader out of the log while it is
-        closed/truncated/reopened."""
+        closed/truncated/reopened.
+
+        A LEADING replica defers compaction entirely: truncating the WAL
+        would invalidate every follower's byte offset cursor mid-stream.
+        Compaction-aware replication (checkpoint shipping + offset
+        rebasing) is the recorded follow-up (ROADMAP)."""
+        if self._repl_hub is not None:
+            counters.inc("storage.repl.compact_deferred")
+            return
         with self._io_lock if self._gc_enabled else _null_ctx():
             with self._lock:
                 if self._gc_enabled:
@@ -1688,7 +1763,167 @@ class DurableObjectStore(ObjectStore):
                 "ckpt_source": self._ckpt_source,
             }
 
+    # -- replication (DESIGN.md §27) ---------------------------------------
+    def wal_end(self) -> int:
+        """Current WAL size in bytes — the replication cursor: a
+        follower resumes tailing from exactly here, and a leader's hub
+        starts its shippable horizon here."""
+        try:
+            if self._log is not None:
+                return self._log.tell()
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
+
+    def wal_range_crc32c(self, start: int, end: int) -> Optional[int]:
+        """CRC32C over a raw byte range of the local WAL — the follower
+        half of digest gossip: re-derived from OUR disk (not a cached
+        value) and compared against the leader's ring, so a disk that
+        lies about already-applied groups is convicted by comparison.
+        None when the range is not fully present."""
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(start)
+                buf = f.read(end - start)
+        except OSError:
+            return None
+        if len(buf) != end - start:
+            return None
+        return group_crc32c(buf)
+
+    def promote_leader(self, hub: Any) -> None:
+        """Attach a ReplicationHub: this store now leads — its barrier
+        owes a follower quorum per group, its WAL is the authoritative
+        byte sequence, and it accepts client writes again."""
+        if not self._gc_enabled:
+            raise RuntimeError(
+                "replication requires group commit "
+                "(MINISCHED_GROUP_COMMIT=0 is incompatible with a "
+                "replicated plane: the quorum barrier lives there)"
+            )
+        with self._io_lock:
+            with self._lock:
+                hub.durable_end = self.wal_end()
+                self._repl_hub = hub
+                self._fenced = False
+                self._leader_hint = ""
+
+    def fence(self, leader_hint: str = "") -> None:
+        """Stop accepting writes: this replica follows (or was deposed).
+        The hub is closed BEFORE the locks are taken — a barrier parked
+        in wait_quorum holds _io_lock, and closing the hub is what fails
+        its group and frees the lock; taking the lock first would
+        deadlock the fence behind the very wait it needs to cancel."""
+        hub = self._repl_hub
+        if hub is not None:
+            hub.close()
+        with self._io_lock:
+            with self._lock:
+                self._repl_hub = None
+                self._fenced = True
+                self._leader_hint = leader_hint
+
+    def is_fenced(self) -> bool:
+        return self._fenced
+
+    def apply_replicated(self, data: bytes, start_offset: Optional[int] =
+                         None) -> int:
+        """Follower apply: append one shipped group's raw bytes to the
+        local WAL (fsync when armed) and replay its records through the
+        SAME ``_apply`` path recovery runs — a promoted follower serves
+        state built exactly the way a reopened leader would build it.
+
+        Ordering: the group decodes STRICTLY first (walio.decode_group
+        — a torn or corrupt group never reaches the local disk), then
+        ``start_offset`` must equal our current WAL end (byte-contiguous
+        by contract; a mismatch means the stream and the file diverged
+        and the caller must resync).  Returns the new WAL end — the
+        offset the follower acks."""
+        recs = decode_group(data, self._path)
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                if self._closed or self._log is None:
+                    raise RuntimeError(
+                        f"store {self._path!r} closed; replicated apply "
+                        f"refused"
+                    )
+                end = self._log.tell()
+                if start_offset is not None and start_offset != end:
+                    raise ValueError(
+                        f"replicated group offset {start_offset} != local "
+                        f"WAL end {end} (resync required)"
+                    )
+                try:
+                    n = self._log.write(data)
+                    if n is not None and n != len(data):
+                        raise OSError(
+                            errno.ENOSPC,
+                            f"short WAL write ({n}/{len(data)} bytes)",
+                        )
+                    if self._fsync:
+                        self._fsync_now()
+                except OSError as e:
+                    try:
+                        self._log.truncate(end)
+                    except OSError:
+                        pass
+                    self._enter_degraded(e)
+                    counters.inc("storage.append_error")
+                    raise StorageDegraded(
+                        f"replicated WAL append failed: {e}"
+                    ) from e
+                kinds = set()
+                for rec in recs:
+                    self._apply(rec)
+                    if rec.get("op") in ("put", "del"):
+                        kinds.add(rec.get("kind"))
+                self._gc_visible_rv = max(self._gc_visible_rv, self._rv)
+                self._cow_publish({k for k in kinds if k})
+                if self._recovered_uid_max:
+                    # uids in replicated puts were ISSUED by the leader;
+                    # floor our generator so a promoted follower never
+                    # re-issues one (same rule replay applies)
+                    from minisched_tpu.api.objects import ensure_uid_floor
+
+                    ensure_uid_floor(self._recovered_uid_max)
+                new_end = self._log.tell()
+        counters.inc("storage.repl.applied_groups")
+        counters.inc("storage.repl.applied_records", len(recs))
+        return new_end
+
+    def replica_reset(self) -> None:
+        """Wipe this replica to empty (WAL truncated to zero, in-memory
+        state cleared) so a follower can re-tail the leader's stream
+        from byte 0 — the resync path after an epoch bump, offset
+        discontinuity, or digest divergence.  Drastic by design: the
+        authoritative log is the leader's, and a full re-ship of a
+        compacted-and-bounded WAL is cheap next to reasoning about
+        partial divergence."""
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                if self._log is not None:
+                    self._log.truncate(0)
+                    self._log.seek(0)
+                kinds = tuple(self._objects)
+                self._objects.clear()
+                self._rv = 0
+                self._gc_visible_rv = 0
+                self._ckpt_rv = 0
+                self._acks.clear()
+                self._history.clear()
+                self._history_bytes_used.clear()
+                self._history_floors.clear()
+                self._history_floor_min = 0
+                self._pod_node_agg.clear()
+                self._recovered_uid_max = 0
+                self._cow_publish(kinds)
+
     def close(self) -> None:
+        hub = self._repl_hub
+        if hub is not None:
+            # wake any barrier parked in wait_quorum so the drain below
+            # can take _io_lock without waiting out the ack timeout
+            hub.close()
         if getattr(self, "_gc_enabled", False):
             # commit whatever is staged first so no waiter hangs on a
             # barrier that will never run (waiters are acked or failed

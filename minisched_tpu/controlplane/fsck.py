@@ -372,12 +372,117 @@ def repair(
     return out
 
 
+def wal_digests(path: str) -> Dict[str, Any]:
+    """``fsck --digests``: per-frame CRC32C digests over a WAL's raw
+    bytes — the operator-facing half of the replication plane's digest
+    gossip (DESIGN.md §27).  The live plane gossips PER-GROUP digests
+    (a group's digest is the CRC32C of its frames' concatenated raw
+    bytes, boundaries known only to the leader's ring); offline, the
+    frame is the durable unit, and per-frame digests compose to any
+    grouping — two replicas whose frame digests match byte-for-byte
+    match under every grouping, and the first mismatching frame locates
+    a divergence more precisely than a group span would."""
+    from minisched_tpu.controlplane.walio import (
+        WalCorrupt,
+        WalReader,
+        _crc32c,
+        _rec_rv,
+    )
+
+    out: Dict[str, Any] = {"wal": path, "frames": []}
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        out["error"] = str(e)
+        return out
+    out["size"] = len(data)
+    out["file_crc32c"] = _crc32c(data)
+    reader = WalReader(data, path=path)
+    prev_end = 0
+    try:
+        for rec, end in reader:
+            out["frames"].append({
+                "index": len(out["frames"]),
+                "offset": prev_end,
+                "end": end,
+                "rv": _rec_rv(rec),
+                "op": rec.get("op"),
+                "crc32c": _crc32c(data[prev_end:end]),
+            })
+            prev_end = end
+    except WalCorrupt as e:
+        out["corrupt"] = {"offset": e.offset, "reason": e.reason}
+    out["torn_tail"] = bool(reader.torn_tail)
+    out["good_end"] = reader.good_end
+    return out
+
+
+def wal_compare(path_a: str, path_b: str) -> Dict[str, Any]:
+    """``fsck --compare``: diff two replica WALs offline by frame
+    digest.  Replication ships contiguous byte ranges, so two healthy
+    replicas' WALs are PREFIXES of one another (the shorter = a
+    follower mid-catch-up); the report states whether that holds, how
+    many frames agree, and — when it does not hold — the exact frame
+    and byte offset where the histories forked (epoch-bump debris, a
+    lying disk, or a fenced ex-leader's unacked tail)."""
+    a, b = wal_digests(path_a), wal_digests(path_b)
+    report: Dict[str, Any] = {"a": a, "b": b}
+    fa, fb = a.get("frames", []), b.get("frames", [])
+    common = 0
+    diverged_at: Optional[Dict[str, Any]] = None
+    for x, y in zip(fa, fb):
+        if (x["offset"], x["end"], x["crc32c"]) != (
+            y["offset"], y["end"], y["crc32c"]
+        ):
+            diverged_at = {
+                "frame": common,
+                "offset": x["offset"],
+                "a": x, "b": y,
+            }
+            break
+        common += 1
+    if diverged_at is None:
+        # a CRC-corrupt frame ends that side's digest list early, so the
+        # zip above never sees the fork — the corrupt offset IS the fork
+        for side, d in (("a", a), ("b", b)):
+            bad = d.get("corrupt")
+            if bad is not None:
+                diverged_at = {
+                    "frame": common,
+                    "offset": bad.get("offset"),
+                    "corrupt_side": side,
+                    "reason": bad.get("reason"),
+                }
+                break
+    report["common_frames"] = common
+    report["diverged"] = diverged_at
+    report["identical"] = (
+        diverged_at is None
+        and len(fa) == len(fb)
+        and a.get("file_crc32c") == b.get("file_crc32c")
+        and not a.get("corrupt") and not b.get("corrupt")
+    )
+    # prefix = one replica simply behind the other (healthy mid-catch-up);
+    # a CRC-corrupt frame truncates that side's digest list, so without
+    # the corrupt check a mid-file bit-flip would read as "just behind"
+    report["prefix"] = (
+        diverged_at is None
+        and (common == len(fa) or common == len(fb))
+        and not a.get("corrupt") and not b.get("corrupt")
+    )
+    return report
+
+
 def main(argv: List[str]) -> int:
     """CLI entry (dispatched from ``python -m minisched_tpu fsck``):
     prints the JSON report; exit 0 clean, 1 on any integrity error.
     ``--repair`` attempts covered salvage first; ``--accept-loss``
     additionally truncates uncovered tails, printing the rv range being
-    discarded."""
+    discarded.  ``--digests`` prints per-frame CRC32C digests instead of
+    the full check; ``--compare OTHER`` diffs two replica WALs (exit 1
+    when they diverged — a shared prefix with one side behind is
+    clean)."""
     import argparse
 
     parser = argparse.ArgumentParser(
@@ -401,7 +506,28 @@ def main(argv: List[str]) -> int:
         "corruption are NOT covered, truncate anyway and print the rv "
         "range being discarded",
     )
+    parser.add_argument(
+        "--digests", action="store_true",
+        help="emit per-frame CRC32C digests (the offline half of the "
+        "replication plane's digest gossip) instead of the full check",
+    )
+    parser.add_argument(
+        "--compare", metavar="OTHER", default=None,
+        help="diff this WAL against another replica's by frame digest; "
+        "exit 1 when the histories diverged (one being a prefix of the "
+        "other is clean — a follower mid-catch-up)",
+    )
     args = parser.parse_args(argv)
+    if args.compare:
+        report = wal_compare(args.wal, args.compare)
+        print(json.dumps(report, indent=2))
+        ok = report["identical"] or report["prefix"]
+        return 0 if ok else 1
+    if args.digests:
+        report = wal_digests(args.wal)
+        print(json.dumps(report, indent=2))
+        return 0 if not report.get("corrupt") and "error" not in report \
+            else 1
     repair_report = None
     if args.repair:
         repair_report = repair(

@@ -33,7 +33,7 @@ from concurrent import futures
 from typing import Any, Callable, Optional, Tuple
 
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
-from minisched_tpu.observability import hist
+from minisched_tpu.observability import counters, hist
 
 SERVICE = "minisched.Evaluator"
 
@@ -193,7 +193,57 @@ def evaluate_cluster(request: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _handlers():
+class _SnapListCache:
+    """Memoized gRPC list encodes keyed off the store's COW read plane
+    (the PR-13 crumb).  The REST façade memoizes its list BODIES on the
+    ``_ReadSnapshot`` itself (store.list_body); the gRPC framing is
+    different bytes (proto field-1 wrap), so this cache holds the
+    WRAPPED encode per (kind, ns) and validates it by snapshot IDENTITY:
+    ``_cow_publish`` replaces the snapshot object wholesale on every
+    publish, so ``cached_snap is current_snap`` proves nothing changed —
+    no rv compare, no lock, no re-encode for relist storms."""
+
+    def __init__(self, store: Any):
+        self._store = store
+        self._mu = threading.Lock()
+        self._cache: dict = {}  # (kind, ns) -> (snap, wrapped_bytes)
+
+    def list_bytes(self, kind: str, namespace: str) -> bytes:
+        read_plane = getattr(self._store, "read_plane", None)
+        snap = read_plane() if read_plane is not None else None
+        key = (kind, namespace)
+        if snap is not None:
+            with self._mu:
+                hit = self._cache.get(key)
+            if hit is not None and hit[0] is snap:
+                counters.inc("grpc.list_cache.hits")
+                return hit[1]
+            objs = snap.maps.get(kind, {})
+            items = [
+                _encode(o) for o in objs.values()
+                if not namespace or o.metadata.namespace == namespace
+            ]
+            body = _wrap_json(json.dumps(
+                {"items": items, "resource_version": snap.rv}
+            ).encode())
+            counters.inc("grpc.list_cache.encodes")
+            with self._mu:
+                self._cache[key] = (snap, body)
+            return body
+        # kill-switch (MINISCHED_COW_READS=0): the locked path, uncached
+        # (no snapshot identity to validate a cache entry against)
+        objs, rv = self._store.list_with_rv(kind)
+        items = [
+            _encode(o) for o in objs
+            if not namespace or o.metadata.namespace == namespace
+        ]
+        counters.inc("grpc.list_cache.encodes")
+        return _wrap_json(json.dumps(
+            {"items": items, "resource_version": rv}
+        ).encode())
+
+
+def _handlers(store: Any = None):
     import grpc
 
     def health(request_bytes: bytes, context) -> bytes:
@@ -234,18 +284,49 @@ def _handlers():
             response_serializer=lambda b: b,
         ),
     }
+    if store is not None:
+        cache = _SnapListCache(store)
+
+        def list_objects(request_bytes: bytes, context) -> bytes:
+            t0 = time.monotonic()
+            try:
+                request = json.loads(
+                    _unwrap_json(request_bytes).decode("utf-8")
+                )
+                kind = request.get("kind", "")
+                if kind not in KIND_TYPES:
+                    raise ValueError(f"unknown kind {kind!r}")
+                return cache.list_bytes(
+                    kind, str(request.get("namespace", ""))
+                )
+            except (ValueError, KeyError) as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+            finally:
+                hist.observe(
+                    "grpc.request_s", time.monotonic() - t0, method="List"
+                )
+
+        rpcs["List"] = grpc.unary_unary_rpc_method_handler(
+            list_objects,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
     return grpc.method_handlers_generic_handler(SERVICE, rpcs)
 
 
 def start_grpc_server(
-    port: int = 0, max_workers: int = 4
+    port: int = 0, max_workers: int = 4, store: Any = None
 ) -> Tuple[Any, str, Callable[[], None]]:
     """Serve the evaluator; returns (server, address, shutdown_fn) — the
-    start_api_server shape (controlplane/httpserver.py)."""
+    start_api_server shape (controlplane/httpserver.py).  With a
+    ``store``, the ``List`` rpc serves snapshot-consistent object lists
+    through the COW read plane with a memoized encode (_SnapListCache);
+    without one, List is unimplemented (evaluator-only shim, as
+    before)."""
     import grpc
 
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_handlers(),))
+    server.add_generic_rpc_handlers((_handlers(store),))
     bound_port = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     address = f"127.0.0.1:{bound_port}"
@@ -278,6 +359,15 @@ class EvaluatorClient:
 
     def health(self) -> dict:
         return self._call("Health", {})
+
+    def list(self, kind: str, namespace: str = "",
+             timeout: float = 120.0) -> dict:
+        """{"items": [encoded objects], "resource_version": rv} — the
+        snapshot-consistent list rpc (requires the server to have been
+        started with a store)."""
+        return self._call(
+            "List", {"kind": kind, "namespace": namespace}, timeout=timeout
+        )
 
     def evaluate(
         self,

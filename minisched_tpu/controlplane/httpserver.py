@@ -45,6 +45,7 @@ from minisched_tpu.controlplane.client import (
 from minisched_tpu.controlplane.store import (
     Conflict,
     HistoryCompacted,
+    NotLeader,
     ObjectStore,
     StorageDegraded,
 )
@@ -218,6 +219,9 @@ class _Handler(BaseHTTPRequestHandler):
     #: streamloop.StreamLoop when the selector fanout path is on (set by
     #: start_api_server; None = thread-per-watcher, the exact old path)
     stream_loop = None
+    #: repl.ReplRuntime when this server fronts a replicated store
+    #: (DESIGN.md §27); None = the /repl/* routes answer 404
+    repl = None
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:  # quiet
@@ -299,8 +303,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_get(path, query)
         finally:
             # long-lived watch streams are not requests; their latency
-            # story is watch.delivery_lag_s, not http.request_s
-            if "watch=true" not in query:
+            # story is watch.delivery_lag_s, not http.request_s — and the
+            # replication tail is the same shape (storage.repl_ship_s)
+            if "watch=true" not in query and path != "/repl/stream":
                 self._observe_request("GET", path, t0)
 
     def _handle_get(self, path: str, query: str) -> None:
@@ -331,6 +336,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if path.startswith("/repl/"):
+            repl = self.repl
+            if repl is None:
+                self._error(404, "replication not enabled on this server")
+            else:
+                repl.handle_get(self, path, query)
             return
         try:
             kind, ns, name, _ = _route(path)
@@ -589,6 +601,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.partition("?")[0] == "/api/v1/bindings":
             self._bind_many()
             return
+        if self.path.partition("?")[0].startswith("/repl/"):
+            repl = self.repl
+            if repl is None:
+                self._error(404, "replication not enabled on this server")
+            else:
+                repl.handle_post(self, self.path.partition("?")[0])
+            return
         try:
             kind, ns, name, sub = _route(self.path)
         except (KeyError, ValueError):
@@ -614,6 +633,11 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except (Conflict, OutOfCapacity) as e:
                 self._error(409, str(e))
+            except NotLeader as e:
+                # 503 with the "not leader" marker: this replica is
+                # fenced (DESIGN.md §27) — the client re-discovers the
+                # plane's current leader, it does NOT blind-retry here
+                self._error(503, str(e))
             except StorageDegraded as e:
                 # 507 Insufficient Storage: the WAL cannot append (ENOSPC/
                 # EIO latch) — transient by contract (the store probes its
@@ -645,6 +669,8 @@ class _Handler(BaseHTTPRequestHandler):
         _fixup_namespace(kind, ns, obj)
         try:
             self._send(201, _encode(self.store.create(kind, obj)))
+        except NotLeader as e:
+            self._error(503, str(e))
         except StorageDegraded as e:
             self._error(507, str(e))
         except KeyError as e:
@@ -692,6 +718,9 @@ class _Handler(BaseHTTPRequestHandler):
             results = self.store.create_many(
                 kind, [o for _, o in decoded], return_objects=return_objects
             )
+        except NotLeader as e:
+            self._error(503, str(e))
+            return
         except StorageDegraded as e:
             self._error(507, str(e))
             return
@@ -764,6 +793,9 @@ class _Handler(BaseHTTPRequestHandler):
             results = Client(self.store).pods().bind_many(
                 [bindings[i] for i in todo], return_objects=return_objects
             )
+        except NotLeader as e:
+            self._error(503, str(e))
+            return
         except StorageDegraded as e:
             # the WHOLE transaction was refused pre-commit (degraded
             # latch): 507, retryable — nothing to ack, nothing landed
@@ -883,6 +915,8 @@ class _Handler(BaseHTTPRequestHandler):
             # 409 with the stale-rv marker: the remote client maps it to
             # store.Conflict and retries get→re-apply→PUT, never blindly
             self._error(409, str(e))
+        except NotLeader as e:
+            self._error(503, str(e))
         except StorageDegraded as e:
             self._error(507, str(e))
         except KeyError as e:
@@ -904,6 +938,8 @@ class _Handler(BaseHTTPRequestHandler):
             kind, ns, name, _ = _route(self.path)
             self.store.delete(kind, ns, name)
             self._send(200, {})
+        except NotLeader as e:
+            self._error(503, str(e))
         except StorageDegraded as e:
             self._error(507, str(e))
         except (KeyError, ValueError) as e:
@@ -916,6 +952,7 @@ def start_api_server(
     faults: Any = None,
     stream_buffer_bytes: Optional[int] = None,
     stream_sndbuf_bytes: Optional[int] = None,
+    repl: Any = None,
 ) -> Tuple[ThreadingHTTPServer, str, Callable[[], None]]:
     """Boot the REST façade on an ephemeral port and poll /healthz until it
     answers (k8sapiserver.go:231-249's readiness loop).  Returns
@@ -956,7 +993,8 @@ def start_api_server(
         {"store": store, "active_watches": set(),
          "watch_lock": threading.Lock(), "faults": faults,
          "ack_registry": acks, "ack_order": _deque(acks),
-         "ack_lock": threading.Lock(), "stream_loop": stream_loop},
+         "ack_lock": threading.Lock(), "stream_loop": stream_loop,
+         "repl": repl},
     )
     server = _WatchHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -1030,6 +1068,11 @@ class HTTPClient:
             raise self._mark(KeyError(body), replayed)
         if status == 404:
             raise self._mark(KeyError(body), replayed)
+        if status == 503 and "not leader" in body:
+            # == in-process fence refusal (DESIGN.md §27): typed so a
+            # leader-aware caller re-discovers the plane's leader rather
+            # than retrying a replica that will keep refusing
+            raise self._mark(NotLeader(body), replayed)
         if status == 507:
             # == in-process WAL refusal
             raise self._mark(StorageDegraded(body), replayed)

@@ -55,6 +55,7 @@ from minisched_tpu.controlplane.store import (
     Conflict,
     EventType,
     HistoryCompacted,
+    NotLeader,
     StorageDegraded,
     WatchEvent,
 )
@@ -350,6 +351,13 @@ class RemoteStore:
                     raise OutOfCapacity(body)
                 if status in (404, 409):
                     raise KeyError(body)
+                if status == 503 and "not leader" in body:
+                    # fenced replica (DESIGN.md §27): retrying HERE can
+                    # never succeed — the typed error surfaces
+                    # immediately so the caller re-discovers the plane's
+                    # leader instead of burning its backoff budget
+                    counters.inc("storage.repl.not_leader_errors")
+                    raise NotLeader(body)
                 if status == 507:
                     # Insufficient Storage: the server's WAL is degraded
                     # (ENOSPC/EIO latch).  In the backoff set on purpose —

@@ -1,0 +1,972 @@
+"""Replicated control plane: quorum-ack WAL shipping at the group-commit
+barrier (DESIGN.md §27).
+
+Every robustness layer so far survives process death (checkpoint ⊕ WAL
+replay), engine loss (HA membership), and a lying disk (CRC frames +
+scrub) — but ONE store process still owned the WAL.  This module removes
+that last SPOF: N store replicas consume the leader's CRC-framed record
+stream, and the leader's group-commit barrier holds every mutation
+between its ONE fsync and its publish until a QUORUM of followers has
+the group durable too.  Nothing is ever acked to a caller that machine
+loss could take back.
+
+The design rides three invariants the stack already proved:
+
+* **The GROUP is the replication unit.**  Group commit writes each
+  group as one contiguous byte range in rv-dense order (durable.py
+  ``_gc_commit_group``).  Shipping exactly that byte range means the
+  follower's apply inherits byte-order == rv-order for free, and the
+  walio v2 frames inside it are self-delimiting and checksummed — the
+  wire format costs nothing.
+* **The recovery path IS the apply path.**  Followers append the
+  shipped bytes to their own WAL, fsync, and replay them through the
+  same ``_apply`` recovery code a restart runs — a promoted follower
+  serves from state built exactly the way a reopened leader would
+  build it.
+* **Failover rides the proven ``expected_rv``-CAS Lease arbitration**
+  (ha/lease.py): each replica hosts a tiny in-memory ARBITER store
+  (coordination only — never the replicated data plane, so lease
+  traffic cannot fork the data rv sequence); the store-leader lease is
+  CAS-acquired on a MAJORITY of arbiters.  A follower that wins
+  promotes and serves from its replayed WAL; demoted ex-leaders fence
+  their writes (store.NotLeader, HTTP 503 ``not leader``).
+
+Quorum rule: with ``cluster_size`` replicas the leader needs
+``cluster_size // 2`` follower acks per group (itself being the +1 of
+the majority).  A quorum that cannot be reached within the ack timeout
+fails the WHOLE group typed (StorageDegraded) with nothing published,
+truncates the unacked suffix off the local WAL — an unacked group may
+not survive, exactly like a torn tail — and bumps the stream EPOCH so
+any follower that buffered it resyncs to the authoritative log.
+
+Digest gossip (the PR-5 crumb): the leader keeps a bounded ring of
+per-group CRC32C digests over the shipped byte ranges; followers verify
+each group on receipt AND periodically re-derive digests from their own
+local WAL bytes against ``GET /repl/digests`` — a replica whose disk
+lies about already-applied groups is convicted by comparison, not by
+trusting local recompute, and resyncs.
+
+Kill-switch: ``MINISCHED_REPL=0`` keeps every hub/follower unattached —
+the single-store path is restored byte-identically (parity pinned in
+tests/test_repl.py).
+
+Wire surface (served by the REST façade when a runtime is attached):
+
+    GET  /repl/status                         → role/rv/epoch/offsets
+    GET  /repl/stream?offset=&epoch=&replica= → group-framed byte tail
+    GET  /repl/digests?since=                 → per-group digest ring
+    POST /repl/ack {replica, offset}          → follower durability ack
+
+The stream is chunked HTTP over the façade's existing machinery; inside
+it, each shipped group is one header line (JSON: off/len/crc/seq) plus
+its raw bytes, with ``{"hb": epoch}`` heartbeats while idle.  Fault
+points: ``repl.ship`` (a follower's stream dies mid-ship) and
+``repl.ack`` (the leader loses a follower's ack) — both keyed by
+replica id on the deterministic fabric.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from minisched_tpu.controlplane.walio import group_crc32c
+from minisched_tpu.observability import counters, hist
+
+#: leader-side ring of per-group digests: deep enough that a follower a
+#: few seconds behind still finds its catch-up boundaries group-aligned
+#: (older ranges ship as raw catch-up chunks, digested on the fly)
+DIGEST_RING = 4096
+
+#: the store-leader lease name on every arbiter
+LEASE_STORE_LEADER = "store-leader"
+
+GroupDigest = collections.namedtuple("GroupDigest", "seq start end crc")
+
+
+def repl_enabled() -> bool:
+    """The MINISCHED_REPL kill-switch: ``0`` keeps every hub and
+    follower unattached, restoring single-store semantics exactly."""
+    return os.environ.get("MINISCHED_REPL", "1") != "0"
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    """One replica's addresses: the data plane façade (replicated store)
+    and the arbiter façade (in-memory coordination store)."""
+
+    replica_id: str
+    data_url: str
+    arbiter_url: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "data_url": self.data_url,
+            "arbiter_url": self.arbiter_url,
+        }
+
+
+# ---------------------------------------------------------------------------
+# leader side: the quorum tracker the barrier parks on
+# ---------------------------------------------------------------------------
+
+
+class ReplicationHub:
+    """Leader-side replication state: which byte offset is durable and
+    shippable, which follower has acked what, and the per-group digest
+    ring.  ``durable.py`` calls ``note_group``/``wait_quorum`` at the
+    group-commit barrier; the façade's stream/ack handlers call
+    ``wait_bytes``/``record_ack``; everything synchronizes on one
+    condition variable."""
+
+    def __init__(
+        self,
+        wal_path: str,
+        cluster_size: int = 1,
+        ack_timeout_s: float = 30.0,
+        epoch: int = 1,
+        digest_ring: int = DIGEST_RING,
+    ):
+        self.wal_path = wal_path
+        self.cluster_size = max(int(cluster_size), 1)
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.epoch = int(epoch)
+        self.durable_end = 0  # set by promote_leader (current WAL size)
+        self.seq = 0
+        self.digests: collections.deque = collections.deque(
+            maxlen=digest_ring
+        )
+        self.closed = False
+        self._acks: Dict[str, int] = {}
+        self._cond = threading.Condition()
+
+    @property
+    def quorum_followers(self) -> int:
+        """Follower acks needed per group: the leader's own fsync is the
+        +1 that makes ``cluster_size // 2 + 1`` a majority."""
+        return self.cluster_size // 2
+
+    # -- barrier side (leader's group-commit thread) -----------------------
+    def note_group(self, start: int, buf: bytes) -> GroupDigest:
+        """Publish one committed group's byte range to the stream plane
+        (called after the leader's fsync, before its publish)."""
+        with self._cond:
+            self.seq += 1
+            digest = GroupDigest(
+                self.seq, start, start + len(buf), group_crc32c(buf)
+            )
+            self.digests.append(digest)
+            if digest.end > self.durable_end:
+                self.durable_end = digest.end
+            self._cond.notify_all()
+        counters.inc("storage.repl.groups")
+        counters.inc("storage.repl.bytes", len(buf))
+        return digest
+
+    def advance(self, end: int) -> None:
+        """Durable-offset advance WITHOUT a group (rv watermarks, ack
+        records, recovery probes): the bytes ship as raw catch-up chunks
+        and need no quorum — they carry no client-visible promise."""
+        with self._cond:
+            if end > self.durable_end:
+                self.durable_end = end
+                self._cond.notify_all()
+
+    def retract(self, end: int) -> None:
+        """A quorum-failed group was truncated off the local WAL: pull
+        the shippable horizon back and bump the EPOCH so followers that
+        buffered the dead bytes resync to the authoritative log."""
+        with self._cond:
+            self.durable_end = end
+            self.epoch += 1
+            self.digests.clear()
+            self._acks.clear()
+            self._cond.notify_all()
+
+    def wait_quorum(self, end: int, timeout: Optional[float] = None) -> bool:
+        """Block until ``quorum_followers`` distinct followers have
+        acked durability through ``end``.  False on timeout or close —
+        the caller fails the group; it was never acked to anyone."""
+        need = self.quorum_followers
+        if need <= 0:
+            return True
+        deadline = (
+            None if timeout is None else time.monotonic() + float(timeout)
+        )
+        with self._cond:
+            while not self.closed:
+                got = sum(1 for off in self._acks.values() if off >= end)
+                if got >= need:
+                    return True
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return False
+
+    # -- stream side (façade handler threads) ------------------------------
+    def record_ack(self, replica: str, offset: int) -> None:
+        with self._cond:
+            if offset > self._acks.get(replica, -1):
+                self._acks[replica] = int(offset)
+                self._cond.notify_all()
+        counters.inc("storage.repl.acks")
+
+    def wait_bytes(
+        self, offset: int, epoch: int, timeout: float
+    ) -> tuple:
+        """Park a stream until bytes past ``offset`` exist (or the epoch
+        moves, or the hub closes).  Returns (durable_end, epoch,
+        closed)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            while (
+                not self.closed
+                and self.epoch == epoch
+                and self.durable_end <= offset
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self.durable_end, self.epoch, self.closed
+
+    def next_chunk(self, offset: int) -> tuple:
+        """The next ship unit starting at ``offset``: a digest-ring group
+        when one starts exactly there (group-aligned fast path), else a
+        raw catch-up range up to the next known group start (or the
+        durable end).  Returns (end, crc_or_None, seq_or_None); crc is
+        None when the range must be digested from the file bytes."""
+        with self._cond:
+            nxt = None
+            for g in self.digests:
+                if g.start == offset:
+                    return g.end, g.crc, g.seq
+                if g.start > offset and (nxt is None or g.start < nxt):
+                    nxt = g.start
+            end = self.durable_end if nxt is None else min(
+                nxt, self.durable_end
+            )
+            return end, None, None
+
+    def digests_since(self, since_seq: int = 0) -> List[GroupDigest]:
+        with self._cond:
+            return [g for g in self.digests if g.seq > since_seq]
+
+    def acks_snapshot(self) -> Dict[str, int]:
+        with self._cond:
+            return dict(self._acks)
+
+    def close(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# follower side: tail the leader's stream through the real recovery path
+# ---------------------------------------------------------------------------
+
+
+class WalFollower(threading.Thread):
+    """Tail one leader's ``/repl/stream`` into a local DurableObjectStore.
+
+    Each received group is CRC-verified, appended to the local WAL
+    (fsync when the store is armed), applied through the store's real
+    recovery path (``apply_replicated``), and acked back with the new
+    durable offset.  Reconnects resume from the local WAL size — the
+    offset IS the replication cursor, no separate bookkeeping to rot.
+    An epoch mismatch, offset discontinuity, or digest divergence wipes
+    the local state and re-tails from zero (``resync``)."""
+
+    def __init__(
+        self,
+        store: Any,
+        leader_url: str,
+        replica_id: str,
+        read_timeout_s: float = 5.0,
+        reconnect_delay_s: float = 0.1,
+        gossip_every_s: float = 2.0,
+    ):
+        super().__init__(name=f"wal-follower-{replica_id}", daemon=True)
+        self._store = store
+        self._leader = leader_url.rstrip("/")
+        self._replica = replica_id
+        self._read_timeout_s = float(read_timeout_s)
+        self._reconnect_delay_s = float(reconnect_delay_s)
+        self._gossip_every_s = float(gossip_every_s)
+        # not named _stop: Thread.join() calls a private _stop() method
+        self._halt = threading.Event()
+        self._epoch = 0
+        self._last_gossip = 0.0
+        #: evidence for tests/status
+        self.last_error: str = ""
+        self.resumed_from: Optional[int] = None
+        self.leader_seen = threading.Event()
+
+    # -- plumbing -----------------------------------------------------------
+    def _local_end(self) -> int:
+        return self._store.wal_end()
+
+    def _get_json(self, path: str, timeout: Optional[float] = None) -> Any:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            self._leader + path, timeout=timeout or self._read_timeout_s
+        ) as r:
+            return json.loads(r.read())
+
+    def _post_json(self, path: str, payload: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._leader + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self._read_timeout_s) as r:
+            r.read()
+
+    def _ack(self, offset: int) -> None:
+        # best-effort: a lost ack (repl.ack fault, transport blip) heals
+        # at the next group or heartbeat re-ack — the offset is absolute
+        try:
+            self._post_json(
+                "/repl/ack", {"replica": self._replica, "offset": offset}
+            )
+        except OSError:
+            pass
+
+    def _resync(self, reason: str) -> None:
+        counters.inc("storage.repl.resyncs")
+        self.last_error = f"resync: {reason}"
+        self._store.replica_reset()
+        self._epoch = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self._sync_epoch()
+                self._tail_once()
+            except Exception as e:  # noqa: BLE001 — any failure retails
+                self.last_error = str(e)
+            if not self._halt.is_set():
+                self._halt.wait(self._reconnect_delay_s)
+
+    def _sync_epoch(self) -> None:
+        status = self._get_json("/repl/status")
+        if status.get("role") != "leader":
+            raise OSError(f"peer {self._leader} is not leading")
+        epoch = int(status.get("epoch", 0))
+        if self._epoch and epoch != self._epoch:
+            self._resync(f"leader epoch moved {self._epoch} -> {epoch}")
+        if self._local_end() > int(status.get("durable_end", 0)):
+            # we hold bytes the leader does not acknowledge (ex-leader
+            # tail, or a quorum-failed group we buffered): authoritative
+            # log wins
+            self._resync("local WAL ahead of leader durable end")
+        self._epoch = epoch
+        self.leader_seen.set()
+
+    def _tail_once(self) -> None:
+        import http.client
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(self._leader)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port, timeout=self._read_timeout_s
+        )
+        try:
+            offset = self._local_end()
+            conn.request(
+                "GET",
+                f"/repl/stream?offset={offset}&epoch={self._epoch}"
+                f"&replica={self._replica}",
+            )
+            resp = conn.getresponse()
+            if resp.status == 410:
+                resp.read()
+                self._resync("stream answered 410 (epoch/offset gone)")
+                return
+            if resp.status != 200:
+                raise OSError(f"stream HTTP {resp.status}")
+            self.resumed_from = offset
+            while not self._halt.is_set():
+                line = resp.readline()
+                if not line:
+                    return  # leader hung up; reconnect resumes
+                header = json.loads(line)
+                if "resync" in header:
+                    self._resync("leader requested resync")
+                    return
+                if "hb" in header:
+                    if int(header["hb"]) != self._epoch:
+                        self._resync("epoch moved mid-stream")
+                        return
+                    self._maybe_gossip()
+                    self._ack(self._local_end())  # heal lost acks
+                    continue
+                off, length, crc = (
+                    int(header["off"]), int(header["len"]), header.get("crc")
+                )
+                payload = self._read_exact(resp, length)
+                if crc is not None and group_crc32c(payload) != int(crc):
+                    counters.inc("storage.repl.digest_mismatch")
+                    self._resync(f"group crc mismatch at {off}")
+                    return
+                if off != self._local_end():
+                    self._resync(
+                        f"offset discontinuity (local {self._local_end()}, "
+                        f"stream {off})"
+                    )
+                    return
+                t0 = time.monotonic()
+                new_end = self._store.apply_replicated(
+                    payload, start_offset=off
+                )
+                self._ack(new_end)
+                hist.observe(
+                    "storage.repl_apply_s", time.monotonic() - t0
+                )
+                self._maybe_gossip()
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(resp: Any, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            piece = resp.read(n - len(out))
+            if not piece:
+                raise OSError("stream truncated mid-group")
+            out += piece
+        return bytes(out)
+
+    # -- digest gossip ------------------------------------------------------
+    def _maybe_gossip(self) -> None:
+        now = time.monotonic()
+        if now - self._last_gossip < self._gossip_every_s:
+            return
+        self._last_gossip = now
+        self.gossip_once()
+
+    def gossip_once(self) -> bool:
+        """One scrub-gossip round: re-derive CRC32C digests from our OWN
+        local WAL bytes for every leader ring entry we have applied, and
+        compare.  A mismatch means a replica's disk (ours or a torn
+        apply) diverged AFTER the transit CRC passed — convict by
+        comparison, count it, and resync.  Returns False on mismatch."""
+        try:
+            ring = self._get_json("/repl/digests")["digests"]
+        except OSError:
+            return True
+        local_end = self._local_end()
+        for entry in ring:
+            start, end = int(entry["start"]), int(entry["end"])
+            if end > local_end:
+                continue  # not applied yet
+            local = self._store.wal_range_crc32c(start, end)
+            if local is None:
+                continue  # file shrank under us (reset mid-gossip)
+            if local != int(entry["crc"]):
+                counters.inc("storage.repl.digest_mismatch")
+                self._resync(
+                    f"digest gossip divergence in group "
+                    f"[{start},{end}) (seq {entry.get('seq')})"
+                )
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# failover: majority lease arbitration over the replicas' arbiter stores
+# ---------------------------------------------------------------------------
+
+
+class PlaneCoordinator(threading.Thread):
+    """Store-leader election among replicas, riding ha/lease.py's
+    ``expected_rv``-CAS arbitration (DESIGN.md §27).
+
+    Each replica hosts an in-memory ARBITER store; the store-leader
+    lease is acquired per-arbiter by CAS, and leadership = holding it on
+    a MAJORITY of the full cluster.  Why this is safe: two candidates
+    racing on one arbiter resolve exactly one winner (the 409), and no
+    two candidates can both assemble a majority.  Why it does not fork
+    data: arbiter stores are volatile and never replicated — lease
+    traffic cannot advance the data plane's rv.
+
+    Failover window: a dead leader stops renewing; after one lease TTL
+    every arbiter reads the lease expired and candidates run.  The
+    most-caught-up candidate should win — candidates poll surviving
+    peers' ``/repl/status`` and stagger their attempts by (rv, id) rank,
+    so a follower missing acked groups yields to one that has them
+    whenever the two can see each other.  (A partitioned stale candidate
+    still cannot win a majority without beating the fresher one's CAS on
+    shared arbiters.)"""
+
+    def __init__(
+        self,
+        runtime: "ReplRuntime",
+        ttl_s: float = 2.0,
+        poll_s: Optional[float] = None,
+        stagger_s: Optional[float] = None,
+    ):
+        super().__init__(
+            name=f"plane-coordinator-{runtime.replica_id}", daemon=True
+        )
+        self._rt = runtime
+        self._ttl = float(ttl_s)
+        self._poll = float(poll_s) if poll_s is not None else self._ttl / 3.0
+        self._stagger = (
+            float(stagger_s) if stagger_s is not None else self._ttl / 4.0
+        )
+        # not named _stop: Thread.join() calls a private _stop() method
+        self._halt = threading.Event()
+        self._managers: Dict[str, Any] = {}
+        self._no_leader_since: Optional[float] = None
+
+    # -- plumbing -----------------------------------------------------------
+    @property
+    def _majority(self) -> int:
+        return len(self._rt.peers) // 2 + 1
+
+    def _manager(self, peer: PeerSpec) -> Any:
+        mgr = self._managers.get(peer.replica_id)
+        if mgr is None:
+            from minisched_tpu.controlplane.remote import RemoteClient
+            from minisched_tpu.ha.lease import LeaseManager
+
+            # no retries and a short timeout: a dead arbiter must cost a
+            # tick fractions of the TTL, not multiples (election timing
+            # is the failover window)
+            client = RemoteClient(
+                peer.arbiter_url,
+                timeout_s=min(1.0, self._ttl / 2.0),
+                retries=0,
+            )
+            mgr = LeaseManager(client)
+            self._managers[peer.replica_id] = mgr
+        return mgr
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                if self._rt.role == "leader":
+                    self._lead_tick()
+                else:
+                    self._follow_tick()
+            except Exception as e:  # noqa: BLE001 — ticks must not die
+                self._rt.last_election_error = str(e)
+            self._halt.wait(self._poll)
+
+    # -- leader: keep the majority or fence --------------------------------
+    def _lead_tick(self) -> None:
+        held = 0
+        for peer in self._rt.peers:
+            try:
+                if self._manager(peer).acquire(
+                    LEASE_STORE_LEADER, self._rt.replica_id, self._ttl
+                ):
+                    held += 1
+            except Exception:  # noqa: BLE001 — unreachable arbiter
+                pass
+        if held < self._majority:
+            # we can no longer prove leadership to a majority: fence
+            # BEFORE someone else wins it — two acking leaders is the
+            # one unforgivable state
+            self._rt.demote("lost arbiter majority")
+
+    # -- follower: watch the lease, elect on expiry ------------------------
+    def _follow_tick(self) -> None:
+        holders: Dict[str, int] = {}
+        now = time.time()
+        reachable = 0
+        for peer in self._rt.peers:
+            try:
+                lease = self._manager(peer).get(LEASE_STORE_LEADER)
+                reachable += 1
+            except Exception:  # noqa: BLE001
+                continue
+            if lease is not None and not lease.expired(now):
+                holders[lease.spec.holder] = (
+                    holders.get(lease.spec.holder, 0) + 1
+                )
+        live = [h for h, n in holders.items() if n >= self._majority]
+        if live:
+            self._no_leader_since = None
+            holder = live[0]
+            if holder == self._rt.replica_id:
+                # the cluster still believes in us (fast restart inside
+                # our own TTL): resume leading rather than fencing the
+                # only majority holder
+                self._rt.promote()
+            else:
+                self._rt.note_leader(holder)
+            return
+        if reachable < self._majority:
+            return  # partitioned: cannot elect, cannot conclude death
+        if self._no_leader_since is None:
+            self._no_leader_since = time.monotonic()
+        # stagger candidacy by data freshness: rank 0 = best (rv, id)
+        if time.monotonic() - self._no_leader_since < (
+            self._rank() * self._stagger
+        ):
+            return
+        self._try_elect()
+
+    def _rank(self) -> int:
+        """How many reachable peers are strictly fresher than us —
+        (higher rv), ties to the lexically-smaller replica id."""
+        mine = (self._rt.store_rv(), self._rt.replica_id)
+        rank = 0
+        for peer in self._rt.peers:
+            if peer.replica_id == self._rt.replica_id:
+                continue
+            try:
+                status = self._rt.peer_status(peer)
+            except OSError:
+                continue
+            theirs = (int(status.get("rv", 0)), str(status.get("replica")))
+            if theirs[0] > mine[0] or (
+                theirs[0] == mine[0] and theirs[1] < mine[1]
+            ):
+                rank += 1
+        return rank
+
+    def _try_elect(self) -> None:
+        won: List[PeerSpec] = []
+        for peer in self._rt.peers:
+            try:
+                if self._manager(peer).acquire(
+                    LEASE_STORE_LEADER, self._rt.replica_id, self._ttl
+                ):
+                    won.append(peer)
+            except Exception:  # noqa: BLE001
+                pass
+        if len(won) >= self._majority:
+            self._no_leader_since = None
+            self._rt.promote()
+            return
+        # minority: release what we grabbed so a fresher candidate is
+        # not blocked by our partial spoils until the TTL
+        for peer in won:
+            try:
+                self._manager(peer).release(
+                    LEASE_STORE_LEADER, self._rt.replica_id
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the per-replica runtime: role state + the façade's /repl handlers
+# ---------------------------------------------------------------------------
+
+
+class ReplRuntime:
+    """Everything one replica process needs: role state (leader hub /
+    follower tailer), the election coordinator, and the ``/repl/*``
+    handlers ``start_api_server(repl=...)`` dispatches to."""
+
+    def __init__(
+        self,
+        store: Any,
+        replica_id: str,
+        peers: Optional[List[PeerSpec]] = None,
+        cluster_size: Optional[int] = None,
+        ack_timeout_s: float = 30.0,
+        ttl_s: float = 2.0,
+        heartbeat_s: float = 0.5,
+    ):
+        self.store = store
+        self.replica_id = replica_id
+        self.peers = list(peers or ())
+        self.cluster_size = int(
+            cluster_size if cluster_size is not None else max(
+                1, len(self.peers)
+            )
+        )
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.ttl_s = float(ttl_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.role = "follower"
+        self.leader_id: str = ""
+        self.hub: Optional[ReplicationHub] = None
+        self.follower: Optional[WalFollower] = None
+        self.coordinator: Optional[PlaneCoordinator] = None
+        self.last_election_error = ""
+        self._epoch_seen = 0
+        self._mu = threading.RLock()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, bootstrap_leader: Optional[str] = None) -> None:
+        """Boot this replica's role: the configured bootstrap leader
+        promotes immediately (epoch 1); everyone else follows it.  With
+        no bootstrap (post-crash rejoin), stay follower and let the
+        coordinator discover or elect."""
+        if bootstrap_leader == self.replica_id:
+            self.promote()
+        elif bootstrap_leader:
+            self.note_leader(bootstrap_leader)
+        if len(self.peers) > 1:
+            self.coordinator = PlaneCoordinator(self, ttl_s=self.ttl_s)
+            self.coordinator.start()
+
+    def close(self) -> None:
+        with self._mu:
+            if self.coordinator is not None:
+                self.coordinator.stop()
+            if self.follower is not None:
+                self.follower.stop()
+            if self.hub is not None:
+                self.hub.close()
+
+    # -- role transitions ---------------------------------------------------
+    def promote(self) -> None:
+        """Become (or resume being) the leader: stop tailing, attach a
+        fresh hub at a NEW epoch, unfence.  Idempotent."""
+        with self._mu:
+            if self.role == "leader" and self.hub is not None:
+                return
+            if self.follower is not None:
+                self.follower.stop()
+                self.follower = None
+            self._epoch_seen += 1
+            hub = ReplicationHub(
+                getattr(self.store, "_path", "<wal>"),
+                cluster_size=self.cluster_size,
+                ack_timeout_s=self.ack_timeout_s,
+                epoch=self._epoch_seen,
+            )
+            self.store.promote_leader(hub)
+            self.hub = hub
+            self.role = "leader"
+            self.leader_id = self.replica_id
+            counters.inc("storage.repl.promotions")
+
+    def demote(self, reason: str = "", leader_hint: str = "") -> None:
+        """Fence: this replica may no longer ack writes.  The hub is
+        closed FIRST so a barrier parked in wait_quorum fails its group
+        instead of blocking the fence."""
+        with self._mu:
+            if self.role != "leader":
+                return
+            self.store.fence(leader_hint)
+            self.hub = None
+            self.role = "follower"
+            self.leader_id = leader_hint
+            self.last_election_error = reason
+
+    def note_leader(self, holder: str) -> None:
+        """A (new) leader is known: make sure we are tailing IT."""
+        with self._mu:
+            if self.role == "leader" and holder != self.replica_id:
+                # deposed while we still thought we led
+                self.demote("observed a newer leader", leader_hint=holder)
+            if holder == self.leader_id and self.follower is not None:
+                return
+            peer = next(
+                (p for p in self.peers if p.replica_id == holder), None
+            )
+            if peer is None:
+                return
+            if self.follower is not None:
+                self.follower.stop()
+            self.leader_id = holder
+            if not self.store.is_fenced():
+                self.store.fence(holder)
+            self.follower = WalFollower(
+                self.store, peer.data_url, self.replica_id,
+                read_timeout_s=max(self.ttl_s, 2.0),
+            )
+            self.follower.start()
+
+    # -- introspection ------------------------------------------------------
+    def store_rv(self) -> int:
+        return int(getattr(self.store, "resource_version", 0))
+
+    def peer_status(self, peer: PeerSpec) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            peer.data_url.rstrip("/") + "/repl/status", timeout=self.ttl_s
+        ) as r:
+            return json.loads(r.read())
+
+    def status(self) -> dict:
+        hub = self.hub
+        return {
+            "replica": self.replica_id,
+            "role": self.role,
+            "leader": self.leader_id,
+            "rv": self.store_rv(),
+            "epoch": hub.epoch if hub is not None else self._epoch_seen,
+            "durable_end": (
+                hub.durable_end if hub is not None else self.store.wal_end()
+            ),
+            "cluster_size": self.cluster_size,
+            "quorum_followers": (
+                hub.quorum_followers if hub is not None else None
+            ),
+            "acks": hub.acks_snapshot() if hub is not None else {},
+            "fenced": bool(self.store.is_fenced()),
+        }
+
+    # -- façade handlers (called from httpserver._Handler) -----------------
+    def handle_get(self, handler: Any, path: str, query: str) -> None:
+        if path == "/repl/status":
+            handler._send(200, self.status())
+            return
+        if path == "/repl/digests":
+            since = handler._int_param(query, "since") or 0
+            hub = self.hub
+            digests = hub.digests_since(since) if hub is not None else []
+            handler._send(
+                200,
+                {
+                    "epoch": hub.epoch if hub is not None else 0,
+                    "digests": [
+                        {"seq": g.seq, "start": g.start,
+                         "end": g.end, "crc": g.crc}
+                        for g in digests
+                    ],
+                },
+            )
+            return
+        if path == "/repl/stream":
+            self._serve_stream(handler, query)
+            return
+        handler._error(404, f"no repl route {path}")
+
+    def handle_post(self, handler: Any, path: str) -> None:
+        if path == "/repl/ack":
+            body = handler._body()
+            replica = str(body.get("replica", ""))
+            offset = int(body.get("offset", -1))
+            hub = self.hub
+            faults = getattr(handler, "faults", None) or getattr(
+                self.store, "faults", None
+            )
+            if faults is not None and faults.should_fire("repl.ack", replica):
+                # the ack is LOST on the leader side: the follower's
+                # durability is real but unproven — it re-acks on its
+                # next group or heartbeat
+                counters.inc("storage.repl.ship_errors")
+                handler._error(503, "injected: ack dropped")
+                return
+            if hub is None or offset < 0 or not replica:
+                handler._error(409, "not leading (or malformed ack)")
+                return
+            hub.record_ack(replica, offset)
+            handler._send(200, {"acked": offset, "epoch": hub.epoch})
+            return
+        handler._error(404, f"no repl route {path}")
+
+    # -- the stream server --------------------------------------------------
+    def _serve_stream(self, handler: Any, query: str) -> None:
+        """One follower's tail: chunked HTTP; inside it, header lines +
+        raw group bytes (module docstring has the framing).  Runs on the
+        façade handler thread — a replica plane is a handful of
+        followers, not the thousand-watcher regime the selector loop
+        exists for (and the loop's event queues would re-buffer what is
+        already a file; the WAL itself is the buffer here)."""
+        hub = self.hub
+        params = dict(
+            p.split("=", 1) for p in query.split("&") if "=" in p
+        )
+        replica = params.get("replica", "?")
+        try:
+            offset = int(params.get("offset", 0))
+            epoch = int(params.get("epoch", 0))
+        except ValueError:
+            handler._error(400, "offset/epoch must be integers")
+            return
+        if hub is None:
+            handler._error(409, "not leading")
+            return
+        if epoch != hub.epoch or offset > hub.durable_end:
+            handler._error(410, "stale epoch or offset beyond durable end")
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        counters.inc("storage.repl.streams")
+        faults = getattr(handler, "faults", None) or getattr(
+            self.store, "faults", None
+        )
+
+        def chunk(data: bytes) -> None:
+            handler.wfile.write(
+                f"{len(data):X}\r\n".encode() + data + b"\r\n"
+            )
+
+        sent = offset
+        try:
+            with open(hub.wal_path, "rb") as wal:
+                while not hub.closed:
+                    end, cur_epoch, closed = hub.wait_bytes(
+                        sent, epoch, timeout=self.heartbeat_s
+                    )
+                    if closed or cur_epoch != epoch:
+                        chunk(b'{"resync": true}\n')
+                        break
+                    if end <= sent:
+                        chunk(
+                            json.dumps({"hb": epoch}).encode() + b"\n"
+                        )
+                        continue
+                    if faults is not None and faults.should_fire(
+                        "repl.ship", replica
+                    ):
+                        # the ship fails mid-flight: drop the stream
+                        # with no goodbye — the follower reconnects and
+                        # resumes from its own offset
+                        counters.inc("storage.repl.ship_errors")
+                        return
+                    chunk_end, crc, seq = hub.next_chunk(sent)
+                    wal.seek(sent)
+                    buf = wal.read(chunk_end - sent)
+                    if len(buf) != chunk_end - sent:
+                        # truncated under us (quorum-fail retract won
+                        # the race): the epoch bumped — tell the
+                        # follower to start over
+                        chunk(b'{"resync": true}\n')
+                        break
+                    if crc is None:
+                        crc = group_crc32c(buf)
+                    t0 = time.monotonic()
+                    header = {
+                        "off": sent, "len": len(buf), "crc": crc,
+                    }
+                    if seq is not None:
+                        header["seq"] = seq
+                    chunk(json.dumps(header).encode() + b"\n" + buf)
+                    hist.observe(
+                        "storage.repl_ship_s", time.monotonic() - t0
+                    )
+                    counters.inc("storage.repl.bytes_shipped", len(buf))
+                    sent = chunk_end
+            try:
+                chunk(b"")  # terminal chunk only on orderly endings
+                handler.wfile.write(b"\r\n")
+            except OSError:
+                pass
+        except OSError:
+            counters.inc("storage.repl.ship_errors")

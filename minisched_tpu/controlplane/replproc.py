@@ -1,0 +1,306 @@
+"""Process-level replicated plane: N killable store replicas (DESIGN.md
+§27) — the harness the `make chaos-repl` soak and the bench ``repl``
+role drive.
+
+faults/proc.py already runs ONE control plane as a SIGKILL-able child;
+this module runs N of them as a quorum.  Each replica child hosts two
+façades on fixed ports:
+
+* the DATA plane — the replicated DurableObjectStore behind
+  ``start_api_server(repl=ReplRuntime)``, serving clients and the
+  ``/repl/*`` replication surface;
+* the ARBITER plane — a tiny in-memory ObjectStore whose only job is
+  lease CAS for leader election.  In-memory on purpose twice over:
+  coordination traffic must never advance the replicated data rv
+  (writes to the data store would fork the byte sequence quorum
+  promised), and an arbiter dying WITH its process gives the lease
+  exactly the TTL semantics election needs.
+
+:class:`ReplicatedPlane` spawns the fleet, discovers the current leader
+by polling ``/repl/status``, SIGKILLs any replica (the leader, for the
+acceptance soak), and asserts a follower promotes within one lease TTL
+with every quorum-acked mutation intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from minisched_tpu.faults.proc import _free_port
+
+#: default election lease TTL for the harness (the soak's promotion
+#: deadline is exactly one of these)
+DEFAULT_TTL_S = 2.0
+
+
+def _replica_child_main(
+    replica_id: str,
+    wal_path: str,
+    data_port: int,
+    arbiter_port: int,
+    peers: List[dict],
+    bootstrap_leader: str = "",
+    fsync: bool = False,
+    ack_timeout_s: float = 10.0,
+    ttl_s: float = DEFAULT_TTL_S,
+    parent_pid: Optional[int] = None,
+) -> None:
+    """One replica's whole life: recover the store from its own WAL,
+    serve data + arbiter façades on fixed ports, join the plane (lead
+    if bootstrapped, else tail/elect), park until SIGKILL.  Runs in a
+    fresh interpreter — import inside, keep it light."""
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.repl import (
+        PeerSpec,
+        ReplRuntime,
+        repl_enabled,
+    )
+    from minisched_tpu.controlplane.store import ObjectStore
+
+    # salvage="covered": a replica restarting after SIGKILL may carry a
+    # torn tail; replay truncates it and the follower re-tails the gap
+    store = DurableObjectStore(wal_path, fsync=fsync, salvage="covered")
+    runtime = None
+    if repl_enabled():
+        runtime = ReplRuntime(
+            store,
+            replica_id,
+            peers=[PeerSpec(**p) for p in peers],
+            ack_timeout_s=ack_timeout_s,
+            ttl_s=ttl_s,
+        )
+    start_api_server(ObjectStore(), port=arbiter_port)
+    start_api_server(store, port=data_port, repl=runtime)
+    if runtime is not None:
+        runtime.start(bootstrap_leader or None)
+    if parent_pid:
+        # orphan watchdog (see faults/proc.py): an aborted soak must not
+        # strand listeners on the fixed ports
+        def watchdog() -> None:
+            while os.getppid() == parent_pid:
+                time.sleep(0.5)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        threading.Thread(target=watchdog, daemon=True).start()
+    threading.Event().wait()  # until SIGKILL — no orderly shutdown, ever
+
+
+_CHILD_CMD = (
+    "import json, sys; "
+    "from minisched_tpu.controlplane.replproc import _replica_child_main; "
+    "_replica_child_main(**json.loads(sys.argv[1]))"
+)
+
+
+class ReplicaSupervisor:
+    """One killable replica child with FIXED data+arbiter ports across
+    restarts (clients and peers need no re-discovery)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        wal_path: str,
+        data_port: int = 0,
+        arbiter_port: int = 0,
+        fsync: bool = False,
+        ack_timeout_s: float = 10.0,
+        ttl_s: float = DEFAULT_TTL_S,
+        boot_timeout_s: float = 30.0,
+    ):
+        self.replica_id = replica_id
+        self.wal_path = wal_path
+        self.data_port = data_port or _free_port()
+        self.arbiter_port = arbiter_port or _free_port()
+        self._fsync = fsync
+        self._ack_timeout_s = ack_timeout_s
+        self._ttl_s = ttl_s
+        self._boot_timeout_s = boot_timeout_s
+        self._proc: Any = None
+        self._peers: List[dict] = []
+        self.kills = 0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.data_port}"
+
+    @property
+    def arbiter_url(self) -> str:
+        return f"http://127.0.0.1:{self.arbiter_port}"
+
+    def spec(self) -> dict:
+        from minisched_tpu.controlplane.repl import PeerSpec
+
+        return PeerSpec(
+            self.replica_id, self.base_url, self.arbiter_url
+        ).as_dict()
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def start(self, peers: List[dict], bootstrap_leader: str = "") -> str:
+        """Spawn the child and block until its DATA façade answers
+        /healthz.  ``bootstrap_leader`` is only honored on the very
+        first generation — a restarted replica rejoins as a follower
+        and lets the coordinator discover (or re-win) leadership."""
+        if self.alive():
+            raise RuntimeError(f"replica {self.replica_id} already running")
+        self._peers = peers
+        cfg = {
+            "replica_id": self.replica_id,
+            "wal_path": self.wal_path,
+            "data_port": self.data_port,
+            "arbiter_port": self.arbiter_port,
+            "peers": peers,
+            "bootstrap_leader": bootstrap_leader,
+            "fsync": self._fsync,
+            "ack_timeout_s": self._ack_timeout_s,
+            "ttl_s": self._ttl_s,
+            "parent_pid": os.getpid(),
+        }
+        env = dict(os.environ)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_CMD, json.dumps(cfg)],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + self._boot_timeout_s
+        url = self.base_url + "/healthz"
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {self.replica_id} died at boot "
+                    f"(exitcode {self._proc.returncode})"
+                )
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    if r.status == 200:
+                        return self.base_url
+            except OSError:
+                pass
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"replica {self.replica_id} failed /healthz within "
+            f"{self._boot_timeout_s}s"
+        )
+
+    def kill(self) -> None:
+        """SIGKILL — no flush, no lease release, no goodbye.  The lease
+        simply stops being renewed; expiry IS the failure detector."""
+        if self._proc is None:
+            return
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self.kills += 1
+        try:
+            self._proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
+        self._proc = None
+
+    def restart(self) -> str:
+        return self.start(self._peers)  # never re-bootstrap
+
+    def status(self, timeout: float = 1.0) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                self.base_url + "/repl/status", timeout=timeout
+            ) as r:
+                return json.loads(r.read())
+        except OSError:
+            return None
+
+
+class ReplicatedPlane:
+    """N replica children forming one control plane."""
+
+    def __init__(
+        self,
+        wal_dir: str,
+        n: int = 3,
+        fsync: bool = False,
+        ack_timeout_s: float = 10.0,
+        ttl_s: float = DEFAULT_TTL_S,
+    ):
+        self.ttl_s = ttl_s
+        os.makedirs(wal_dir, exist_ok=True)
+        self.replicas: List[ReplicaSupervisor] = [
+            ReplicaSupervisor(
+                f"r{i}",
+                os.path.join(wal_dir, f"r{i}.wal"),
+                fsync=fsync,
+                ack_timeout_s=ack_timeout_s,
+                ttl_s=ttl_s,
+            )
+            for i in range(n)
+        ]
+
+    def __getitem__(self, i: int) -> ReplicaSupervisor:
+        return self.replicas[i]
+
+    def start(self) -> str:
+        """Boot every replica (r0 bootstraps as leader) and return the
+        leader's base_url once a majority of followers is tailing."""
+        peers = [r.spec() for r in self.replicas]
+        for r in self.replicas:
+            r.start(peers, bootstrap_leader="r0")
+        return self.wait_for_leader()["url"]
+
+    def statuses(self) -> Dict[str, dict]:
+        out = {}
+        for r in self.replicas:
+            s = r.status()
+            if s is not None:
+                out[r.replica_id] = s
+        return out
+
+    def leader(self) -> Optional[ReplicaSupervisor]:
+        """The replica currently claiming the leader role (alive +
+        unfenced).  None while the plane is between leaders."""
+        for r in self.replicas:
+            s = r.status()
+            if s is not None and s.get("role") == "leader" \
+                    and not s.get("fenced"):
+                return r
+        return None
+
+    def wait_for_leader(
+        self, timeout_s: float = 30.0, exclude: str = ""
+    ) -> dict:
+        """Block until some replica (optionally: not ``exclude``) serves
+        as leader; returns {"id", "url", "elapsed_s"}."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        while time.monotonic() < deadline:
+            r = self.leader()
+            if r is not None and r.replica_id != exclude:
+                return {
+                    "id": r.replica_id,
+                    "url": r.base_url,
+                    "elapsed_s": time.monotonic() - t0,
+                }
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"no leader within {timeout_s}s (statuses: {self.statuses()})"
+        )
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.kill()

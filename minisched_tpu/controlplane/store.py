@@ -52,6 +52,16 @@ class HistoryCompacted(Exception):
     the apiserver's 410 Gone.  The consumer must relist."""
 
 
+class NotLeader(Exception):
+    """A mutation reached a FENCED replica: this store consumes the
+    leader's replicated WAL stream (controlplane/repl.py) and must not
+    accept writes of its own — a demoted ex-leader or a follower taking
+    client traffic would fork the history quorum durability promised.
+    Reads keep serving (stale-bounded by replication lag).  On the wire
+    it is 503 with a ``not leader`` marker; leader-aware clients
+    re-discover the plane's current leader and retry there."""
+
+
 class StorageDegraded(Exception):
     """The durable layer cannot persist mutations (ENOSPC/EIO on the WAL
     append, or the degraded latch a prior failure set) — etcd's NOSPACE

@@ -417,6 +417,36 @@ def iter_wal_records_lenient(path: str) -> Iterator[dict]:
     yield from iter_records_lenient(data, 0, path=path)
 
 
+def decode_group(data: bytes, path: str = "<repl-group>") -> List[dict]:
+    """Strictly decode one CONTIGUOUS in-memory byte range of WAL frames —
+    the replication unit (controlplane/repl.py ships exactly the byte
+    range one group commit wrote, so byte order == rv order carries over
+    to the follower for free).  Unlike file replay, a torn tail is NOT
+    tolerated here: a shipped group is complete by contract, so trailing
+    partial bytes raise :class:`WalCorrupt` like mid-file damage."""
+    reader = WalReader(bytes(data), path)
+    recs = [rec for rec, _end in reader]
+    if reader.torn_tail or reader.good_end != len(data):
+        raise WalCorrupt(
+            path,
+            reader.good_end,
+            reader.index,
+            "incomplete frame in shipped group",
+            last_good_rv=reader.last_good_rv,
+        )
+    return recs
+
+
+def group_crc32c(data: bytes) -> int:
+    """Digest of one shipped group's RAW frame bytes (header + payload).
+    CRC32C always — the digest crosses processes in the replication
+    stream and the cross-replica scrub gossip, so both sides must agree
+    on the algorithm regardless of which checksum each frame's own
+    flags byte carries (the frame bytes, checksums included, are what
+    is being compared)."""
+    return _crc32c(bytes(data))
+
+
 def scan_file(path: str) -> dict:
     """One file's integrity report (fsck building block): decodes every
     record, classifying the outcome instead of raising.  Returns

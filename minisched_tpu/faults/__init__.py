@@ -49,6 +49,17 @@ Named points wired through the tree (grep for the literal string):
     engine.bind
         — the device engine's batch-bind transaction raises before the
           store call (exercises the wave's failed-commit requeue path)
+    repl.ship
+        — the leader's replication stream server drops a follower's
+          connection mid-ship with no goodbye (a flaky replica link);
+          the follower reconnects and resumes from its own WAL offset;
+          key = replica id
+    repl.ack
+        — the leader's /repl/ack handler answers 503 and DISCARDS the
+          follower's durability ack (the follower's write is real but
+          unproven); the follower's next group or heartbeat re-ack
+          heals it — quorum waits stretch, correctness holds; key =
+          replica id
 
 Determinism: whether call *n* at (point, key) fires is a pure function of
 ``(seed, point, key, n)`` — a blake2s hash, not a shared RNG — so the

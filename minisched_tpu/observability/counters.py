@@ -253,6 +253,58 @@ The gang subsystem (plugins/coscheduling + engine/gang) records under
           from a victim search (gang capacity is unpreemptable until
           whole-gang eviction lands — evicting one member would strand
           the rest as a partial gang; the churn bench audits this)
+
+The replicated control plane (controlplane/repl + the quorum hook in
+durable.py — DESIGN.md §27) records under ``storage.repl.`` — the
+chaos-repl soak's replication evidence:
+
+    storage.repl.groups / storage.repl.bytes
+        — commit groups (and their WAL bytes) the leader registered
+          with the replication hub at the group-commit barrier: the
+          unit of shipping, acking, and digest gossip
+    storage.repl.acks
+        — follower durability acks the leader recorded (each a
+          max-monotonic "my WAL is fsynced through offset N")
+    storage.repl.quorum_timeouts
+        — groups the barrier FAILED because a follower quorum never
+          acked in time; the group's bytes are truncated off the
+          leader's WAL and the stream epoch bumps (no divergence)
+    storage.repl.streams / storage.repl.bytes_shipped
+        — follower tail streams the leader served, and the framed WAL
+          bytes shipped down them
+    storage.repl.ship_errors
+        — ship/ack paths broken by a dead socket or the ``repl.ship``/
+          ``repl.ack`` fault points (the follower reconnects/re-acks)
+    storage.repl.applied_groups / storage.repl.applied_records
+        — groups (and the mutations inside) a follower applied through
+          the real recovery path; byte-order == rv-order by invariant
+    storage.repl.resyncs
+        — followers that wiped local state and re-tailed from zero
+          (leader epoch moved, offset discontinuity, digest mismatch)
+    storage.repl.digest_mismatch
+        — cross-replica scrub gossip convicted a byte range whose
+          CRC32C diverged from the leader's digest ring (bit rot or a
+          forked history; the follower resyncs rather than serve it)
+    storage.repl.fenced_writes
+        — mutations a demoted ex-leader refused with typed NotLeader
+          (the fence that makes split-brain writes impossible)
+    storage.repl.not_leader_errors
+        — remote-client requests answered 503 not-leader (re-discover
+          the leader; never blind-retried)
+    storage.repl.promotions
+        — follower→leader promotions won via arbiter-majority lease CAS
+    storage.repl.compact_deferred
+        — WAL compactions skipped while a replication hub was attached
+          (compaction-aware shipping is a ROADMAP follow-up; a leader
+          never rewrites bytes a follower may still need)
+
+The gRPC facade's memoized LIST encode (grpcserver._SnapListCache)
+mirrors the REST relist cache:
+
+    grpc.list_cache.hits / grpc.list_cache.encodes
+        — List RPCs served from the snapshot-keyed memo vs. fresh
+          encodes (one per COW snapshot flip per kind; hits/encodes is
+          the relist-storm sharing ratio)
 """
 
 from __future__ import annotations

@@ -57,7 +57,18 @@ same contract as counters.py):
           carries the object key of the waiter
     grpc.request_s
         — gRPC facade request latency, labeled ``method=`` (Health /
-          Evaluate) — the wire-RPC mirror of ``http.request_s``
+          Evaluate / List) — the wire-RPC mirror of ``http.request_s``
+    storage.quorum_wait_s
+        — time the leader's group-commit barrier spent awaiting a
+          follower quorum's durability acks, between the group's fsync
+          and its publish (DESIGN.md §27) — the replication tax every
+          acked mutation pays; the bench ``repl`` role's headline
+    storage.repl_ship_s
+        — leader-side per-group ship time: framing one commit group and
+          writing it down a follower's tail stream socket
+    storage.repl_apply_s
+        — follower-side per-group apply time: CRC verify + WAL append +
+          fsync + replay through the real recovery path
 
 **Exemplars**: ``observe(..., exemplar="default/pod-1")`` stamps the
 bucket the sample lands in with that string (last writer wins, one per

@@ -1,0 +1,507 @@
+"""Replicated control plane (ISSUE 15, DESIGN.md §27): quorum-ack WAL
+shipping at the group-commit barrier.
+
+This file owns the fast direct contracts: the quorum gate (no acks →
+the group FAILS typed and its bytes never existed), real-HTTP shipping
+with the GROUP as the replication unit (any prefix of shipped groups is
+a valid store — byte order IS rv order), follower resume-from-offset,
+the ``MINISCHED_REPL=0`` kill-switch's byte-identical parity, fencing
+(typed NotLeader end to end), digest-gossip divergence conviction, the
+``fsck --digests/--compare`` offline halves, the ``repl.ack`` fault
+point healing, and a deterministic arbiter-majority election round.
+The process-level failover soak (SIGKILL the leader mid-load) lives in
+test_repl_chaos.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_node, make_pod
+from minisched_tpu.controlplane.durable import DurableObjectStore
+from minisched_tpu.controlplane.fsck import wal_compare, wal_digests
+from minisched_tpu.controlplane.httpserver import start_api_server
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.repl import (
+    PeerSpec,
+    ReplicationHub,
+    ReplRuntime,
+    WalFollower,
+)
+from minisched_tpu.controlplane.store import (
+    NotLeader,
+    ObjectStore,
+    StorageDegraded,
+)
+from minisched_tpu.faults import FaultFabric
+from minisched_tpu.observability import counters
+
+
+def _wait(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _Plane:
+    """One in-process leader (hub attached, façade serving /repl/*) plus
+    N real-HTTP followers — the smallest true replication topology."""
+
+    def __init__(self, tmp_path, n_followers=2, cluster_size=3,
+                 ack_timeout_s=10.0, faults=None):
+        self.leader_wal = str(tmp_path / "leader.wal")
+        self.leader = DurableObjectStore(self.leader_wal, fsync=True)
+        self.runtime = ReplRuntime(
+            self.leader, "r0", peers=[], cluster_size=cluster_size,
+            ack_timeout_s=ack_timeout_s,
+        )
+        self.runtime.promote()
+        self.server, self.url, self._shutdown = start_api_server(
+            self.leader, port=0, repl=self.runtime, faults=faults
+        )
+        self.followers = []
+        for i in range(n_followers):
+            fid = f"r{i + 1}"
+            fstore = DurableObjectStore(
+                str(tmp_path / f"{fid}.wal"), fsync=True
+            )
+            fstore.fence("r0")
+            tail = WalFollower(fstore, self.url, fid)
+            tail.start()
+            self.followers.append((fid, fstore, tail))
+
+    def converge(self, timeout_s=10.0):
+        want = self.leader.resource_version
+        _wait(
+            lambda: all(
+                f[1].resource_version >= want for f in self.followers
+            ),
+            timeout_s,
+            f"followers to reach rv {want}",
+        )
+
+    def close(self):
+        self._shutdown()
+        for _fid, fstore, tail in self.followers:
+            tail.stop()
+        for _fid, fstore, tail in self.followers:
+            tail.join(timeout=5.0)
+            fstore.close()
+        self.runtime.close()
+        self.leader.close()
+
+
+def test_quorum_gates_publish(tmp_path):
+    """A cluster_size=3 leader owes ONE follower ack per group.  With
+    no follower, the mutation fails typed (StorageDegraded), its bytes
+    are truncated off the WAL (a reopen has never heard of it), and the
+    stream epoch bumps so any follower that buffered the dead bytes
+    resyncs.  With an acking follower, the same mutation commits."""
+    path = str(tmp_path / "q.wal")
+    store = DurableObjectStore(path, fsync=True)
+    hub = ReplicationHub(path, cluster_size=3, ack_timeout_s=0.3)
+    store.promote_leader(hub)
+    epoch0 = hub.epoch
+    counters.reset()
+    with pytest.raises(StorageDegraded):
+        store.create("Pod", make_pod("never-acked"))
+    assert counters.get("storage.repl.quorum_timeouts") == 1
+    assert hub.epoch == epoch0 + 1, "quorum failure must bump the epoch"
+    # the failed group's bytes are gone: the WAL replays to empty
+    re = DurableObjectStore(path)
+    assert re.list("Pod") == []
+    re.close()
+
+    # now give the hub a live follower: acks arrive, so the degraded
+    # store's recovery probe (itself a quorum-gated group) re-arms
+    # writes and the same mutation commits
+    stop_acks = threading.Event()
+
+    def acker():
+        while not stop_acks.is_set():
+            hub.record_ack("r1", hub.durable_end)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=acker, daemon=True)
+    t.start()
+    try:
+        _wait(
+            lambda: _recovered(store), 10.0, "degraded store to recover"
+        )
+        store.create("Pod", make_pod("acked"))
+    finally:
+        stop_acks.set()
+        t.join()
+    assert [p.metadata.name for p in store.list("Pod")] == ["acked"]
+    hub.close()
+    store.close()
+
+
+def _recovered(store) -> bool:
+    try:
+        store.create("Node", make_node("probe"))
+        store.delete("Node", "default", "probe")
+        return True
+    except StorageDegraded:
+        return False
+    except KeyError:
+        return True
+
+
+def test_ship_apply_ack_over_real_http(tmp_path):
+    """The tentpole end to end: groups ship over /repl/stream, followers
+    apply through the real recovery path and ack, the barrier's quorum
+    wait is satisfied by real acks, and both replicas converge to the
+    leader's exact state — rv-dense, WALs byte-identical."""
+    counters.reset()
+    plane = _Plane(tmp_path)
+    try:
+        client = RemoteClient(plane.url)
+        for i in range(20):
+            client.pods().create(make_pod(f"p-{i:03d}"))
+        plane.converge()
+        for fid, fstore, _tail in plane.followers:
+            assert fstore.resource_version == plane.leader.resource_version
+            assert len(fstore.list("Pod")) == 20, fid
+            rvs = sorted(
+                p.metadata.resource_version for p in fstore.list("Pod")
+            )
+            assert rvs == list(range(1, 21)), f"{fid} rv not dense"
+        assert counters.get("storage.repl.groups") >= 1
+        assert counters.get("storage.repl.applied_records") >= 40  # 2 × 20
+        assert counters.get("storage.repl.resyncs") == 0
+        acks = plane.runtime.hub.acks_snapshot()
+        assert set(acks) == {"r1", "r2"}
+    finally:
+        plane.close()
+    for fid, fstore, _tail in plane.followers:
+        cmp = wal_compare(plane.leader_wal, fstore._path)
+        assert cmp["identical"], f"{fid} WAL diverged: {cmp['diverged']}"
+
+
+def test_any_prefix_of_shipped_groups_is_a_valid_store(tmp_path):
+    """The GROUP-as-replication-unit property: replication ships whole
+    commit groups in byte order, so EVERY group boundary is a valid
+    recovery point — truncating the leader's WAL at any shipped-group
+    edge replays cleanly to a dense-rv store (what a follower that has
+    applied exactly k groups IS)."""
+    path = str(tmp_path / "prefix.wal")
+    store = DurableObjectStore(path, fsync=True)
+    hub = ReplicationHub(path, cluster_size=1)  # no quorum owed
+    store.promote_leader(hub)
+
+    def burst(w: int) -> None:
+        for i in range(10):
+            store.create("Pod", make_pod(f"b{w}-{i:02d}"))
+
+    threads = [
+        threading.Thread(target=burst, args=(w,)) for w in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    digests = hub.digests_since(0)
+    assert digests, "no groups recorded"
+    store.close()
+    with open(path, "rb") as f:
+        full = f.read()
+    assert digests[-1].end == len(full)
+    prev_rv = 0
+    for g in digests:
+        trunc = str(tmp_path / f"prefix-{g.seq}.wal")
+        with open(trunc, "wb") as f:
+            f.write(full[: g.end])
+        replica = DurableObjectStore(trunc)
+        rv = replica.resource_version
+        pods = replica.list("Pod")
+        rvs = sorted(p.metadata.resource_version for p in pods)
+        replica.close()
+        assert rv > prev_rv, f"group {g.seq}: rv did not advance"
+        assert rvs == list(range(1, rv + 1)), (
+            f"group {g.seq}: prefix replay not rv-dense"
+        )
+        prev_rv = rv
+    assert prev_rv == 40
+
+
+def test_follower_resumes_from_own_offset(tmp_path):
+    """A follower killed mid-tail reconnects with its WAL size as the
+    cursor: the stream resumes exactly there (resumed_from > 0), no
+    resync, no reapplied records — the WAL offset IS the bookkeeping."""
+    counters.reset()
+    plane = _Plane(tmp_path, n_followers=1, cluster_size=2)
+    try:
+        client = RemoteClient(plane.url)
+        for i in range(5):
+            client.pods().create(make_pod(f"a-{i}"))
+        plane.converge()
+        fid, fstore, tail = plane.followers[0]
+        tail.stop()
+        tail.join(timeout=5.0)
+        mid_end = fstore.wal_end()
+        assert mid_end > 0
+        # writes continue: cluster_size=2 owes 1 follower ack, so feed
+        # acks by hand while the follower is down
+        feeder_stop = threading.Event()
+
+        def feed():
+            while not feeder_stop.is_set():
+                plane.runtime.hub.record_ack("ghost", plane.runtime.hub.durable_end)
+                time.sleep(0.02)
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        feeder.start()
+        for i in range(5):
+            client.pods().create(make_pod(f"b-{i}"))
+        feeder_stop.set()
+        feeder.join()
+        resumed = WalFollower(fstore, plane.url, fid)
+        resumed.start()
+        plane.followers[0] = (fid, fstore, resumed)
+        plane.converge()
+        assert resumed.resumed_from == mid_end
+        assert counters.get("storage.repl.resyncs") == 0
+        assert len(fstore.list("Pod")) == 10
+    finally:
+        plane.close()
+
+
+def test_kill_switch_byte_identical_parity(tmp_path):
+    """MINISCHED_REPL=0 semantics: a store with NO hub attached and a
+    leader store with a single-replica hub (quorum_followers=0) write
+    byte-identical WALs for the same workload — replication adds zero
+    bytes, zero reordering, zero framing changes to the durable log."""
+    pods = []
+    for i in range(12):
+        p = make_pod(f"par-{i:02d}", requests={"cpu": "100m"})
+        p.metadata.uid = f"pin-{i:08d}"
+        p.metadata.creation_timestamp = 1000.0 + i
+        pods.append(p)
+
+    plain_path = str(tmp_path / "plain.wal")
+    plain = DurableObjectStore(plain_path, fsync=True)
+    for p in pods:
+        plain.create("Pod", p)
+    plain.close()
+
+    hub_path = str(tmp_path / "hubbed.wal")
+    hubbed = DurableObjectStore(hub_path, fsync=True)
+    hub = ReplicationHub(hub_path, cluster_size=1)
+    hubbed.promote_leader(hub)
+    for p in pods:
+        hubbed.create("Pod", p)
+    hub.close()
+    hubbed.close()
+
+    with open(plain_path, "rb") as f:
+        a = f.read()
+    with open(hub_path, "rb") as f:
+        b = f.read()
+    assert a == b, "hub attachment changed the WAL bytes"
+
+
+def test_fencing_refuses_writes_typed(tmp_path):
+    """A fenced (demoted / following) replica refuses every mutation
+    with typed NotLeader: directly, over HTTP (503 with the not-leader
+    marker), and through RemoteStore (typed, never blind-retried)."""
+    store = DurableObjectStore(str(tmp_path / "f.wal"), fsync=True)
+    store.fence("r9")
+    counters.reset()
+    with pytest.raises(NotLeader, match="not leader"):
+        store.create("Pod", make_pod("refused"))
+    assert counters.get("storage.repl.fenced_writes") == 1
+    server, url, shutdown = start_api_server(store, port=0)
+    try:
+        client = RemoteClient(url)
+        with pytest.raises(NotLeader):
+            client.pods().create(make_pod("refused-remote"))
+        assert counters.get("storage.repl.not_leader_errors") == 1
+        # reads still serve: a fenced replica is a warm standby
+        assert client.pods().list() == []
+    finally:
+        shutdown()
+        store.close()
+
+
+def test_digest_gossip_convicts_divergence_and_resyncs(tmp_path):
+    """Post-apply divergence (a lying follower disk: the transit CRC
+    passed, then a byte rotted) is caught by digest gossip — the
+    follower convicts itself by comparing its own WAL bytes against the
+    leader's ring, resyncs from zero, and converges back to identical."""
+    counters.reset()
+    plane = _Plane(tmp_path, n_followers=1, cluster_size=1)
+    try:
+        client = RemoteClient(plane.url)
+        for i in range(6):
+            client.pods().create(make_pod(f"g-{i}"))
+        plane.converge()
+        fid, fstore, tail = plane.followers[0]
+        tail.stop()
+        tail.join(timeout=5.0)
+        # rot one byte in the follower's applied WAL, mid-file
+        with open(fstore._path, "r+b") as f:
+            f.seek(fstore.wal_end() // 2)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0x40]))
+        probe = WalFollower(fstore, plane.url, fid)
+        assert probe.gossip_once() is False
+        assert counters.get("storage.repl.digest_mismatch") == 1
+        assert counters.get("storage.repl.resyncs") == 1
+        assert fstore.resource_version == 0, "resync must wipe state"
+        assert fstore.wal_end() == 0
+        probe.start()
+        plane.followers[0] = (fid, fstore, probe)
+        plane.converge()
+        assert probe.gossip_once() is True
+        assert len(fstore.list("Pod")) == 6
+    finally:
+        plane.close()
+    cmp = wal_compare(plane.leader_wal, plane.followers[0][1]._path)
+    assert cmp["identical"]
+
+
+def test_fsck_digests_and_compare(tmp_path):
+    """The offline halves: --digests emits per-frame CRC32C digests
+    (composable to any grouping), --compare calls identical/prefix
+    clean and locates the exact forked frame on divergence."""
+    path = str(tmp_path / "d.wal")
+    store = DurableObjectStore(path, fsync=True)
+    for i in range(8):
+        store.create("Pod", make_pod(f"d-{i}"))
+    store.close()
+    report = wal_digests(path)
+    assert len(report["frames"]) >= 8  # puts + any watermarks
+    assert report["frames"][-1]["end"] == report["size"]
+    assert not report["torn_tail"] and "corrupt" not in report
+
+    twin = str(tmp_path / "twin.wal")
+    with open(path, "rb") as f:
+        full = f.read()
+    with open(twin, "wb") as f:
+        f.write(full)
+    assert wal_compare(path, twin)["identical"]
+
+    prefix = str(tmp_path / "prefix.wal")
+    with open(prefix, "wb") as f:
+        f.write(full[: report["frames"][2]["end"]])
+    cmp = wal_compare(path, prefix)
+    assert cmp["prefix"] and not cmp["identical"]
+    assert cmp["common_frames"] == 3
+
+    forked = str(tmp_path / "forked.wal")
+    rotten = bytearray(full)
+    target = report["frames"][4]
+    rotten[(target["offset"] + target["end"]) // 2] ^= 0x01
+    with open(forked, "wb") as f:
+        f.write(bytes(rotten))
+    cmp = wal_compare(path, forked)
+    assert not cmp["identical"] and not cmp["prefix"]
+    assert cmp["diverged"]["frame"] == 4
+
+    # the CLI contract: exit 0 on prefix, 1 on fork, 1 on corruption
+    from minisched_tpu.controlplane.fsck import main as fsck_main
+
+    assert fsck_main([path, "--compare", prefix]) == 0
+    assert fsck_main([path, "--compare", forked]) == 1
+    assert fsck_main([forked, "--digests"]) == 1
+    assert fsck_main([path, "--digests"]) == 0
+
+
+def test_repl_ack_fault_heals_by_reack(tmp_path):
+    """The ``repl.ack`` injection point: the leader discards a
+    follower's ack (503) — durability is real but unproven.  The
+    follower's heartbeat re-ack heals it, so the write completes and
+    nothing is lost; the only symptom is a longer quorum wait."""
+    fab = FaultFabric(7).on("repl.ack", rate=1.0, max_fires=2)
+    counters.reset()
+    plane = _Plane(
+        tmp_path, n_followers=1, cluster_size=2, ack_timeout_s=20.0,
+        faults=fab,
+    )
+    try:
+        client = RemoteClient(plane.url, timeout_s=30.0)
+        t0 = time.monotonic()
+        client.pods().create(make_pod("survives-dropped-acks"))
+        elapsed = time.monotonic() - t0
+        assert fab.fires("repl.ack") >= 1
+        assert counters.get("storage.repl.ship_errors") >= 1
+        assert counters.get("storage.repl.quorum_timeouts") == 0
+        plane.converge()
+        assert len(plane.followers[0][1].list("Pod")) == 1
+        assert elapsed < 20.0, "healed by re-ack, not by timeout"
+    finally:
+        plane.close()
+
+
+def test_arbiter_majority_election(tmp_path):
+    """Leaderless plane, all three arbiters reachable: the freshest
+    replica (rv rank, ties broken to the lexically smaller id) wins the
+    store-leader lease on an arbiter MAJORITY and promotes; the other
+    stays a follower pointed at the winner.  Exactly one leader."""
+    arbiters = []
+    for _ in range(3):
+        _srv, url, shutdown = start_api_server(ObjectStore(), port=0)
+        arbiters.append((url, shutdown))
+    runtimes = []
+    servers = []
+    try:
+        # r0 is DEAD (its data plane never answers; its arbiter — a
+        # separate in-memory store — is still up, so a majority of
+        # arbiters is reachable); r1 and r2 boot post-crash with no
+        # bootstrap leader and live data façades (freshness ranking
+        # reads /repl/status off them)
+        for rid in ("r1", "r2"):
+            store = DurableObjectStore(
+                str(tmp_path / f"{rid}.wal"), fsync=True
+            )
+            rt = ReplRuntime(
+                store, rid, peers=[], cluster_size=3, ttl_s=0.5
+            )
+            _srv, url, shutdown = start_api_server(store, port=0, repl=rt)
+            servers.append(shutdown)
+            runtimes.append((rid, store, rt, url))
+        peers = [PeerSpec("r0", "http://127.0.0.1:9", arbiters[0][0])]
+        peers += [
+            PeerSpec(rid, url, arbiters[i + 1][0])
+            for i, (rid, _s, _rt, url) in enumerate(runtimes)
+        ]
+        for _rid, _store, rt, _url in runtimes:
+            rt.peers = list(peers)
+            rt.start(bootstrap_leader=None)
+        _wait(
+            lambda: sorted(
+                rt.role for _rid, _s, rt, _u in runtimes
+            ) == ["follower", "leader"],
+            timeout_s=10.0,
+            what="exactly one leader elected",
+        )
+        leaders = [
+            rid for rid, _s, rt, _u in runtimes if rt.role == "leader"
+        ]
+        assert leaders == ["r1"], "freshness tie must break to r1"
+        follower_rt = runtimes[1][2]
+        _wait(
+            lambda: follower_rt.leader_id == "r1",
+            timeout_s=5.0,
+            what="r2 to observe r1 leading",
+        )
+        assert runtimes[1][1].is_fenced()
+    finally:
+        for _rid, store, rt, _u in runtimes:
+            rt.close()
+        for shutdown in servers:
+            shutdown()
+        for _rid, store, rt, _u in runtimes:
+            store.close()
+        for _url, shutdown in arbiters:
+            shutdown()

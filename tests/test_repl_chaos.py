@@ -1,0 +1,190 @@
+"""Replicated-plane chaos (ISSUE 15): SIGKILL the LEADER, keep the data.
+
+Process-level failover for the quorum-ack replication plane
+(controlplane/repl + replproc): a 3-replica cluster — each replica one
+OS process hosting a DurableObjectStore data façade plus an in-memory
+arbiter — takes client load; the leader is SIGKILLed with no goodbye;
+a follower must win the store-leader lease on an arbiter majority
+within ~2 lease TTLs (one TTL for the dead lease to expire + one
+election window) and serve every mutation the old leader ever acked —
+quorum means at least one live follower holds each acked group.
+
+The tier-1 smoke does ONE kill at small scale; the soak (slow) keeps
+writers running THROUGH the failover, restarts the deposed ex-leader
+(it must rejoin fenced and catch up), and ends in the standing audits:
+zero acked-write loss, WALs prefix/identical across live replicas
+(fsck.wal_compare), and the full-history double-bind audit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_pod
+from minisched_tpu.controlplane.fsck import wal_compare
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.replproc import ReplicatedPlane
+from minisched_tpu.faults import wal_double_binds
+
+TTL_S = 1.0
+
+
+def _names(client) -> set:
+    return {p.metadata.name for p in client.pods().list()}
+
+
+def test_leader_kill_failover_smoke(tmp_path):
+    """One SIGKILL: every write acked before the kill survives on the
+    promoted follower, promotion lands within ~2 TTLs of the kill, and
+    the new leader accepts writes (2-of-3 alive still quorums)."""
+    plane = ReplicatedPlane(str(tmp_path), n=3, fsync=True, ttl_s=TTL_S)
+    try:
+        url = plane.start()
+        client = RemoteClient(url, timeout_s=10.0)
+        acked = []
+        for i in range(20):
+            client.pods().create(make_pod(f"pre-{i:03d}"))
+            acked.append(f"pre-{i:03d}")
+        old = plane.leader()
+        assert old is not None
+        t_kill = time.monotonic()
+        old.kill()
+        won = plane.wait_for_leader(
+            timeout_s=10 * TTL_S, exclude=old.replica_id
+        )
+        elapsed = time.monotonic() - t_kill
+        assert elapsed <= 2 * TTL_S + 1.0, (
+            f"promotion took {elapsed:.2f}s (ttl {TTL_S}s)"
+        )
+        survivor = RemoteClient(won["url"], timeout_s=10.0)
+        assert set(acked) <= _names(survivor), "acked writes lost"
+        # the halved plane still quorums: 1 live follower = majority-1
+        survivor.pods().create(make_pod("post-failover"))
+        assert "post-failover" in _names(survivor)
+    finally:
+        plane.stop()
+
+
+@pytest.mark.slow
+def test_leader_kill_soak_under_load(tmp_path):
+    """The acceptance soak: writers hammer the plane THROUGH a leader
+    SIGKILL; the deposed replica restarts mid-run and must rejoin
+    fenced + catch up.  Ends in the standing audits — every acked
+    mutation present on the final leader, live replica WALs
+    identical/prefix, zero double binds across the full history."""
+    plane = ReplicatedPlane(str(tmp_path), n=3, fsync=True, ttl_s=TTL_S)
+    acked: set = set()
+    acked_mu = threading.Lock()
+    stop = threading.Event()
+    errs: list = []
+
+    def writer(w: int, plane_url: list) -> None:
+        i = 0
+        client = RemoteClient(plane_url[0], timeout_s=10.0, retries=0)
+        while not stop.is_set():
+            name = f"w{w}-{i:04d}"
+            try:
+                client.pods().create(make_pod(name))
+            except KeyError:
+                # a retransmission of a create that DID commit before
+                # its socket died: the object exists, the ack stands
+                pass
+            except Exception:
+                # mid-failover: rebind to whoever leads now and retry
+                # the SAME name — only a returned ack admits it to the
+                # acked set
+                time.sleep(0.2)
+                try:
+                    won = plane.wait_for_leader(timeout_s=10 * TTL_S)
+                except RuntimeError:
+                    continue
+                plane_url[0] = won["url"]
+                client = RemoteClient(
+                    plane_url[0], timeout_s=10.0, retries=0
+                )
+                continue
+            with acked_mu:
+                acked.add(name)
+            i += 1
+        if i == 0:
+            errs.append(f"writer {w} never acked a single write")
+
+    try:
+        url = plane.start()
+        shared_url = [url]
+        writers = [
+            threading.Thread(target=writer, args=(w, shared_url))
+            for w in range(4)
+        ]
+        for t in writers:
+            t.start()
+        # let load build, then murder the leader mid-write
+        time.sleep(2.0)
+        old = plane.leader()
+        assert old is not None
+        t_kill = time.monotonic()
+        old.kill()
+        won = plane.wait_for_leader(
+            timeout_s=10 * TTL_S, exclude=old.replica_id
+        )
+        promote_s = time.monotonic() - t_kill
+        assert promote_s <= 2 * TTL_S + 1.0, (
+            f"promotion took {promote_s:.2f}s (ttl {TTL_S}s)"
+        )
+        time.sleep(2.0)  # writers keep acking against the new leader
+        # the deposed ex-leader rejoins: follower, fenced, catching up
+        old.restart()
+        deadline = time.monotonic() + 20.0
+        rejoined = None
+        while time.monotonic() < deadline:
+            s = old.status()
+            if s is not None and s.get("role") == "follower" \
+                    and s.get("fenced"):
+                rejoined = s
+                break
+            time.sleep(0.1)
+        assert rejoined is not None, "ex-leader never rejoined fenced"
+        time.sleep(2.0)
+        stop.set()
+        for t in writers:
+            t.join(timeout=30.0)
+        assert not errs, errs
+        assert len(acked) >= 50, f"soak too quiet: {len(acked)} acked"
+
+        # audit 1: zero acked-write loss on the final leader
+        final = plane.wait_for_leader(timeout_s=10 * TTL_S)
+        client = RemoteClient(final["url"], timeout_s=10.0)
+        missing = acked - _names(client)
+        assert not missing, f"{len(missing)} acked writes lost: " \
+            f"{sorted(missing)[:5]}"
+
+        # audit 2: the ex-leader caught back up to the live plane's rv
+        want_rv = int(client.store.list_with_rv("Pod")[1])
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            s = old.status()
+            if s is not None and int(s.get("rv", 0)) >= want_rv:
+                break
+            time.sleep(0.1)
+        s = old.status()
+        assert s is not None and int(s.get("rv", 0)) >= want_rv, (
+            f"ex-leader stuck at {s and s.get('rv')} < {want_rv}"
+        )
+    finally:
+        plane.stop()
+
+    # audit 3 (offline, post-shutdown): replica histories never forked —
+    # every pair of WALs is identical or a clean prefix
+    paths = [r.wal_path for r in plane.replicas]
+    for i in range(len(paths)):
+        for j in range(i + 1, len(paths)):
+            cmp = wal_compare(paths[i], paths[j])
+            assert cmp["identical"] or cmp["prefix"], (
+                f"{paths[i]} vs {paths[j]} diverged: {cmp['diverged']}"
+            )
+    # audit 4: the full-history double-bind audit stays clean
+    for p in paths:
+        assert wal_double_binds(p) == []
