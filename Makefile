@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl chaos chaos-proc chaos-ha chaos-disk chaos-repl metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -80,7 +80,12 @@ bench-wal: native
 # box.  FAILS on any acked mutation missing from a follower, follower
 # WALs diverging from the leader's bytes (fsck --compare), or quorum
 # timeouts on a healthy local plane; the record carries the mutate
-# p50/p99 replication tax and the storage.quorum_wait_s histogram
+# p50/p99 replication tax and the storage.quorum_wait_s histogram.
+# Phase 3 (ISSUE 16): a FRESH follower attaches while writers run and
+# background compaction ships checkpoint generations — FAILS when the
+# catch-up blows BENCH_REPL_BOOTSTRAP_S, on any offset-0 re-tail, on a
+# deferred compaction, or when the leader's WAL peak exceeds ~2
+# compaction intervals of growth (unbounded history)
 bench-repl: native
 	JAX_PLATFORMS=cpu BENCH_REPL=1 python bench.py --only repl
 
@@ -133,6 +138,20 @@ chaos-disk: native
 chaos-repl: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_repl.py tests/test_repl_chaos.py -q
+
+# partition chaos (ISSUE 16, DESIGN.md §28): the network-fault layer
+# cuts LINKS instead of processes — the leader is isolated from the
+# arbiter majority (data links up) and must fence itself within ~2
+# lease TTLs, strictly before a follower wins the election: no
+# dual-leader ack window, ever.  Runs BOTH the tier-1 half (NetFabric
+# contract + one partition/heal cycle) and the slow soak: writers
+# through repeated cycles with background compaction shipping
+# checkpoint generations, a dual-leader sampler armed the whole run,
+# ending in the zero-acked-loss / replica-consistency (state-replay
+# arm) / double-bind audits
+chaos-partition: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_partition_chaos.py -q
 
 # live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
 # 100 pods to bind, then validate ONLY through the wire — /metrics must

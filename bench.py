@@ -2669,7 +2669,13 @@ def bench_repl() -> dict:
     single-store plane).  The record carries the replication tax (mutate
     p50/p99 + ``storage.quorum_wait_s``) and the correctness evidence:
     every acked mutation on BOTH followers and follower WALs
-    byte-identical to the leader's (``fsck.wal_compare``).  Opt-in via
+    byte-identical to the leader's (``fsck.wal_compare``).  Phase 3
+    (ISSUE 16, DESIGN.md §28) is bootstrap-under-load: writers hammer a
+    leader whose background compaction ships checkpoint generations; a
+    FRESH follower attaches mid-load and must catch up to the leader's
+    rv within ``BENCH_REPL_BOOTSTRAP_S`` by seeding from the shipped
+    checkpoint — zero offset-0 re-tails — while the leader's WAL stays
+    bounded by the compaction interval, not by history.  Opt-in via
     ``BENCH_REPL=1`` — the role boots four HTTP servers and three
     fsync-armed stores, which is chaos-tier cost, not headline-tier."""
     import tempfile
@@ -2790,6 +2796,201 @@ def bench_repl() -> dict:
     if counters.get("storage.repl.quorum_timeouts"):
         raise SystemExit("[repl] QUORUM TIMEOUTS on a healthy local plane")
 
+    # -- phase 3: fresh-follower bootstrap under load (DESIGN.md §28) -------
+    compact_every_s = float(
+        os.environ.get("BENCH_REPL_COMPACT_EVERY_S", "0.5")
+    )
+    bootstrap_budget_s = float(
+        os.environ.get("BENCH_REPL_BOOTSTRAP_S", "20.0")
+    )
+    boot_writers = int(os.environ.get("BENCH_REPL_BOOT_WRITERS", "6"))
+    counters.reset()
+    wal3 = os.path.join(base_dir, "leader3.wal")
+    leader3 = DurableObjectStore(wal3, fsync=True)
+    runtime3 = ReplRuntime(
+        leader3, "r0", peers=[], cluster_size=3, ack_timeout_s=15.0
+    )
+    runtime3.promote()
+    server3, url3, shutdown3 = start_api_server(
+        leader3, port=0, repl=runtime3
+    )
+    standing = DurableObjectStore(
+        os.path.join(base_dir, "standing.wal"), fsync=True
+    )
+    standing.fence("r0")
+    standing_tail = WalFollower(standing, url3, "r1", leader_id="r0")
+    standing_tail.start()
+
+    stop = threading.Event()
+    errs3: list = []
+
+    def boot_writer(w: int) -> None:
+        client = RemoteClient(url3, timeout_s=30.0)
+        i = 0
+        try:
+            while not stop.is_set():
+                client.pods().create(
+                    make_pod(
+                        f"bl{w:02d}-{i:05d}",
+                        requests={"cpu": "100m", "memory": "64Mi"},
+                    )
+                )
+                i += 1
+        except Exception as e:
+            errs3.append(f"boot writer {w}: {e!r}")
+
+    def compactor() -> None:
+        while not stop.is_set():
+            stop.wait(compact_every_s)
+            if stop.is_set():
+                return
+            try:
+                leader3.compact()
+            except Exception as e:  # pragma: no cover - audit below
+                errs3.append(f"compactor: {e!r}")
+                return
+
+    wal_samples: list = []
+    total_growth = [0]
+
+    def sampler() -> None:
+        prev = 0
+        while not stop.is_set():
+            cur = leader3.wal_end()
+            wal_samples.append(cur)
+            if cur > prev:
+                total_growth[0] += cur - prev
+            prev = cur
+            stop.wait(0.05)
+
+    threads3 = [
+        threading.Thread(target=boot_writer, args=(w,), name=f"boot-w{w}")
+        for w in range(boot_writers)
+    ]
+    threads3 += [
+        threading.Thread(target=compactor, name="boot-compactor"),
+        threading.Thread(target=sampler, name="boot-sampler"),
+    ]
+    for t in threads3:
+        t.start()
+    # wait for ≥2 shipped generations so the fresh follower's seed is a
+    # MID-STREAM checkpoint, not the boot state
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and (
+        counters.get("storage.repl.ckpt_published") < 2 and not errs3
+    ):
+        time.sleep(0.05)
+    if errs3 or counters.get("storage.repl.ckpt_published") < 2:
+        stop.set()
+        raise SystemExit(
+            f"[repl] PHASE-3 WARMUP FAILED: {errs3[:3] or 'no generations'}"
+        )
+    bstore = DurableObjectStore(
+        os.path.join(base_dir, "boot.wal"), fsync=True
+    )
+    bstore.fence("r0")
+    target_rv = leader3.resource_version
+    t_attach = time.monotonic()
+    boot_tail = WalFollower(bstore, url3, "boot", leader_id="r0")
+    boot_tail.start()
+    deadline = time.monotonic() + bootstrap_budget_s
+    while time.monotonic() < deadline and (
+        bstore.resource_version < target_rv and not errs3
+    ):
+        time.sleep(0.02)
+    bootstrap_s = time.monotonic() - t_attach
+    caught_up = bstore.resource_version >= target_rv
+    stop.set()
+    for t in threads3:
+        t.join(timeout=30.0)
+    # let the tails drain the last groups before auditing convergence
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and (
+        bstore.resource_version < leader3.resource_version
+        or standing.resource_version < leader3.resource_version
+    ):
+        time.sleep(0.05)
+    bq = hist.quantile_bounds("storage.repl.bootstrap_s", 0.99) or (
+        None, None,
+    )
+    # stop the tails BEFORE the server so their stream sockets close
+    # client-side (no reset noise from the handler threads)
+    for tail in (standing_tail, boot_tail):
+        tail.stop()
+        tail.join(timeout=5.0)
+    shutdown3()
+    runtime3.close()
+
+    if errs3:
+        raise SystemExit(f"[repl] PHASE-3 WRITERS FAILED: {errs3[:3]}")
+    if not caught_up:
+        raise SystemExit(
+            f"[repl] BOOTSTRAP BLEW THE BUDGET: follower at rv "
+            f"{bstore.resource_version} < {target_rv} after "
+            f"{bootstrap_budget_s}s"
+        )
+    if counters.get("storage.repl.full_retails"):
+        raise SystemExit(
+            "[repl] OFFSET-0 RE-TAIL: a follower replayed history "
+            "instead of seeding from the shipped checkpoint"
+        )
+    if counters.get("storage.repl.ckpt_seeds") < 2 or not (
+        bstore.checkpoint_rv > 0
+    ):
+        raise SystemExit(
+            "[repl] fresh follower did not seed from a shipped checkpoint"
+        )
+    if counters.get("storage.repl.compact_deferred"):
+        raise SystemExit(
+            "[repl] COMPACTION DEFERRED under a hub — the WAL is unbounded"
+        )
+    # WAL boundedness: the peak never reaches the full appended history
+    # and stays within ~2 compaction intervals of growth
+    drops, seg, max_seg = 0, 0, 0
+    prev = 0
+    for cur in wal_samples:
+        if cur < prev:
+            drops += 1
+            max_seg = max(max_seg, seg)
+            seg = cur
+        else:
+            seg += cur - prev
+        prev = cur
+    max_seg = max(max_seg, seg)
+    peak = max(wal_samples) if wal_samples else 0
+    if drops < 2:
+        raise SystemExit(
+            f"[repl] WAL NEVER TRUNCATED under load ({drops} drops)"
+        )
+    if peak > 2 * max_seg + 65536 or peak >= total_growth[0]:
+        raise SystemExit(
+            f"[repl] WAL UNBOUNDED: peak {peak}B vs per-interval growth "
+            f"{max_seg}B (total appended {total_growth[0]}B)"
+        )
+    if bstore.resource_version != leader3.resource_version or (
+        standing.resource_version != leader3.resource_version
+    ):
+        raise SystemExit("[repl] PHASE-3 REPLICAS NEVER CONVERGED")
+    boot_pods = {p.metadata.name for p in bstore.list("Pod")}
+    lead_pods = {p.metadata.name for p in leader3.list("Pod")}
+    if boot_pods != lead_pods:
+        raise SystemExit(
+            f"[repl] BOOTSTRAPPED STATE DIVERGED: "
+            f"{len(lead_pods ^ boot_pods)} names differ"
+        )
+    n_boot = len(lead_pods)
+    leader3.close()
+    standing.close()
+    bstore.close()
+    log(
+        f"[repl] bootstrap-under-load: fresh follower caught "
+        f"{n_boot} pods / rv {target_rv} in {bootstrap_s:.2f}s "
+        f"(budget {bootstrap_budget_s}s) off generation "
+        f"{counters.get('storage.repl.ckpt_published')} ships; WAL peak "
+        f"{peak}B ≤ 2× interval growth {max_seg}B across {drops} "
+        f"truncations; zero offset-0 re-tails"
+    )
+
     def _p(lat: list, q: float) -> float:
         return round(lat[min(len(lat) - 1, int(q * len(lat)))], 4)
 
@@ -2821,6 +3022,20 @@ def bench_repl() -> dict:
         "replication_tax_p50_s": round(tax, 4),
         "followers_identical": True,
         "acked_writes_lost": 0,
+        "bootstrap": {
+            "budget_s": bootstrap_budget_s,
+            "bootstrap_s": round(bootstrap_s, 3),
+            "bootstrap_p99_bucket_s": bq[1],
+            "target_rv": target_rv,
+            "generations_shipped": counters.get(
+                "storage.repl.ckpt_published"
+            ),
+            "ckpt_seeds": counters.get("storage.repl.ckpt_seeds"),
+            "full_retails": 0,
+            "wal_peak_bytes": peak,
+            "wal_interval_growth_bytes": max_seg,
+            "wal_truncations": drops,
+        },
     }
 
 

@@ -219,6 +219,7 @@ class DurableObjectStore(ObjectStore):
         self._defer_flush = False  # batch mutations share one fsync
         self._log = None  # replay must not re-log
         self._ckpt_rv = 0  # WAL records at/below this are pre-snapshot
+        self._ckpt_gen = 0  # checkpoint GENERATION counter (repl shipping)
         self._ckpt_source = "none"  # current | prev | replay | none
         #: binding acks recovered from WAL ``ack`` records (insertion
         #: order == append order; the HTTP façade seeds its registry
@@ -1549,18 +1550,66 @@ class DurableObjectStore(ObjectStore):
         holding the IO lock keeps the leader out of the log while it is
         closed/truncated/reopened.
 
-        A LEADING replica defers compaction entirely: truncating the WAL
-        would invalidate every follower's byte offset cursor mid-stream.
-        Compaction-aware replication (checkpoint shipping + offset
-        rebasing) is the recorded follow-up (ROADMAP)."""
-        if self._repl_hub is not None:
-            counters.inc("storage.repl.compact_deferred")
-            return
+        A LEADING replica compacts too (DESIGN.md §28): the checkpoint
+        it just wrote becomes a shipped GENERATION — under the same
+        io+store hold, the hub ``rebase()``s onto the fresh WAL (epoch
+        bump, digest ring + acks cleared, durable_end re-anchored at
+        the post-compaction size), and followers whose cursor predates
+        the rebase reseed from ``GET /repl/checkpoint`` instead of an
+        unbounded offset-0 re-tail.  That is what keeps the leader's
+        WAL bounded by the compaction interval while replicating."""
         with self._io_lock if self._gc_enabled else _null_ctx():
             with self._lock:
                 if self._gc_enabled:
                     self._gc_drain_commit_locked()
                 self._compact_locked()
+                hub = self._repl_hub
+                if hub is not None:
+                    self._ckpt_gen += 1
+                    hub.rebase(
+                        self._ckpt_gen, self._ckpt_rv, self.wal_end()
+                    )
+                    counters.inc("storage.repl.ckpt_published")
+
+    def _land_checkpoint_pair(self, body: bytes) -> None:
+        """Land one checkpoint body + sha256 sidecar on disk: temp
+        write + fsync both, rotate the old generation to ``.prev``,
+        then atomic-replace the new pair in.  The sequence compaction
+        has always used — shared with the checkpoint-seeded
+        ``replica_reset`` so a seeded follower's NEXT restart recovers
+        from the same pair a compaction would have left."""
+        digest = _sha256_hex(body)
+        sidecar = self._ckpt_path + CKPT_DIGEST_SUFFIX
+        tmp = self._ckpt_path + ".tmp"
+        tmp_side = sidecar + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(tmp_side, "w", encoding="utf-8") as f:
+            f.write(f"sha256 {digest}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        # rotate the old generation aside (keep exactly one), then
+        # land the new pair.  A crash between any two renames leaves
+        # a chain arm that still recovers: prev + full WAL.
+        if os.path.exists(self._ckpt_path):
+            os.replace(self._ckpt_path, self._ckpt_path + ".prev")
+            if os.path.exists(sidecar):
+                os.replace(
+                    sidecar, self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
+                )
+            else:
+                # the old generation predates sidecars — drop any
+                # stale prev sidecar so it can't mis-verify it
+                try:
+                    os.unlink(
+                        self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
+                    )
+                except FileNotFoundError:
+                    pass
+        os.replace(tmp, self._ckpt_path)
+        os.replace(tmp_side, sidecar)
 
     def _compact_locked(self) -> None:
         with self._lock:
@@ -1573,38 +1622,7 @@ class DurableObjectStore(ObjectStore):
                 # keys are ignored by older/foreign checkpoint readers.
                 doc["acks"] = dict(self._acks)
             body = json.dumps(doc).encode()
-            digest = _sha256_hex(body)
-            sidecar = self._ckpt_path + CKPT_DIGEST_SUFFIX
-            tmp = self._ckpt_path + ".tmp"
-            tmp_side = sidecar + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(body)
-                f.flush()
-                os.fsync(f.fileno())
-            with open(tmp_side, "w", encoding="utf-8") as f:
-                f.write(f"sha256 {digest}\n")
-                f.flush()
-                os.fsync(f.fileno())
-            # rotate the old generation aside (keep exactly one), then
-            # land the new pair.  A crash between any two renames leaves
-            # a chain arm that still recovers: prev + full WAL.
-            if os.path.exists(self._ckpt_path):
-                os.replace(self._ckpt_path, self._ckpt_path + ".prev")
-                if os.path.exists(sidecar):
-                    os.replace(
-                        sidecar, self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
-                    )
-                else:
-                    # the old generation predates sidecars — drop any
-                    # stale prev sidecar so it can't mis-verify it
-                    try:
-                        os.unlink(
-                            self._ckpt_path + ".prev" + CKPT_DIGEST_SUFFIX
-                        )
-                    except FileNotFoundError:
-                        pass
-            os.replace(tmp, self._ckpt_path)
-            os.replace(tmp_side, sidecar)
+            self._land_checkpoint_pair(body)
             faults = self.faults
             if faults is not None and faults.should_fire(
                 "ckpt.corrupt", self._ckpt_path
@@ -1804,6 +1822,16 @@ class DurableObjectStore(ObjectStore):
         with self._io_lock:
             with self._lock:
                 hub.durable_end = self.wal_end()
+                if self._ckpt_rv > 0 and os.path.exists(self._ckpt_path):
+                    # promoting over a compacted WAL: the on-disk
+                    # checkpoint IS a generation of this leadership —
+                    # our WAL alone is only the tail, so any follower
+                    # without this base must seed from the checkpoint,
+                    # never re-tail from byte 0
+                    if self._ckpt_gen == 0:
+                        self._ckpt_gen = 1
+                    hub.ckpt_gen = self._ckpt_gen
+                    hub.ckpt_rv = self._ckpt_rv
                 self._repl_hub = hub
                 self._fenced = False
                 self._leader_hint = ""
@@ -1825,6 +1853,36 @@ class DurableObjectStore(ObjectStore):
 
     def is_fenced(self) -> bool:
         return self._fenced
+
+    @property
+    def checkpoint_rv(self) -> int:
+        """The rv watermark of the checkpoint generation this store's
+        WAL tail sits on (0 = full history).  A follower's stream cursor
+        is only meaningful against a leader advertising the same base —
+        repl.WalFollower compares this against /repl/status."""
+        return self._ckpt_rv
+
+    def checkpoint_ship_blob(self) -> Optional[dict]:
+        """The current checkpoint generation as a shippable blob:
+        ``{"body": bytes, "sha256": hex, "rv": snapshot rv}``, or None
+        when there is no generation or the sidecar CONVICTS the bytes —
+        a leader never ships state it cannot prove.  The rv is parsed
+        from the body itself (not ``_ckpt_rv``) so a racing rotation can
+        never pair one generation's rv with another's bytes."""
+        try:
+            with open(self._ckpt_path, "rb") as f:
+                body = f.read()
+        except OSError:
+            return None
+        verdict = checkpoint_digest(self._ckpt_path, body)
+        if verdict["ok"] is False:
+            counters.inc("storage.ckpt_digest_mismatch")
+            return None
+        try:
+            rv = int(json.loads(body).get("resource_version", 0))
+        except (ValueError, json.JSONDecodeError):
+            return None
+        return {"body": body, "sha256": _sha256_hex(body), "rv": rv}
 
     def apply_replicated(self, data: bytes, start_offset: Optional[int] =
                          None) -> int:
@@ -1891,14 +1949,31 @@ class DurableObjectStore(ObjectStore):
         counters.inc("storage.repl.applied_records", len(recs))
         return new_end
 
-    def replica_reset(self) -> None:
-        """Wipe this replica to empty (WAL truncated to zero, in-memory
-        state cleared) so a follower can re-tail the leader's stream
-        from byte 0 — the resync path after an epoch bump, offset
+    def replica_reset(self, seed: Optional[dict] = None) -> None:
+        """Wipe this replica (WAL truncated to zero, in-memory state
+        cleared) so a follower can re-tail the leader's stream from
+        byte 0 — the resync path after an epoch bump, offset
         discontinuity, or digest divergence.  Drastic by design: the
-        authoritative log is the leader's, and a full re-ship of a
-        compacted-and-bounded WAL is cheap next to reasoning about
-        partial divergence."""
+        authoritative log is the leader's, and reasoning about partial
+        divergence is how replicas rot.
+
+        With ``seed`` (a digest-verified checkpoint blob fetched from
+        the leader — DESIGN.md §28) the wiped replica re-bases on the
+        leader's checkpoint GENERATION instead of empty: the pair lands
+        on our own disk first through the same atomic sequence
+        compaction uses (so our next restart recovers from it), the
+        snapshot restores into the object maps, and the rv-skip
+        watermark moves to the snapshot rv.  The caller then tails the
+        leader's post-compaction WAL from byte 0 — bootstrap is
+        O(state), not O(history)."""
+        doc = None
+        if seed is not None:
+            doc = json.loads(seed["body"])
+            if doc.get("version") != CHECKPOINT_VERSION:
+                raise ValueError(
+                    f"unsupported shipped checkpoint version "
+                    f"{doc.get('version')!r}"
+                )
         with self._io_lock if self._gc_enabled else _null_ctx():
             with self._lock:
                 if self._log is not None:
@@ -1916,6 +1991,24 @@ class DurableObjectStore(ObjectStore):
                 self._history_floor_min = 0
                 self._pod_node_agg.clear()
                 self._recovered_uid_max = 0
+                if doc is not None:
+                    self._land_checkpoint_pair(seed["body"])
+                    rv = self._restore_snapshot_doc(doc)
+                    self._ckpt_rv = rv
+                    self._ckpt_source = "shipped"
+                    self._gc_visible_rv = self._rv
+                    # events at/below the seeded snapshot are not
+                    # reconstructable here: watches resuming from before
+                    # it must 410 and relist (same rule as recovery)
+                    self.set_history_floor(rv)
+                    if self._recovered_uid_max:
+                        from minisched_tpu.api.objects import (
+                            ensure_uid_floor,
+                        )
+
+                        ensure_uid_floor(self._recovered_uid_max)
+                    self._rebuild_node_agg()
+                    kinds = tuple(set(kinds) | set(self._objects))
                 self._cow_publish(kinds)
 
     def close(self) -> None:

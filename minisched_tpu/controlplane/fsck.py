@@ -474,6 +474,76 @@ def wal_compare(path_a: str, path_b: str) -> Dict[str, Any]:
     return report
 
 
+def state_digest(
+    wal_path: str, checkpoint_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Replay one replica offline through the REAL recovery path
+    (checkpoint fallback chain ⊕ WAL tail, readonly) and reduce the
+    result to a canonical state document + its sha256.  The replica's
+    identity independent of its byte history: two stores at different
+    checkpoint generations replay different FILES but must land on the
+    same state when they hold the same data."""
+    import hashlib
+
+    from minisched_tpu.controlplane.checkpoint import build_snapshot_doc
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+
+    store = DurableObjectStore(
+        wal_path,
+        checkpoint_path=checkpoint_path,
+        archive_compacted=os.path.exists(wal_path + ".history"),
+        readonly=True,
+    )
+    doc = build_snapshot_doc(store._objects, store.resource_version)
+    # process-global (uid counter), not replica state: two replicas that
+    # replayed identical objects can still disagree here
+    doc.pop("uid_floor", None)
+    body = json.dumps(doc, sort_keys=True).encode()
+    return {
+        "wal": wal_path,
+        "resource_version": store.resource_version,
+        "ckpt_source": store._ckpt_source,
+        "objects": {
+            kind: len(objs)
+            for kind, objs in store._objects.items()
+            if objs
+        },
+        "sha256": hashlib.sha256(body).hexdigest(),
+    }
+
+
+def replica_consistent(path_a: str, path_b: str) -> Dict[str, Any]:
+    """``fsck --compare`` for checkpoint⊕tail topologies (DESIGN.md
+    §28).  Raw frame-digest identity/prefix (wal_compare) is the fast
+    path, but once checkpoint SHIPPING is on, two healthy replicas can
+    sit on different checkpoint generations — their WALs are different
+    byte tails of the same logical history and share no prefix at all.
+    Consistency is then judged where it actually matters: both sides
+    replay offline through the real recovery path (generation ⊕ tail)
+    and must land on the SAME canonical state.  ``mode`` records which
+    judgement decided (``raw`` or ``state``)."""
+    raw = wal_compare(path_a, path_b)
+    report: Dict[str, Any] = {"raw": raw}
+    if raw["identical"] or raw["prefix"]:
+        report["mode"] = "raw"
+        report["consistent"] = True
+        return report
+    report["mode"] = "state"
+    states = {}
+    for side, path in (("a", path_a), ("b", path_b)):
+        try:
+            states[side] = state_digest(path)
+        except Exception as e:  # noqa: BLE001 — fsck reports, not crashes
+            states[side] = {"wal": path, "error": f"{type(e).__name__}: {e}"}
+    report["state"] = states
+    report["consistent"] = (
+        "error" not in states["a"]
+        and "error" not in states["b"]
+        and states["a"]["sha256"] == states["b"]["sha256"]
+    )
+    return report
+
+
 def main(argv: List[str]) -> int:
     """CLI entry (dispatched from ``python -m minisched_tpu fsck``):
     prints the JSON report; exit 0 clean, 1 on any integrity error.
@@ -513,16 +583,16 @@ def main(argv: List[str]) -> int:
     )
     parser.add_argument(
         "--compare", metavar="OTHER", default=None,
-        help="diff this WAL against another replica's by frame digest; "
-        "exit 1 when the histories diverged (one being a prefix of the "
-        "other is clean — a follower mid-catch-up)",
+        help="diff this WAL against another replica's: frame-digest "
+        "identity/prefix fast path, then (checkpoint-shipping "
+        "topologies) an offline generation⊕tail replay of BOTH sides — "
+        "exit 1 only when neither judgement finds them consistent",
     )
     args = parser.parse_args(argv)
     if args.compare:
-        report = wal_compare(args.wal, args.compare)
+        report = replica_consistent(args.wal, args.compare)
         print(json.dumps(report, indent=2))
-        ok = report["identical"] or report["prefix"]
-        return 0 if ok else 1
+        return 0 if report["consistent"] else 1
     if args.digests:
         report = wal_digests(args.wal)
         print(json.dumps(report, indent=2))

@@ -337,6 +337,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if path == "/net/partition":
+            # the partition nemesis's control surface (faults/net.py):
+            # the chaos harness inspects a replica child's link table
+            from minisched_tpu.faults.net import GLOBAL_NET
+
+            self._send(200, GLOBAL_NET.describe())
+            return
         if path.startswith("/repl/"):
             repl = self.repl
             if repl is None:
@@ -600,6 +607,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path.partition("?")[0] == "/api/v1/bindings":
             self._bind_many()
+            return
+        if self.path.partition("?")[0] == "/net/partition":
+            # cut/heal this process's outbound links (faults/net.py) —
+            # how the chaos soak partitions replica children it cannot
+            # reach into
+            from minisched_tpu.faults.net import GLOBAL_NET
+
+            try:
+                self._send(200, GLOBAL_NET.control(self._body()))
+            except (KeyError, ValueError) as e:
+                self._error(400, f"bad partition control: {e}")
             return
         if self.path.partition("?")[0].startswith("/repl/"):
             repl = self.repl

@@ -50,24 +50,44 @@ Kill-switch: ``MINISCHED_REPL=0`` keeps every hub/follower unattached —
 the single-store path is restored byte-identically (parity pinned in
 tests/test_repl.py).
 
+Checkpoint generations (DESIGN.md §28): the leader COMPACTS normally
+while the hub is attached.  Each compaction publishes the fresh
+checkpoint as a numbered *generation* and ``rebase()``s the hub — the
+stream epoch bumps, the digest ring and acks clear (they describe a
+byte space that no longer exists), and ``durable_end`` re-anchors at
+the post-compaction WAL size.  A follower whose cursor predates the
+rebase (or a brand-new replica) fetches the generation over
+``GET /repl/checkpoint?gen=``, verifies the sha256 the leader proved
+against its own sidecar, seeds through the checkpoint-seeded
+``replica_reset(seed=...)``, and resumes tailing the new WAL from byte
+zero — WAL size stays bounded by the compaction interval, and replica
+bootstrap is O(state), not O(history).
+
 Wire surface (served by the REST façade when a runtime is attached):
 
     GET  /repl/status                         → role/rv/epoch/offsets
     GET  /repl/stream?offset=&epoch=&replica= → group-framed byte tail
     GET  /repl/digests?since=                 → per-group digest ring
-    POST /repl/ack {replica, offset}          → follower durability ack
+    GET  /repl/checkpoint?gen=                → checkpoint generation
+                                                bytes (sha256 in headers)
+    POST /repl/ack {replica, offset, epoch}   → follower durability ack
 
 The stream is chunked HTTP over the façade's existing machinery; inside
 it, each shipped group is one header line (JSON: off/len/crc/seq) plus
 its raw bytes, with ``{"hb": epoch}`` heartbeats while idle.  Fault
 points: ``repl.ship`` (a follower's stream dies mid-ship) and
 ``repl.ack`` (the leader loses a follower's ack) — both keyed by
-replica id on the deterministic fabric.
+replica id on the deterministic fabric.  Every outbound call — the
+follower's stream/status/ack/checkpoint traffic and the coordinator's
+arbiter lease CAS — additionally consults the network-fault layer
+(faults/net.py), which is how the partition nemesis severs links
+without touching this module's logic.
 """
 
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import os
 import threading
@@ -76,6 +96,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from minisched_tpu.controlplane.walio import group_crc32c
+from minisched_tpu.faults.net import GLOBAL_NET
 from minisched_tpu.observability import counters, hist
 
 #: leader-side ring of per-group digests: deep enough that a follower a
@@ -138,6 +159,11 @@ class ReplicationHub:
         self.ack_timeout_s = float(ack_timeout_s)
         self.epoch = int(epoch)
         self.durable_end = 0  # set by promote_leader (current WAL size)
+        #: the current checkpoint generation (0 = none shipped yet) and
+        #: the rv its snapshot covers — set by promote_leader when a
+        #: checkpoint already exists on disk, advanced by rebase()
+        self.ckpt_gen = 0
+        self.ckpt_rv = 0
         self.seq = 0
         self.digests: collections.deque = collections.deque(
             maxlen=digest_ring
@@ -178,6 +204,24 @@ class ReplicationHub:
                 self.durable_end = end
                 self._cond.notify_all()
 
+    def rebase(self, gen: int, ckpt_rv: int, wal_end: int) -> None:
+        """A compaction passed under the hub: the WAL restarted past the
+        checkpoint, so every old byte offset is meaningless.  Publish
+        the new generation, bump the EPOCH (every stream must
+        re-handshake and every behind follower reseeds from the
+        checkpoint), clear the digest ring and acks (they describe the
+        dead byte space), and re-anchor ``durable_end`` at the fresh
+        WAL's size.  Called by ``durable.compact()`` under the store's
+        io+store locks — this only takes the hub condition."""
+        with self._cond:
+            self.ckpt_gen = int(gen)
+            self.ckpt_rv = int(ckpt_rv)
+            self.durable_end = int(wal_end)
+            self.epoch += 1
+            self.digests.clear()
+            self._acks.clear()
+            self._cond.notify_all()
+
     def retract(self, end: int) -> None:
         """A quorum-failed group was truncated off the local WAL: pull
         the shippable horizon back and bump the EPOCH so followers that
@@ -213,7 +257,16 @@ class ReplicationHub:
             return False
 
     # -- stream side (façade handler threads) ------------------------------
-    def record_ack(self, replica: str, offset: int) -> None:
+    def record_ack(
+        self, replica: str, offset: int, epoch: Optional[int] = None
+    ) -> None:
+        """Record one follower's durable offset.  Epoch-tagged acks from
+        a RETIRED byte space (pre-retract or pre-rebase offsets can be
+        numerically huge in the new, restarted space) are dropped — a
+        stale ack must never satisfy a quorum it does not describe."""
+        if epoch is not None and int(epoch) != self.epoch:
+            counters.inc("storage.repl.stale_acks")
+            return
         with self._cond:
             if offset > self._acks.get(replica, -1):
                 self._acks[replica] = int(offset)
@@ -284,8 +337,17 @@ class WalFollower(threading.Thread):
     recovery path (``apply_replicated``), and acked back with the new
     durable offset.  Reconnects resume from the local WAL size — the
     offset IS the replication cursor, no separate bookkeeping to rot.
-    An epoch mismatch, offset discontinuity, or digest divergence wipes
-    the local state and re-tails from zero (``resync``)."""
+
+    Resync (epoch mismatch, offset discontinuity, digest divergence,
+    checkpoint-generation drift) consults the leader's status FIRST:
+    when the leader has a checkpoint generation, the follower fetches
+    it, verifies the sha256, and seeds through the checkpoint-seeded
+    ``replica_reset(seed=...)`` — never a blind wipe-and-re-tail, which
+    against a compacted leader would replay only the tail and serve
+    partial state.  Only a leader with NO checkpoint (``ckpt_rv`` 0)
+    still gets the full offset-0 re-tail (``storage.repl.full_retails``).
+    When the leader cannot even be asked, local state is left UNTOUCHED
+    and the retry loop re-decides — not resetting is always safe."""
 
     def __init__(
         self,
@@ -295,10 +357,12 @@ class WalFollower(threading.Thread):
         read_timeout_s: float = 5.0,
         reconnect_delay_s: float = 0.1,
         gossip_every_s: float = 2.0,
+        leader_id: str = "",
     ):
         super().__init__(name=f"wal-follower-{replica_id}", daemon=True)
         self._store = store
         self._leader = leader_url.rstrip("/")
+        self._leader_id = leader_id
         self._replica = replica_id
         self._read_timeout_s = float(read_timeout_s)
         self._reconnect_delay_s = float(reconnect_delay_s)
@@ -316,9 +380,18 @@ class WalFollower(threading.Thread):
     def _local_end(self) -> int:
         return self._store.wal_end()
 
+    def _net_gate(self, timeout: Optional[float] = None) -> None:
+        GLOBAL_NET.check(
+            self._leader_id or "?",
+            channel="data",
+            src=self._replica,
+            timeout_s=timeout or self._read_timeout_s,
+        )
+
     def _get_json(self, path: str, timeout: Optional[float] = None) -> Any:
         import urllib.request
 
+        self._net_gate(timeout)
         with urllib.request.urlopen(
             self._leader + path, timeout=timeout or self._read_timeout_s
         ) as r:
@@ -327,6 +400,7 @@ class WalFollower(threading.Thread):
     def _post_json(self, path: str, payload: dict) -> None:
         import urllib.request
 
+        self._net_gate()
         req = urllib.request.Request(
             self._leader + path,
             data=json.dumps(payload).encode(),
@@ -339,18 +413,82 @@ class WalFollower(threading.Thread):
     def _ack(self, offset: int) -> None:
         # best-effort: a lost ack (repl.ack fault, transport blip) heals
         # at the next group or heartbeat re-ack — the offset is absolute
+        # within the epoch it is tagged with, and the hub drops acks
+        # from retired epochs
         try:
             self._post_json(
-                "/repl/ack", {"replica": self._replica, "offset": offset}
+                "/repl/ack",
+                {
+                    "replica": self._replica,
+                    "offset": offset,
+                    "epoch": self._epoch,
+                },
             )
         except OSError:
             pass
 
+    def _base_rv(self) -> int:
+        """The rv of the checkpoint generation this replica's WAL tail
+        sits on (0 = full history).  Our cursor is only meaningful
+        against a leader advertising the SAME base."""
+        return int(getattr(self._store, "checkpoint_rv", 0) or 0)
+
     def _resync(self, reason: str) -> None:
+        """Local state is suspect or obsolete: re-base on the leader.
+        The leader's status decides HOW — checkpoint seed when it has a
+        generation, full re-tail when it does not.  If the leader cannot
+        be consulted, local state stays untouched (safe: the retry loop
+        lands back here)."""
+        try:
+            status = self._get_json("/repl/status")
+        except OSError as e:
+            self.last_error = f"resync pending ({reason}): {e}"
+            self._epoch = 0
+            return
+        if status.get("role") != "leader":
+            self.last_error = f"resync pending ({reason}): peer not leading"
+            self._epoch = 0
+            return
+        self._reseed(status, reason)
+
+    def _reseed(self, status: dict, reason: str) -> None:
         counters.inc("storage.repl.resyncs")
         self.last_error = f"resync: {reason}"
-        self._store.replica_reset()
         self._epoch = 0
+        ckpt_rv = int(status.get("ckpt_rv", 0) or 0)
+        if ckpt_rv <= 0:
+            # leader has no checkpoint: its WAL IS the full history, so
+            # the offset-0 re-tail reconstructs everything
+            counters.inc("storage.repl.full_retails")
+            self._store.replica_reset()
+            return
+        t0 = time.monotonic()
+        blob = self._fetch_checkpoint(int(status.get("ckpt_gen", 0) or 0))
+        self._store.replica_reset(seed=blob)
+        counters.inc("storage.repl.ckpt_seeds")
+        hist.observe("storage.repl.bootstrap_s", time.monotonic() - t0)
+
+    def _fetch_checkpoint(self, gen: int) -> dict:
+        """GET one checkpoint generation off the leader and verify the
+        sha256 it proved against its own sidecar before anything is
+        trusted.  Raises OSError on transport failure, wrong generation
+        (the leader compacted again mid-fetch — retry re-decides), or a
+        digest mismatch (bytes rotted in transit or on either disk)."""
+        import urllib.request
+
+        self._net_gate()
+        url = self._leader + f"/repl/checkpoint?gen={int(gen)}"
+        with urllib.request.urlopen(
+            url, timeout=max(self._read_timeout_s, 30.0)
+        ) as r:
+            body = r.read()
+            sha = r.headers.get("X-Ckpt-Sha256", "")
+            rv = int(r.headers.get("X-Ckpt-Rv", "0"))
+            got_gen = int(r.headers.get("X-Ckpt-Gen", "0"))
+        if sha and hashlib.sha256(body).hexdigest() != sha:
+            counters.inc("storage.repl.digest_mismatch")
+            raise OSError(f"checkpoint gen {got_gen} failed sha256 check")
+        return {"body": body, "rv": rv, "gen": got_gen, "sha256": sha}
 
     # -- lifecycle ----------------------------------------------------------
     def stop(self) -> None:
@@ -372,19 +510,28 @@ class WalFollower(threading.Thread):
             raise OSError(f"peer {self._leader} is not leading")
         epoch = int(status.get("epoch", 0))
         if self._epoch and epoch != self._epoch:
-            self._resync(f"leader epoch moved {self._epoch} -> {epoch}")
-        if self._local_end() > int(status.get("durable_end", 0)):
+            self._reseed(
+                status, f"leader epoch moved {self._epoch} -> {epoch}"
+            )
+        elif int(status.get("ckpt_rv", 0) or 0) != self._base_rv():
+            # the leader's checkpoint generation is not the base our WAL
+            # tail sits on: every byte offset we hold belongs to a
+            # different coordinate space (leader compacted while we were
+            # away, or we are brand new against a compacted leader)
+            self._reseed(status, "checkpoint generation moved")
+        elif self._local_end() > int(status.get("durable_end", 0)):
             # we hold bytes the leader does not acknowledge (ex-leader
             # tail, or a quorum-failed group we buffered): authoritative
             # log wins
-            self._resync("local WAL ahead of leader durable end")
-        self._epoch = epoch
+            self._reseed(status, "local WAL ahead of leader durable end")
+        self._epoch = int(status.get("epoch", 0))
         self.leader_seen.set()
 
     def _tail_once(self) -> None:
         import http.client
         import urllib.parse
 
+        self._net_gate()
         parsed = urllib.parse.urlsplit(self._leader)
         conn = http.client.HTTPConnection(
             parsed.hostname, parsed.port, timeout=self._read_timeout_s
@@ -405,6 +552,9 @@ class WalFollower(threading.Thread):
                 raise OSError(f"stream HTTP {resp.status}")
             self.resumed_from = offset
             while not self._halt.is_set():
+                # a partition imposed MID-STREAM must sever the
+                # established flow too, not just the next connect
+                self._net_gate()
                 line = resp.readline()
                 if not line:
                     return  # leader hung up; reconnect resumes
@@ -543,6 +693,16 @@ class PlaneCoordinator(threading.Thread):
     def _majority(self) -> int:
         return len(self._rt.peers) // 2 + 1
 
+    def _net_gate(self, peer: PeerSpec) -> None:
+        """Consult the partition layer before touching a peer's arbiter
+        — a cut arbiter link must look exactly like a dead arbiter."""
+        GLOBAL_NET.check(
+            peer.replica_id,
+            channel="arbiter",
+            src=self._rt.replica_id,
+            timeout_s=min(1.0, self._ttl / 2.0),
+        )
+
     def _manager(self, peer: PeerSpec) -> Any:
         mgr = self._managers.get(peer.replica_id)
         if mgr is None:
@@ -580,6 +740,7 @@ class PlaneCoordinator(threading.Thread):
         held = 0
         for peer in self._rt.peers:
             try:
+                self._net_gate(peer)
                 if self._manager(peer).acquire(
                     LEASE_STORE_LEADER, self._rt.replica_id, self._ttl
                 ):
@@ -599,6 +760,7 @@ class PlaneCoordinator(threading.Thread):
         reachable = 0
         for peer in self._rt.peers:
             try:
+                self._net_gate(peer)
                 lease = self._manager(peer).get(LEASE_STORE_LEADER)
                 reachable += 1
             except Exception:  # noqa: BLE001
@@ -653,6 +815,7 @@ class PlaneCoordinator(threading.Thread):
         won: List[PeerSpec] = []
         for peer in self._rt.peers:
             try:
+                self._net_gate(peer)
                 if self._manager(peer).acquire(
                     LEASE_STORE_LEADER, self._rt.replica_id, self._ttl
                 ):
@@ -794,6 +957,7 @@ class ReplRuntime:
             self.follower = WalFollower(
                 self.store, peer.data_url, self.replica_id,
                 read_timeout_s=max(self.ttl_s, 2.0),
+                leader_id=holder,
             )
             self.follower.start()
 
@@ -804,6 +968,12 @@ class ReplRuntime:
     def peer_status(self, peer: PeerSpec) -> dict:
         import urllib.request
 
+        GLOBAL_NET.check(
+            peer.replica_id,
+            channel="data",
+            src=self.replica_id,
+            timeout_s=self.ttl_s,
+        )
         with urllib.request.urlopen(
             peer.data_url.rstrip("/") + "/repl/status", timeout=self.ttl_s
         ) as r:
@@ -826,6 +996,15 @@ class ReplRuntime:
             ),
             "acks": hub.acks_snapshot() if hub is not None else {},
             "fenced": bool(self.store.is_fenced()),
+            # checkpoint generation: a leader advertises the hub's (what
+            # a follower must base on); a follower reports its own
+            # seeded base (what its WAL tail sits on)
+            "ckpt_gen": hub.ckpt_gen if hub is not None else 0,
+            "ckpt_rv": (
+                hub.ckpt_rv
+                if hub is not None
+                else int(getattr(self.store, "checkpoint_rv", 0) or 0)
+            ),
         }
 
     # -- façade handlers (called from httpserver._Handler) -----------------
@@ -849,10 +1028,45 @@ class ReplRuntime:
                 },
             )
             return
+        if path == "/repl/checkpoint":
+            self._serve_checkpoint(handler, query)
+            return
         if path == "/repl/stream":
             self._serve_stream(handler, query)
             return
         handler._error(404, f"no repl route {path}")
+
+    def _serve_checkpoint(self, handler: Any, query: str) -> None:
+        """Ship the current checkpoint generation: raw body bytes, with
+        the generation number, snapshot rv, and sha256 in headers so the
+        follower can verify before trusting a byte.  410 when the asked
+        generation already rotated away (the follower re-consults status
+        and retries against the new one)."""
+        hub = self.hub
+        if hub is None:
+            handler._error(409, "not leading")
+            return
+        want = handler._int_param(query, "gen")
+        if want is not None and int(want) != hub.ckpt_gen:
+            handler._error(
+                410, f"generation {want} gone (current {hub.ckpt_gen})"
+            )
+            return
+        blob = self.store.checkpoint_ship_blob()
+        if blob is None:
+            handler._error(404, "no shippable checkpoint generation")
+            return
+        body = blob["body"]
+        counters.inc("storage.repl.ckpt_ships")
+        counters.inc("storage.repl.ckpt_bytes", len(body))
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/octet-stream")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.send_header("X-Ckpt-Gen", str(hub.ckpt_gen))
+        handler.send_header("X-Ckpt-Rv", str(blob["rv"]))
+        handler.send_header("X-Ckpt-Sha256", blob["sha256"])
+        handler.end_headers()
+        handler.wfile.write(body)
 
     def handle_post(self, handler: Any, path: str) -> None:
         if path == "/repl/ack":
@@ -873,7 +1087,11 @@ class ReplRuntime:
             if hub is None or offset < 0 or not replica:
                 handler._error(409, "not leading (or malformed ack)")
                 return
-            hub.record_ack(replica, offset)
+            epoch = body.get("epoch")
+            hub.record_ack(
+                replica, offset,
+                epoch=int(epoch) if epoch is not None else None,
+            )
             handler._send(200, {"acked": offset, "epoch": hub.epoch})
             return
         handler._error(404, f"no repl route {path}")

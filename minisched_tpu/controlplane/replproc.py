@@ -52,11 +52,17 @@ def _replica_child_main(
     ack_timeout_s: float = 10.0,
     ttl_s: float = DEFAULT_TTL_S,
     parent_pid: Optional[int] = None,
+    compact_every_s: float = 0.0,
 ) -> None:
     """One replica's whole life: recover the store from its own WAL,
     serve data + arbiter façades on fixed ports, join the plane (lead
     if bootstrapped, else tail/elect), park until SIGKILL.  Runs in a
-    fresh interpreter — import inside, keep it light."""
+    fresh interpreter — import inside, keep it light.
+
+    ``compact_every_s`` > 0 runs a background compaction loop that
+    fires only while THIS replica leads with a hub attached — the
+    checkpoint-shipping half of DESIGN.md §28: the soak's leader keeps
+    its WAL bounded and followers reseed through generations."""
     from minisched_tpu.controlplane.durable import DurableObjectStore
     from minisched_tpu.controlplane.httpserver import start_api_server
     from minisched_tpu.controlplane.repl import (
@@ -65,7 +71,12 @@ def _replica_child_main(
         repl_enabled,
     )
     from minisched_tpu.controlplane.store import ObjectStore
+    from minisched_tpu.faults.net import GLOBAL_NET
 
+    # every outbound replication call this process makes is keyed off
+    # this identity in the partition layer (the /net/partition control
+    # surface cuts/heals links by (src, dst) pair)
+    GLOBAL_NET.configure(identity=replica_id)
     # salvage="covered": a replica restarting after SIGKILL may carry a
     # torn tail; replay truncates it and the follower re-tails the gap
     store = DurableObjectStore(wal_path, fsync=fsync, salvage="covered")
@@ -82,6 +93,20 @@ def _replica_child_main(
     start_api_server(store, port=data_port, repl=runtime)
     if runtime is not None:
         runtime.start(bootstrap_leader or None)
+    if compact_every_s and compact_every_s > 0:
+        rt = runtime
+
+        def compactor() -> None:
+            while True:
+                time.sleep(compact_every_s)
+                try:
+                    if rt is not None and rt.role == "leader" \
+                            and rt.hub is not None:
+                        store.compact()
+                except Exception:  # noqa: BLE001 — housekeeping only;
+                    pass  # a failed compaction leaves the old chain arm
+
+        threading.Thread(target=compactor, daemon=True).start()
     if parent_pid:
         # orphan watchdog (see faults/proc.py): an aborted soak must not
         # strand listeners on the fixed ports
@@ -115,6 +140,7 @@ class ReplicaSupervisor:
         ack_timeout_s: float = 10.0,
         ttl_s: float = DEFAULT_TTL_S,
         boot_timeout_s: float = 30.0,
+        compact_every_s: float = 0.0,
     ):
         self.replica_id = replica_id
         self.wal_path = wal_path
@@ -124,6 +150,7 @@ class ReplicaSupervisor:
         self._ack_timeout_s = ack_timeout_s
         self._ttl_s = ttl_s
         self._boot_timeout_s = boot_timeout_s
+        self._compact_every_s = compact_every_s
         self._proc: Any = None
         self._peers: List[dict] = []
         self.kills = 0
@@ -169,6 +196,7 @@ class ReplicaSupervisor:
             "ack_timeout_s": self._ack_timeout_s,
             "ttl_s": self._ttl_s,
             "parent_pid": os.getpid(),
+            "compact_every_s": self._compact_every_s,
         }
         env = dict(os.environ)
         repo_root = os.path.dirname(
@@ -227,6 +255,20 @@ class ReplicaSupervisor:
         except OSError:
             return None
 
+    def net_control(self, body: dict, timeout: float = 5.0) -> dict:
+        """Drive this child's network-fault layer (faults/net.py) over
+        its /net/partition control surface — how the partition soak
+        cuts and heals a replica's OUTBOUND links from outside the
+        process.  Symmetric partitions need the op on both sides."""
+        req = urllib.request.Request(
+            self.base_url + "/net/partition",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
 
 class ReplicatedPlane:
     """N replica children forming one control plane."""
@@ -238,6 +280,7 @@ class ReplicatedPlane:
         fsync: bool = False,
         ack_timeout_s: float = 10.0,
         ttl_s: float = DEFAULT_TTL_S,
+        compact_every_s: float = 0.0,
     ):
         self.ttl_s = ttl_s
         os.makedirs(wal_dir, exist_ok=True)
@@ -248,6 +291,7 @@ class ReplicatedPlane:
                 fsync=fsync,
                 ack_timeout_s=ack_timeout_s,
                 ttl_s=ttl_s,
+                compact_every_s=compact_every_s,
             )
             for i in range(n)
         ]

@@ -60,6 +60,12 @@ Named points wired through the tree (grep for the literal string):
           unproven); the follower's next group or heartbeat re-ack
           heals it — quorum waits stretch, correctness holds; key =
           replica id
+    net.drop
+        — an outbound replication-plane call (arbiter lease CAS,
+          follower stream/status/ack) is refused before it touches the
+          socket: the scheduled flaky-link half of faults/net.py, keyed
+          "src>dst"; imposed partitions (cut/blackhole/delay) live in
+          :class:`minisched_tpu.faults.net.NetFabric` beside it
 
 Determinism: whether call *n* at (point, key) fires is a pure function of
 ``(seed, point, key, n)`` — a blake2s hash, not a shared RNG — so the

@@ -279,8 +279,26 @@ chaos-repl soak's replication evidence:
         — groups (and the mutations inside) a follower applied through
           the real recovery path; byte-order == rv-order by invariant
     storage.repl.resyncs
-        — followers that wiped local state and re-tailed from zero
-          (leader epoch moved, offset discontinuity, digest mismatch)
+        — followers that re-based on the leader after local state went
+          suspect or obsolete (leader epoch moved, offset
+          discontinuity, digest mismatch, checkpoint generation moved);
+          each resolves as a ckpt_seed or a full_retail
+    storage.repl.ckpt_seeds / storage.repl.full_retails
+        — how each resync re-based: seeded from a shipped checkpoint
+          generation (O(state) bootstrap, DESIGN.md §28) vs wiped and
+          re-tailed the leader's FULL WAL from offset 0 (only legal
+          against a leader that has never compacted)
+    storage.repl.ckpt_published
+        — checkpoint generations a LEADING store published at
+          compaction (hub rebased: epoch bump, byte space restarted)
+    storage.repl.ckpt_ships / storage.repl.ckpt_bytes
+        — checkpoint generations served over GET /repl/checkpoint, and
+          their body bytes (the bootstrap traffic that replaces
+          unbounded history re-tails)
+    storage.repl.stale_acks
+        — follower acks dropped because they were tagged with a
+          RETIRED stream epoch (pre-rebase/pre-retract byte offsets
+          must never satisfy a quorum in the restarted space)
     storage.repl.digest_mismatch
         — cross-replica scrub gossip convicted a byte range whose
           CRC32C diverged from the leader's digest ring (bit rot or a
@@ -294,9 +312,25 @@ chaos-repl soak's replication evidence:
     storage.repl.promotions
         — follower→leader promotions won via arbiter-majority lease CAS
     storage.repl.compact_deferred
-        — WAL compactions skipped while a replication hub was attached
-          (compaction-aware shipping is a ROADMAP follow-up; a leader
-          never rewrites bytes a follower may still need)
+        — retired (always 0 since checkpoint shipping landed): WAL
+          compactions a leading replica used to skip while a hub was
+          attached; kept registered so old dashboards read zero
+          instead of breaking
+
+The network-fault layer (faults/net.py — the partition nemesis) records
+under ``net.partition.``, the chaos-partition soak's injection evidence:
+
+    net.partition.dropped / net.partition.blackholed /
+    net.partition.delayed
+        — outbound replication-plane calls the layer enforced against:
+          refused immediately (drop / scheduled net.drop), hung for the
+          caller's timeout then refused (blackhole), or delayed then
+          allowed through (one-way latency)
+    net.partition.cuts / net.partition.heals
+        — link rules imposed and removed (cut()/heal(), including over
+          the POST /net/partition control surface)
+    net.partition.links  (gauge)
+        — imposed link rules currently in force in this process
 
 The gRPC facade's memoized LIST encode (grpcserver._SnapListCache)
 mirrors the REST relist cache:

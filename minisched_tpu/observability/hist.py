@@ -69,6 +69,12 @@ same contract as counters.py):
     storage.repl_apply_s
         — follower-side per-group apply time: CRC verify + WAL append +
           fsync + replay through the real recovery path
+    storage.repl.bootstrap_s
+        — follower checkpoint-seeded reseed time: fetch the leader's
+          checkpoint generation, verify its sha256, land + restore it
+          locally (DESIGN.md §28) — the O(state) replica-bootstrap cost
+          that replaced O(history) re-tails; the bench ``repl`` role's
+          bootstrap-under-load gate
 
 **Exemplars**: ``observe(..., exemplar="default/pod-1")`` stamps the
 bucket the sample lands in with that string (last writer wins, one per

@@ -1,0 +1,360 @@
+"""Partition chaos (ISSUE 16, DESIGN.md §28): cut LINKS, keep the data.
+
+test_repl_chaos.py kills replica *processes*; this file kills *links*.
+The deterministic network-fault layer (faults/net.py) imposes
+directional (src, dst) rules per channel — ``arbiter`` lease traffic vs
+``data`` replication traffic — on each replica child over the
+``/net/partition`` control surface.  The scenario the tentpole demands:
+isolate the LEADER from the arbiter majority (its data links stay up!),
+and it must fence itself within ~2 lease TTLs — before a follower can
+win the election — so there is never an instant where two unfenced
+leaders both ack writes.  Heal the partition and the deposed ex-leader
+rejoins fenced and catches back up (through a shipped checkpoint when
+compaction moved past its cursor).
+
+The tier-1 half: the in-process NetFabric contract (directional rules,
+channels, wildcard, delay/blackhole modes, seed-scheduled drops) and a
+ONE-cycle partition smoke at small scale.  The soak (slow) keeps
+writers running through repeated partition/heal cycles with background
+compaction shipping checkpoints, samples the plane for dual unfenced
+leaders the whole time, and ends in the standing audits: zero
+acked-write loss, replica consistency (fsck.replica_consistent — the
+state arm, since checkpoint⊕tail WALs need not share bytes), and the
+double-bind audit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import make_pod
+from minisched_tpu.controlplane.fsck import replica_consistent
+from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.replproc import ReplicatedPlane
+from minisched_tpu.faults import wal_double_binds
+from minisched_tpu.faults.net import NetFabric, NetPartitioned
+
+TTL_S = 1.0
+
+
+def _names(client) -> set:
+    return {p.metadata.name for p in client.pods().list()}
+
+
+def _partition_arbiter(leader, others) -> None:
+    """Symmetric arbiter-channel partition between the leader and every
+    other replica — each side cuts its own OUTBOUND edge (processes
+    enforce only their own egress, like a real firewall).  Data links
+    stay up: the isolated leader can still ship groups; it just cannot
+    prove leadership."""
+    for o in others:
+        leader.net_control({
+            "op": "cut", "src": leader.replica_id, "dst": o.replica_id,
+            "channel": "arbiter",
+        })
+        o.net_control({
+            "op": "cut", "src": o.replica_id, "dst": leader.replica_id,
+            "channel": "arbiter",
+        })
+
+
+def _heal_all(plane) -> None:
+    for r in plane.replicas:
+        if r.alive():
+            r.net_control({"op": "heal_all"})
+
+
+def _wait_fenced(sup, timeout_s: float) -> float:
+    """Block until ``sup`` reports it is no longer an unfenced leader;
+    returns the observation instant (time.monotonic)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        s = sup.status()
+        if s is not None and (s.get("role") != "leader" or s.get("fenced")):
+            return time.monotonic()
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{sup.replica_id} still an unfenced leader after {timeout_s}s "
+        f"(status: {sup.status()})"
+    )
+
+
+def test_net_fabric_rules_channels_and_schedule():
+    """The in-process NetFabric contract: directional imposed rules per
+    channel, wildcard dst, heal, the delay mode's imposed latency, the
+    blackhole mode's capped hang, and the blake2s-scheduled ``net.drop``
+    point replaying identically from a seed."""
+    net = NetFabric().configure(identity="a")
+    net.check("b")  # no rules: every link up
+    net.cut("a", "b", channel="arbiter")
+    with pytest.raises(NetPartitioned):
+        net.check("b", channel="arbiter")
+    net.check("b", channel="data")  # other channel untouched
+    with pytest.raises(ValueError):
+        net.cut("a", "b", mode="sever")
+    net.cut("*", "c")  # any local actor -> c, every channel
+    with pytest.raises(NetPartitioned):
+        net.check("c", src="z")
+    assert net.heal("a", "b") is True
+    net.check("b", channel="arbiter")
+    net.heal_all()
+
+    net.cut("a", "b", mode="delay", delay_s=0.05)
+    t0 = time.monotonic()
+    net.check("b")  # delayed, then allowed through
+    assert time.monotonic() - t0 >= 0.05
+    net.heal_all()
+
+    net.cut("a", "b", mode="blackhole")
+    t0 = time.monotonic()
+    with pytest.raises(NetPartitioned):
+        net.check("b", timeout_s=0.1)
+    assert 0.1 <= time.monotonic() - t0 < 1.0, "hang must respect timeout"
+    net.heal_all()
+
+    def verdicts(seed: int) -> list:
+        f = NetFabric().configure(identity="a").flake(rate=0.5, seed=seed)
+        out = []
+        for _ in range(24):
+            try:
+                f.check("b")
+                out.append(True)
+            except NetPartitioned:
+                out.append(False)
+        return out
+
+    assert verdicts(77) == verdicts(77), "schedule must replay from seed"
+    assert False in verdicts(77) and True in verdicts(77)
+
+
+def test_arbiter_partition_fences_leader_smoke(tmp_path):
+    """One partition cycle: the leader loses the arbiter majority (data
+    links untouched), fences itself within ~2 TTLs, a follower wins the
+    election — strictly AFTER the fence: no dual-leader ack window —
+    with zero acked-write loss; the healed ex-leader rejoins fenced and
+    catches up to the live plane."""
+    plane = ReplicatedPlane(str(tmp_path), n=3, fsync=True, ttl_s=TTL_S)
+    try:
+        url = plane.start()
+        client = RemoteClient(url, timeout_s=10.0)
+        acked = []
+        for i in range(10):
+            client.pods().create(make_pod(f"pre-{i:03d}"))
+            acked.append(f"pre-{i:03d}")
+        old = plane.leader()
+        assert old is not None
+        others = [r for r in plane.replicas if r is not old]
+        t_cut = time.monotonic()
+        _partition_arbiter(old, others)
+        # the isolated leader must fence BEFORE anyone can be elected
+        t_fenced = _wait_fenced(old, 2 * TTL_S + 1.0)
+        assert t_fenced - t_cut <= 2 * TTL_S + 1.0
+        won = plane.wait_for_leader(
+            timeout_s=10 * TTL_S, exclude=old.replica_id
+        )
+        t_elected = time.monotonic()
+        assert t_fenced <= t_elected, "election observed before the fence"
+        # the ex-leader is minority-side: it can neither lead nor elect
+        s = old.status()
+        assert s is not None and s.get("role") != "leader"
+        survivor = RemoteClient(won["url"], timeout_s=10.0)
+        assert set(acked) <= _names(survivor), "acked writes lost"
+        survivor.pods().create(make_pod("post-partition"))
+        assert "post-partition" in _names(survivor)
+
+        # heal: the deposed replica rejoins fenced and catches up
+        _heal_all(plane)
+        deadline = time.monotonic() + 20.0
+        rejoined = None
+        while time.monotonic() < deadline:
+            s = old.status()
+            if s is not None and s.get("role") == "follower" \
+                    and s.get("fenced"):
+                rejoined = s
+                break
+            time.sleep(0.1)
+        assert rejoined is not None, "ex-leader never rejoined fenced"
+        want_rv = int(survivor.store.list_with_rv("Pod")[1])
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            s = old.status()
+            if s is not None and int(s.get("rv", 0)) >= want_rv:
+                break
+            time.sleep(0.1)
+        s = old.status()
+        assert s is not None and int(s.get("rv", 0)) >= want_rv, (
+            f"healed ex-leader stuck at {s and s.get('rv')} < {want_rv}"
+        )
+    finally:
+        plane.stop()
+
+
+@pytest.mark.slow
+def test_partition_heal_cycles_soak_under_load(tmp_path):
+    """The acceptance soak: writers hammer the plane through repeated
+    arbiter-partition/heal cycles while background compaction ships
+    checkpoint generations (the deposed replica's catch-up may cross a
+    compaction point — the checkpoint path, not an offset-0 re-tail).
+    A sampler watches the whole run for the unforgivable state: two
+    unfenced leaders at once.  Ends in the standing audits."""
+    plane = ReplicatedPlane(
+        str(tmp_path), n=3, fsync=True, ttl_s=TTL_S, compact_every_s=0.5
+    )
+    acked: set = set()
+    acked_mu = threading.Lock()
+    stop = threading.Event()
+    errs: list = []
+    dual: list = []
+
+    def writer(w: int, plane_url: list) -> None:
+        i = 0
+        client = RemoteClient(plane_url[0], timeout_s=10.0, retries=0)
+        while not stop.is_set():
+            name = f"w{w}-{i:04d}"
+            try:
+                client.pods().create(make_pod(name))
+            except KeyError:
+                # a retransmission of a create that DID commit before
+                # its socket died: the object exists, the ack stands
+                pass
+            except Exception:
+                # mid-failover: rebind to whoever leads now and retry
+                # the SAME name — only a returned ack admits it
+                time.sleep(0.2)
+                try:
+                    won = plane.wait_for_leader(timeout_s=10 * TTL_S)
+                except RuntimeError:
+                    continue
+                plane_url[0] = won["url"]
+                client = RemoteClient(
+                    plane_url[0], timeout_s=10.0, retries=0
+                )
+                continue
+            with acked_mu:
+                acked.add(name)
+            i += 1
+        if i == 0:
+            errs.append(f"writer {w} never acked a single write")
+
+    def leader_sampler() -> None:
+        while not stop.is_set():
+            unfenced = [
+                rid for rid, s in plane.statuses().items()
+                if s.get("role") == "leader" and not s.get("fenced")
+            ]
+            if len(unfenced) > 1:
+                dual.append(sorted(unfenced))
+            time.sleep(0.05)
+
+    try:
+        url = plane.start()
+        shared_url = [url]
+        writers = [
+            threading.Thread(target=writer, args=(w, shared_url))
+            for w in range(4)
+        ]
+        for t in writers:
+            t.start()
+        sampler = threading.Thread(target=leader_sampler, daemon=True)
+        sampler.start()
+        time.sleep(2.0)  # build load + let compaction ship a generation
+
+        deposed = []
+        for cycle in range(2):
+            old = plane.leader()
+            assert old is not None, f"cycle {cycle}: no leader to isolate"
+            others = [r for r in plane.replicas if r is not old]
+            t_cut = time.monotonic()
+            _partition_arbiter(old, others)
+            t_fenced = _wait_fenced(old, 2 * TTL_S + 1.0)
+            assert t_fenced - t_cut <= 2 * TTL_S + 1.0, (
+                f"cycle {cycle}: fence took {t_fenced - t_cut:.2f}s"
+            )
+            plane.wait_for_leader(
+                timeout_s=10 * TTL_S, exclude=old.replica_id
+            )
+            deposed.append(old.replica_id)
+            time.sleep(1.5)  # partitioned load: writers on the new leader
+            _heal_all(plane)
+            # the healed replica must rejoin fenced and catch up before
+            # the next cycle picks (possibly) a different victim
+            deadline = time.monotonic() + 30.0
+            caught_up = False
+            while time.monotonic() < deadline:
+                s = old.status()
+                live = plane.leader()
+                if s is not None and live is not None \
+                        and s.get("role") == "follower" and s.get("fenced"):
+                    ls = live.status()
+                    if ls is not None and int(s.get("rv", 0)) >= int(
+                        ls.get("rv", 0)
+                    ) - 5:
+                        caught_up = True
+                        break
+                time.sleep(0.1)
+            assert caught_up, (
+                f"cycle {cycle}: deposed {old.replica_id} never caught "
+                f"up (status: {old.status()})"
+            )
+            time.sleep(1.0)
+
+        stop.set()
+        for t in writers:
+            t.join(timeout=30.0)
+        sampler.join(timeout=5.0)
+        assert not errs, errs
+        assert not dual, f"dual unfenced leaders observed: {dual[:3]}"
+        assert len(acked) >= 50, f"soak too quiet: {len(acked)} acked"
+
+        # audit 1: zero acked-write loss on the final leader
+        final = plane.wait_for_leader(timeout_s=10 * TTL_S)
+        client = RemoteClient(final["url"], timeout_s=10.0)
+        missing = acked - _names(client)
+        assert not missing, f"{len(missing)} acked writes lost: " \
+            f"{sorted(missing)[:5]}"
+
+        # audit 2: compaction really ran under fire — the WAL stayed
+        # bounded by shipped generations, not by deferral
+        final_s = plane.statuses()[final["id"]]
+        assert int(final_s.get("ckpt_gen", 0)) >= 1, (
+            "no checkpoint generation shipped during the soak"
+        )
+
+        # audit 3: every live replica converges to the leader's rv
+        want_rv = int(client.store.list_with_rv("Pod")[1])
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            rvs = {
+                rid: int(s.get("rv", 0))
+                for rid, s in plane.statuses().items()
+            }
+            if len(rvs) == 3 and all(v >= want_rv for v in rvs.values()):
+                break
+            time.sleep(0.1)
+        rvs = {
+            rid: int(s.get("rv", 0))
+            for rid, s in plane.statuses().items()
+        }
+        assert all(v >= want_rv for v in rvs.values()), (
+            f"replicas stuck behind rv {want_rv}: {rvs}"
+        )
+    finally:
+        stop.set()
+        plane.stop()
+
+    # audit 4 (offline): replica consistency across checkpoint⊕tail
+    # topologies — WALs from different generations share no bytes, so
+    # the state-replay arm is what calls them consistent
+    paths = [r.wal_path for r in plane.replicas]
+    for i in range(len(paths)):
+        for j in range(i + 1, len(paths)):
+            report = replica_consistent(paths[i], paths[j])
+            assert report["consistent"], (
+                f"{paths[i]} vs {paths[j]}: {report}"
+            )
+    # audit 5: the full-history double-bind audit stays clean
+    for p in paths:
+        assert wal_double_binds(p) == []

@@ -9,14 +9,20 @@ the ``MINISCHED_REPL=0`` kill-switch's byte-identical parity, fencing
 (typed NotLeader end to end), digest-gossip divergence conviction, the
 ``fsck --digests/--compare`` offline halves, the ``repl.ack`` fault
 point healing, and a deterministic arbiter-majority election round.
+ISSUE 16 adds the checkpoint-shipping contracts (DESIGN.md §28): a
+leading replica compacts mid-stream and followers reseed from the
+shipped generation instead of re-tailing offset 0, a promoted leader
+advertises its pre-existing on-disk checkpoint as a generation, and
+the gen-N ⊕ any-prefix-of-post-compaction-groups replay property.
 The process-level failover soak (SIGKILL the leader mid-load) lives in
-test_repl_chaos.py.
+test_repl_chaos.py; partition faults live in test_partition_chaos.py.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 import time
 
@@ -24,7 +30,12 @@ import pytest
 
 from minisched_tpu.api.objects import make_node, make_pod
 from minisched_tpu.controlplane.durable import DurableObjectStore
-from minisched_tpu.controlplane.fsck import wal_compare, wal_digests
+from minisched_tpu.controlplane.fsck import (
+    replica_consistent,
+    state_digest,
+    wal_compare,
+    wal_digests,
+)
 from minisched_tpu.controlplane.httpserver import start_api_server
 from minisched_tpu.controlplane.remote import RemoteClient
 from minisched_tpu.controlplane.repl import (
@@ -505,3 +516,174 @@ def test_arbiter_majority_election(tmp_path):
             store.close()
         for _url, shutdown in arbiters:
             shutdown()
+
+
+def test_compaction_ships_checkpoint_generation(tmp_path):
+    """DESIGN.md §28 tentpole: the LEADER compacts while followers tail.
+    Compaction publishes a checkpoint generation (epoch restart, WAL
+    truncated to zero), both followers reseed from the shipped blob —
+    never by re-tailing offset 0 — and the plane converges with every
+    replica's WAL holding only the post-compaction tail."""
+    counters.reset()
+    plane = _Plane(tmp_path)
+    try:
+        client = RemoteClient(plane.url)
+        for i in range(8):
+            client.pods().create(make_pod(f"pre-{i}"))
+        plane.converge()
+        pre_end = plane.leader.wal_end()
+        assert pre_end > 0
+        plane.leader.compact()
+        hub = plane.runtime.hub
+        assert plane.leader.wal_end() == 0, "compaction must bound the WAL"
+        assert hub.ckpt_gen == 1
+        assert hub.ckpt_rv == plane.leader.resource_version
+        assert counters.get("storage.repl.ckpt_published") == 1
+        assert counters.get("storage.repl.compact_deferred") == 0, (
+            "the deferral is retired: a leading replica compacts"
+        )
+        # writes continue through the new generation: the first one
+        # blocks on quorum until a follower has reseeded and re-acked
+        for i in range(8):
+            client.pods().create(make_pod(f"post-{i}"))
+        plane.converge()
+        for fid, fstore, _tail in plane.followers:
+            assert fstore.resource_version == plane.leader.resource_version
+            assert len(fstore.list("Pod")) == 16, fid
+            assert fstore.checkpoint_rv == hub.ckpt_rv, (
+                f"{fid} must be seeded at the shipped generation"
+            )
+            assert fstore.wal_end() == plane.leader.wal_end(), (
+                f"{fid} WAL must hold only the post-compaction tail"
+            )
+        assert counters.get("storage.repl.ckpt_seeds") == 2
+        assert counters.get("storage.repl.full_retails") == 0, (
+            "zero offset-0 re-tails"
+        )
+        assert counters.get("storage.repl.ckpt_ships") == 2
+        assert counters.get("storage.repl.ckpt_bytes") > 0
+    finally:
+        plane.close()
+    # seeded follower vs leader: same tail bytes, raw-comparable
+    for fid, fstore, _tail in plane.followers:
+        cmp = wal_compare(plane.leader_wal, fstore._path)
+        assert cmp["identical"], f"{fid} tail diverged: {cmp['diverged']}"
+
+
+def test_promote_advertises_existing_checkpoint(tmp_path):
+    """A replica that compacted in a PREVIOUS life and is promoted now
+    must advertise its on-disk checkpoint as generation >= 1 — a fresh
+    follower seeds from it instead of tailing a WAL whose first byte is
+    not history's first byte (the latent partial-state trap)."""
+    path = str(tmp_path / "seed.wal")
+    store = DurableObjectStore(path, fsync=True)
+    for i in range(6):
+        store.create("Pod", make_pod(f"s-{i}"))
+    store.compact()  # hubless compaction, then a clean restart
+    store.close()
+
+    counters.reset()
+    leader = DurableObjectStore(path, fsync=True)
+    runtime = ReplRuntime(leader, "r0", peers=[], cluster_size=2)
+    runtime.promote()
+    hub = runtime.hub
+    assert hub.ckpt_gen >= 1, "pre-existing checkpoint must be advertised"
+    assert hub.ckpt_rv == 6
+    server, url, shutdown = start_api_server(leader, port=0, repl=runtime)
+    fstore = DurableObjectStore(str(tmp_path / "f.wal"), fsync=True)
+    fstore.fence("r0")
+    tail = WalFollower(fstore, url, "r1", leader_id="r0")
+    tail.start()
+    try:
+        _wait(
+            lambda: fstore.resource_version >= 6, 10.0,
+            "fresh follower to bootstrap from the shipped checkpoint",
+        )
+        assert len(fstore.list("Pod")) == 6
+        assert fstore.checkpoint_rv == 6
+        assert counters.get("storage.repl.ckpt_seeds") == 1
+        assert counters.get("storage.repl.full_retails") == 0
+        # and the stream is live: the next write replicates normally
+        leader.create("Pod", make_pod("after-promote"))
+        _wait(
+            lambda: fstore.resource_version
+            == leader.resource_version,
+            10.0, "follower to tail past the seed",
+        )
+        assert len(fstore.list("Pod")) == 7
+    finally:
+        shutdown()
+        tail.stop()
+        tail.join(timeout=5.0)
+        runtime.close()
+        leader.close()
+        fstore.close()
+
+
+def test_checkpoint_plus_any_prefix_replays_identically(tmp_path):
+    """The generation-replay property: checkpoint-gen-N ⊕ any prefix of
+    post-compaction commit groups replays BIT-IDENTICALLY (canonical
+    state digest) to a full-history replay of the same mutations — so a
+    follower seeded from the shipped blob at any group boundary holds
+    exactly the store a from-genesis replica would.  Also the fsck
+    ``--compare`` state arm: checkpoint⊕tail vs full-history WALs share
+    no bytes, yet replica_consistent calls them consistent."""
+    path = str(tmp_path / "gen.wal")
+    store = DurableObjectStore(path, fsync=True, archive_compacted=True)
+    hub = ReplicationHub(path, cluster_size=1)  # no quorum owed
+    store.promote_leader(hub)
+    for i in range(10):
+        store.create("Pod", make_pod(f"pre-{i:02d}"))
+    store.compact()  # generation 1: WAL restarts, history archived
+    assert hub.ckpt_gen == 1 and hub.ckpt_rv == 10
+
+    def burst(w: int) -> None:
+        for i in range(5):
+            store.create("Pod", make_pod(f"g{w}-{i:02d}"))
+
+    threads = [
+        threading.Thread(target=burst, args=(w,)) for w in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    groups = hub.digests_since(0)
+    assert groups, "no post-compaction groups recorded"
+    store.close()
+    with open(path, "rb") as f:
+        tail = f.read()
+    with open(path + ".history", "rb") as f:
+        history = f.read()
+    assert groups[-1].end == len(tail)
+
+    for k, end in enumerate([0] + [g.end for g in groups]):
+        rdir = tmp_path / f"boundary-{k}"
+        rdir.mkdir()
+        # the seeded replica: shipped checkpoint pair ⊕ k groups of tail
+        rwal = str(rdir / "replica.wal")
+        shutil.copy(path + ".ckpt", rwal + ".ckpt")
+        shutil.copy(path + ".ckpt.sha256", rwal + ".ckpt.sha256")
+        with open(rwal, "wb") as f:
+            f.write(tail[:end])
+        # the reference: full mutation history ⊕ the same prefix, no
+        # checkpoint anywhere — replay from genesis
+        fwal = str(rdir / "full.wal")
+        with open(fwal, "wb") as f:
+            f.write(history + tail[:end])
+        a = state_digest(rwal)
+        b = state_digest(fwal)
+        assert "error" not in a, f"boundary {k}: {a}"
+        assert "error" not in b, f"boundary {k}: {b}"
+        assert a["resource_version"] == b["resource_version"]
+        assert a["sha256"] == b["sha256"], (
+            f"boundary {k}: seeded replay diverged from full-history "
+            f"replay at rv {a['resource_version']}"
+        )
+        report = replica_consistent(rwal, fwal)
+        if end > 0:
+            # the seeded WAL's first byte is mid-history: no shared
+            # bytes, so consistency must come from the state replay arm
+            assert report["mode"] == "state"
+        assert report["consistent"], f"boundary {k}: {report}"
+    assert a["resource_version"] == 30
