@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl bench-readscale chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition chaos-read metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -100,6 +100,17 @@ bench-repl: native
 bench-relist: native
 	JAX_PLATFORMS=cpu python bench.py --only relist
 
+# follower-serving read plane (ISSUE 17, DESIGN.md §29): 1->3 replica
+# list-rate scaling over a real process plane (gated >=1.7x on >=4-core
+# boxes; informational where the replicas share one core), encode-once
+# list caching verified on EVERY serving replica, and read availability
+# across a leader SIGKILL — endpoint-aware min_rv-bounded readers must
+# ride the surviving followers through the election (max read gap
+# BENCH_READSCALE_GAP_S, zero errors, zero rv regressions).  Scale with
+# BENCH_READSCALE_CLIENTS / _PROCS / _OBJECTS / BENCH_READ_FAILOVER_S
+bench-readscale: native
+	JAX_PLATFORMS=cpu BENCH_READSCALE=1 python bench.py --only readscale
+
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.
 # Runs BOTH the tier-1 smoke (1 kill) and the slow soak (≥3 scheduled
@@ -152,6 +163,20 @@ chaos-repl: native
 chaos-partition: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_partition_chaos.py -q
+
+# read-plane chaos (ISSUE 17, DESIGN.md §29): the follower-serving read
+# plane through leader loss.  Runs BOTH the tier-1 half (every replica
+# of a process plane answers rv-bounded reads with the X-Minisched-RV
+# watermark, unsatisfiable bounds typed 504, live watch fanout on a
+# follower façade, and the interleaved-read property: session-monotonic
+# rv + read-your-writes across randomly-chosen replicas under 6-writer
+# load) and the slow soak: ≥200 live watch streams spread across three
+# replicas while writers run through an arbiter partition AND a leader
+# SIGKILL — every stream must resume exactly once (no duplicate rv, no
+# gap, no regression) and every watcher must observe every acked create
+chaos-read: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_read_chaos.py -q
 
 # live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
 # 100 pods to bind, then validate ONLY through the wire — /metrics must

@@ -4239,6 +4239,400 @@ def bench_relist() -> dict:
     }
 
 
+def bench_readscale() -> dict:
+    """``make bench-readscale`` (ISSUE 17, DESIGN.md §29): the
+    follower-serving read plane must BUY capacity, not just redundancy.
+    Opt-in via ``BENCH_READSCALE=1`` — the role boots a 3-replica
+    process plane twice over plus an in-process triple.  Three phases:
+
+    * **scaling storm** — the process plane seeded with
+      BENCH_READSCALE_OBJECTS pods; W keep-alive clients run the same
+      fixed list window twice: every client on the leader alone, then
+      spread across all three replica façades.  Gate: spread rate ≥
+      BENCH_READSCALE_GATE × the single-replica rate (default 1.7×).
+    * **encode-once everywhere** — an IN-PROCESS leader + two served
+      followers (counters are process-global there, so the deltas are
+      visible) absorb a quiet list storm spread across all three
+      façades at one rv.  Gate: every serving replica answered from
+      its own memoized COW payload — ``store.list_cache.encodes``
+      delta between 1 and 2 per replica for hundreds of requests.
+    * **read availability across leader kill** — endpoint-aware
+      readers (min_rv-bounded, session-monotonic rv) list continuously
+      for BENCH_READ_FAILOVER_S while the leader is SIGKILLed
+      mid-window and a writer keeps advancing rv through the failover.
+      Gates: zero read errors, zero rv regressions, and the longest
+      gap between successive successful reads ≤ BENCH_READSCALE_GAP_S
+      (reads must ride the surviving followers THROUGH the election,
+      not wait it out).
+    """
+    import http.client
+    import tempfile
+    import threading
+    import urllib.parse
+    import urllib.request
+
+    from minisched_tpu.api.objects import make_pod
+    from minisched_tpu.controlplane.durable import DurableObjectStore
+    from minisched_tpu.controlplane.httpserver import start_api_server
+    from minisched_tpu.controlplane.remote import RemoteClient, RemoteStore
+    from minisched_tpu.controlplane.repl import ReplRuntime, WalFollower
+    from minisched_tpu.controlplane.replproc import ReplicatedPlane
+    from minisched_tpu.observability import counters
+
+    if os.environ.get("BENCH_READSCALE", "0") == "0":
+        bench_skip("BENCH_READSCALE unset: read-scaling role is opt-in")
+
+    P = int(os.environ.get("BENCH_READSCALE_PROCS", "4"))
+    W = int(os.environ.get("BENCH_READSCALE_CLIENTS", "8"))  # per proc
+    n_obj = int(os.environ.get("BENCH_READSCALE_OBJECTS", "300"))
+    window_s = float(os.environ.get("BENCH_READSCALE_WINDOW_S", "2.0"))
+    gate = float(os.environ.get("BENCH_READSCALE_GATE", "1.7"))
+    fail_s = float(os.environ.get("BENCH_READ_FAILOVER_S", "6.0"))
+    gap_gate_s = float(os.environ.get("BENCH_READSCALE_GAP_S", "2.0"))
+    ttl_s = 1.0
+
+    counters.reset()
+
+    # ---- phase 1+3 topology: the real process plane -------------------
+    tmp = tempfile.mkdtemp(prefix="bench-readscale-")
+
+    # the storm drives from SEPARATE client processes: the replicas are
+    # each their own process, so a single GIL-bound bench client would
+    # measure its own ceiling, not the plane's serving capacity
+    helper = os.path.join(tmp, "_list_storm.py")
+    with open(helper, "w") as f:
+        f.write(
+            "import http.client, sys, threading, time, urllib.parse\n"
+            "urls = sys.argv[1].split(',')\n"
+            "window_s, W, off = float(sys.argv[2]), int(sys.argv[3]), "
+            "int(sys.argv[4])\n"
+            "counts = [0] * W\n"
+            "stop = threading.Event()\n"
+            "errs = []\n"
+            "def client(i):\n"
+            "    u = urllib.parse.urlparse(urls[(off + i) % len(urls)])\n"
+            "    conn = http.client.HTTPConnection(u.hostname, u.port,"
+            " timeout=10)\n"
+            "    try:\n"
+            "        while not stop.is_set():\n"
+            "            conn.request('GET', '/api/v1/pods')\n"
+            "            r = conn.getresponse()\n"
+            "            body = r.read()\n"
+            "            if r.status != 200:\n"
+            "                errs.append('HTTP %d: %r' % (r.status,"
+            " body[:80]))\n"
+            "                return\n"
+            "            counts[i] += 1\n"
+            "    except Exception as e:\n"
+            "        if not stop.is_set():\n"
+            "            errs.append(repr(e))\n"
+            "    finally:\n"
+            "        conn.close()\n"
+            "threads = [threading.Thread(target=client, args=(i,))"
+            " for i in range(W)]\n"
+            "for t in threads:\n"
+            "    t.start()\n"
+            "time.sleep(window_s)\n"
+            "stop.set()\n"
+            "for t in threads:\n"
+            "    t.join(timeout=30)\n"
+            "if errs:\n"
+            "    print(errs[0], file=sys.stderr)\n"
+            "    sys.exit(1)\n"
+            "print(sum(counts))\n"
+        )
+
+    def storm(urls: list, label: str) -> float:
+        """Fixed-window keep-alive list storm: P client processes × W
+        connections each, round-robin across façades; returns lists/s."""
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, helper, ",".join(urls),
+                    str(window_s), str(W), str(k),
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            for k in range(P)
+        ]
+        total = 0
+        for p in procs:
+            out, err = p.communicate(timeout=window_s + 60)
+            if p.returncode != 0:
+                raise SystemExit(
+                    f"[readscale] {label} CLIENT FAILED: "
+                    f"{err.decode(errors='replace')[-200:]}"
+                )
+            total += int(out.strip())
+        rate = total / window_s
+        log(
+            f"[readscale] {label}: {rate:.0f} lists/s "
+            f"({P}x{W} client connections)"
+        )
+        return rate
+
+    plane = ReplicatedPlane(tmp, n=3, fsync=False, ttl_s=ttl_s)
+    try:
+        url = plane.start()
+        client = RemoteClient(url, timeout_s=10.0)
+        for i in range(n_obj):
+            client.pods().create(make_pod(f"seed-{i:04d}"))
+        seed_rv = int(client.store.list_with_rv("Pod")[1])
+        bases = [r.base_url for r in plane.replicas]
+        # every replica must have applied the seed before the storm —
+        # the bounded read IS the convergence probe
+        for b in bases:
+            deadline = time.monotonic() + 15.0
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"{b}/api/v1/pods?min_rv={seed_rv}"
+                    ) as r:
+                        r.read()
+                    break
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    if e.code != 504 or time.monotonic() > deadline:
+                        raise SystemExit(
+                            f"[readscale] {b} never applied rv {seed_rv} "
+                            f"(HTTP {e.code})"
+                        )
+                    time.sleep(0.05)
+
+        leader = plane.leader()
+        leader_base = leader.base_url
+        rate_1 = storm([leader_base], "1-replica storm")
+        rate_3 = storm(bases, "3-replica storm")
+        scaling = rate_3 / rate_1 if rate_1 else 0.0
+        # the scaling gate needs hardware that can EXPRESS scaling: three
+        # server processes plus the client fleet on fewer than 4 cores
+        # all share the same silicon, so wall-clock throughput is pinned
+        # at ~1x no matter how good the read plane is.  Same philosophy
+        # as the TPU-gap skips: a capability gap is not a regression.
+        cores = os.cpu_count() or 1
+        scaling_gated = cores >= 4
+        if scaling_gated and scaling < gate:
+            raise SystemExit(
+                f"[readscale] SCALING UNDER GATE: {rate_3:.0f}/s across 3 "
+                f"replicas vs {rate_1:.0f}/s on 1 = {scaling:.2f}x < "
+                f"{gate}x — followers are not buying read capacity"
+            )
+        if not scaling_gated:
+            log(
+                f"[readscale] scaling gate SKIPPED: {cores} CPU core(s) "
+                f"— replicas share the silicon, wall-clock scaling is "
+                f"bounded at ~1x (measured {scaling:.2f}x, recorded "
+                f"informationally; gate re-arms on >=4 cores)"
+            )
+        else:
+            log(f"[readscale] read scaling 1->3 replicas: {scaling:.2f}x")
+
+        # ---- phase 3: availability across a leader SIGKILL ------------
+        R = int(os.environ.get("BENCH_READSCALE_READERS", "6"))
+        stop_all = threading.Event()
+        rerrs: list = []
+        werrs: list = []
+        done_ts: list = []
+        lats: list = []
+        mu = threading.Lock()
+
+        def reader(i: int) -> None:
+            home = bases[i % len(bases)]
+            rs = RemoteStore(
+                home, endpoints=[b for b in bases if b != home],
+                timeout_s=10.0,
+            )
+            last_rv = 0
+            try:
+                while not stop_all.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        _pods, rv = rs.list_with_rv("Pod")
+                    except Exception as e:
+                        rerrs.append(f"reader {i}: {e!r}")
+                        return
+                    now = time.monotonic()
+                    if rv < last_rv:
+                        rerrs.append(
+                            f"reader {i}: rv regressed {last_rv}->{rv}"
+                        )
+                        return
+                    last_rv = rv
+                    with mu:
+                        done_ts.append(now)
+                        lats.append(now - t0)
+            finally:
+                rs.close()
+
+        def writer() -> None:
+            rs = RemoteStore(bases[1], endpoints=bases, timeout_s=10.0)
+            i = 0
+            acked = 0
+            try:
+                while not stop_all.is_set():
+                    try:
+                        rs.create("Pod", make_pod(f"fo-{i:05d}"))
+                        acked += 1
+                    except Exception:
+                        time.sleep(0.2)  # mid-election: retry fresh
+                    i += 1
+                    time.sleep(0.02)
+            finally:
+                rs.close()
+            if acked == 0:
+                werrs.append("failover writer never acked a write")
+
+        threads = [
+            threading.Thread(target=reader, args=(i,)) for i in range(R)
+        ]
+        wt = threading.Thread(target=writer)
+        log(
+            f"[readscale] failover window: {R} bounded readers, leader "
+            f"SIGKILL at t+{fail_s / 3:.1f}s of {fail_s:.1f}s"
+        )
+        for t in threads:
+            t.start()
+        wt.start()
+        time.sleep(fail_s / 3)
+        victim = plane.leader()
+        t_kill = time.monotonic()
+        victim.kill()
+        plane.wait_for_leader(
+            timeout_s=10 * ttl_s, exclude=victim.replica_id
+        )
+        time.sleep(max(0.0, fail_s - (time.monotonic() - t_kill)))
+        stop_all.set()
+        for t in threads:
+            t.join(timeout=30)
+        wt.join(timeout=30)
+        if rerrs or werrs:
+            raise SystemExit(
+                f"[readscale] FAILOVER WINDOW FAILED: {(rerrs + werrs)[0]}"
+            )
+        done_ts.sort()
+        gaps = [
+            b - a for a, b in zip(done_ts, done_ts[1:])
+            if b >= t_kill  # only gaps that could span the kill matter
+        ]
+        max_gap_s = max(gaps) if gaps else 0.0
+        if max_gap_s > gap_gate_s:
+            raise SystemExit(
+                f"[readscale] READ GAP {max_gap_s:.2f}s ACROSS THE KILL "
+                f"> {gap_gate_s}s — reads waited out the election "
+                f"instead of riding the followers"
+            )
+        lats.sort()
+        read_p99_s = _pct(lats, 0.99, 4)
+        log(
+            f"[readscale] {len(done_ts)} reads through the kill, max "
+            f"gap {max_gap_s:.3f}s, p99 {read_p99_s}s"
+        )
+    finally:
+        plane.stop()
+
+    # ---- phase 2: encode-once on EVERY serving replica (in-process,
+    # where the counters of all three stores share one registry) -------
+    tmp2 = tempfile.mkdtemp(prefix="bench-readscale-inproc-")
+    leader = DurableObjectStore(os.path.join(tmp2, "l.wal"), fsync=False)
+    if leader.read_plane() is None:
+        leader.close()
+        bench_skip(
+            "MINISCHED_COW_READS=0: readscale benches the COW read plane"
+        )
+    runtime = ReplRuntime(leader, "r0", peers=[], cluster_size=3)
+    runtime.promote()
+    _srv, lurl, lshutdown = start_api_server(leader, port=0, repl=runtime)
+    followers = []
+    for i in range(2):
+        fid = f"r{i + 1}"
+        fstore = DurableObjectStore(
+            os.path.join(tmp2, f"{fid}.wal"), fsync=False
+        )
+        fstore.fence("r0")
+        tail = WalFollower(fstore, lurl, fid)
+        tail.start()
+        _fs, furl, fshutdown = start_api_server(fstore, port=0)
+        followers.append((fstore, tail, furl, fshutdown))
+    try:
+        for i in range(n_obj):
+            leader.create("Pod", make_pod(f"enc-{i:04d}"))
+        want = leader.resource_version
+        deadline = time.monotonic() + 15.0
+        while any(f[0].resource_version < want for f in followers):
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    "[readscale] in-process followers never converged"
+                )
+            time.sleep(0.02)
+        urls = [lurl] + [f[2] for f in followers]
+        enc0 = counters.get("store.list_cache.encodes")
+        req0 = counters.get("wire.relist_requests")
+        per_url = 60
+
+        def lister(u: str) -> None:
+            for _ in range(per_url):
+                with urllib.request.urlopen(f"{u}/api/v1/pods") as r:
+                    r.read()
+
+        lthreads = [
+            threading.Thread(target=lister, args=(u,))
+            for u in urls for _ in range(3)
+        ]
+        for t in lthreads:
+            t.start()
+        for t in lthreads:
+            t.join(timeout=60)
+        encodes = counters.get("store.list_cache.encodes") - enc0
+        requests = counters.get("wire.relist_requests") - req0
+        if requests < 3 * 3 * per_url:
+            raise SystemExit(
+                f"[readscale] encode-once storm too quiet: {requests} "
+                f"list requests"
+            )
+        if not (3 <= encodes <= 6):
+            raise SystemExit(
+                f"[readscale] ENCODE-ONCE BROKEN ON A REPLICA: {encodes} "
+                f"encodes for {requests} quiet lists across 3 façades "
+                f"(want one per replica, ≤2 with benign races)"
+            )
+        log(
+            f"[readscale] encode-once everywhere: {encodes} encodes for "
+            f"{requests} lists across 3 serving replicas"
+        )
+    finally:
+        for _fs, _tail, _furl, fshutdown in followers:
+            fshutdown()
+        lshutdown()
+        for fstore, tail, _furl, _sd in followers:
+            tail.stop()
+        for fstore, tail, _furl, _sd in followers:
+            tail.join(timeout=5.0)
+            fstore.close()
+        runtime.close()
+        leader.close()
+
+    return {
+        "clients": W,
+        "objects": n_obj,
+        "window_s": window_s,
+        "rate_1_replica_s": round(rate_1, 1),
+        "rate_3_replicas_s": round(rate_3, 1),
+        "read_scaling_x": round(scaling, 2),
+        "scaling_gate_x": gate,
+        "scaling_gated": scaling_gated,
+        "cpu_cores": cores,
+        "failover_reads": len(done_ts),
+        "failover_read_p99_s": read_p99_s,
+        "failover_max_gap_s": round(max_gap_s, 3),
+        "gap_gate_s": gap_gate_s,
+        "read_failovers": counters.get("remote.read_failover"),
+        "not_yet_observed": counters.get("remote.not_yet_observed"),
+        "leader_discoveries": counters.get("remote.leader_discoveries"),
+        "encode_once_encodes": encodes,
+        "encode_once_requests": requests,
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
@@ -4255,6 +4649,7 @@ ROLES = {
     "gang": bench_gang,
     "churn": bench_churn,
     "relist": bench_relist,
+    "readscale": bench_readscale,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -4405,6 +4800,11 @@ def main() -> None:
         # mutate p50/p99 tax vs the MINISCHED_REPL=0 kill-switch, plus
         # zero-acked-loss + byte-identical-follower audits
         optional.append(("repl_plane", "repl", None, "repl"))
+    if os.environ.get("BENCH_READSCALE", "0") != "0":
+        # follower-serving read plane (ISSUE 17, opt-in): 1->3 replica
+        # list-rate scaling gate, encode-once on every serving replica,
+        # and read availability across a leader SIGKILL
+        optional.append(("read_scaling", "readscale", None, "readscale"))
     if os.environ.get("BENCH_MESH", "1") != "0":
         # multi-chip live wave engine (ISSUE 7): sharded vs single-device
         # on the same workload, parity-pinned, device_total_s gated.

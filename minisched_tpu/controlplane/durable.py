@@ -1471,14 +1471,24 @@ class DurableObjectStore(ObjectStore):
             with open(path, "rb+") as f:
                 f.truncate(good_end)
 
-    def _apply(self, rec: dict) -> None:
+    def _apply(
+        self, rec: dict, collect: Optional[list] = None
+    ) -> None:
         """Apply one WAL record; also rebuilds the watch-resume history
         ring (replay = the tail of the live event stream).  Records at or
         below the checkpoint's rv are SKIPPED: they are already folded
         into the snapshot, and re-applying a pre-snapshot put would
         resurrect an object a later (also pre-snapshot) delete removed —
         the crash-between-checkpoint-and-truncate window makes such
-        overlap possible."""
+        overlap possible.
+
+        ``collect`` switches the event sink: recovery replay (None)
+        records straight into the history ring — no watcher can exist
+        yet; the replicated-apply path passes a list and gets
+        ``(kind, WatchEvent)`` pairs back instead, so apply_replicated
+        can run the FULL ``_fanout_many`` (history + live watcher
+        delivery) per kind — a follower's watch streams see replicated
+        mutations exactly as a leader's see local ones."""
         op = rec["op"]
         if op == "rv":
             self._rv = max(self._rv, rec["rv"])
@@ -1507,13 +1517,14 @@ class DurableObjectStore(ObjectStore):
             old = objs.get(key)
             objs[key] = obj
             self._rv = max(self._rv, rv)
-            self._record_history(
-                kind,
-                WatchEvent(
-                    EventType.MODIFIED if old is not None else EventType.ADDED,
-                    obj, old, rv=rv,
-                ),
+            event = WatchEvent(
+                EventType.MODIFIED if old is not None else EventType.ADDED,
+                obj, old, rv=rv,
             )
+            if collect is not None:
+                collect.append((kind, event))
+            else:
+                self._record_history(kind, event)
         elif op == "del":
             rv = rec.get("rv", 0)
             if rv and rv <= self._ckpt_rv:
@@ -1521,9 +1532,11 @@ class DurableObjectStore(ObjectStore):
             old = self._objects.get(kind, {}).pop(rec["key"], None)
             self._rv = max(self._rv, rv)
             if old is not None:
-                self._record_history(
-                    kind, WatchEvent(EventType.DELETED, old, rv=rv)
-                )
+                event = WatchEvent(EventType.DELETED, old, rv=rv)
+                if collect is not None:
+                    collect.append((kind, event))
+                else:
+                    self._record_history(kind, event)
 
     # -- compaction --------------------------------------------------------
     def compact(self) -> None:
@@ -1931,11 +1944,23 @@ class DurableObjectStore(ObjectStore):
                         f"replicated WAL append failed: {e}"
                     ) from e
                 kinds = set()
+                collected: list = []
                 for rec in recs:
-                    self._apply(rec)
+                    self._apply(rec, collect=collected)
                     if rec.get("op") in ("put", "del"):
                         kinds.add(rec.get("kind"))
                 self._gc_visible_rv = max(self._gc_visible_rv, self._rv)
+                # fan the group's events into LIVE watcher queues (and
+                # the history ring) exactly as the leader's publish path
+                # does — follower-attached watch streams observe
+                # replicated mutations, not just future resumes.  One
+                # _fanout_many per kind preserves intra-kind order and
+                # batches the per-watcher delivery.
+                by_kind: dict = {}
+                for k, ev in collected:
+                    by_kind.setdefault(k, []).append(ev)
+                for k, events in by_kind.items():
+                    self._fanout_many(k, events)
                 self._cow_publish({k for k in kinds if k})
                 if self._recovered_uid_max:
                     # uids in replicated puts were ISSUED by the leader;

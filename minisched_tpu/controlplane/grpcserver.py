@@ -296,6 +296,23 @@ def _handlers(store: Any = None):
                 kind = request.get("kind", "")
                 if kind not in KIND_TYPES:
                     raise ValueError(f"unknown kind {kind!r}")
+                # rv-bounded read, same contract as the REST façade's
+                # ?min_rv= (DESIGN.md §29): a bound past this replica's
+                # applied rv is refused RETRYABLY (UNAVAILABLE, the
+                # gRPC analog of the 504), never answered stale
+                min_rv = int(request.get("min_rv", 0) or 0)
+                if min_rv > 0:
+                    counters.inc("wire.read.bounded_requests")
+                    applied = int(
+                        getattr(store, "applied_rv", lambda: 0)() or 0
+                    )
+                    if min_rv > applied:
+                        counters.inc("wire.read.not_yet_observed")
+                        context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            f"resource_version {min_rv} not yet observed "
+                            f"by this replica (applied {applied})",
+                        )
                 return cache.list_bytes(
                     kind, str(request.get("namespace", ""))
                 )

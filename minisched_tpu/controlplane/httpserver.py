@@ -46,6 +46,7 @@ from minisched_tpu.controlplane.store import (
     Conflict,
     HistoryCompacted,
     NotLeader,
+    NotYetObserved,
     ObjectStore,
     StorageDegraded,
 )
@@ -257,11 +258,20 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         return False
 
-    def _send(self, code: int, payload: Any) -> None:
+    def _send(
+        self, code: int, payload: Any, rv: Optional[int] = None
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if rv is not None:
+            # the rv watermark this response's state reflects — the
+            # read plane's freshness stamp (DESIGN.md §29): a client
+            # reading across replicas advances its session rv from it
+            # and bounds later reads with ?min_rv= so reads never go
+            # backwards across an endpoint switch
+            self.send_header("X-Minisched-RV", str(rv))
         self.end_headers()
         self.wfile.write(body)
 
@@ -364,9 +374,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._watch(kind, ns, resume_rv)
             return
         try:
+            min_rv = self._int_param(query, "min_rv")
+        except ValueError:
+            return  # 400 already sent
+        # the rv watermark of the state this replica serves RIGHT NOW,
+        # taken before the read: the stamp promises "at least this
+        # fresh", and only-forward rv movement keeps that true even if
+        # a publish lands mid-read
+        applied = self.store.applied_rv()
+        if min_rv is not None:
+            from minisched_tpu.observability import counters
+
+            counters.inc("wire.read.bounded_requests")
+            if min_rv > applied:
+                # rv-bounded read ahead of this replica's applied state:
+                # refuse RETRYABLY (504) rather than serve silently
+                # stale data — the client waits out the replication lag
+                # or fails over to a fresher replica (DESIGN.md §29)
+                counters.inc("wire.read.not_yet_observed")
+                self._send(
+                    504,
+                    {
+                        "error": (
+                            f"resource_version {min_rv} not yet observed "
+                            f"by this replica (applied {applied})"
+                        )
+                    },
+                    rv=applied,
+                )
+                return
+        try:
             if name:
                 obj = self.store.get(kind, ns, name)
-                self._send(200, _encode(obj))
+                self._send(200, _encode(obj), rv=applied)
             else:
                 self._list(kind, ns)
         except KeyError as e:
@@ -407,7 +447,7 @@ class _Handler(BaseHTTPRequestHandler):
 
                 body = snap.list_body(kind, ns, build)
                 counters.inc("wire.relist_bytes_shared", len(body))
-                self._send_shared_body(200, body)
+                self._send_shared_body(200, body, rv=snap.rv)
             else:
                 # the rv is taken ATOMICALLY with the snapshot (one
                 # store lock hold) so consumers deriving versioned
@@ -421,13 +461,16 @@ class _Handler(BaseHTTPRequestHandler):
                         "items": [_encode(o) for o in items],
                         "resource_version": rv,
                     },
+                    rv=rv,
                 )
         finally:
             hist.observe(
                 "http.list_s", time.monotonic() - t0, kind=kind.lower()
             )
 
-    def _send_shared_body(self, code: int, body: bytes) -> None:
+    def _send_shared_body(
+        self, code: int, body: bytes, rv: Optional[int] = None
+    ) -> None:
         """Stream shared cached bytes chunked WITHOUT copying the whole
         payload per response — memoryview slices of the one cached body
         go straight to the socket.  ``http.client`` dechunks
@@ -436,6 +479,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
+        if rv is not None:
+            self.send_header("X-Minisched-RV", str(rv))  # see _send
         self.end_headers()
         mv = memoryview(body)
         for off in range(0, len(mv), _LIST_CHUNK_BYTES):
@@ -466,6 +511,15 @@ class _Handler(BaseHTTPRequestHandler):
                 resume_rv=resume_rv,
                 clone_snapshot=False,
             )
+        except NotYetObserved as e:
+            # follower lagging behind the resume cursor: retryable 504,
+            # NOT the relist-forcing 410 (the client's cache is fine —
+            # this replica just hasn't applied that far yet)
+            from minisched_tpu.observability import counters
+
+            counters.inc("wire.read.not_yet_observed")
+            self._error(504, str(e))
+            return
         except HistoryCompacted as e:
             self._error(410, str(e))
             return
@@ -1094,6 +1148,11 @@ class HTTPClient:
         if status == 507:
             # == in-process WAL refusal
             raise self._mark(StorageDegraded(body), replayed)
+        if status == 504 and "not yet observed" in body:
+            # == in-process rv-bounded read refusal (DESIGN.md §29):
+            # typed so the caller retries / fails over instead of
+            # treating a lagging follower as a hard error
+            raise self._mark(NotYetObserved(body), replayed)
         raise RuntimeError(f"HTTP {status}: {body}")
 
     @staticmethod

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from minisched_tpu.controlplane.store import (
     EventType,
     HistoryCompacted,
+    NotYetObserved,
     ObjectStore,
     WatchEvent,
 )
@@ -183,6 +184,17 @@ class Informer:
                         self._relist_jitter()
                         resume_rv = None
                         continue
+                    except NotYetObserved:
+                        # a lagging replica has not applied our cursor
+                        # yet (DESIGN.md §29): the cache is FINE — keep
+                        # the resume_rv, wait out the replication lag
+                        # (or an endpoint-aware store's next rotation)
+                        # with a short bounded backoff.  Relisting here
+                        # would throw away a valid cache for nothing.
+                        counters.inc("informer.resume_not_yet_observed")
+                        self._stop.wait(backoff)
+                        backoff = min(backoff * 2, 2.0)
+                        continue
                 else:
                     watch, payload, mode = self._open_relist()
             except Exception as err:
@@ -323,6 +335,15 @@ class Informer:
                     if ev.rv > self._last_rv:
                         # the resume cursor: what a reconnect replays from
                         self._last_rv = ev.rv
+            # feed the cursor into an endpoint-aware store's session
+            # floor (DESIGN.md §29): a relist after failover is then
+            # min_rv-bounded at what this stream already delivered, so
+            # the cache can never be rebuilt from an older replica
+            observe = getattr(self._store, "observe_rv", None)
+            if observe is not None:
+                observe(self._last_rv)
+            with self._lock:
+                for ev in batch:
                     key = ev.obj.metadata.key
                     if self._replay_pending > 0:
                         self._replay_pending -= 1

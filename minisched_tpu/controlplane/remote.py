@@ -56,6 +56,7 @@ from minisched_tpu.controlplane.store import (
     EventType,
     HistoryCompacted,
     NotLeader,
+    NotYetObserved,
     StorageDegraded,
     WatchEvent,
 )
@@ -119,6 +120,11 @@ class RemoteWatch:
                 # resume asked for compacted history: the caller must
                 # relist (HistoryCompacted == the in-process store's)
                 raise HistoryCompacted(body)
+            if self._resp.status == 504 and "not yet observed" in body:
+                # a lagging FOLLOWER has not applied the resume cursor
+                # yet: retryable — the caller re-opens here later or on
+                # a fresher replica; relisting would be wasted work
+                raise NotYetObserved(body)
             raise RuntimeError(f"HTTP {self._resp.status}: {body}")
         self._thread = threading.Thread(
             target=self._read, name=f"remote-watch-{kind}", daemon=True
@@ -268,6 +274,7 @@ class RemoteStore:
         faults: Any = None,
         watch_read_timeout_s: float = 3600.0,
         pool_max_idle: int = DEFAULT_MAX_IDLE,
+        endpoints: Optional[List[str]] = None,
     ):
         self._base = base_url.rstrip("/")
         self._timeout_s = timeout_s
@@ -292,6 +299,161 @@ class RemoteStore:
         self._pool = shared_pool(
             self._base, max_idle=pool_max_idle, timeout_s=timeout_s
         )
+        # -- multi-endpoint read policy (DESIGN.md §29) -------------------
+        # ``endpoints`` lists every replica façade of one replicated
+        # plane.  With two or more, this store becomes endpoint-aware:
+        # reads round-robin-failover across replicas carrying a
+        # ``min_rv`` bound at the session rv (monotonic reads + read-
+        # your-writes across endpoint switches), writes are routed to
+        # the leader discovered via ``/repl/status``, and a dead or
+        # fenced or lagging endpoint rotates instead of erroring.  With
+        # one endpoint every path below is byte-identical to before.
+        bases = [self._base]
+        for e in endpoints or []:
+            e = e.rstrip("/")
+            if e not in bases:
+                bases.append(e)
+        self._endpoints = bases
+        self._multi = len(bases) > 1
+        self._pools = {self._base: self._pool}
+        for b in bases[1:]:
+            self._pools[b] = shared_pool(
+                b, max_idle=pool_max_idle, timeout_s=timeout_s
+            )
+        self._ep_mu = threading.Lock()
+        #: highest rv this SESSION has observed (response bodies: list
+        #: rvs, object rvs on writes) — the monotonic floor every
+        #: endpoint-routed read is bounded by
+        self._session_rv = 0
+        self._read_base = self._base
+        self._leader_base: Optional[str] = None if self._multi else self._base
+
+    # -- endpoint routing ---------------------------------------------------
+    @property
+    def session_rv(self) -> int:
+        with self._ep_mu:
+            return self._session_rv
+
+    def observe_rv(self, rv: int) -> None:
+        """Advance the session rv floor (never backwards).  Called from
+        response decoding and by consumers that learn an rv out-of-band
+        (an informer's delivered watch events)."""
+        if rv <= 0:
+            return
+        with self._ep_mu:
+            if rv > self._session_rv:
+                self._session_rv = rv
+
+    def _advance_from(self, out: Any) -> None:
+        """Harvest rv watermarks from a decoded response body: list
+        envelopes carry ``resource_version``, single objects carry
+        ``metadata.resource_version``, batch responses carry them per
+        item — an acked write advances the floor so the next read
+        (wherever routed) must observe it (read-your-writes)."""
+        if not isinstance(out, dict):
+            return
+        rv = out.get("resource_version")
+        if rv is None:
+            md = out.get("metadata")
+            if isinstance(md, dict):
+                rv = md.get("resource_version")
+        best = int(rv or 0)
+        items = out.get("items")
+        if isinstance(items, list):
+            for item in items:
+                if not isinstance(item, dict):
+                    continue
+                obj = item if "metadata" in item else item.get("object")
+                if isinstance(obj, dict):
+                    md = obj.get("metadata")
+                    if isinstance(md, dict):
+                        best = max(best, int(md.get("resource_version") or 0))
+        self.observe_rv(best)
+
+    def _rotate_read(self, failed: str) -> None:
+        """Move the read cursor off a failed/lagging endpoint (no-op if
+        another thread already rotated past it)."""
+        with self._ep_mu:
+            if self._read_base == failed and self._multi:
+                i = self._endpoints.index(failed)
+                self._read_base = self._endpoints[
+                    (i + 1) % len(self._endpoints)
+                ]
+                counters.inc("remote.read_failover")
+
+    def _invalidate_leader(self, failed: str) -> None:
+        with self._ep_mu:
+            if self._leader_base == failed and self._multi:
+                self._leader_base = None
+
+    def _discover_leader(self) -> Optional[str]:
+        """Probe every endpoint's ``/repl/status`` and return the base
+        URL of the replica that currently leads.  A 404 means the plane
+        is not replicated — that sole server IS the leader.  When no
+        replica claims the role (mid-election), the fenced replicas'
+        ``leader_hint`` is followed if it names a probed peer; else
+        None, and the caller's backoff loop re-discovers."""
+        statuses: dict = {}
+        for base in self._endpoints:
+            try:
+                st, raw, _ = self._pools[base].request(
+                    "GET", "/repl/status"
+                )
+            except _TRANSIENT_ERRORS:
+                continue
+            if st == 404:
+                counters.inc("remote.leader_discoveries")
+                return base
+            if st != 200:
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            statuses[base] = doc
+            if doc.get("role") == "leader" and not doc.get("fenced"):
+                counters.inc("remote.leader_discoveries")
+                return base
+        by_id = {d.get("replica"): b for b, d in statuses.items()}
+        for doc in statuses.values():
+            hint = doc.get("leader_hint") or doc.get("leader") or ""
+            if hint in by_id:
+                counters.inc("remote.leader_discoveries")
+                return by_id[hint]
+        return None
+
+    def _route(
+        self, is_read: bool, path: str
+    ) -> Tuple[HTTPConnectionPool, str, str]:
+        """(pool, base, wire path) for one attempt.  Reads ride the
+        current read endpoint with the session-rv ``min_rv`` bound
+        appended; writes ride the discovered leader.  Raises OSError
+        (transient — the retry loop backs off) when no leader is
+        discoverable mid-election."""
+        if not self._multi:
+            return self._pool, self._base, path
+        if path.startswith("/repl/") or path.startswith("/net/"):
+            return self._pool, self._base, path
+        if is_read:
+            with self._ep_mu:
+                base = self._read_base
+                rv = self._session_rv
+            wire = path
+            if rv > 0:
+                wire += ("&" if "?" in wire else "?") + f"min_rv={rv}"
+            return self._pools[base], base, wire
+        with self._ep_mu:
+            base = self._leader_base
+        if base is None:
+            base = self._discover_leader()
+            if base is None:
+                raise OSError(
+                    "no leader discoverable among "
+                    f"{len(self._endpoints)} endpoints"
+                )
+            with self._ep_mu:
+                self._leader_base = base
+        return self._pools[base], base, path
 
     # -- plumbing -----------------------------------------------------------
     def _path(self, kind: str, namespace: str = "", name: str = "") -> str:
@@ -320,9 +482,15 @@ class RemoteStore:
             self._rng,
         )
         last_err: Optional[BaseException] = None
+        is_read = method == "GET"
         for attempt in range(self._retries + 1):
             status = None
+            base: Optional[str] = None
             try:
+                # endpoint routing happens PER ATTEMPT: a rotation or a
+                # leader re-discovery between attempts re-routes the
+                # retry instead of hammering the same dead replica
+                pool, base, wire_path = self._route(is_read, path)
                 if self._faults is not None:
                     self._faults.check("remote.request", path)
                 # pooled keep-alive transport: reuses an idle socket when
@@ -332,14 +500,23 @@ class RemoteStore:
                 # attempts bind_many_remote's idempotency dedup reasons
                 # about (the first wire attempt may have committed
                 # before the socket died)
-                status, raw, replayed = self._pool.request(
-                    method, path, body=data
+                status, raw, replayed = pool.request(
+                    method, wire_path, body=data
                 )
             except _TRANSIENT_ERRORS as e:
                 last_err = e
+                if self._multi and base is not None:
+                    # a dead endpoint fails over instead of burning the
+                    # whole backoff budget against one corpse
+                    if is_read:
+                        self._rotate_read(base)
+                    else:
+                        self._invalidate_leader(base)
             if status is not None:
                 if status < 400:
-                    return json.loads(raw), attempt + (1 if replayed else 0)
+                    out = json.loads(raw)
+                    self._advance_from(out)
+                    return out, attempt + (1 if replayed else 0)
                 body = raw.decode(errors="replace")
                 if status == 409 and "already bound" in body:
                     raise AlreadyBound(body)
@@ -353,12 +530,25 @@ class RemoteStore:
                     raise KeyError(body)
                 if status == 503 and "not leader" in body:
                     # fenced replica (DESIGN.md §27): retrying HERE can
-                    # never succeed — the typed error surfaces
-                    # immediately so the caller re-discovers the plane's
-                    # leader instead of burning its backoff budget
+                    # never succeed.  Single-endpoint callers get the
+                    # typed error immediately and re-discover themselves;
+                    # an endpoint-aware store drops its cached leader and
+                    # lets the next attempt re-route via /repl/status
                     counters.inc("storage.repl.not_leader_errors")
-                    raise NotLeader(body)
-                if status == 507:
+                    if not self._multi:
+                        raise NotLeader(body)
+                    self._invalidate_leader(base or "")
+                    last_err = NotLeader(body)
+                elif status == 504 and "not yet observed" in body:
+                    # rv-bounded read ahead of this replica's applied rv
+                    # (DESIGN.md §29): retryable by contract — rotate to
+                    # a (hopefully fresher) replica and back off; the
+                    # write we are bound by IS acked and will arrive
+                    counters.inc("remote.not_yet_observed")
+                    if self._multi and base is not None:
+                        self._rotate_read(base)
+                    last_err = NotYetObserved(body)
+                elif status == 507:
                     # Insufficient Storage: the server's WAL is degraded
                     # (ENOSPC/EIO latch).  In the backoff set on purpose —
                     # the store probes its own recovery, so a later retry
@@ -377,6 +567,16 @@ class RemoteStore:
         if isinstance(last_err, StorageDegraded):
             raise StorageDegraded(
                 f"remote {method} {path} still degraded after "
+                f"{self._retries + 1} attempts: {last_err}"
+            )
+        if isinstance(last_err, NotYetObserved):
+            raise NotYetObserved(
+                f"remote {method} {path} still unobserved after "
+                f"{self._retries + 1} attempts: {last_err}"
+            )
+        if isinstance(last_err, NotLeader):
+            raise NotLeader(
+                f"remote {method} {path} found no writable leader after "
                 f"{self._retries + 1} attempts: {last_err}"
             )
         raise RuntimeError(
@@ -403,15 +603,40 @@ class RemoteStore:
         ``resume_rv``: resume from that resource_version instead of a
         full snapshot replay (``?resource_version=N`` on the wire) —
         SYNC count 0, history events stream in as live events.  Raises
-        HistoryCompacted (the server's 410) when the tail is gone."""
+        HistoryCompacted (the server's 410) when the tail is gone.
+
+        Endpoint-aware stores open the stream on the current READ
+        endpoint and fail over across replicas on connect failure or a
+        lagging follower's NotYetObserved — combined with the server's
+        exact rv>resume_rv replay, a consumer that resumes at its last
+        delivered rv gets every event exactly once no matter which
+        replica ends up serving the stream (DESIGN.md §29)."""
         path = f"{self._path(kind)}?watch=true"
         if resume_rv is not None:
             path += f"&resource_version={int(resume_rv)}"
-        w = RemoteWatch(
-            self._pool, path, kind,
-            read_timeout_s=self._watch_read_timeout_s,
+        if not self._multi:
+            w = RemoteWatch(
+                self._pool, path, kind,
+                read_timeout_s=self._watch_read_timeout_s,
+            )
+            return w, [None] * w.initial_count()
+        last: Optional[BaseException] = None
+        for _ in range(len(self._endpoints)):
+            with self._ep_mu:
+                base = self._read_base
+            try:
+                w = RemoteWatch(
+                    self._pools[base], path, kind,
+                    read_timeout_s=self._watch_read_timeout_s,
+                )
+                return w, [None] * w.initial_count()
+            except (NotYetObserved,) + _TRANSIENT_ERRORS as e:
+                last = e
+                counters.inc("remote.watch_failover")
+                self._rotate_read(base)
+        raise last if last is not None else RuntimeError(
+            f"watch {kind} open failed on every endpoint"
         )
-        return w, [None] * w.initial_count()
 
     def list(self, kind: str) -> List[Any]:
         typ = _kind_types()[kind]
@@ -531,9 +756,10 @@ class RemoteStore:
         self._req("DELETE", self._path(kind, namespace, name))
 
     def close(self) -> None:
-        """Drop the pool's idle keep-alive sockets (open watch streams
+        """Drop the pools' idle keep-alive sockets (open watch streams
         own their connections and are unaffected)."""
-        self._pool.close()
+        for pool in self._pools.values():
+            pool.close()
 
     def bind_many_remote(
         self, bindings: List[Binding], return_objects: bool = True

@@ -525,7 +525,24 @@ class WalFollower(threading.Thread):
             # log wins
             self._reseed(status, "local WAL ahead of leader durable end")
         self._epoch = int(status.get("epoch", 0))
+        self._leader_rv = int(status.get("rv", 0) or 0)
+        self._note_apply_lag()
         self.leader_seen.set()
+
+    def _note_apply_lag(self) -> None:
+        """Gauge how far this replica's applied rv trails the leader's
+        last OBSERVED rv (floored at 0 — the observation may be stale
+        while groups stream in).  Updated at status sync and after every
+        applied group, so observability can alarm on a replica that
+        stops keeping up and clients can see the lag decay during
+        catch-up."""
+        local = int(
+            getattr(self._store, "applied_rv", lambda: 0)() or 0
+        )
+        counters.set_gauge(
+            "storage.repl.apply_lag_rv",
+            max(0, getattr(self, "_leader_rv", 0) - local),
+        )
 
     def _tail_once(self) -> None:
         import http.client
@@ -591,6 +608,7 @@ class WalFollower(threading.Thread):
                 hist.observe(
                     "storage.repl_apply_s", time.monotonic() - t0
                 )
+                self._note_apply_lag()
                 self._maybe_gossip()
         finally:
             conn.close()
@@ -981,11 +999,21 @@ class ReplRuntime:
 
     def status(self) -> dict:
         hub = self.hub
+        applied = int(getattr(self.store, "applied_rv", self.store_rv)())
         return {
             "replica": self.replica_id,
             "role": self.role,
             "leader": self.leader_id,
             "rv": self.store_rv(),
+            # the rv this replica's READ plane serves right now — the
+            # freshness stamp clients use to pick a follower and the
+            # bound NotYetObserved is judged against (DESIGN.md §29)
+            "applied_rv": applied,
+            # best routing hint for writes: the leader we tail (or are);
+            # "" when between leaders — the client probes other replicas
+            "leader_hint": (
+                self.replica_id if self.role == "leader" else self.leader_id
+            ),
             "epoch": hub.epoch if hub is not None else self._epoch_seen,
             "durable_end": (
                 hub.durable_end if hub is not None else self.store.wal_end()

@@ -74,6 +74,19 @@ class StorageDegraded(Exception):
     freed, IO error cleared)."""
 
 
+class NotYetObserved(Exception):
+    """An rv-bounded read (``min_rv=N`` on get/list, or a watch resume
+    at rv N) reached a FOLLOWER whose applied rv is still below the
+    bound: the replica is healthy but lagging the leader's commit
+    stream, and serving the request now would be a silently stale read.
+    On the wire it is HTTP 504 with a ``not yet observed`` marker —
+    RETRYABLE, unlike HistoryCompacted's 410: the client waits out the
+    replication lag or fails over to a fresher replica; a relist would
+    be wasted work.  Only ever raised by a fenced replica — the same
+    condition on a leader means the client observed versions a crash
+    rolled back, which stays a 410 (DESIGN.md §29)."""
+
+
 @dataclass
 class WatchEvent:
     type: EventType
@@ -1028,6 +1041,24 @@ class ObjectStore:
         with self._lock:
             return self._rv
 
+    def is_fenced(self) -> bool:
+        """True when this store refuses writes because it follows a
+        leader's replicated stream (DurableObjectStore.fence overrides).
+        The base in-memory store always leads itself."""
+        return False
+
+    def applied_rv(self) -> int:
+        """The rv watermark of the state this store would SERVE right
+        now — the read plane's freshness stamp (`X-Minisched-RV`).  COW
+        mode reads it lock-free off the published snapshot (maps and rv
+        are atomic by construction); the kill-switch path falls back to
+        the visible rv under the lock."""
+        snap = self._snap
+        if snap is not None:
+            return snap.rv
+        with self._lock:
+            return self._visible_rv()
+
     def locked(self):
         """The store's RLock as a context manager — for multi-call
         operations that need one consistent view (checkpoint snapshots)."""
@@ -1106,6 +1137,17 @@ class ObjectStore:
                         f"for {kind} (floor {floor})"
                     )
                 if resume_rv > self._rv:
+                    if self.is_fenced():
+                        # a FOLLOWER that has not yet applied the group
+                        # carrying resume_rv: the consumer is not wrong,
+                        # this replica is just behind the commit stream.
+                        # Retryable — the client waits out the lag or
+                        # resumes on a fresher replica (DESIGN.md §29).
+                        raise NotYetObserved(
+                            f"resource_version {resume_rv} not yet "
+                            f"observed by this replica (applied "
+                            f"{self._rv})"
+                        )
                     # the consumer is AHEAD of this server: it observed
                     # versions a crash rolled back (fanout raced the WAL
                     # flush, or fsync=False lost the tail).  Honoring the

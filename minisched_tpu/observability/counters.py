@@ -316,6 +316,50 @@ chaos-repl soak's replication evidence:
           compactions a leading replica used to skip while a hub was
           attached; kept registered so old dashboards read zero
           instead of breaking
+    storage.repl.apply_lag_rv  (gauge)
+        — how many rv this follower's applied state trails the leader's
+          advertised rv (refreshed on every applied group and on each
+          epoch sync; 0 = caught up).  The freshness number behind the
+          ``applied_rv`` field /repl/status reports and the bound
+          NotYetObserved answers are judged against (DESIGN.md §29)
+
+The follower-serving read plane (ISSUE 17, DESIGN.md §29: rv-bounded
+reads off any replica, watch fanout on followers, the endpoint-aware
+client) records under ``wire.read.`` / ``remote.`` — the chaos-read
+soak's and the readscale bench's evidence:
+
+    wire.read.bounded_requests
+        — GET/LIST requests that carried a ``min_rv`` freshness bound
+          (REST query param or gRPC List field); every read answer also
+          stamps its ``X-Minisched-RV`` watermark, bounded or not
+    wire.read.not_yet_observed
+        — bounded reads and watch resumes this replica REFUSED typed
+          (HTTP 504 / gRPC UNAVAILABLE, ``not yet observed``) because
+          its applied rv still trailed the bound: the retryable lag
+          signal, never a silently stale 200 — distinct from
+          HistoryCompacted's 410, which means relist
+    remote.read_failover
+        — endpoint-aware reads rotated off a dead, fenced, or lagging
+          replica onto the next endpoint (the read cursor moved; the
+          request itself is then retried on the new façade)
+    remote.not_yet_observed
+        — 504 lag answers the endpoint-aware client absorbed (each
+          rotates the read cursor in multi-endpoint mode and consumes
+          one backoff slot; single-endpoint stores raise typed)
+    remote.watch_failover
+        — watch streams re-opened on a rotated replica after the
+          serving endpoint died or lagged the resume cursor; combined
+          with the server's exact rv>resume replay this is the
+          exactly-once failover the chaos-read soak audits
+    remote.leader_discoveries
+        — leader lookups resolved by probing ``/repl/status`` across
+          the endpoint list (writes route to the discovered leader;
+          invalidated on NotLeader/transport failure and re-discovered)
+    informer.resume_not_yet_observed
+        — informer watch re-opens answered "not yet observed" by a
+          lagging replica: the informer KEEPS its resume cursor and
+          backs off (the cache is intact — waiting out lag is cheaper
+          than a relist), unlike the 410 path which must relist
 
 The network-fault layer (faults/net.py — the partition nemesis) records
 under ``net.partition.``, the chaos-partition soak's injection evidence:

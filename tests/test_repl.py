@@ -20,11 +20,13 @@ test_repl_chaos.py; partition faults live in test_partition_chaos.py.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import shutil
 import threading
 import time
+import urllib.parse
 
 import pytest
 
@@ -37,7 +39,7 @@ from minisched_tpu.controlplane.fsck import (
     wal_digests,
 )
 from minisched_tpu.controlplane.httpserver import start_api_server
-from minisched_tpu.controlplane.remote import RemoteClient
+from minisched_tpu.controlplane.remote import RemoteClient, RemoteStore
 from minisched_tpu.controlplane.repl import (
     PeerSpec,
     ReplicationHub,
@@ -45,7 +47,10 @@ from minisched_tpu.controlplane.repl import (
     WalFollower,
 )
 from minisched_tpu.controlplane.store import (
+    EventType,
+    HistoryCompacted,
     NotLeader,
+    NotYetObserved,
     ObjectStore,
     StorageDegraded,
 )
@@ -687,3 +692,294 @@ def test_checkpoint_plus_any_prefix_replays_identically(tmp_path):
             assert report["mode"] == "state"
         assert report["consistent"], f"boundary {k}: {report}"
     assert a["resource_version"] == 30
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 (DESIGN.md §29): the follower-serving read plane — rv-bounded
+# reads, typed NotYetObserved, live watch fanout on replicas, and the
+# multi-endpoint client's leader routing + watch failover.
+# ---------------------------------------------------------------------------
+
+
+class _ServedPlane(_Plane):
+    """_Plane plus an HTTP façade (follower ReplRuntime attached, so
+    ``/repl/status`` answers with role/leader_hint) in front of every
+    follower — the read topology ISSUE 17 clients route across."""
+
+    def __init__(self, tmp_path, n_followers=2, cluster_size=3, **kw):
+        super().__init__(
+            tmp_path, n_followers=n_followers, cluster_size=cluster_size,
+            **kw,
+        )
+        self.fservers = []
+        for fid, fstore, _tail in self.followers:
+            frt = ReplRuntime(fstore, fid, peers=[], cluster_size=cluster_size)
+            frt.leader_id = "r0"
+            _srv, furl, fshutdown = start_api_server(
+                fstore, port=0, repl=frt
+            )
+            self.fservers.append((fid, furl, fshutdown, frt))
+
+    def follower_urls(self):
+        return [furl for _fid, furl, _sd, _rt in self.fservers]
+
+    def close(self):
+        for _fid, _furl, fshutdown, frt in self.fservers:
+            fshutdown()
+            frt.close()
+        super().close()
+
+
+def _http_get(base_url, path):
+    """(status, headers dict, body bytes) — raw wire access so tests can
+    see the X-Minisched-RV stamp RemoteStore's decode layer hides."""
+    u = urllib.parse.urlparse(base_url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, dict(resp.getheaders()), body
+    finally:
+        conn.close()
+
+
+def test_follower_live_watch_fanout(tmp_path):
+    """The tentpole's store half: a watch attached to a FOLLOWER store
+    observes replicated mutations live (apply_replicated fans groups
+    into watcher queues, not just the resume history ring), in rv order,
+    and the follower's COW read plane republishes per group."""
+    plane = _Plane(tmp_path, n_followers=1)
+    try:
+        _fid, fstore, _tail = plane.followers[0]
+        w, _snap = fstore.watch("Pod", send_initial=False)
+        for i in range(3):
+            plane.leader.create("Pod", make_pod(f"live-{i}"))
+        plane.converge()
+        events = [w.next(timeout=5.0) for _ in range(3)]
+        assert all(ev is not None for ev in events), "follower watch is deaf"
+        assert [ev.obj.metadata.name for ev in events] == [
+            "live-0", "live-1", "live-2"
+        ]
+        assert all(ev.type == EventType.ADDED for ev in events)
+        rvs = [ev.rv for ev in events]
+        assert rvs == sorted(rvs) and rvs[0] > 0
+        plane.leader.delete("Pod", "default", "live-1")
+        plane.converge()
+        ev = w.next(timeout=5.0)
+        assert ev is not None and ev.type == EventType.DELETED
+        assert ev.obj.metadata.name == "live-1"
+        # the COW snapshot republished too: lock-free reads see the group
+        assert {p.metadata.name for p in fstore.list("Pod")} == {
+            "live-0", "live-2"
+        }
+        w.stop()
+    finally:
+        plane.close()
+
+
+def test_watch_resume_ahead_is_typed_by_role(tmp_path):
+    """Resuming ABOVE the server's applied rv forks on role: a fenced
+    replica is merely behind (NotYetObserved — retryable, the client
+    waits or fails over), an unfenced leader can only mean the client's
+    rv came from a crashed-and-rolled-back future (HistoryCompacted —
+    relist).  Never a silent stall, never a bogus relist on mere lag."""
+    store = DurableObjectStore(str(tmp_path / "role.wal"), fsync=False)
+    store.create("Pod", make_pod("seed"))
+    rv = store.resource_version
+    with pytest.raises(HistoryCompacted):
+        store.watch("Pod", resume_rv=rv + 10)
+    store.fence("r0")
+    with pytest.raises(NotYetObserved):
+        store.watch("Pod", resume_rv=rv + 10)
+    # at-or-below applied rv a fenced replica resumes normally
+    w, _snap = store.watch("Pod", resume_rv=rv)
+    assert w.next(timeout=0.2) is None
+    w.stop()
+    store.close()
+
+
+def test_checkpoint_seed_floors_follower_history(tmp_path):
+    """Regression (satellite 2): a checkpoint-seeded replica must floor
+    its watch-resume history at the seed rv — events at/below the
+    snapshot are not reconstructable, so resuming below it is a typed
+    410 relist, never an empty-but-wrong replay."""
+    leader = DurableObjectStore(str(tmp_path / "cl.wal"), fsync=True)
+    for i in range(6):
+        leader.create("Pod", make_pod(f"c-{i}"))
+    leader.compact()
+    ckpt_rv = leader.resource_version
+    blob = leader.checkpoint_ship_blob()
+    assert blob is not None and blob["rv"] == ckpt_rv
+    fstore = DurableObjectStore(str(tmp_path / "cf.wal"), fsync=True)
+    fstore.fence("r0")
+    fstore.replica_reset(seed=blob)
+    assert fstore.resource_version == ckpt_rv
+    assert len(fstore.list("Pod")) == 6
+    with pytest.raises(HistoryCompacted):
+        fstore.watch("Pod", resume_rv=ckpt_rv - 1)
+    # exactly AT the seed rv: clean resume, empty replay
+    w, _snap = fstore.watch("Pod", resume_rv=ckpt_rv)
+    assert w.next(timeout=0.2) is None
+    w.stop()
+    # and ABOVE the applied rv the fenced replica is typed-retryable
+    with pytest.raises(NotYetObserved):
+        fstore.watch("Pod", resume_rv=ckpt_rv + 3)
+    fstore.close()
+    leader.close()
+
+
+def test_repl_status_applied_rv_and_leader_hint(tmp_path):
+    """Satellite 1: /repl/status carries the read-routing fields — the
+    replica's applied rv (what its read plane serves NOW) and the best
+    leader hint for write routing — on both roles, and the follower
+    exports its apply lag as a gauge."""
+    counters.reset()
+    plane = _ServedPlane(tmp_path, n_followers=1)
+    try:
+        client = RemoteClient(plane.url)
+        for i in range(3):
+            client.pods().create(make_pod(f"st-{i}"))
+        plane.converge()
+        st, _hdrs, body = _http_get(plane.url, "/repl/status")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["role"] == "leader"
+        assert doc["leader_hint"] == "r0"
+        assert doc["applied_rv"] == plane.leader.resource_version
+        fst, _fh, fbody = _http_get(
+            plane.follower_urls()[0], "/repl/status"
+        )
+        assert fst == 200
+        fdoc = json.loads(fbody)
+        assert fdoc["role"] == "follower"
+        assert fdoc["fenced"] is True
+        assert fdoc["leader_hint"] == "r0"
+        assert fdoc["applied_rv"] == doc["applied_rv"], "converged plane"
+        # the tail noted its lag after the last applied group: caught up
+        assert counters.get("storage.repl.apply_lag_rv") == 0
+    finally:
+        plane.close()
+
+
+def test_http_min_rv_bound_and_rv_header(tmp_path):
+    """The wire half of rv-bounded reads: every read answer carries the
+    X-Minisched-RV watermark; a ``min_rv`` above the replica's applied
+    rv is a typed 504 (``not yet observed``), counted, and surfaced to
+    RemoteStore callers as NotYetObserved — never a silently stale 200."""
+    counters.reset()
+    store = DurableObjectStore(str(tmp_path / "wire.wal"), fsync=False)
+    _srv, url, shutdown = start_api_server(store, port=0)
+    try:
+        client = RemoteClient(url)
+        for i in range(4):
+            client.pods().create(make_pod(f"b-{i}"))
+        rv = store.resource_version
+        # satisfiable bound: 200, stamped at least as fresh as the bound
+        st, hdrs, body = _http_get(url, f"/api/v1/pods?min_rv={rv}")
+        assert st == 200
+        assert int(hdrs["X-Minisched-RV"]) >= rv
+        assert len(json.loads(body)["items"]) == 4
+        assert counters.get("wire.read.bounded_requests") == 1
+        # unstamped reads still carry the watermark (list + named get)
+        _st, hdrs2, _b = _http_get(url, "/api/v1/pods")
+        assert int(hdrs2["X-Minisched-RV"]) >= rv
+        _st, hdrs3, _b = _http_get(
+            url, "/api/v1/namespaces/default/pods/b-0"
+        )
+        assert int(hdrs3["X-Minisched-RV"]) >= rv
+        # unsatisfiable bound: typed 504, watermark says how far behind
+        st, hdrs4, body4 = _http_get(url, f"/api/v1/pods?min_rv={rv + 100}")
+        assert st == 504
+        assert b"not yet observed" in body4
+        assert int(hdrs4["X-Minisched-RV"]) == rv
+        assert counters.get("wire.read.not_yet_observed") == 1
+        # and the typed client exception
+        rs = RemoteStore(url, retries=0)
+        with pytest.raises(NotYetObserved):
+            rs._req("GET", f"/api/v1/pods?min_rv={rv + 100}")
+        rs.close()
+    finally:
+        shutdown()
+        store.close()
+
+
+def test_multi_endpoint_client_routes_and_reads(tmp_path):
+    """The client half of the tentpole: a RemoteStore pointed at a
+    FOLLOWER with the full endpoint list discovers the leader via
+    /repl/status and routes writes there; reads ride the follower with
+    the session-rv bound, so read-your-writes holds once the follower
+    converges.  A single-endpoint store stays byte-identical (inert)."""
+    counters.reset()
+    plane = _ServedPlane(tmp_path, n_followers=2)
+    try:
+        furls = plane.follower_urls()
+        rs = RemoteStore(
+            furls[0], endpoints=[furls[1], plane.url],
+            timeout_s=10.0,
+        )
+        assert rs._multi and rs._read_base == furls[0]
+        created = rs.create("Pod", make_pod("routed-1"))
+        assert created.metadata.resource_version > 0
+        assert rs._leader_base == plane.url, "writes must find the leader"
+        assert counters.get("remote.leader_discoveries") >= 1
+        assert rs.session_rv >= created.metadata.resource_version, (
+            "acked write must advance the session floor"
+        )
+        # the bounded read blocks on convergence semantics: retried
+        # against the follower until its applied rv passes the floor
+        pods, rv = rs.list_with_rv("Pod")
+        assert [p.metadata.name for p in pods] == ["routed-1"]
+        assert rv >= created.metadata.resource_version
+        assert rs._read_base in furls, "reads must stay on followers"
+        rs.close()
+    finally:
+        plane.close()
+
+
+def test_watch_failover_resumes_exactly_once(tmp_path):
+    """Kill the replica serving a watch stream mid-flight and resume at
+    the last delivered rv through the endpoint-aware store: the rotated
+    replica replays exactly the rv>resume suffix — the prefix/tail union
+    has no duplicate and no gap (exactly-once across the failover)."""
+    counters.reset()
+    plane = _ServedPlane(tmp_path, n_followers=2)
+    try:
+        furls = plane.follower_urls()
+        client = RemoteClient(plane.url)
+        for i in range(3):
+            client.pods().create(make_pod(f"pre-{i}"))
+        plane.converge()
+        rs = RemoteStore(
+            furls[0], endpoints=[furls[1]], timeout_s=10.0,
+        )
+        w, snap = rs.watch("Pod")
+        prefix = [w.next(timeout=5.0) for _ in range(len(snap))]
+        assert all(ev is not None for ev in prefix)
+        last_rv = max(ev.rv for ev in prefix)
+        # the serving follower dies; more writes land on the survivors
+        fid0, furl0, fshutdown0, frt0 = plane.fservers[0]
+        fshutdown0()
+        for i in range(3):
+            client.pods().create(make_pod(f"post-{i}"))
+        plane.converge()
+        w.stop()
+        w2, _ = rs.watch("Pod", resume_rv=last_rv)
+        tail = [w2.next(timeout=5.0) for _ in range(3)]
+        assert all(ev is not None for ev in tail)
+        assert counters.get("remote.watch_failover") >= 1
+        assert rs._read_base == furls[1]
+        tail_rvs = [ev.rv for ev in tail]
+        assert all(rv > last_rv for rv in tail_rvs), "duplicate replay"
+        assert tail_rvs == sorted(tail_rvs)
+        names = {ev.obj.metadata.name for ev in prefix} | {
+            ev.obj.metadata.name for ev in tail
+        }
+        assert names == {f"pre-{i}" for i in range(3)} | {
+            f"post-{i}" for i in range(3)
+        }, "gap across the failover"
+        assert w2.next(timeout=0.2) is None, "over-replay past the tail"
+        w2.stop()
+        rs.close()
+    finally:
+        plane.close()
