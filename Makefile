@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl bench-readscale chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition chaos-read metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl bench-readscale bench-shard chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition chaos-read chaos-shard metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -111,6 +111,19 @@ bench-relist: native
 bench-readscale: native
 	JAX_PLATFORMS=cpu BENCH_READSCALE=1 python bench.py --only readscale
 
+# sharded write plane (ISSUE 18, DESIGN.md §30): the same ≥6-process
+# HTTP writer fleet through the shard router against a 1-group and then
+# a 2-group plane, every group fsync-armed with a real durability floor
+# (MINISCHED_FSYNC_FLOOR_US via BENCH_SHARD_FSYNC_FLOOR_US) — a second
+# leader group must BUY write throughput (gated ≥1.5x on ≥4-core boxes;
+# informational where the groups share one core, readscale precedent).
+# The cross-shard bind batch tax (two-shard commit: two round trips +
+# two barriers in parallel) is measured SEPARATELY — it is the price of
+# exactly-once across groups, not a regression.  Scale with
+# BENCH_SHARD_WRITERS / _WINDOW_S / _BIND_BATCHES
+bench-shard: native
+	JAX_PLATFORMS=cpu BENCH_SHARD=1 python bench.py --only shard
+
 # process-level chaos: SIGKILL/restart the control-plane child process
 # mid-workload (faults/proc.ServerSupervisor) under the same fixed seed.
 # Runs BOTH the tier-1 smoke (1 kill) and the slow soak (≥3 scheduled
@@ -177,6 +190,19 @@ chaos-partition: native
 chaos-read: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_read_chaos.py -q
+
+# sharded-plane chaos (ISSUE 18, DESIGN.md §30): a 2-group × 3-replica
+# plane under cross-shard bind load (every batch spans both groups —
+# the two-shard commit path); g0's leader is SIGKILLed mid-run.  Runs
+# BOTH the tier-1 smoke (1 kill) and the slow soak (heavier load + a
+# second kill on g1), each ending in the standing audits: zero
+# acked-write loss, no half-committed cross-shard batch (every retried
+# batch fully bound on BOTH sides, full-history double-bind audit over
+# all six replica WALs clean), and the unaffected shard never stalls
+# (the g1 writer must keep acking THROUGH g0's failover window)
+chaos-shard: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_shard_chaos.py -q
 
 # live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
 # 100 pods to bind, then validate ONLY through the wire — /metrics must

@@ -4633,6 +4633,226 @@ def bench_readscale() -> dict:
     }
 
 
+def bench_shard() -> dict:
+    """``make bench-shard`` (DESIGN.md §30): the sharded write plane
+    must BUY write throughput, not just partition it.  Opt-in via
+    ``BENCH_SHARD=1``.  Two phases:
+
+    * **1-vs-2-group write storm** — the same W (≥6) HTTP writer
+      PROCESSES, each creating pods in its own namespace through the
+      shard router, against a K=1 plane and then a K=2 plane (same
+      replica count per group, same fsync floor).  Namespaces are
+      pre-picked to land half on each K=2 group, so the K=2 run splits
+      the identical load across two independent group-commit barriers.
+      The fsync floor (``BENCH_SHARD_FSYNC_FLOOR_US``, default 2000µs)
+      makes the durability barrier cost something real — on tmpfs an
+      fsync is near-free and no amount of sharding shows.  Gate: K=2
+      rate ≥ BENCH_SHARD_GATE × K=1 rate (default 1.5×), armed only on
+      ≥4 cores (readscale precedent: on fewer cores every server
+      process shares the silicon and wall-clock scaling is pinned at
+      ~1× regardless of architecture); always measured and recorded.
+    * **cross-shard batch tax** — on the K=2 plane: p50/p99 latency of
+      single-group bind batches vs batches spanning both groups (the
+      two-shard commit pays two HTTP round trips + two barriers in
+      parallel).  Informational, recorded separately — the tax is the
+      price of exactly-once across groups, not a regression.
+    """
+    import tempfile
+    import threading
+
+    from minisched_tpu.api.objects import Binding, make_node, make_pod
+    from minisched_tpu.controlplane.shards import ShardedPlane, ShardTopology
+    from minisched_tpu.observability import counters
+
+    if os.environ.get("BENCH_SHARD", "0") == "0":
+        bench_skip("BENCH_SHARD unset: sharded write plane role is opt-in")
+
+    W = max(int(os.environ.get("BENCH_SHARD_WRITERS", "6")), 6)
+    window_s = float(os.environ.get("BENCH_SHARD_WINDOW_S", "2.0"))
+    gate = float(os.environ.get("BENCH_SHARD_GATE", "1.5"))
+    floor_us = os.environ.get("BENCH_SHARD_FSYNC_FLOOR_US", "2000")
+    batches = int(os.environ.get("BENCH_SHARD_BIND_BATCHES", "30"))
+    ttl_s = 1.0
+
+    counters.reset()
+    tmp = tempfile.mkdtemp(prefix="bench-shard-")
+
+    # writer namespaces balanced across the K=2 topology up front, so
+    # both runs carry the identical client load and only the group
+    # count differs
+    probe = ShardTopology({"g0": ["http://a"], "g1": ["http://b"]})
+    per_group: dict = {"g0": [], "g1": []}
+    i = 0
+    while any(len(v) < (W + 1) // 2 for v in per_group.values()):
+        ns = f"bench-ns-{i:03d}"
+        per_group[probe.owner(ns)].append(ns)
+        i += 1
+    writer_ns = [
+        per_group[gid][j]
+        for j in range((W + 1) // 2)
+        for gid in ("g0", "g1")
+    ][:W]
+
+    helper = os.path.join(tmp, "_write_storm.py")
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    with open(helper, "w") as f:
+        f.write(
+            "import sys, time\n"
+            f"sys.path.insert(0, {repo_dir!r})\n"
+            "from minisched_tpu.api.objects import make_pod\n"
+            "from minisched_tpu.controlplane.shards import ShardedStore\n"
+            "seed, ns, window_s = sys.argv[1], sys.argv[2], "
+            "float(sys.argv[3])\n"
+            "ss = ShardedStore(seeds=[seed], timeout_s=10.0, retries=2)\n"
+            "n = 0\n"
+            "deadline = time.monotonic() + window_s\n"
+            "try:\n"
+            "    while time.monotonic() < deadline:\n"
+            "        ss.create('Pod', make_pod('%s-%06d' % (ns, n), "
+            "namespace=ns))\n"
+            "        n += 1\n"
+            "finally:\n"
+            "    ss.close()\n"
+            "print(n)\n"
+        )
+
+    def storm(seed_url: str, label: str) -> float:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, helper, seed_url, writer_ns[w],
+                 str(window_s)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            for w in range(W)
+        ]
+        total = 0
+        for p in procs:
+            out, err = p.communicate(timeout=window_s + 120)
+            if p.returncode != 0:
+                raise SystemExit(
+                    f"[shard] {label} WRITER FAILED: "
+                    f"{err.decode(errors='replace')[-300:]}"
+                )
+            total += int(out.strip())
+        rate = total / window_s
+        log(f"[shard] {label}: {rate:.0f} creates/s ({W} writer procs)")
+        return rate
+
+    old_floor = os.environ.get("MINISCHED_FSYNC_FLOOR_US")
+    os.environ["MINISCHED_FSYNC_FLOOR_US"] = floor_us
+    try:
+        rates = {}
+        for k in (1, 2):
+            plane = ShardedPlane(
+                os.path.join(tmp, f"k{k}"), k=k, replicas_per_group=1,
+                fsync=True, ttl_s=ttl_s,
+            )
+            try:
+                seeds = plane.start()
+                rates[k] = storm(seeds[0], f"K={k} write storm")
+            finally:
+                plane.stop()
+
+        scaling = rates[2] / rates[1] if rates[1] else 0.0
+        cores = os.cpu_count() or 1
+        scaling_gated = cores >= 4
+        if scaling_gated and scaling < gate:
+            raise SystemExit(
+                f"[shard] WRITE SCALING UNDER GATE: {rates[2]:.0f}/s on 2 "
+                f"groups vs {rates[1]:.0f}/s on 1 = {scaling:.2f}x < "
+                f"{gate}x — a second leader group is not buying write "
+                f"throughput"
+            )
+        if not scaling_gated:
+            log(
+                f"[shard] scaling gate SKIPPED: {cores} CPU core(s) — "
+                f"groups share the silicon (measured {scaling:.2f}x, "
+                f"recorded informationally; gate re-arms on >=4 cores)"
+            )
+        else:
+            log(f"[shard] write scaling 1->2 groups: {scaling:.2f}x")
+
+        # ---- cross-shard batch tax (K=2, measured separately) ---------
+        plane = ShardedPlane(
+            os.path.join(tmp, "tax"), k=2, replicas_per_group=1,
+            fsync=True, ttl_s=ttl_s,
+        )
+        try:
+            plane.start()
+            ss = plane.client(timeout_s=10.0, retries=2)
+            # placement hashes only group ids, so the probe buckets hold
+            ns0, ns1 = per_group["g0"][0], per_group["g1"][0]
+            ss.create("Node", make_node("bn1", capacity={
+                "cpu": "64", "memory": "256Gi", "pods": 8 * batches,
+            }))
+            for b in range(batches):
+                ss.create("Pod", make_pod(f"s{b:03d}", namespace=ns0))
+                ss.create("Pod", make_pod(f"t{b:03d}", namespace=ns0))
+                ss.create("Pod", make_pod(f"x{b:03d}", namespace=ns0))
+                ss.create("Pod", make_pod(f"y{b:03d}", namespace=ns1))
+            single_lat, cross_lat = [], []
+            for b in range(batches):
+                t0 = time.monotonic()
+                res = ss.bind_many_remote(
+                    [Binding(pod_name=f"s{b:03d}", pod_namespace=ns0,
+                             node_name="bn1"),
+                     Binding(pod_name=f"t{b:03d}", pod_namespace=ns0,
+                             node_name="bn1")],
+                    return_objects=False,
+                )
+                single_lat.append(time.monotonic() - t0)
+                if any(isinstance(r, BaseException) for r in res):
+                    raise SystemExit(f"[shard] single-group bind: {res}")
+                t0 = time.monotonic()
+                res = ss.bind_many_remote(
+                    [Binding(pod_name=f"x{b:03d}", pod_namespace=ns0,
+                             node_name="bn1"),
+                     Binding(pod_name=f"y{b:03d}", pod_namespace=ns1,
+                             node_name="bn1")],
+                    return_objects=False,
+                )
+                cross_lat.append(time.monotonic() - t0)
+                if any(isinstance(r, BaseException) for r in res):
+                    raise SystemExit(f"[shard] cross-shard bind: {res}")
+            ss.close()
+        finally:
+            plane.stop()
+        single_lat.sort()
+        cross_lat.sort()
+        single_p50 = _pct(single_lat, 0.50, 4)
+        cross_p50 = _pct(cross_lat, 0.50, 4)
+        tax = cross_p50 / single_p50 if single_p50 else 0.0
+        log(
+            f"[shard] cross-shard batch tax: single p50 {single_p50}s vs "
+            f"cross p50 {cross_p50}s = {tax:.2f}x"
+        )
+    finally:
+        if old_floor is None:
+            os.environ.pop("MINISCHED_FSYNC_FLOOR_US", None)
+        else:
+            os.environ["MINISCHED_FSYNC_FLOOR_US"] = old_floor
+
+    return {
+        "writers": W,
+        "window_s": window_s,
+        "fsync_floor_us": float(floor_us),
+        "rate_1_group_s": round(rates[1], 1),
+        "rate_2_groups_s": round(rates[2], 1),
+        "write_scaling_x": round(scaling, 2),
+        "scaling_gate_x": gate,
+        "scaling_gated": scaling_gated,
+        "cpu_cores": cores,
+        "bind_batches": batches,
+        "single_group_bind_p50_s": single_p50,
+        "single_group_bind_p99_s": _pct(single_lat, 0.99, 4),
+        "cross_shard_bind_p50_s": cross_p50,
+        "cross_shard_bind_p99_s": _pct(cross_lat, 0.99, 4),
+        "cross_shard_tax_x": round(tax, 2),
+        "cross_bind_batches": counters.get("shard.cross_bind_batches"),
+        "wrong_shard_chased": counters.get("shard.wrong_shard_chased"),
+    }
+
+
 ROLES = {
     "headline": bench_headline,
     "c5": bench_config5_fullchain,
@@ -4650,6 +4870,7 @@ ROLES = {
     "churn": bench_churn,
     "relist": bench_relist,
     "readscale": bench_readscale,
+    "shard": bench_shard,
     "c1": bench_config1,
     "c2": bench_config2,
     "c3": bench_config3,
@@ -4805,6 +5026,11 @@ def main() -> None:
         # list-rate scaling gate, encode-once on every serving replica,
         # and read availability across a leader SIGKILL
         optional.append(("read_scaling", "readscale", None, "readscale"))
+    if os.environ.get("BENCH_SHARD", "0") != "0":
+        # sharded write plane (ISSUE 18, opt-in): 1-vs-2-group write
+        # throughput under an fsync floor (gate arms on >=4 cores), plus
+        # the cross-shard bind batch tax measured separately
+        optional.append(("shard_plane", "shard", None, "shard"))
     if os.environ.get("BENCH_MESH", "1") != "0":
         # multi-chip live wave engine (ISSUE 7): sharded vs single-device
         # on the same workload, parity-pinned, device_total_s gated.

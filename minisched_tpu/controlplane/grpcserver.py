@@ -243,6 +243,124 @@ class _SnapListCache:
         ).encode())
 
 
+#: per-stream out-buffer bound, in EVENTS — the gRPC analog of the
+#: stream loop's byte bound: a consumer that stops reading while the
+#: store keeps mutating gets EVICTED (OUT_OF_RANGE → relist), never
+#: buffered without limit on the server's heap.
+DEFAULT_WATCH_STREAM_EVENTS = 8192
+
+
+def _event_wire(ev: Any) -> bytes:
+    """One watch event's framed gRPC bytes (field-1 wrap of the JSON
+    line), encoded ONCE and memoized on the event object — the store
+    fans the SAME WatchEvent instance into every watcher queue, so N
+    streams serializing one mutation cost one encode (the REST façade's
+    ``event_wire_chunk``, re-framed).  Distinct attribute from ``wire``:
+    the HTTP chunk framing and the proto framing are different bytes."""
+    wire = getattr(ev, "_grpc_wire", None)
+    if wire is None:
+        wire = _wrap_json(
+            json.dumps(
+                {
+                    "type": ev.type.value,
+                    "object": _encode(ev.obj),
+                    "resource_version": int(ev.rv),
+                }
+            ).encode()
+        )
+        ev._grpc_wire = wire
+        counters.inc("grpc.watch.encoded")
+    else:
+        counters.inc("grpc.watch.shared")
+    return wire
+
+
+class _HubStream:
+    """One gRPC watch stream's hub-side half: a bounded deque of framed
+    bytes the hub fills and the rpc generator drains."""
+
+    def __init__(self, watch: Any, bound: int):
+        self.watch = watch
+        self.cond = threading.Condition()
+        self.buf: list = []
+        self.bound = int(bound)
+        self.evicted = False
+        self.ended = False  # underlying store watch stopped
+        self.done = False  # rpc generator detached (hub must drop us)
+
+    def push(self, frames: list) -> None:
+        with self.cond:
+            if self.done:
+                return
+            if len(self.buf) + len(frames) > self.bound:
+                # laggard: its unread history is gone from this buffer
+                # just as surely as from a compacted ring — evict, the
+                # consumer relists (stream loop's eviction, ported)
+                self.evicted = True
+                counters.inc("grpc.watch.evicted")
+            else:
+                self.buf.extend(frames)
+            self.cond.notify_all()
+
+    def finish(self) -> None:
+        with self.cond:
+            self.ended = True
+            self.cond.notify_all()
+
+
+class _WatchHub:
+    """The §23 stream-loop handoff, ported to the gRPC facade: ONE hub
+    thread drains every adopted store watch, pays each event's encode
+    once (``_event_wire``), and fans framed bytes into bounded
+    per-stream buffers.  The rpc generators (whose threads the gRPC
+    runtime owns regardless) only pop bytes and yield — no store access,
+    no JSON work, no per-stream encode.  Edge-triggered: each adopted
+    watch's ``set_notify`` pokes the hub condvar, so an idle hub sleeps
+    instead of polling hot."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._streams: list = []
+        self._thread: Optional[threading.Thread] = None
+
+    def adopt(self, watch: Any, bound: int) -> _HubStream:
+        hs = _HubStream(watch, bound)
+        with self._cond:
+            self._streams.append(hs)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="grpc-watch-hub", daemon=True
+                )
+                self._thread.start()
+        watch.set_notify(self._wake)
+        counters.inc("grpc.watch.streams")
+        return hs
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._streams = [s for s in self._streams if not s.done]
+                streams = list(self._streams)
+            moved = False
+            for hs in streams:
+                batch = hs.watch.next_batch(timeout=0)
+                if batch:
+                    moved = True
+                    counters.inc("grpc.watch.events", len(batch))
+                    hs.push([_event_wire(ev) for ev in batch])
+                elif hs.watch.stopped:
+                    hs.finish()
+            with self._cond:
+                if not moved:
+                    # capped wait: set_notify wakes us on the event edge,
+                    # the timeout only backstops a missed registration
+                    self._cond.wait(timeout=0.25)
+
+
 def _handlers(store: Any = None):
     import grpc
 
@@ -328,6 +446,86 @@ def _handlers(store: Any = None):
             request_deserializer=lambda b: b,
             response_serializer=lambda b: b,
         )
+
+        hub = _WatchHub()
+
+        def watch_stream(request_bytes: bytes, context):
+            from minisched_tpu.controlplane.store import (
+                HistoryCompacted,
+                NotYetObserved,
+            )
+
+            try:
+                request = json.loads(
+                    _unwrap_json(request_bytes).decode("utf-8")
+                )
+                kind = request.get("kind", "")
+                if kind not in KIND_TYPES:
+                    raise ValueError(f"unknown kind {kind!r}")
+                resume_rv = request.get("resume_rv")
+                send_initial = bool(request.get("send_initial", True))
+            except (ValueError, KeyError) as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+            try:
+                w, snapshot = store.watch(
+                    kind,
+                    send_initial=send_initial and resume_rv is None,
+                    resume_rv=(
+                        int(resume_rv) if resume_rv is not None else None
+                    ),
+                    clone_snapshot=False,
+                )
+            except HistoryCompacted as err:
+                # the REST 410: the consumer's cursor predates the
+                # retained tail — relist and re-watch
+                context.abort(grpc.StatusCode.OUT_OF_RANGE, str(err))
+            except NotYetObserved as err:
+                # the REST 504: a follower lagging the resume point —
+                # retryable, wait out the replication lag
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(err))
+            sync = len(snapshot) if (send_initial and resume_rv is None) \
+                else 0
+            hs = hub.adopt(w, DEFAULT_WATCH_STREAM_EVENTS)
+            try:
+                yield _wrap_json(json.dumps(
+                    {
+                        "sync": sync,
+                        "resource_version": int(
+                            getattr(store, "applied_rv", lambda: 0)() or 0
+                        ),
+                    }
+                ).encode())
+                while context.is_active():
+                    with hs.cond:
+                        while (
+                            not hs.buf
+                            and not hs.evicted
+                            and not hs.ended
+                        ):
+                            if not hs.cond.wait(timeout=1.0):
+                                break
+                        frames, hs.buf = hs.buf, []
+                        evicted, ended = hs.evicted, hs.ended
+                    for frame in frames:
+                        yield frame
+                    if evicted:
+                        context.abort(
+                            grpc.StatusCode.OUT_OF_RANGE,
+                            "watch stream evicted: consumer fell "
+                            f"behind {DEFAULT_WATCH_STREAM_EVENTS} "
+                            "buffered events — relist and re-watch",
+                        )
+                    if ended:
+                        return
+            finally:
+                hs.done = True
+                w.stop()
+
+        rpcs["Watch"] = grpc.unary_stream_rpc_method_handler(
+            watch_stream,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
     return grpc.method_handlers_generic_handler(SERVICE, rpcs)
 
 
@@ -352,6 +550,25 @@ def start_grpc_server(
         server.stop(grace=1.0).wait()
 
     return server, address, shutdown
+
+
+class EvaluatorWatch:
+    """Iterator half of ``EvaluatorClient.watch``: decodes each framed
+    stream message to its JSON dict; ``cancel()`` aborts the rpc (the
+    server's generator unwinds and stops the store watch)."""
+
+    def __init__(self, call: Any):
+        self._call = call
+
+    def __iter__(self) -> "EvaluatorWatch":
+        return self
+
+    def __next__(self) -> dict:
+        raw = next(self._call)
+        return json.loads(_unwrap_json(raw).decode("utf-8"))
+
+    def cancel(self) -> None:
+        self._call.cancel()
 
 
 class EvaluatorClient:
@@ -385,6 +602,30 @@ class EvaluatorClient:
         return self._call(
             "List", {"kind": kind, "namespace": namespace}, timeout=timeout
         )
+
+    def watch(
+        self,
+        kind: str,
+        send_initial: bool = True,
+        resume_rv: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> "EvaluatorWatch":
+        """Open the server-streaming Watch rpc; returns an iterator of
+        decoded JSON messages — the sync line first, then one dict per
+        event (schema: the .proto's comments).  ``cancel()`` tears the
+        stream down server-side."""
+        fn = self._channel.unary_stream(
+            f"/{SERVICE}/Watch",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        payload: dict = {"kind": kind, "send_initial": send_initial}
+        if resume_rv is not None:
+            payload["resume_rv"] = int(resume_rv)
+        call = fn(
+            _wrap_json(json.dumps(payload).encode()), timeout=timeout
+        )
+        return EvaluatorWatch(call)
 
     def evaluate(
         self,

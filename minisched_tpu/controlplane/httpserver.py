@@ -48,7 +48,9 @@ from minisched_tpu.controlplane.store import (
     NotLeader,
     NotYetObserved,
     ObjectStore,
+    ShardFrozen,
     StorageDegraded,
+    WrongShard,
 )
 
 
@@ -223,6 +225,11 @@ class _Handler(BaseHTTPRequestHandler):
     #: repl.ReplRuntime when this server fronts a replicated store
     #: (DESIGN.md §27); None = the /repl/* routes answer 404
     repl = None
+    #: shards.ShardInfo when this server fronts ONE leader group of a
+    #: sharded write plane (DESIGN.md §30); None = unsharded — the
+    #: /shards/* routes answer 404 and no write is ever shard-refused,
+    #: which is exactly the MINISCHED_SHARDS=1 parity invariant
+    shard = None
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:  # quiet
@@ -298,6 +305,35 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"{name} must be an integer")
             raise
 
+    def _shard_guard(self, kind: str, *namespaces: str) -> bool:
+        """Refuse a write whose namespace this leader group does not own
+        (421 ``wrong shard``) or that sits inside a split's freeze
+        window (503 ``shard frozen``) — BEFORE the store executes
+        anything, so a refused request is always safe to re-route or
+        retry whole.  True = proceed.  Unsharded servers (shard None)
+        never refuse: the kill-switch parity path."""
+        sh = self.shard
+        if sh is None:
+            return True
+        from minisched_tpu.observability import counters
+
+        eff = [
+            "" if kind in _CLUSTER_SCOPED else (ns or "default")
+            for ns in namespaces
+        ]
+        try:
+            for ns in dict.fromkeys(eff):
+                sh.check_write(ns)
+        except WrongShard as e:
+            counters.inc("storage.shard.wrong_shard_refused")
+            self._error(421, str(e))
+            return False
+        except ShardFrozen as e:
+            counters.inc("storage.shard.frozen_refused")
+            self._error(503, str(e))
+            return False
+        return True
+
     def _observe_request(self, verb: str, path: str, t0: float) -> None:
         from minisched_tpu.observability import hist
 
@@ -360,6 +396,26 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, "replication not enabled on this server")
             else:
                 repl.handle_get(self, path, query)
+            return
+        if path.startswith("/shards/"):
+            # the sharded write plane's discovery + split surface
+            # (DESIGN.md §30), mirroring /repl/*'s 404-when-absent so a
+            # router can probe any façade and learn whether it is sharded
+            sh = self.shard
+            if sh is None:
+                self._error(404, "sharding not enabled on this server")
+            elif path == "/shards/status":
+                self._send(200, sh.describe(), rv=self.store.applied_rv())
+            elif path == "/shards/handoff":
+                ns = (parse_qs(query).get("namespace") or [""])[0]
+                if not ns:
+                    self._error(400, "handoff requires ?namespace=")
+                    return
+                from minisched_tpu.controlplane import shards as _shards
+
+                self._send(200, _shards.build_handoff(self.store, ns))
+            else:
+                self._error(404, f"no route {path}")
             return
         try:
             kind, ns, name, _ = _route(path)
@@ -680,6 +736,9 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 repl.handle_post(self, self.path.partition("?")[0])
             return
+        if self.path.partition("?")[0].startswith("/shards/"):
+            self._shards_post(self.path.partition("?")[0])
+            return
         try:
             kind, ns, name, sub = _route(self.path)
         except (KeyError, ValueError):
@@ -692,6 +751,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(400, "binding body requires node_name")
                 return
             expected_rv = data.get("expected_rv")
+            if not self._shard_guard("Pod", ns):
+                return
             try:
                 pod = Client(self.store).pods(ns or "default").bind(
                     Binding(name, ns or "default", node_name,
@@ -739,6 +800,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"malformed body: {e}")
             return
         _fixup_namespace(kind, ns, obj)
+        if not self._shard_guard(kind, obj.metadata.namespace):
+            return
         try:
             self._send(201, _encode(self.store.create(kind, obj)))
         except NotLeader as e:
@@ -747,6 +810,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(507, str(e))
         except KeyError as e:
             self._error(409, str(e))
+
+    def _shards_post(self, path: str) -> None:
+        """The split-procedure control surface (DESIGN.md §30):
+
+        ``/shards/control``  topology/freeze/unfreeze on this façade's
+                             ShardInfo (every replica of every group gets
+                             the same op — the topology is config pushed
+                             by the split driver, not consensus state);
+        ``/shards/seed``     install a handoff doc's objects into THIS
+                             group's store (leader only — the writes ride
+                             the normal durable path and replicate);
+        ``/shards/purge``    delete a moved namespace's objects from the
+                             SOURCE group after the topology flips.
+
+        seed/purge bypass ``_shard_guard`` by construction: they are the
+        split's own machinery, moving objects the topology says this
+        group does not (yet / any longer) own."""
+        sh = self.shard
+        if sh is None:
+            self._error(404, "sharding not enabled on this server")
+            return
+        try:
+            body = self._body()
+        except Exception as e:
+            self._error(400, f"malformed body: {e}")
+            return
+        from minisched_tpu.controlplane import shards as _shards
+
+        try:
+            if path == "/shards/control":
+                sh.apply_control(body)
+                self._send(200, sh.describe())
+            elif path == "/shards/seed":
+                self._send(200, _shards.apply_seed(self.store, body))
+            elif path == "/shards/purge":
+                ns = body.get("namespace") or ""
+                if not ns:
+                    self._error(400, "purge requires namespace")
+                    return
+                self._send(200, _shards.purge_namespace(self.store, ns))
+            else:
+                self._error(404, f"no route {path}")
+        except NotLeader as e:
+            self._error(503, str(e))
+        except StorageDegraded as e:
+            self._error(507, str(e))
+        except (KeyError, ValueError) as e:
+            self._error(400, f"bad shard control: {e}")
 
     def _already_bound_entry(
         self, err: BaseException, namespace: str, name: str
@@ -786,6 +897,10 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             _fixup_namespace(kind, ns, obj)
             decoded.append((i, obj))
+        if not self._shard_guard(
+            kind, *[o.metadata.namespace for _, o in decoded]
+        ):
+            return
         try:
             results = self.store.create_many(
                 kind, [o for _, o in decoded], return_objects=return_objects
@@ -836,7 +951,8 @@ class _Handler(BaseHTTPRequestHandler):
             return_objects = data.get("return_objects", True)
             batch_id = str(data.get("batch_id") or "")
             bindings = []
-            for it in items:
+            ack_keys = []
+            for i, it in enumerate(items):
                 if not it.get("name") or not it.get("node_name"):
                     self._error(400, "each binding requires name and node_name")
                     return
@@ -847,6 +963,12 @@ class _Handler(BaseHTTPRequestHandler):
                         expected_rv=it.get("expected_rv"),
                     )
                 )
+                # ack identity suffix: the item's position by default, or
+                # a caller-pinned "ack" field — a cross-shard commit
+                # (shards.ShardedStore) pins each binding's ordinal in
+                # the LOGICAL batch, so the registry key survives a
+                # topology change re-partitioning the sub-batches
+                ack_keys.append(str(it.get("ack", i)))
         except Exception as e:
             # malformed JSON / non-dict body / non-dict items: a client
             # mistake must get a 400 like every other handler, not a
@@ -857,10 +979,21 @@ class _Handler(BaseHTTPRequestHandler):
         if batch_id:
             with self.ack_lock:
                 for i in range(len(bindings)):
-                    entry = self.ack_registry.get(f"{batch_id}/{i}")
+                    entry = self.ack_registry.get(
+                        f"{batch_id}/{ack_keys[i]}"
+                    )
                     if entry is not None:
                         replayed[i] = entry
         todo = [i for i in range(len(bindings)) if i not in replayed]
+        # shard ownership is checked for the TODO entries only, BEFORE
+        # any executes: a refused request has run nothing, so the shard
+        # router can safely re-split and re-dispatch the whole sub-batch
+        # (already-acked entries keep replaying from THIS group's
+        # registry wherever the namespace lives now)
+        if not self._shard_guard(
+            "Pod", *[bindings[i].pod_namespace for i in todo]
+        ):
+            return
         try:
             results = Client(self.store).pods().bind_many(
                 [bindings[i] for i in todo], return_objects=return_objects
@@ -921,7 +1054,7 @@ class _Handler(BaseHTTPRequestHandler):
         if batch_id and fresh:
             with self.ack_lock:
                 for i, entry in fresh.items():
-                    ack_id = f"{batch_id}/{i}"
+                    ack_id = f"{batch_id}/{ack_keys[i]}"
                     if ack_id not in self.ack_registry:
                         self.ack_order.append(ack_id)
                     self.ack_registry[ack_id] = entry
@@ -937,7 +1070,10 @@ class _Handler(BaseHTTPRequestHandler):
             if record_acks is not None:
                 try:
                     record_acks(
-                        {f"{batch_id}/{i}": e for i, e in fresh.items()}
+                        {
+                            f"{batch_id}/{ack_keys[i]}": e
+                            for i, e in fresh.items()
+                        }
                     )
                 except Exception:
                     pass  # never fail a response whose binds committed
@@ -978,6 +1114,8 @@ class _Handler(BaseHTTPRequestHandler):
         if ns and obj.metadata.namespace != ns:
             self._error(400, f"body namespace {obj.metadata.namespace!r} != {ns!r}")
             return
+        if not self._shard_guard(kind, ns or obj.metadata.namespace):
+            return
         try:
             self._send(
                 200,
@@ -1008,6 +1146,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             kind, ns, name, _ = _route(self.path)
+            if not self._shard_guard(kind, ns):
+                return
             self.store.delete(kind, ns, name)
             self._send(200, {})
         except NotLeader as e:
@@ -1025,6 +1165,7 @@ def start_api_server(
     stream_buffer_bytes: Optional[int] = None,
     stream_sndbuf_bytes: Optional[int] = None,
     repl: Any = None,
+    shard: Any = None,
 ) -> Tuple[ThreadingHTTPServer, str, Callable[[], None]]:
     """Boot the REST façade on an ephemeral port and poll /healthz until it
     answers (k8sapiserver.go:231-249's readiness loop).  Returns
@@ -1066,7 +1207,7 @@ def start_api_server(
          "watch_lock": threading.Lock(), "faults": faults,
          "ack_registry": acks, "ack_order": _deque(acks),
          "ack_lock": threading.Lock(), "stream_loop": stream_loop,
-         "repl": repl},
+         "repl": repl, "shard": shard},
     )
     server = _WatchHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -1140,6 +1281,14 @@ class HTTPClient:
             raise self._mark(KeyError(body), replayed)
         if status == 404:
             raise self._mark(KeyError(body), replayed)
+        if status == 421:
+            # == in-process shard-ownership refusal (DESIGN.md §30):
+            # typed so a shard-aware caller re-routes to the owning
+            # group instead of retrying a façade that will keep refusing
+            raise self._mark(WrongShard(body), replayed)
+        if status == 503 and "shard frozen" in body:
+            # == in-process split-freeze refusal: transient by contract
+            raise self._mark(ShardFrozen(body), replayed)
         if status == 503 and "not leader" in body:
             # == in-process fence refusal (DESIGN.md §27): typed so a
             # leader-aware caller re-discovers the plane's leader rather

@@ -57,8 +57,10 @@ from minisched_tpu.controlplane.store import (
     HistoryCompacted,
     NotLeader,
     NotYetObserved,
+    ShardFrozen,
     StorageDegraded,
     WatchEvent,
+    WrongShard,
 )
 from minisched_tpu.faults import InjectedFault
 from minisched_tpu.observability import counters
@@ -528,7 +530,22 @@ class RemoteStore:
                     raise OutOfCapacity(body)
                 if status in (404, 409):
                     raise KeyError(body)
-                if status == 503 and "not leader" in body:
+                if status == 421:
+                    # misdirected write: this plane is SHARDED and the
+                    # namespace belongs to another leader group
+                    # (DESIGN.md §30).  Semantic, never blindly retried —
+                    # retrying the same group can never succeed.  The
+                    # shard router (shards.ShardedStore) catches this,
+                    # refreshes /shards/status topology and re-routes.
+                    raise WrongShard(body)
+                if status == 503 and "shard frozen" in body:
+                    # bounded write-freeze window of a shard split:
+                    # transient by contract — the freeze is one
+                    # namespace-filtered checkpoint ship long, well
+                    # inside the backoff budget
+                    counters.inc("remote.shard_frozen_retry")
+                    last_err = ShardFrozen(body)
+                elif status == 503 and "not leader" in body:
                     # fenced replica (DESIGN.md §27): retrying HERE can
                     # never succeed.  Single-endpoint callers get the
                     # typed error immediately and re-discover themselves;
@@ -572,6 +589,11 @@ class RemoteStore:
         if isinstance(last_err, NotYetObserved):
             raise NotYetObserved(
                 f"remote {method} {path} still unobserved after "
+                f"{self._retries + 1} attempts: {last_err}"
+            )
+        if isinstance(last_err, ShardFrozen):
+            raise ShardFrozen(
+                f"remote {method} {path} still frozen after "
                 f"{self._retries + 1} attempts: {last_err}"
             )
         if isinstance(last_err, NotLeader):
@@ -762,16 +784,29 @@ class RemoteStore:
             pool.close()
 
     def bind_many_remote(
-        self, bindings: List[Binding], return_objects: bool = True
+        self,
+        bindings: List[Binding],
+        return_objects: bool = True,
+        batch_id: Optional[str] = None,
+        ack_ids: Optional[List[str]] = None,
+        assume_retry: bool = False,
     ) -> List[Any]:
         import uuid
 
         # one ack identity per LOGICAL batch: _req_ex serializes the
         # payload once before its retry loop, so every transport retry
         # carries the same batch_id and the server answers already-acked
-        # entries from its registry instead of re-running them
+        # entries from its registry instead of re-running them.
+        # ``batch_id``/``ack_ids`` let a caller that SPLITS one logical
+        # batch across servers (shards.ShardedStore's two-shard commit)
+        # pin the identity itself: the per-item ack id stays stable even
+        # when a topology change re-partitions the sub-batches, so a
+        # chased retry still dedups against the registry entry the first
+        # dispatch recorded.  ``assume_retry`` widens the AlreadyBound→
+        # success conversion to attempt 0 — only safe when the CALLER
+        # knows this call is a re-dispatch of an already-attempted batch.
         items = []
-        for b in bindings:
+        for i, b in enumerate(bindings):
             it: dict = {
                 "namespace": b.pod_namespace,
                 "name": b.pod_name,
@@ -779,6 +814,8 @@ class RemoteStore:
             }
             if b.expected_rv is not None:
                 it["expected_rv"] = b.expected_rv
+            if ack_ids is not None:
+                it["ack"] = str(ack_ids[i])
             items.append(it)
         out, attempts = self._req_ex(
             "POST",
@@ -786,9 +823,11 @@ class RemoteStore:
             {
                 "items": items,
                 "return_objects": return_objects,
-                "batch_id": uuid.uuid4().hex,
+                "batch_id": batch_id or uuid.uuid4().hex,
             },
         )
+        if assume_retry:
+            attempts = max(attempts, 1)
         from minisched_tpu.api.objects import Pod
 
         results: List[Any] = []

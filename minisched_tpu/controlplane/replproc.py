@@ -53,6 +53,7 @@ def _replica_child_main(
     ttl_s: float = DEFAULT_TTL_S,
     parent_pid: Optional[int] = None,
     compact_every_s: float = 0.0,
+    shard: Optional[dict] = None,
 ) -> None:
     """One replica's whole life: recover the store from its own WAL,
     serve data + arbiter façades on fixed ports, join the plane (lead
@@ -62,7 +63,14 @@ def _replica_child_main(
     ``compact_every_s`` > 0 runs a background compaction loop that
     fires only while THIS replica leads with a hub attached — the
     checkpoint-shipping half of DESIGN.md §28: the soak's leader keeps
-    its WAL bounded and followers reseed through generations."""
+    its WAL bounded and followers reseed through generations.
+
+    ``shard`` (DESIGN.md §30) makes this replica one member of one
+    LEADER GROUP of a sharded write plane:
+    ``{"group_id": gid, "topology": ShardTopology.as_dict()}`` — the
+    façade grows the ``/shards/*`` surface and refuses writes for
+    namespaces the topology assigns to other groups.  None (the
+    default) is the unsharded plane, byte-identical to before."""
     from minisched_tpu.controlplane.durable import DurableObjectStore
     from minisched_tpu.controlplane.httpserver import start_api_server
     from minisched_tpu.controlplane.repl import (
@@ -89,8 +97,13 @@ def _replica_child_main(
             ack_timeout_s=ack_timeout_s,
             ttl_s=ttl_s,
         )
+    shard_info = None
+    if shard:
+        from minisched_tpu.controlplane.shards import ShardInfo
+
+        shard_info = ShardInfo(shard["group_id"], shard["topology"])
     start_api_server(ObjectStore(), port=arbiter_port)
-    start_api_server(store, port=data_port, repl=runtime)
+    start_api_server(store, port=data_port, repl=runtime, shard=shard_info)
     if runtime is not None:
         runtime.start(bootstrap_leader or None)
     if compact_every_s and compact_every_s > 0:
@@ -141,6 +154,7 @@ class ReplicaSupervisor:
         ttl_s: float = DEFAULT_TTL_S,
         boot_timeout_s: float = 30.0,
         compact_every_s: float = 0.0,
+        shard: Optional[dict] = None,
     ):
         self.replica_id = replica_id
         self.wal_path = wal_path
@@ -151,6 +165,9 @@ class ReplicaSupervisor:
         self._ttl_s = ttl_s
         self._boot_timeout_s = boot_timeout_s
         self._compact_every_s = compact_every_s
+        #: shard-membership config passed through to the child verbatim
+        #: ({"group_id", "topology"}); None = unsharded replica
+        self.shard = shard
         self._proc: Any = None
         self._peers: List[dict] = []
         self.kills = 0
@@ -197,6 +214,7 @@ class ReplicaSupervisor:
             "ttl_s": self._ttl_s,
             "parent_pid": os.getpid(),
             "compact_every_s": self._compact_every_s,
+            "shard": self.shard,
         }
         env = dict(os.environ)
         repo_root = os.path.dirname(
@@ -281,17 +299,24 @@ class ReplicatedPlane:
         ack_timeout_s: float = 10.0,
         ttl_s: float = DEFAULT_TTL_S,
         compact_every_s: float = 0.0,
+        shard: Optional[dict] = None,
+        replica_prefix: str = "r",
     ):
         self.ttl_s = ttl_s
         os.makedirs(wal_dir, exist_ok=True)
+        # replica ids must be unique across a MULTI-GROUP plane (the
+        # partition layer and replication hub key channels on them), so
+        # a sharded harness prefixes them per group (e.g. "g0r0")
+        self.replica_prefix = replica_prefix
         self.replicas: List[ReplicaSupervisor] = [
             ReplicaSupervisor(
-                f"r{i}",
-                os.path.join(wal_dir, f"r{i}.wal"),
+                f"{replica_prefix}{i}",
+                os.path.join(wal_dir, f"{replica_prefix}{i}.wal"),
                 fsync=fsync,
                 ack_timeout_s=ack_timeout_s,
                 ttl_s=ttl_s,
                 compact_every_s=compact_every_s,
+                shard=shard,
             )
             for i in range(n)
         ]
@@ -303,8 +328,9 @@ class ReplicatedPlane:
         """Boot every replica (r0 bootstraps as leader) and return the
         leader's base_url once a majority of followers is tailing."""
         peers = [r.spec() for r in self.replicas]
+        boot = self.replicas[0].replica_id
         for r in self.replicas:
-            r.start(peers, bootstrap_leader="r0")
+            r.start(peers, bootstrap_leader=boot)
         return self.wait_for_leader()["url"]
 
     def statuses(self) -> Dict[str, dict]:

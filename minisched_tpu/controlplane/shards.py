@@ -1,0 +1,1312 @@
+"""Sharded write plane: namespace-partitioned leader groups (DESIGN.md §30).
+
+Replication (§27) bought redundancy and follower reads (§29) bought N×
+read capacity, but every mutation still funnels through ONE leader's
+group-commit barrier — write throughput is flat no matter how many
+replicas exist.  This module partitions the keyspace by NAMESPACE (the
+tenant boundary the quota layer already enforces) across K independent
+leader groups, each a full §25/§27/§28 plane of its own: its own WAL,
+its own group-commit barrier, its own replication hub + follower quorum,
+its own checkpoint generations.  Aggregate write throughput scales with
+K because the groups share nothing but the topology document.
+
+The moving parts:
+
+* **Placement** — ``ShardTopology.owner(namespace)``: the rendezvous
+  hash from ``ha/membership.shard_owner`` over the sorted group ids,
+  with an ``overrides`` map for namespaces a split has reassigned.
+  Deterministic from the topology alone (two routers that agree on the
+  document agree on every namespace's owner, no coordination round) and
+  minimal-churn by construction (adding/removing a group moves exactly
+  the namespaces whose owner changed).
+* **Server guard** — ``ShardInfo`` on each façade refuses writes for
+  namespaces the topology assigns elsewhere (421 ``WrongShard``) or
+  that sit inside a split's freeze window (503 ``ShardFrozen``), BEFORE
+  the store executes anything.  Accepting a misdirected write would
+  fork the namespace's history across two WALs.
+* **Router** — ``ShardedStore``: one endpoint-aware ``RemoteStore`` per
+  group (so each group keeps its own leader discovery, read rotation,
+  and session-monotonic rv), writes routed by namespace, ``WrongShard``
+  chased by refreshing ``/shards/status`` topology and re-routing.
+* **Vector cursor** — per-shard rvs never form one total order, so
+  cross-namespace consumers carry a ``VectorRV`` ``{group: rv}``:
+  lists merge per-group snapshots under a vector rv, watches merge
+  per-group streams re-tagging every event with the vector cursor after
+  it, and resume/410/relist plus the §29 ``min_rv`` bound stay
+  exactly-once PER SHARD — a scalar rv can never 504 against an
+  unrelated shard's follower because each component only ever bounds
+  its own group.
+* **Two-shard commit** — a bind batch spanning groups splits
+  deterministically, dispatches concurrently under ONE logical batch id
+  with per-item ack ordinals pinned in the logical batch, and returns
+  only after every group is durable.  The WAL-backed ack registry is
+  the dedup primitive: a retried batch replays acked entries from each
+  group's registry and never re-executes on either side, even when a
+  topology change re-partitions the sub-batches between attempts.
+* **Split** — ``split_namespace``: freeze one namespace, ship its
+  objects as a checkpoint-codec handoff doc from the source leader,
+  seed the target leader (§28 machinery), flip the topology epoch,
+  unfreeze, purge the source.  The write-freeze window covers only the
+  moving namespace and only for the doc's round trip.
+
+Kill-switch parity: ``MINISCHED_SHARDS=1`` (or an unsharded server,
+``shard=None``) is byte-identical to today's plane — the guard never
+fires, the router degenerates to a single ``RemoteStore`` passthrough
+(scalar rvs, the same watch object), and no shard record ever touches
+the WAL.  The parity test pins WAL bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
+from minisched_tpu.controlplane.store import (
+    HistoryCompacted,
+    NotYetObserved,
+    ShardFrozen,
+    StorageDegraded,
+    WatchEvent,
+    WrongShard,
+)
+from minisched_tpu.ha.membership import shard_owner
+from minisched_tpu.observability import counters, hist
+
+__all__ = [
+    "ShardTopology",
+    "ShardInfo",
+    "VectorRV",
+    "ShardedStore",
+    "ShardedWatch",
+    "ShardedClient",
+    "ShardedPlane",
+    "split_namespace",
+    "build_handoff",
+    "apply_seed",
+    "purge_namespace",
+    "shard_count",
+]
+
+_CLUSTER_SCOPED = {"Node", "PersistentVolume"}
+
+
+def shard_count(default: int = 1) -> int:
+    """The ``MINISCHED_SHARDS`` kill switch: how many leader groups a
+    harness should run.  1 (the default) is the unsharded plane —
+    pinned byte-identical to the pre-shard plane by the parity test."""
+    try:
+        return max(int(os.environ.get("MINISCHED_SHARDS", str(default))), 1)
+    except ValueError:
+        return max(default, 1)
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+
+class ShardTopology:
+    """The pure-data shard map: which leader groups exist, which
+    endpoints serve each, and which namespaces a split has reassigned.
+    Pushed as config by the split driver (never consensus state — the
+    correctness backstop is the server-side guard: a router holding a
+    stale document gets a typed 421 and refreshes)."""
+
+    def __init__(
+        self,
+        groups: Dict[str, List[str]],
+        epoch: int = 1,
+        overrides: Optional[Dict[str, str]] = None,
+        frozen: Optional[List[str]] = None,
+    ):
+        if not groups:
+            raise ValueError("topology requires at least one group")
+        self.epoch = int(epoch)
+        self.groups = {
+            str(g): [u.rstrip("/") for u in urls] for g, urls in groups.items()
+        }
+        self.overrides = dict(overrides or {})
+        self.frozen = set(frozen or [])
+        for ns, gid in self.overrides.items():
+            if gid not in self.groups:
+                raise ValueError(f"override {ns!r} names unknown group {gid!r}")
+
+    def owner(self, namespace: str) -> str:
+        """The group owning ``namespace`` — override first, else the
+        rendezvous hash over the sorted group ids.  Cluster-scoped
+        objects live in namespace "" and get one deterministic home
+        group like any other key."""
+        own = self.overrides.get(namespace)
+        if own is not None:
+            return own
+        return shard_owner(namespace, sorted(self.groups))
+
+    def as_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "groups": {g: list(u) for g, u in self.groups.items()},
+            "overrides": dict(self.overrides),
+            "frozen": sorted(self.frozen),
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ShardTopology":
+        return cls(
+            doc["groups"],
+            epoch=doc.get("epoch", 1),
+            overrides=doc.get("overrides"),
+            frozen=doc.get("frozen"),
+        )
+
+    def copy(self) -> "ShardTopology":
+        return ShardTopology.from_dict(self.as_dict())
+
+
+class ShardInfo:
+    """One façade's view of its own shard membership: the group this
+    replica belongs to plus the current topology.  The ownership guard
+    every write verb consults lives here (httpserver._shard_guard); the
+    split driver mutates it through ``/shards/control``."""
+
+    def __init__(self, group_id: str, topology: Any):
+        self.group_id = str(group_id)
+        if isinstance(topology, dict):
+            topology = ShardTopology.from_dict(topology)
+        self._mu = threading.Lock()
+        self._topology = topology
+        if self.group_id not in topology.groups:
+            raise ValueError(
+                f"group {self.group_id!r} not in topology "
+                f"{sorted(topology.groups)}"
+            )
+
+    @property
+    def topology(self) -> ShardTopology:
+        with self._mu:
+            return self._topology
+
+    def check_write(self, namespace: str) -> None:
+        """Raise WrongShard/ShardFrozen when this group must not execute
+        a write in ``namespace`` (the effective namespace: "" for
+        cluster-scoped kinds).  Called BEFORE the store runs anything."""
+        with self._mu:
+            topo = self._topology
+            if namespace in topo.frozen:
+                raise ShardFrozen(
+                    f"shard frozen: namespace {namespace!r} is mid-split "
+                    f"(epoch {topo.epoch})"
+                )
+            own = topo.owner(namespace)
+            if own != self.group_id:
+                raise WrongShard(
+                    f"wrong shard: namespace {namespace!r} is owned by "
+                    f"group {own!r}, this façade serves group "
+                    f"{self.group_id!r} (epoch {topo.epoch})"
+                )
+
+    def describe(self) -> dict:
+        with self._mu:
+            return {
+                "group": self.group_id,
+                "epoch": self._topology.epoch,
+                "topology": self._topology.as_dict(),
+            }
+
+    def apply_control(self, body: dict) -> None:
+        """One ``/shards/control`` op: ``topology`` replaces the whole
+        document (stale epochs refused — a racing older push must not
+        roll the map back), ``freeze``/``unfreeze`` toggle one
+        namespace's split window without an epoch bump."""
+        op = body.get("op")
+        if op == "topology":
+            new = ShardTopology.from_dict(body["topology"])
+            with self._mu:
+                if new.epoch < self._topology.epoch:
+                    raise ValueError(
+                        f"stale topology epoch {new.epoch} < "
+                        f"{self._topology.epoch}"
+                    )
+                # a freeze applied through the freeze op survives a
+                # same-epoch re-push that does not mention it
+                new.frozen |= self._topology.frozen - set(
+                    body["topology"].get("unfrozen", [])
+                )
+                self._topology = new
+            counters.inc("storage.shard.topology_updates")
+        elif op == "freeze":
+            ns = body["namespace"]
+            with self._mu:
+                self._topology.frozen.add(ns)
+            counters.inc("storage.shard.freezes")
+        elif op == "unfreeze":
+            ns = body["namespace"]
+            with self._mu:
+                self._topology.frozen.discard(ns)
+        else:
+            raise ValueError(f"unknown shard control op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# split machinery: handoff / seed / purge (server side)
+# ---------------------------------------------------------------------------
+
+
+def build_handoff(store: Any, namespace: str) -> dict:
+    """One namespace's objects as a checkpoint-codec document — the §28
+    snapshot encoding filtered to the moving namespace.  Served by the
+    SOURCE group's leader while the namespace is frozen, so the doc is a
+    consistent cut: no write can land between the per-kind lists."""
+    objects: Dict[str, list] = {}
+    total = 0
+    for kind in KIND_TYPES:
+        items = [
+            _encode(o)
+            for o in store.list(kind)
+            if o.metadata.namespace == namespace
+        ]
+        if items:
+            objects[kind] = items
+            total += len(items)
+    counters.inc("storage.shard.handoff_ships")
+    counters.inc("storage.shard.handoff_objects", total)
+    return {
+        "version": 1,
+        "namespace": namespace,
+        "resource_version": store.applied_rv(),
+        "objects": objects,
+    }
+
+
+def apply_seed(store: Any, doc: dict) -> dict:
+    """Install a handoff doc's objects into the TARGET group's store
+    through the normal durable create path (they WAL, they replicate,
+    they fan out — the namespace's history restarts cleanly on this
+    group's rv line with uids preserved).  Idempotent per item: a
+    retried seed's already-created objects come back as per-item
+    conflicts and are counted as skipped."""
+    created = skipped = 0
+    for kind, items in (doc.get("objects") or {}).items():
+        if kind not in KIND_TYPES:
+            raise ValueError(f"handoff doc names unknown kind {kind!r}")
+        objs = [_decode(KIND_TYPES[kind], it) for it in items]
+        for res in store.create_many(kind, objs, return_objects=False):
+            if isinstance(res, StorageDegraded):
+                raise res
+            if isinstance(res, BaseException):
+                skipped += 1
+            else:
+                created += 1
+    counters.inc("storage.shard.seed_objects", created)
+    return {
+        "namespace": doc.get("namespace", ""),
+        "created": created,
+        "skipped": skipped,
+    }
+
+
+def purge_namespace(store: Any, namespace: str) -> dict:
+    """Delete a moved namespace's objects from the SOURCE group after
+    the topology flipped — the final step of a split.  The deletes fan
+    out as DELETED watch events on this group; a vector-cursor watch
+    suppresses them (the group no longer owns the namespace), so
+    consumers keep the target group's live copies."""
+    deleted = 0
+    for kind in KIND_TYPES:
+        for o in store.list(kind):
+            if o.metadata.namespace != namespace:
+                continue
+            try:
+                store.delete(kind, namespace, o.metadata.name)
+                deleted += 1
+            except KeyError:
+                pass  # raced its own retry
+    counters.inc("storage.shard.purged_objects", deleted)
+    return {"namespace": namespace, "deleted": deleted}
+
+
+# ---------------------------------------------------------------------------
+# vector cursor
+# ---------------------------------------------------------------------------
+
+
+def _covers(a: Dict[str, int], b: Dict[str, int]) -> bool:
+    """Pointwise a ≥ b (missing components are 0)."""
+    for k, v in b.items():
+        if int(a.get(k, 0)) < int(v):
+            return False
+    return True
+
+
+class VectorRV(dict):
+    """A ``{group_id: rv}`` watch/list cursor over the sharded plane.
+
+    Per-shard rvs never form one total order, so the cursor is a vector
+    ordered by DOMINANCE: ``a > b`` iff a is pointwise ≥ b and has
+    advanced somewhere.  That is exactly the comparison the informer's
+    cursor logic performs (``ev.rv > self._last_rv``; ``max(cursor,
+    start_rv)``) — events from a merged stream only ever advance one
+    component at a time, so successive cursors are always comparable and
+    the informer code runs UNCHANGED over vectors.  Serializes as a
+    plain JSON object (it is a dict).
+
+    Against an int, only the 0/"" falsy case is ever exercised (the
+    informer's initial cursor): truthiness and ``> 0`` mean "any
+    component has advanced"."""
+
+    def __bool__(self) -> bool:
+        return any(int(v) > 0 for v in self.values())
+
+    def __gt__(self, other: Any) -> bool:
+        if isinstance(other, dict):
+            return _covers(self, other) and not _covers(other, self)
+        o = int(other)
+        if o <= 0:
+            return bool(self)
+        return bool(self) and min(int(v) for v in self.values()) > o
+
+    def __ge__(self, other: Any) -> bool:
+        if isinstance(other, dict):
+            return _covers(self, other)
+        o = int(other)
+        if o <= 0:
+            return True
+        return bool(self) and min(int(v) for v in self.values()) >= o
+
+    def __lt__(self, other: Any) -> bool:
+        if isinstance(other, dict):
+            return _covers(other, self) and not _covers(self, other)
+        return not self.__ge__(other)
+
+    def __le__(self, other: Any) -> bool:
+        if isinstance(other, dict):
+            return _covers(other, self)
+        return not self.__gt__(other)
+
+
+# ---------------------------------------------------------------------------
+# merged watch
+# ---------------------------------------------------------------------------
+
+#: how long a per-shard merger waits between reopen attempts after its
+#: stream dies mid-run (the per-group RemoteStore already rotates
+#: endpoints inside one open; this paces attempts across elections)
+_REOPEN_BACKOFF_S = 0.25
+_REOPEN_BACKOFF_MAX_S = 2.0
+
+
+class ShardedWatch:
+    """K per-group watch streams merged into one Watch-shaped consumer.
+
+    Every delivered event is RE-TAGGED with the vector cursor after it
+    (``{**cursor, group: event.rv}`` built under the merge lock, so
+    cursors are monotone in delivery order).  A shard's stream dying
+    mid-run reopens ONLY that shard at its last-delivered component rv —
+    the server's exact ``rv > resume_rv`` replay keeps that shard
+    exactly-once while the other shards never miss a beat.  Any shard's
+    history being compacted past its cursor kills the whole watch (the
+    consumer's 410 path relists with a fresh vector).
+
+    Ownership filter: LIVE events from a group that does not own the
+    event's namespace (a split's purge deletes, or stale pre-move
+    copies) are suppressed — the owner's stream is the one source of
+    truth per namespace.  Initial snapshot replay is NOT suppressed:
+    the SYNC contract promises exactly ``initial_count()`` replayed
+    events and the sync barrier counts them."""
+
+    def __init__(
+        self,
+        sstore: "ShardedStore",
+        kind: str,
+        send_initial: bool,
+        resume: Optional[Dict[str, int]],
+    ):
+        self._sstore = sstore
+        self._kind = kind
+        self._cond = threading.Condition()
+        self._events: List[WatchEvent] = []
+        self._stopped = False
+        self._explicit_stop = False
+        self._initial_total = 0
+        gids = sorted(sstore._stores)
+        if resume is not None:
+            missing = [g for g in gids if int(resume.get(g, 0)) <= 0]
+            if missing:
+                # a group this cursor has never observed (topology grew
+                # since the cursor was cut): resuming it from 0 would
+                # replay its whole history — force the relist path, the
+                # fresh list carries a complete vector
+                raise HistoryCompacted(
+                    f"vector cursor missing groups {missing} "
+                    f"(topology epoch {sstore._topology.epoch})"
+                )
+        self._shard_rv: Dict[str, int] = {}
+        self._watches: Dict[str, Any] = {}
+        #: initial-replay countdown per group: events inside it bypass
+        #: the ownership filter (see class docstring)
+        self._replaying: Dict[str, int] = {}
+        opened: List[Any] = []
+        try:
+            for gid in gids:
+                rs = sstore._stores[gid]
+                rv = int(resume[gid]) if resume is not None else None
+                w, snapshot = rs.watch(
+                    kind,
+                    send_initial=send_initial and resume is None,
+                    resume_rv=rv,
+                )
+                opened.append(w)
+                self._watches[gid] = w
+                self._shard_rv[gid] = (
+                    rv if rv is not None else int(getattr(w, "start_rv", 0))
+                )
+                self._replaying[gid] = len(snapshot)
+                self._initial_total += len(snapshot)
+        except BaseException:
+            for w in opened:
+                w.stop()
+            raise
+        self.start_rv = VectorRV(self._shard_rv)
+        self._threads = [
+            threading.Thread(
+                target=self._merge,
+                args=(gid,),
+                name=f"shard-watch-{kind}-{gid}",
+                daemon=True,
+            )
+            for gid in gids
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- merger -------------------------------------------------------------
+    def _merge(self, gid: str) -> None:
+        watch = self._watches[gid]
+        backoff = _REOPEN_BACKOFF_S
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+            batch = watch.next_batch(timeout=0.25)
+            if batch:
+                backoff = _REOPEN_BACKOFF_S
+                self._deliver(gid, batch)
+                continue
+            if not watch.stopped:
+                continue
+            if self._explicit_stop:
+                return
+            # mid-run stream death: reopen ONLY this shard at its
+            # last-delivered component rv — the other shards' mergers
+            # never notice (the "unaffected shards never stall" half of
+            # the chaos gate)
+            try:
+                watch = self._reopen(gid)
+                self._watches[gid] = watch
+                backoff = _REOPEN_BACKOFF_S
+            except HistoryCompacted:
+                # this shard's tail is gone past our cursor: the whole
+                # vector cursor is dead — consumer must relist
+                self._die()
+                return
+            except Exception:
+                with self._cond:
+                    if self._stopped:
+                        return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, _REOPEN_BACKOFF_MAX_S)
+
+    def _reopen(self, gid: str) -> Any:
+        with self._cond:
+            rv = self._shard_rv[gid]
+        counters.inc("shard.watch_reopen")
+        w, _ = self._sstore._stores[gid].watch(
+            self._kind, send_initial=False, resume_rv=rv
+        )
+        return w
+
+    def _deliver(self, gid: str, batch: List[WatchEvent]) -> None:
+        sstore = self._sstore
+        out: List[WatchEvent] = []
+        with self._cond:
+            if self._stopped:
+                return
+            for ev in batch:
+                replay = self._replaying.get(gid, 0)
+                if replay > 0:
+                    self._replaying[gid] = replay - 1
+                else:
+                    ns = (
+                        ""
+                        if self._kind in _CLUSTER_SCOPED
+                        else ev.obj.metadata.namespace
+                    )
+                    if sstore._owner_gid(ns) != gid:
+                        counters.inc("shard.events_suppressed")
+                        if ev.rv > self._shard_rv[gid]:
+                            # the cursor still advances past suppressed
+                            # events — a resume must not replay them
+                            self._shard_rv[gid] = ev.rv
+                        continue
+                if ev.rv > self._shard_rv[gid]:
+                    self._shard_rv[gid] = ev.rv
+                out.append(
+                    WatchEvent(
+                        ev.type,
+                        ev.obj,
+                        old_obj=ev.old_obj,
+                        rv=VectorRV(self._shard_rv),
+                        born=ev.born,
+                    )
+                )
+            if out:
+                self._events.extend(out)
+                self._cond.notify_all()
+
+    def _die(self) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+        for w in self._watches.values():
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+    # -- Watch surface ------------------------------------------------------
+    def initial_count(self, timeout: float = 30.0) -> int:
+        return self._initial_total
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        batch = self._wait(timeout, take_all=False)
+        return batch[0] if batch else None
+
+    def next_batch(self, timeout: Optional[float] = None) -> List[WatchEvent]:
+        return self._wait(timeout, take_all=True)
+
+    def _wait(
+        self, timeout: Optional[float], take_all: bool
+    ) -> List[WatchEvent]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._events and not self._stopped:
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        break
+            if not self._events:
+                return []
+            if take_all:
+                out, self._events = self._events, []
+                return out
+            return [self._events.pop(0)]
+
+    def stop(self) -> None:
+        self._explicit_stop = True
+        self._die()
+
+    @property
+    def stopped(self) -> bool:
+        with self._cond:
+            return self._stopped
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+#: bounded WrongShard chase: stale-topology retries per logical call
+_CHASE_ATTEMPTS = 3
+
+
+def _raw_req(
+    base: str, method: str, path: str, payload: Any = None,
+    timeout_s: float = 10.0,
+) -> Tuple[int, Any]:
+    """One pooled request outside any RemoteStore (topology discovery
+    and the split driver's control fanout)."""
+    from minisched_tpu.controlplane.httppool import shared_pool
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    status, raw, _ = shared_pool(base, timeout_s=timeout_s).request(
+        method, path, body=data
+    )
+    try:
+        doc = json.loads(raw) if raw else {}
+    except ValueError:
+        doc = {}
+    return status, doc
+
+
+def fetch_topology(url: str, timeout_s: float = 10.0) -> ShardTopology:
+    """One façade's ``/shards/status`` → its topology document.  A 404
+    means the server is UNSHARDED: synthesized as a single-group
+    topology so every router code path (including the K=1 parity
+    passthrough) works against it unchanged."""
+    status, doc = _raw_req(url, "GET", "/shards/status", timeout_s=timeout_s)
+    if status == 404:
+        return ShardTopology({"g0": [url]}, epoch=0)
+    if status != 200:
+        raise RuntimeError(f"GET {url}/shards/status: HTTP {status}: {doc}")
+    return ShardTopology.from_dict(doc["topology"])
+
+
+class ShardedStore:
+    """The ObjectStore surface informers + the engine consume, routed
+    across K leader groups.  One endpoint-aware RemoteStore per group;
+    ``**remote_kwargs`` pass through to each (timeouts, retry policy,
+    fault fabric).
+
+    K=1 is a literal passthrough to the single RemoteStore — scalar
+    rvs, the same RemoteWatch objects, the same bytes on the wire: the
+    kill-switch parity path."""
+
+    def __init__(
+        self,
+        seeds: Optional[List[str]] = None,
+        topology: Optional[ShardTopology] = None,
+        **remote_kwargs: Any,
+    ):
+        if topology is None:
+            if not seeds:
+                raise ValueError("ShardedStore needs seeds or a topology")
+            last: Optional[BaseException] = None
+            for url in seeds:
+                try:
+                    topology = fetch_topology(url)
+                    break
+                except Exception as e:  # noqa: BLE001 — probe next seed
+                    last = e
+            if topology is None:
+                raise RuntimeError(f"no seed answered /shards/status: {last}")
+        self._kw = dict(remote_kwargs)
+        self._mu = threading.Lock()
+        self._topology = topology
+        self._stores: Dict[str, Any] = {}
+        self._build_stores(topology)
+        #: RemoteStore parity: informer jitter reads ``store.faults``
+        self.faults = self._kw.get("faults")
+
+    def _build_stores(self, topology: ShardTopology) -> None:
+        from minisched_tpu.controlplane.remote import RemoteStore
+
+        fresh: Dict[str, Any] = {}
+        for gid, eps in topology.groups.items():
+            old = self._stores.get(gid)
+            if old is not None and old._endpoints == [
+                u.rstrip("/") for u in eps
+            ]:
+                fresh[gid] = old
+                continue
+            fresh[gid] = RemoteStore(
+                eps[0], endpoints=list(eps), **self._kw
+            )
+        for gid, rs in self._stores.items():
+            if fresh.get(gid) is not rs:
+                rs.close()
+        self._stores = fresh
+
+    # -- routing ------------------------------------------------------------
+    @property
+    def topology(self) -> ShardTopology:
+        with self._mu:
+            return self._topology
+
+    @property
+    def _single(self) -> Optional[Any]:
+        """The one RemoteStore when K == 1 (the passthrough path)."""
+        with self._mu:
+            if len(self._stores) == 1:
+                return next(iter(self._stores.values()))
+        return None
+
+    def _owner_gid(self, namespace: str) -> str:
+        with self._mu:
+            return self._topology.owner(namespace)
+
+    def _effective_ns(self, kind: str, namespace: str) -> str:
+        return "" if kind in _CLUSTER_SCOPED else (namespace or "default")
+
+    def _store_for(self, kind: str, namespace: str) -> Any:
+        gid = self._owner_gid(self._effective_ns(kind, namespace))
+        with self._mu:
+            return self._stores[gid]
+
+    def refresh_topology(self) -> ShardTopology:
+        """Re-discover the topology from every known endpoint, adopting
+        the highest epoch that answers — the WrongShard chase's other
+        half."""
+        t0 = time.monotonic()
+        with self._mu:
+            urls = [u for eps in self._topology.groups.values() for u in eps]
+            best = self._topology
+        for url in urls:
+            try:
+                topo = fetch_topology(url)
+            except Exception:  # noqa: BLE001 — dead endpoint, probe on
+                continue
+            if topo.epoch > best.epoch:
+                best = topo
+        with self._mu:
+            if best.epoch > self._topology.epoch:
+                self._topology = best
+                self._build_stores(best)
+            out = self._topology
+        counters.inc("shard.topology_refreshes")
+        hist.observe("shard.route_s", time.monotonic() - t0)
+        return out
+
+    def _chase(self, fn: Any) -> Any:
+        """Run ``fn()`` (which resolves its target group per call),
+        refreshing topology on WrongShard — the typed 421 a stale
+        router gets from a façade whose namespace moved."""
+        last: Optional[BaseException] = None
+        for _ in range(_CHASE_ATTEMPTS):
+            try:
+                return fn()
+            except WrongShard as e:
+                counters.inc("shard.wrong_shard_chased")
+                last = e
+                self.refresh_topology()
+        raise last if last is not None else RuntimeError("unreachable")
+
+    # -- session rv (vector) -------------------------------------------------
+    @property
+    def session_rv(self) -> Any:
+        single = self._single
+        if single is not None:
+            return single.session_rv
+        with self._mu:
+            return VectorRV(
+                {g: rs.session_rv for g, rs in self._stores.items()}
+            )
+
+    def observe_rv(self, rv: Any) -> None:
+        """Advance per-group session floors from a vector cursor.  A
+        bare int is DROPPED in multi-group mode on purpose: a scalar rv
+        carries no group identity, and bounding every group's reads by
+        it would 504 unrelated shards' followers against a number from
+        someone else's history (the exact failure the vector cursor
+        exists to prevent)."""
+        single = self._single
+        if single is not None:
+            if isinstance(rv, dict):
+                rv = max((int(v) for v in rv.values()), default=0)
+            single.observe_rv(int(rv))
+            return
+        if not isinstance(rv, dict):
+            return
+        with self._mu:
+            stores = dict(self._stores)
+        for gid, component in rv.items():
+            rs = stores.get(gid)
+            if rs is not None:
+                rs.observe_rv(int(component))
+
+    # -- reads --------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        single = self._single
+        if single is not None:
+            return single.get(kind, namespace, name)
+        try:
+            return self._store_for(kind, namespace).get(kind, namespace, name)
+        except KeyError:
+            # the namespace may have MOVED since our topology: one
+            # refresh, and only a changed owner earns a retry (a true
+            # 404 must not pay a second round trip every time)
+            ns = self._effective_ns(kind, namespace)
+            before = self._owner_gid(ns)
+            self.refresh_topology()
+            if self._owner_gid(ns) == before:
+                raise
+            return self._store_for(kind, namespace).get(kind, namespace, name)
+
+    def list(self, kind: str) -> List[Any]:
+        return self.list_with_rv(kind)[0]
+
+    def list_with_rv(self, kind: str) -> Tuple[List[Any], Any]:
+        """Merged cross-shard list under a vector rv: each group's
+        snapshot is epoch-consistent per shard, filtered to the
+        namespaces that group OWNS (a mid-split double-residence never
+        yields duplicates), concatenated.  The vector rv is exactly the
+        resume cursor a follow-up ``watch(resume_rv=...)`` consumes."""
+        single = self._single
+        if single is not None:
+            return single.list_with_rv(kind)
+        with self._mu:
+            stores = dict(self._stores)
+        items: List[Any] = []
+        rv = VectorRV()
+        for gid in sorted(stores):
+            sub, sub_rv = stores[gid].list_with_rv(kind)
+            for o in sub:
+                ns = self._effective_ns(kind, o.metadata.namespace)
+                if self._owner_gid(ns) == gid:
+                    items.append(o)
+            rv[gid] = int(sub_rv)
+        return items, rv
+
+    def watch(
+        self,
+        kind: str,
+        send_initial: bool = True,
+        resume_rv: Any = None,
+    ) -> Tuple[Any, List[Any]]:
+        single = self._single
+        if single is not None:
+            if isinstance(resume_rv, dict):
+                resume_rv = max(
+                    (int(v) for v in resume_rv.values()), default=0
+                )
+            return single.watch(
+                kind, send_initial=send_initial, resume_rv=resume_rv
+            )
+        resume: Optional[Dict[str, int]] = None
+        if isinstance(resume_rv, dict):
+            resume = {g: int(v) for g, v in resume_rv.items()}
+        elif resume_rv:
+            # a scalar resume cursor cannot be attributed to any shard:
+            # force the relist path rather than replay the wrong history
+            raise HistoryCompacted(
+                f"scalar resume cursor {resume_rv!r} on a sharded plane"
+            )
+        w = ShardedWatch(self, kind, send_initial, resume)
+        return w, [None] * w.initial_count()
+
+    # -- writes -------------------------------------------------------------
+    def create(self, kind: str, obj: Any) -> Any:
+        return self._chase(
+            lambda: self._store_for(kind, obj.metadata.namespace).create(
+                kind, obj
+            )
+        )
+
+    def update(
+        self, kind: str, obj: Any, expected_rv: Optional[int] = None
+    ) -> Any:
+        return self._chase(
+            lambda: self._store_for(kind, obj.metadata.namespace).update(
+                kind, obj, expected_rv=expected_rv
+            )
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        return self._chase(
+            lambda: self._store_for(kind, namespace).delete(
+                kind, namespace, name
+            )
+        )
+
+    def mutate(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        fn: Any,
+        max_conflict_retries: int = 16,
+    ) -> Any:
+        return self._chase(
+            lambda: self._store_for(kind, namespace).mutate(
+                kind, namespace, name, fn,
+                max_conflict_retries=max_conflict_retries,
+            )
+        )
+
+    def create_many(
+        self, kind: str, objs: List[Any], return_objects: bool = True
+    ) -> List[Any]:
+        single = self._single
+        if single is not None:
+            return single.create_many(
+                kind, objs, return_objects=return_objects
+            )
+        results: List[Any] = [None] * len(objs)
+        pending = list(range(len(objs)))
+        for attempt in range(_CHASE_ATTEMPTS):
+            by_gid: Dict[str, List[int]] = {}
+            for i in pending:
+                ns = self._effective_ns(kind, objs[i].metadata.namespace)
+                by_gid.setdefault(self._owner_gid(ns), []).append(i)
+            still: List[int] = []
+            chased = False
+            with self._mu:
+                stores = dict(self._stores)
+            for gid, idxs in by_gid.items():
+                try:
+                    sub = stores[gid].create_many(
+                        kind, [objs[i] for i in idxs],
+                        return_objects=return_objects,
+                    )
+                except WrongShard:
+                    counters.inc("shard.wrong_shard_chased")
+                    chased = True
+                    still.extend(idxs)
+                    continue
+                for i, res in zip(idxs, sub):
+                    results[i] = res
+            if not still:
+                return results
+            pending = still
+            if chased and attempt < _CHASE_ATTEMPTS - 1:
+                self.refresh_topology()
+        for i in pending:
+            results[i] = WrongShard(
+                f"create_many: no owning group accepted item {i} after "
+                f"{_CHASE_ATTEMPTS} topology refreshes"
+            )
+        return results
+
+    # -- two-shard bind commit ----------------------------------------------
+    def bind_many_remote(
+        self,
+        bindings: List[Any],
+        return_objects: bool = True,
+        batch_id: Optional[str] = None,
+    ) -> List[Any]:
+        """A wave's bind batch across shards as a TWO-SHARD COMMIT.
+
+        The batch splits deterministically by namespace owner and every
+        sub-batch POSTs concurrently under ONE logical ``batch_id`` with
+        each binding's ordinal in the LOGICAL batch pinned as its ack
+        id.  The call returns only after EVERY group has answered — and
+        a group's 200 is ack-after-durability (§25), so success means
+        both sides are durable.
+
+        Exactly-once across retries: each group's WAL-backed ack
+        registry (PR 5) answers already-acked ordinals without
+        re-executing, keyed ``{batch_id}/{ordinal}`` — stable even when
+        a topology change re-partitions the sub-batches, because the
+        ordinal is the LOGICAL batch position, not the sub-batch index.
+        A group that fails outright leaves its items as typed per-item
+        errors; the caller re-posts the SAME logical batch and the
+        durable side replays from its registry while the failed side
+        executes for the first time — never a double execution, never a
+        half-acked batch reported as success."""
+        single = self._single
+        if single is not None:
+            return single.bind_many_remote(
+                bindings, return_objects=return_objects, batch_id=batch_id
+            )
+        logical = batch_id or uuid.uuid4().hex
+        results: List[Any] = [None] * len(bindings)
+        pending = list(range(len(bindings)))
+        t0 = time.monotonic()
+        crossed = False
+        for attempt in range(_CHASE_ATTEMPTS):
+            by_gid: Dict[str, List[int]] = {}
+            for i in pending:
+                ns = self._effective_ns(
+                    "Pod", bindings[i].pod_namespace
+                )
+                by_gid.setdefault(self._owner_gid(ns), []).append(i)
+            if attempt == 0 and len(by_gid) > 1:
+                crossed = True
+                counters.inc("shard.cross_bind_batches")
+                counters.inc("shard.cross_bind_entries", len(bindings))
+            with self._mu:
+                stores = dict(self._stores)
+            wrong: List[int] = []
+            wrong_mu = threading.Lock()
+
+            def dispatch(gid: str, idxs: List[int]) -> None:
+                try:
+                    sub = stores[gid].bind_many_remote(
+                        [bindings[i] for i in idxs],
+                        return_objects=return_objects,
+                        batch_id=logical,
+                        ack_ids=[str(i) for i in idxs],
+                        # a re-dispatch after a chase may follow a lost
+                        # first execution on the previous owner (whose
+                        # bound pods the split seeded over): convert
+                        # AlreadyBound-to-our-node to success like any
+                        # retried attempt
+                        assume_retry=attempt > 0,
+                    )
+                except WrongShard:
+                    counters.inc("shard.wrong_shard_chased")
+                    with wrong_mu:
+                        wrong.extend(idxs)
+                    return
+                except BaseException as e:  # noqa: BLE001 — typed per item
+                    for i in idxs:
+                        results[i] = e
+                    return
+                for i, res in zip(idxs, sub):
+                    results[i] = res
+
+            threads = [
+                threading.Thread(target=dispatch, args=(gid, idxs))
+                for gid, idxs in by_gid.items()
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if not wrong:
+                break
+            pending = wrong
+            if attempt < _CHASE_ATTEMPTS - 1:
+                self.refresh_topology()
+            else:
+                for i in pending:
+                    results[i] = WrongShard(
+                        "bind: no owning group accepted after "
+                        f"{_CHASE_ATTEMPTS} topology refreshes"
+                    )
+        if crossed:
+            hist.observe("shard.crossbind_s", time.monotonic() - t0)
+        return results
+
+    def close(self) -> None:
+        with self._mu:
+            stores = list(self._stores.values())
+        for rs in stores:
+            rs.close()
+
+
+class ShardedClient:
+    """Client facade over a ShardedStore — what ``RemoteClient`` is to
+    one ``RemoteStore``.  ``seeds`` may be any façade of any group
+    (topology discovery finds the rest); kwargs pass to each group's
+    RemoteStore."""
+
+    def __init__(self, seeds: List[str], **kwargs: Any):
+        self.store = ShardedStore(seeds=seeds, **kwargs)
+
+    def nodes(self) -> Any:
+        from minisched_tpu.controlplane.remote import _RemoteNodeAPI
+
+        return _RemoteNodeAPI(self.store)
+
+    def pods(self, namespace: str = "default") -> Any:
+        from minisched_tpu.controlplane.remote import _RemotePodAPI
+
+        return _RemotePodAPI(self.store, namespace)
+
+
+# ---------------------------------------------------------------------------
+# split driver
+# ---------------------------------------------------------------------------
+
+
+def _leader_of(endpoints: List[str], timeout_s: float = 10.0) -> str:
+    """The writable façade of one group: probe ``/repl/status`` on each
+    endpoint — 404 means unreplicated (that server IS the leader),
+    otherwise the replica claiming the unfenced leader role."""
+    last: Any = None
+    for url in endpoints:
+        try:
+            status, doc = _raw_req(
+                url, "GET", "/repl/status", timeout_s=timeout_s
+            )
+        except Exception as e:  # noqa: BLE001 — dead replica, probe on
+            last = e
+            continue
+        if status == 404:
+            return url
+        if status == 200 and doc.get("role") == "leader" \
+                and not doc.get("fenced"):
+            return url
+    raise RuntimeError(f"no leader among {endpoints}: {last}")
+
+
+def _control_all(topology: ShardTopology, body: dict) -> None:
+    """Push one ``/shards/control`` op to EVERY replica of every group
+    (each façade guards writes off its own ShardInfo copy)."""
+    errors = []
+    for gid, eps in topology.groups.items():
+        for url in eps:
+            try:
+                status, doc = _raw_req(
+                    url, "POST", "/shards/control", body
+                )
+                if status != 200:
+                    errors.append(f"{url}: HTTP {status}: {doc}")
+            except Exception as e:  # noqa: BLE001 — collect, report below
+                errors.append(f"{url}: {e}")
+    # a dead replica is tolerated (it re-learns the topology when its
+    # supervisor restarts it with the new doc, and until then its
+    # fenced store refuses writes anyway); a LIVE refusal is not
+    if any("HTTP 4" in e for e in errors):
+        raise RuntimeError(f"shard control refused: {errors}")
+
+
+def split_namespace(
+    topology: ShardTopology,
+    namespace: str,
+    target_gid: str,
+    timeout_s: float = 30.0,
+) -> dict:
+    """Reassign ``namespace`` to ``target_gid`` via checkpoint-seed
+    handoff (DESIGN.md §30): freeze writes for ONLY this namespace on
+    every façade, ship its objects from the source leader as a §28-codec
+    doc, seed the target leader through the normal durable path, flip
+    the topology epoch everywhere, unfreeze, purge the source.  Returns
+    ``{namespace, from, to, epoch, objects, freeze_s}``; the freeze
+    window is the doc's round trip, not a function of shard size.
+
+    On failure before the topology flip, the namespace is unfrozen and
+    ownership is UNCHANGED (a partially-seeded target holds orphaned
+    copies the next attempt's seed skips as conflicts — harmless, the
+    topology never pointed at them)."""
+    if target_gid not in topology.groups:
+        raise ValueError(f"unknown target group {target_gid!r}")
+    source_gid = topology.owner(namespace)
+    if source_gid == target_gid:
+        return {
+            "namespace": namespace, "from": source_gid, "to": target_gid,
+            "epoch": topology.epoch, "objects": 0, "freeze_s": 0.0,
+        }
+    t0 = time.monotonic()
+    _control_all(topology, {"op": "freeze", "namespace": namespace})
+    flipped = False
+    try:
+        src = _leader_of(topology.groups[source_gid], timeout_s)
+        dst = _leader_of(topology.groups[target_gid], timeout_s)
+        status, doc = _raw_req(
+            src, "GET", f"/shards/handoff?namespace={namespace}",
+            timeout_s=timeout_s,
+        )
+        if status != 200:
+            raise RuntimeError(f"handoff: HTTP {status}: {doc}")
+        status, seeded = _raw_req(
+            dst, "POST", "/shards/seed", doc, timeout_s=timeout_s
+        )
+        if status != 200:
+            raise RuntimeError(f"seed: HTTP {status}: {seeded}")
+        new_topo = topology.copy()
+        new_topo.epoch += 1
+        new_topo.overrides[namespace] = target_gid
+        new_topo.frozen.discard(namespace)
+        _control_all(
+            topology,
+            {
+                "op": "topology",
+                "topology": dict(
+                    new_topo.as_dict(), unfrozen=[namespace]
+                ),
+            },
+        )
+        flipped = True
+    finally:
+        _control_all(topology, {"op": "unfreeze", "namespace": namespace})
+    freeze_s = time.monotonic() - t0
+    # purge AFTER the unfreeze: ownership already flipped, so the source
+    # refuses new writes for the namespace regardless — the purge only
+    # clears the stale residents out of its snapshot
+    status, purged = _raw_req(
+        src, "POST", "/shards/purge", {"namespace": namespace},
+        timeout_s=timeout_s,
+    )
+    if status != 200:
+        raise RuntimeError(f"purge: HTTP {status}: {purged}")
+    counters.inc("shard.splits")
+    assert flipped
+    topology.epoch = new_topo.epoch
+    topology.overrides[namespace] = target_gid
+    topology.frozen.discard(namespace)
+    return {
+        "namespace": namespace,
+        "from": source_gid,
+        "to": target_gid,
+        "epoch": new_topo.epoch,
+        "objects": int(
+            sum(len(v) for v in (doc.get("objects") or {}).values())
+        ),
+        "freeze_s": freeze_s,
+    }
+
+
+# ---------------------------------------------------------------------------
+# process-level harness
+# ---------------------------------------------------------------------------
+
+
+class ShardedPlane:
+    """K leader groups of N replica children each — the harness `make
+    chaos-shard` and the bench ``shard`` role drive.  Each group is one
+    full :class:`replproc.ReplicatedPlane` (own WAL dir, own arbiter,
+    own election); the shard topology is computed up front from the
+    supervisors' pre-allocated ports and threaded to every child."""
+
+    def __init__(
+        self,
+        wal_dir: str,
+        k: Optional[int] = None,
+        replicas_per_group: int = 3,
+        fsync: bool = False,
+        ack_timeout_s: float = 10.0,
+        ttl_s: Optional[float] = None,
+        compact_every_s: float = 0.0,
+    ):
+        from minisched_tpu.controlplane.replproc import (
+            DEFAULT_TTL_S,
+            ReplicatedPlane,
+        )
+
+        self.k = k if k is not None else shard_count()
+        self.ttl_s = DEFAULT_TTL_S if ttl_s is None else ttl_s
+        os.makedirs(wal_dir, exist_ok=True)
+        self.groups: Dict[str, ReplicatedPlane] = {}
+        for i in range(self.k):
+            gid = f"g{i}"
+            self.groups[gid] = ReplicatedPlane(
+                os.path.join(wal_dir, gid),
+                n=replicas_per_group,
+                fsync=fsync,
+                ack_timeout_s=ack_timeout_s,
+                ttl_s=self.ttl_s,
+                compact_every_s=compact_every_s,
+                replica_prefix=f"{gid}r",
+            )
+        self.topology = ShardTopology(
+            {
+                gid: [r.base_url for r in plane.replicas]
+                for gid, plane in self.groups.items()
+            },
+            epoch=1,
+        )
+        topo_doc = self.topology.as_dict()
+        for gid, plane in self.groups.items():
+            for r in plane.replicas:
+                r.shard = {"group_id": gid, "topology": topo_doc}
+
+    def start(self) -> List[str]:
+        """Boot every group (its own r0 bootstraps); returns the seed
+        urls (one leader per group)."""
+        return [plane.start() for plane in self.groups.values()]
+
+    def client(self, **kwargs: Any) -> ShardedStore:
+        return ShardedStore(topology=self.topology.copy(), **kwargs)
+
+    def leader(self, gid: str) -> Any:
+        return self.groups[gid].leader()
+
+    def wait_for_leader(
+        self, gid: str, timeout_s: float = 30.0, exclude: str = ""
+    ) -> dict:
+        return self.groups[gid].wait_for_leader(
+            timeout_s=timeout_s, exclude=exclude
+        )
+
+    def split(self, namespace: str, target_gid: str) -> dict:
+        """Drive the split procedure against the live plane and fold the
+        new epoch into this harness's own topology record."""
+        return split_namespace(self.topology, namespace, target_gid)
+
+    def statuses(self) -> Dict[str, dict]:
+        return {
+            gid: plane.statuses() for gid, plane in self.groups.items()
+        }
+
+    def stop(self) -> None:
+        for plane in self.groups.values():
+            plane.stop()

@@ -87,6 +87,32 @@ class NotYetObserved(Exception):
     rolled back, which stays a 410 (DESIGN.md §29)."""
 
 
+class WrongShard(Exception):
+    """A write reached a leader group that does not OWN the object's
+    namespace: the sharded write plane (controlplane/shards.py,
+    DESIGN.md §30) partitions the keyspace by namespace across K
+    independent leader groups, and a façade whose topology says another
+    group owns the namespace refuses the mutation BEFORE executing it —
+    accepting it would fork the namespace's history across two WALs.
+    On the wire it is HTTP 421 (Misdirected Request) with a ``wrong
+    shard`` marker.  SEMANTIC, never blindly retried: the shard router
+    (shards.ShardedStore) chases it by refreshing ``/shards/status``
+    topology and re-routing to the owning group — the same chase
+    discipline NotLeader gets from leader discovery, one level up."""
+
+
+class ShardFrozen(Exception):
+    """A write hit a namespace inside a shard split's bounded
+    write-freeze window (DESIGN.md §30): the namespace is mid-handoff
+    between leader groups and neither side may accept mutations until
+    the checkpoint seed lands on the target and the topology epoch
+    advances.  On the wire it is HTTP 503 with a ``shard frozen``
+    marker — TRANSIENT: the remote client's normal 5xx backoff outlasts
+    the freeze (the window is bounded by one namespace-filtered
+    checkpoint ship, not by the size of the whole shard).  Reads are
+    never frozen."""
+
+
 @dataclass
 class WatchEvent:
     type: EventType

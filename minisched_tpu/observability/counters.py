@@ -383,6 +383,75 @@ mirrors the REST relist cache:
         — List RPCs served from the snapshot-keyed memo vs. fresh
           encodes (one per COW snapshot flip per kind; hits/encodes is
           the relist-storm sharing ratio)
+
+The gRPC Watch facade (grpcserver._WatchHub — the REST selector
+stream-loop handoff ported to the unary-stream rpc) records under
+``grpc.watch.``:
+
+    grpc.watch.streams
+        — watch streams adopted by the hub after handshake + sync-line
+          (one drain thread serves ALL of them; thread count must not
+          scale with stream count)
+    grpc.watch.events
+        — store events the hub drained and fanned out to its streams
+    grpc.watch.encoded / grpc.watch.shared
+        — first encode of an event's framed wire bytes (memoized on the
+          shared WatchEvent) vs. reuses by every other stream: the
+          encode-once claim, same shape as watch.fanout.encoded/shared
+    grpc.watch.evicted
+        — streams evicted because their bounded buffer overflowed
+          (DEFAULT_WATCH_STREAM_EVENTS): the laggard is aborted
+          OUT_OF_RANGE — its history is gone from the buffer just as
+          surely as from a compacted ring — and recovers via
+          relist + resume, never by blocking the hub
+
+The sharded write plane (ISSUE 18, DESIGN.md §30: controlplane/shards —
+namespace-partitioned leader groups behind one logical surface) records
+the router side under ``shard.`` and the façade/store side under
+``storage.shard.``; surfaced in the chaos-shard audits and the bench
+``shard`` role's record:
+
+    shard.topology_refreshes
+        — router re-fetches of /shards/status after a WrongShard/typed
+          refusal or an explicit probe; each adopts the highest epoch
+          seen across endpoints
+    shard.wrong_shard_chased
+        — writes/reads the router re-dispatched after a 421 WrongShard
+          refusal + topology refresh (the stale-router chase; bounded
+          attempts, then the typed error surfaces)
+    shard.cross_bind_batches / shard.cross_bind_entries
+        — bind batches that SPANNED >1 leader group (the two-shard
+          commit path: one logical batch id, per-group ack ordinals,
+          registry replay on retry) and the bindings inside them
+    shard.watch_reopen
+        — per-group component streams of a merged vector watch reopened
+          at that shard's cursor component after a drop/failover (the
+          other groups' streams keep flowing meanwhile)
+    shard.events_suppressed
+        — merged-watch events dropped because the emitting group no
+          longer owns the object's namespace under the current topology
+          (post-split echoes; the vector cursor still advances)
+    shard.splits
+        — namespace reassignments completed via the freeze → handoff →
+          seed → topology-bump → unfreeze → purge protocol
+    storage.shard.wrong_shard_refused / storage.shard.frozen_refused
+        — façade-side typed refusals: a write for a namespace this
+          group does not own under its topology epoch (421) / for a
+          namespace mid-handoff write-freeze (503, retryable — the
+          freeze is bounded by the split protocol)
+    storage.shard.topology_updates / storage.shard.freezes
+        — topology epochs adopted over POST /shards/control, and
+          namespace write-freezes imposed there
+    storage.shard.handoff_ships / storage.shard.handoff_objects
+        — namespace handoff snapshots served over GET /shards/handoff
+          (the checkpoint-seed unit of a split) and the objects inside
+    storage.shard.seed_objects / storage.shard.purged_objects
+        — objects applied from a handoff seed on the receiving group /
+          deleted from the source group after ownership flipped
+    remote.shard_frozen_retry
+        — remote-client requests that absorbed a 503 "shard frozen"
+          answer and retried with backoff (rides the split's bounded
+          write-freeze instead of failing the caller)
 """
 
 from __future__ import annotations

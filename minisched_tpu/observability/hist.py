@@ -75,6 +75,17 @@ same contract as counters.py):
           locally (DESIGN.md §28) — the O(state) replica-bootstrap cost
           that replaced O(history) re-tails; the bench ``repl`` role's
           bootstrap-under-load gate
+    shard.route_s
+        — sharded-router topology refresh time: probing /shards/status
+          across the known endpoints and adopting the highest epoch
+          (DESIGN.md §30) — the stale-router recovery cost a WrongShard
+          chase pays before its re-dispatch
+    shard.crossbind_s
+        — end-to-end latency of a bind batch that spanned >1 leader
+          group: the two-shard commit (per-group dispatch in parallel,
+          each side's group-commit barrier + registry insert) — the
+          cross-shard tax the bench ``shard`` role reports separately
+          from single-group binds
 
 **Exemplars**: ``observe(..., exemplar="default/pod-1")`` stamps the
 bucket the sample lands in with that string (last writer wins, one per

@@ -1,0 +1,202 @@
+"""Sharded-plane chaos (`make chaos-shard`): SIGKILL one shard leader
+under cross-shard bind load.
+
+The blast-radius claim of DESIGN.md §30: a leader group dying is ONE
+shard's failover, not the plane's.  A 2-group × 3-replica plane takes
+cross-shard bind batches (every batch spans both groups — the two-shard
+commit path) while a dedicated writer hammers the OTHER group; g0's
+leader is SIGKILLed mid-run with no goodbye.  Standing audits:
+
+* zero acked-write loss — every create and bind acked to a client is
+  present on the final plane;
+* no half-committed cross-shard batch — every logical batch the driver
+  retried to success is fully bound on BOTH sides, and the full-history
+  double-bind audit over all six replica WALs is clean (a retried batch
+  re-executing on the durable side would show the same pod bound
+  twice);
+* the unaffected shard never stalls — the g1 writer keeps acking
+  THROUGH g0's failover window (measured, not assumed).
+
+The tier-1 smoke runs one kill at small scale; the soak (slow) doubles
+the load and adds a second kill on the other group.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minisched_tpu.api.objects import Binding, make_node, make_pod
+from minisched_tpu.controlplane.shards import ShardedPlane
+from minisched_tpu.faults import wal_double_binds
+
+TTL_S = 1.0
+NAMESPACES = [f"tenant-{i:02d}" for i in range(40)] + ["default"]
+
+
+def _ns_for(topology, gid):
+    return next(ns for ns in NAMESPACES if topology.owner(ns) == gid)
+
+
+def _run_cross_shard_kill(plane, batches, kill_after, second_kill=False):
+    ss = plane.client(timeout_s=10.0, retries=2)
+    topo = plane.topology
+    ns_g0, ns_g1 = _ns_for(topo, "g0"), _ns_for(topo, "g1")
+    try:
+        ss.create("Node", make_node("n1", capacity={
+            "cpu": "64", "memory": "256Gi", "pods": 4 * batches + 8,
+        }))
+        for i in range(batches):
+            ss.create("Pod", make_pod(f"a{i:03d}", namespace=ns_g0))
+            ss.create("Pod", make_pod(f"b{i:03d}", namespace=ns_g1))
+
+        # liveness probe: a writer pinned to g1's namespace, recording
+        # every ack timestamp — the "unaffected shards never stall"
+        # evidence
+        ack_times: list = []
+        stop = threading.Event()
+
+        def g1_writer():
+            wss = plane.client(timeout_s=10.0, retries=2)
+            i = 0
+            try:
+                while not stop.is_set():
+                    try:
+                        wss.create(
+                            "Pod",
+                            make_pod(f"live-{i:04d}", namespace=ns_g1),
+                        )
+                        ack_times.append(time.monotonic())
+                        i += 1
+                    except Exception:
+                        time.sleep(0.1)
+                    else:
+                        time.sleep(0.02)
+            finally:
+                wss.close()
+
+        writer = threading.Thread(target=g1_writer, daemon=True)
+        writer.start()
+
+        kill_window: list = []
+        acked_batches = 0
+        for i in range(batches):
+            if i == kill_after:
+                old = plane.leader("g0")
+                assert old is not None
+                t_kill = time.monotonic()
+                old.kill()
+                kill_window.append(t_kill)
+            if second_kill and i == kill_after * 2:
+                old1 = plane.leader("g1")
+                if old1 is not None:
+                    old1.kill()
+            binds = [
+                Binding(pod_name=f"a{i:03d}", pod_namespace=ns_g0,
+                        node_name="n1"),
+                Binding(pod_name=f"b{i:03d}", pod_namespace=ns_g1,
+                        node_name="n1"),
+            ]
+            # retry the SAME logical batch until both sides ack — the
+            # registry replay makes this safe no matter how many
+            # attempts straddle the failover
+            deadline = time.monotonic() + 60.0
+            while True:
+                res = ss.bind_many_remote(
+                    binds, return_objects=False,
+                    batch_id=f"xbatch-{i:03d}",
+                )
+                if all(not isinstance(r, BaseException) for r in res):
+                    acked_batches += 1
+                    break
+                assert time.monotonic() < deadline, (
+                    f"batch {i} never fully acked: {res}"
+                )
+                time.sleep(0.2)
+        if kill_window:
+            won = plane.wait_for_leader("g0", timeout_s=10 * TTL_S)
+            kill_window.append(
+                kill_window[0] + max(won["elapsed_s"], 0.0) + 0.5
+            )
+        stop.set()
+        writer.join(timeout=30.0)
+        assert acked_batches == batches
+
+        # audit: unaffected shard never stalled — g1 acks continued
+        # INSIDE g0's failover window
+        if kill_window:
+            t0, t1 = kill_window
+            in_window = [t for t in ack_times if t0 <= t <= t1]
+            assert in_window, (
+                f"g1 writer acked nothing during g0's failover "
+                f"({t1 - t0:.2f}s window, {len(ack_times)} acks total)"
+            )
+
+        # audit: zero acked-write loss + no half-committed batch — every
+        # batch's BOTH pods bound on the final plane
+        final = plane.client(timeout_s=10.0, retries=2)
+        try:
+            pods = {
+                (p.metadata.namespace, p.metadata.name): p
+                for p in final.list("Pod")
+            }
+            for i in range(batches):
+                for ns, name in ((ns_g0, f"a{i:03d}"),
+                                 (ns_g1, f"b{i:03d}")):
+                    p = pods.get((ns, name))
+                    assert p is not None, f"acked pod {ns}/{name} lost"
+                    assert p.spec.node_name == "n1", (
+                        f"half-committed batch {i}: {ns}/{name} unbound"
+                    )
+            live_acked = len(ack_times)
+            live_present = sum(
+                1 for (ns, name) in pods if name.startswith("live-")
+            )
+            assert live_present >= live_acked, (
+                f"{live_acked - live_present} acked liveness writes lost"
+            )
+        finally:
+            final.close()
+    finally:
+        ss.close()
+
+
+def test_shard_leader_kill_under_cross_shard_binds(tmp_path):
+    """One SIGKILL on g0's leader while every bind batch spans both
+    groups: all batches drive to fully-committed, g1 never stalls, and
+    the offline double-bind audit over all six WALs is clean."""
+    plane = ShardedPlane(
+        str(tmp_path), k=2, replicas_per_group=3, fsync=True, ttl_s=TTL_S
+    )
+    try:
+        plane.start()
+        _run_cross_shard_kill(plane, batches=12, kill_after=4)
+    finally:
+        plane.stop()
+    # offline: the full-history audit — a registry miss that re-executed
+    # a bind after the failover would surface here as a double bind
+    for gid, group in plane.groups.items():
+        for r in group.replicas:
+            assert wal_double_binds(r.wal_path) == [], (gid, r.replica_id)
+
+
+@pytest.mark.slow
+def test_shard_leader_kills_soak(tmp_path):
+    """The heavier variant: more batches and a SECOND kill on g1 once
+    its failover matters too — both groups survive their own election
+    while the cross-shard commit protocol keeps every batch whole."""
+    plane = ShardedPlane(
+        str(tmp_path), k=2, replicas_per_group=3, fsync=True, ttl_s=TTL_S
+    )
+    try:
+        plane.start()
+        _run_cross_shard_kill(
+            plane, batches=30, kill_after=6, second_kill=True
+        )
+    finally:
+        plane.stop()
+    for gid, group in plane.groups.items():
+        for r in group.replicas:
+            assert wal_double_binds(r.wal_path) == [], (gid, r.replica_id)
