@@ -4,7 +4,7 @@
 NATIVE_SRC := native/tablebuilder.cc
 NATIVE_SO  := minisched_tpu/native/libminisched_native.so
 
-.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl bench-readscale bench-shard chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition chaos-read chaos-shard metrics-smoke docker clean
+.PHONY: test native start serve bench bench-wave bench-mesh bench-gang bench-churn bench-wire bench-wal bench-relist bench-repl bench-readscale bench-shard chaos chaos-proc chaos-ha chaos-disk chaos-repl chaos-partition chaos-read chaos-shard chaos-split metrics-smoke docker clean
 
 test: native
 	python -m pytest tests/ -q -m 'not slow'
@@ -203,6 +203,19 @@ chaos-read: native
 chaos-shard: native
 	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
 		python -m pytest tests/test_shard_chaos.py -q
+
+# split-protocol chaos (ISSUE 20, DESIGN.md §31): crash-safe autonomous
+# splits on a 2-group × 3-replica plane.  Two kill schedules: the SOURCE
+# shard's leader is SIGKILLed mid-handoff (the split must complete after
+# failover or abort with a clean thaw), and the split COORDINATOR itself
+# is SIGKILLed mid-freeze (every replica's WAL-journaled freeze lease
+# must auto-thaw within its TTL — zero stranded frozen namespaces).
+# Standing audits both times: zero acked-write loss, exactly-once
+# delivery on vector-cursor watches, full-history double-bind audit over
+# all replica WALs clean
+chaos-split: native
+	MINISCHED_CHAOS_SEED=$${MINISCHED_CHAOS_SEED:-1234} \
+		python -m pytest tests/test_split_chaos.py -q
 
 # live-telemetry smoke (ISSUE 11): boot the façade + scheduler, drive
 # 100 pods to bind, then validate ONLY through the wire — /metrics must

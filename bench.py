@@ -4656,6 +4656,14 @@ def bench_shard() -> dict:
       two-shard commit pays two HTTP round trips + two barriers in
       parallel).  Informational, recorded separately — the tax is the
       price of exactly-once across groups, not a regression.
+    * **skewed-load autosplit** (DESIGN.md §31) — every writer hammers
+      one g0-owned namespace on a fresh K=2 plane with the in-process
+      load watcher armed (low thresholds via ``BENCH_AUTOSPLIT_P99_S``).
+      Gates: the watcher splits the hot namespace to g1 within
+      ``BENCH_AUTOSPLIT_DEADLINE_S`` (default 60s) with
+      ``shard.autosplit.triggered`` counted, AND the source group's
+      windowed ``storage.group_wait_s`` p99 — computed from cumulative
+      /metrics bucket deltas — recovers after the flip.
     """
     import tempfile
     import threading
@@ -4826,6 +4834,179 @@ def bench_shard() -> dict:
             f"[shard] cross-shard batch tax: single p50 {single_p50}s vs "
             f"cross p50 {cross_p50}s = {tax:.2f}x"
         )
+
+        # ---- skewed-load autosplit phase (DESIGN.md §31 leg 2) --------
+        # every writer hammers ONE g0-owned namespace; the per-group
+        # load watcher inside g0's replica must notice the saturated
+        # group-commit barrier and split the hot namespace to g1 with
+        # no operator in the loop.  Two gates: the split FIRES within
+        # the deadline, and the source group's windowed group_wait p99
+        # RECOVERS once the load has moved.
+        import urllib.request as _urlreq
+
+        auto_env = {
+            "MINISCHED_AUTOSPLIT": "1",
+            "MINISCHED_AUTOSPLIT_P99_S": os.environ.get(
+                "BENCH_AUTOSPLIT_P99_S", "0.004"
+            ),
+            "MINISCHED_AUTOSPLIT_HOT": "2",
+            "MINISCHED_AUTOSPLIT_INTERVAL_S": "0.25",
+            "MINISCHED_AUTOSPLIT_COOLDOWN_S": "3600",
+        }
+        saved_env = {k: os.environ.get(k) for k in auto_env}
+        os.environ.update(auto_env)
+
+        def _scrape_wait(base: str):
+            """(cumulative group_wait buckets {le: count}, autosplit
+            trigger count) off one replica's /metrics exposition."""
+            with _urlreq.urlopen(base + "/metrics", timeout=5.0) as r:
+                text = r.read().decode()
+            buckets: dict = {}
+            fired = 0
+            for line in text.splitlines():
+                if line.startswith("storage_group_wait_seconds_bucket"):
+                    le_s = line.split('le="', 1)[1].split('"', 1)[0]
+                    le = float("inf") if le_s == "+Inf" else float(le_s)
+                    val = line.split("} ", 1)[1].split(" #", 1)[0]
+                    buckets[le] = buckets.get(le, 0) + int(float(val))
+                elif line.startswith("shard_autosplit_triggered "):
+                    fired = int(float(line.split()[1]))
+            return buckets, fired
+
+        def _window_p99(before: dict, after: dict) -> float:
+            """Nearest-rank p99 of the observations BETWEEN two scrapes
+            (cumulative-bucket deltas); 0.0 for an empty window."""
+            bounds = sorted(set(before) | set(after))
+            delta = {
+                le: after.get(le, 0) - before.get(le, 0) for le in bounds
+            }
+            n = delta.get(float("inf"), 0)
+            if n <= 0:
+                return 0.0
+            rank = max(1, int(n * 0.99 + 0.999999))
+            # buckets are cumulative per scrape, so the delta at each le
+            # is already cumulative across the window
+            for le in bounds:
+                if delta[le] >= rank:
+                    return le
+            return float("inf")
+
+        split_deadline_s = float(
+            os.environ.get("BENCH_AUTOSPLIT_DEADLINE_S", "60")
+        )
+        post_window_s = float(
+            os.environ.get("BENCH_AUTOSPLIT_POST_WINDOW_S", "3.0")
+        )
+        plane = ShardedPlane(
+            os.path.join(tmp, "auto"), k=2, replicas_per_group=1,
+            fsync=True, ttl_s=ttl_s,
+        )
+        try:
+            plane.start()
+            hot_ns = per_group["g0"][0]
+            g0_url = plane.groups["g0"].replicas[0].base_url
+            stop_evt = threading.Event()
+            write_errors: list = []
+
+            def skew_writer(widx: int) -> None:
+                ss = plane.client(timeout_s=10.0, retries=4)
+                i = 0
+                try:
+                    while not stop_evt.is_set():
+                        try:
+                            ss.create("Pod", make_pod(
+                                f"skew-{widx}-{i:05d}", namespace=hot_ns,
+                            ))
+                            i += 1
+                        except Exception as e:  # noqa: BLE001
+                            write_errors.append(repr(e))
+                            time.sleep(0.1)
+                finally:
+                    ss.close()
+
+            writers = [
+                threading.Thread(target=skew_writer, args=(w,), daemon=True)
+                for w in range(W)
+            ]
+            for t in writers:
+                t.start()
+            s0, _ = _scrape_wait(g0_url)
+            t0 = time.monotonic()
+            fired_at = None
+            while time.monotonic() - t0 < split_deadline_s:
+                try:
+                    with _urlreq.urlopen(
+                        g0_url + "/shards/status", timeout=5.0
+                    ) as r:
+                        doc = json.loads(r.read())
+                except OSError:
+                    time.sleep(0.25)
+                    continue
+                if doc["topology"].get("overrides", {}).get(hot_ns) \
+                        == "g1":
+                    fired_at = time.monotonic() - t0
+                    break
+                time.sleep(0.25)
+            s1, fired_count = _scrape_wait(g0_url)
+            if fired_at is None:
+                stop_evt.set()
+                raise SystemExit(
+                    f"[shard] AUTOSPLIT NEVER FIRED within "
+                    f"{split_deadline_s}s (hot p99 threshold "
+                    f"{auto_env['MINISCHED_AUTOSPLIT_P99_S']}s, "
+                    f"writer errors {len(write_errors)})"
+                )
+            pre_p99 = _window_p99(s0, s1)
+            # the override flips BEFORE the watcher's trigger counter
+            # bumps (the split's purge still runs) — give the counter a
+            # moment instead of racing it
+            cdl = time.monotonic() + 10.0
+            while fired_count < 1 and time.monotonic() < cdl:
+                time.sleep(0.25)
+                _b, fired_count = _scrape_wait(g0_url)
+            time.sleep(1.5)  # purge tail + frozen retries chase over
+            s2, _ = _scrape_wait(g0_url)
+            time.sleep(post_window_s)
+            s3, _ = _scrape_wait(g0_url)
+            post_p99 = _window_p99(s2, s3)
+            stop_evt.set()
+            for t in writers:
+                t.join(timeout=30.0)
+            log(
+                f"[shard] autosplit fired after {fired_at:.1f}s "
+                f"(trigger count {fired_count}); source group_wait p99 "
+                f"{pre_p99:.4f}s before -> {post_p99:.4f}s after"
+            )
+            if fired_count < 1:
+                raise SystemExit(
+                    "[shard] override flipped but shard.autosplit."
+                    "triggered never counted — split did not come from "
+                    "the watcher"
+                )
+            recovered = post_p99 < pre_p99 or post_p99 == 0.0
+            if scaling_gated and not recovered:
+                # same arming rule as the write-scaling gate: on <4
+                # cores the moved load still shares the silicon with
+                # the source group, so recovery is recorded but not
+                # gated
+                raise SystemExit(
+                    f"[shard] GROUP WAIT DID NOT RECOVER: p99 "
+                    f"{pre_p99:.4f}s before the split vs "
+                    f"{post_p99:.4f}s after — moving the hot namespace "
+                    f"bought nothing"
+                )
+            if not scaling_gated and not recovered:
+                log(
+                    f"[shard] recovery gate SKIPPED: {cores} CPU "
+                    f"core(s) — recorded informationally"
+                )
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            plane.stop()
     finally:
         if old_floor is None:
             os.environ.pop("MINISCHED_FSYNC_FLOOR_US", None)
@@ -4850,6 +5031,10 @@ def bench_shard() -> dict:
         "cross_shard_tax_x": round(tax, 2),
         "cross_bind_batches": counters.get("shard.cross_bind_batches"),
         "wrong_shard_chased": counters.get("shard.wrong_shard_chased"),
+        "autosplit_fired_after_s": round(fired_at, 2),
+        "autosplit_trigger_count": fired_count,
+        "autosplit_pre_p99_s": round(pre_p99, 4),
+        "autosplit_post_p99_s": round(post_p99, 4),
     }
 
 

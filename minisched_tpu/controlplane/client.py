@@ -238,14 +238,50 @@ class _PodAPI:
         nodes) per batch; the full pod-population scan this replaces was
         the last O(all pods) term in the bind path (ROADMAP crumb).  A
         store without the index (foreign test double) falls back to the
-        scan."""
+        scan.
+
+        Sharded stores (DESIGN.md §31) carry a ``_shard_budget_view``:
+        a NON-home group — whose store holds no Node objects at all —
+        answers from the rv-stamped budget MIRROR (home allocatable
+        minus every OTHER vantage's usage; this group's own share is
+        the live local agg, subtracted below under this very lock
+        hold), and those entries keep the mirror rv as a 4th element so
+        the refusal can carry its staleness watermark.  The HOME group
+        additionally debits the board's reported non-home usage from
+        its locally-present Nodes."""
         budgets: Dict[str, list] = {}
+        view = getattr(store, "_shard_budget_view", None)
+        mirrored: set = set()
         for name in targets:
             node = store._objects.get(KIND_NODE, {}).get(f"/{name}")
             if node is None:
+                if view is None:
+                    continue
+                from minisched_tpu.observability import counters
+
+                counters.inc("shard.budget.mirror_checks")
+                ent = view.budget(name)
+                if ent is None:
+                    counters.inc("shard.budget.unknown_node")
+                    continue
+                alloc, elsewhere, rv = ent
+                budgets[name] = [
+                    alloc[0] - elsewhere[0],
+                    alloc[1] - elsewhere[1],
+                    alloc[2] - elsewhere[2],
+                    rv,
+                ]
+                mirrored.add(name)
                 continue
             alloc = node.status.allocatable
             budgets[name] = [alloc.milli_cpu, alloc.memory, alloc.pods]
+            if view is not None:
+                extra = view.extra_used(name)
+                if extra is not None:
+                    b = budgets[name]
+                    b[0] -= extra[0]
+                    b[1] -= extra[1]
+                    b[2] -= extra[2]
         if not budgets:
             return budgets
         agg = getattr(store, "_pod_node_agg", None)
@@ -329,11 +365,23 @@ class _PodAPI:
                         or req.memory > budget[1]
                         or req.pods > budget[2]
                     ):
+                        # length-4 budgets came from the cross-shard
+                        # mirror (see _node_budgets): the refusal
+                        # carries the mirror rv so a consumer can judge
+                        # how stale the verdict was
+                        mirror = ""
+                        if len(budget) > 3:
+                            mirror = f", budget-mirror rv={budget[3]}"
+                            from minisched_tpu.observability import (
+                                counters,
+                            )
+
+                            counters.inc("shard.budget.refused")
                         raise OutOfCapacity(
                             f"node {binding.node_name} out of capacity for "
                             f"pod {pod.metadata.key} (remaining "
                             f"cpu={budget[0]}m mem={budget[1]} "
-                            f"pods={budget[2]})"
+                            f"pods={budget[2]}{mirror})"
                         )
                     budget[0] -= req.milli_cpu
                     budget[1] -= req.memory

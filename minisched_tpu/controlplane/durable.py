@@ -225,6 +225,11 @@ class DurableObjectStore(ObjectStore):
         #: order == append order; the HTTP façade seeds its registry
         #: from this so retried batches stay idempotent across restarts)
         self._acks: Dict[str, dict] = {}
+        #: shard freeze leases recovered from WAL ``lease`` records
+        #: (DESIGN.md §31): ns → lease doc; the façade re-arms its
+        #: ShardInfo from these so a restart inside a split's freeze
+        #: window keeps refusing the namespace until the lease TTL
+        self._shard_leases: Dict[str, dict] = {}
         # -- degraded-mode state (all guarded by the store lock) --------
         self._degraded = False
         self._degraded_reason = ""
@@ -1217,6 +1222,50 @@ class DurableObjectStore(ObjectStore):
         with self._lock:
             return dict(self._acks)
 
+    # -- shard freeze-lease persistence (DESIGN.md §31) --------------------
+    def record_shard_lease(self, entry: dict) -> None:
+        """Journal one shard freeze-lease transition as a volatile WAL
+        record (``{"op": "lease", "action": "freeze"|"thaw", "ns", ...}``)
+        so a RESTARTED replica still refuses writes inside a split's
+        freeze window it acknowledged before dying — without this, a
+        leader that crashes and recovers mid-split would happily commit
+        writes the in-flight handoff doc never shipped.  Same volatile
+        contract as ``record_acks``: no rv, no publish ordering, no
+        replication (each replica journals its OWN view), best-effort on
+        a degraded disk — the lease TTL bounds the damage of a dropped
+        record.  Fenced followers skip the append entirely: their WAL is
+        the leader's replicated byte stream and must stay that way; a
+        follower's fence already refuses the writes a freeze would."""
+        if self._fenced:
+            return
+        with self._io_lock if self._gc_enabled else _null_ctx():
+            with self._lock:
+                if self._closed or self._degraded or self._log is None:
+                    return
+                self._defer_flush = True
+                try:
+                    self._append_raw(dict(entry, op="lease"))
+                    ns = str(entry.get("ns"))
+                    if entry.get("action") == "thaw":
+                        self._shard_leases.pop(ns, None)
+                    else:
+                        self._shard_leases[ns] = {
+                            k: entry[k] for k in entry if k != "op"
+                        }
+                    self._fsync_log()
+                except StorageDegraded:
+                    pass  # latched; ShardInfo's in-memory lease still holds
+                finally:
+                    self._defer_flush = False
+
+    def recovered_shard_leases(self) -> Dict[str, dict]:
+        """Freeze leases replayed from the WAL/checkpoint — the façade
+        re-arms its ShardInfo from this at boot; expired entries are
+        dropped by the adopter, not here (clock reads belong in one
+        place)."""
+        with self._lock:
+            return dict(self._shard_leases)
+
     # -- recovery ----------------------------------------------------------
     def _read_checkpoint_file(self, path: str) -> dict:
         """Read + digest-verify one checkpoint generation.  A sidecar
@@ -1269,6 +1318,10 @@ class DurableObjectStore(ObjectStore):
             self._acks[str(ack_id)] = entry
         while len(self._acks) > ACK_REPLAY_CAP:
             self._acks.pop(next(iter(self._acks)))
+        # shard freeze leases compacted into the snapshot; WAL ``lease``
+        # records replayed afterwards overwrite/extend (they are newer)
+        for ns, lease in (doc.get("shard_leases") or {}).items():
+            self._shard_leases[str(ns)] = lease
         rv = int(doc.get("resource_version", 0))
         self._rv = max(self._rv, rv)
         return rv
@@ -1500,6 +1553,17 @@ class DurableObjectStore(ObjectStore):
             while len(self._acks) > ACK_REPLAY_CAP:
                 self._acks.pop(next(iter(self._acks)))
             return
+        if op == "lease":
+            # shard freeze-lease records (volatile like acks): the last
+            # transition per namespace wins — a thaw erases the freeze
+            ns = str(rec.get("ns"))
+            if rec.get("action") == "thaw":
+                self._shard_leases.pop(ns, None)
+            else:
+                self._shard_leases[ns] = {
+                    k: rec[k] for k in rec if k != "op"
+                }
+            return
         kind = rec["kind"]
         if kind not in KIND_TYPES:
             return  # written by a newer schema; skip rather than fail open
@@ -1634,6 +1698,12 @@ class DurableObjectStore(ObjectStore):
                 # must survive compaction, not just the WAL tail.  Extra
                 # keys are ignored by older/foreign checkpoint readers.
                 doc["acks"] = dict(self._acks)
+            if self._shard_leases:
+                # active freeze leases ride the checkpoint for the same
+                # reason: a compaction mid-split must not erase the
+                # journaled freeze (key absent when empty, so unsharded
+                # checkpoints stay byte-identical)
+                doc["shard_leases"] = dict(self._shard_leases)
             body = json.dumps(doc).encode()
             self._land_checkpoint_pair(body)
             faults = self.faults

@@ -332,6 +332,8 @@ class _Handler(BaseHTTPRequestHandler):
             counters.inc("storage.shard.frozen_refused")
             self._error(503, str(e))
             return False
+        # accepted: feed the autosplit watcher's hottest-namespace tally
+        sh.note_writes(dict.fromkeys(eff))
         return True
 
     def _observe_request(self, verb: str, path: str, t0: float) -> None:
@@ -414,6 +416,18 @@ class _Handler(BaseHTTPRequestHandler):
                 from minisched_tpu.controlplane import shards as _shards
 
                 self._send(200, _shards.build_handoff(self.store, ns))
+            elif path == "/shards/budget":
+                # the HOME group's per-Node budget doc (DESIGN.md §31);
+                # any home replica serves it (rv-stamped, follower reads
+                # fine) — 404 elsewhere so mirrors can probe blindly
+                if sh.topology.owner("") != sh.group_id:
+                    self._error(
+                        404, "budget doc lives on the home group"
+                    )
+                    return
+                from minisched_tpu.controlplane import shards as _shards
+
+                self._send(200, _shards.build_budget_doc(self.store, sh))
             else:
                 self._error(404, f"no route {path}")
             return
@@ -849,7 +863,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if not ns:
                     self._error(400, "purge requires namespace")
                     return
-                self._send(200, _shards.purge_namespace(self.store, ns))
+                self._send(
+                    200,
+                    _shards.purge_namespace(
+                        self.store, ns, names=body.get("names")
+                    ),
+                )
             else:
                 self._error(404, f"no route {path}")
         except NotLeader as e:
@@ -1209,6 +1228,15 @@ def start_api_server(
          "ack_lock": threading.Lock(), "stream_loop": stream_loop,
          "repl": repl, "shard": shard},
     )
+    # sharded façades grow a runtime besides the request surface
+    # (DESIGN.md §31): freeze-lease journal wiring + WAL re-arm, the
+    # capacity-mirror sync loop, optional autosplit.  None for shard
+    # None — the unsharded plane stays byte-identical.
+    shard_runtime = None
+    if shard is not None:
+        from minisched_tpu.controlplane.shards import attach_shard_runtime
+
+        shard_runtime = attach_shard_runtime(store, shard)
     server = _WatchHTTPServer(("127.0.0.1", port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
@@ -1236,6 +1264,8 @@ def start_api_server(
             w.stop()
         if stream_loop is not None:
             stream_loop.stop()
+        if shard_runtime is not None:
+            shard_runtime.stop()
         server.shutdown()
         server.server_close()
         thread.join(timeout=2.0)

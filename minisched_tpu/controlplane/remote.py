@@ -58,6 +58,7 @@ from minisched_tpu.controlplane.store import (
     NotLeader,
     NotYetObserved,
     ShardFrozen,
+    ShardFrozenTimeout,
     StorageDegraded,
     WatchEvent,
     WrongShard,
@@ -277,10 +278,18 @@ class RemoteStore:
         watch_read_timeout_s: float = 3600.0,
         pool_max_idle: int = DEFAULT_MAX_IDLE,
         endpoints: Optional[List[str]] = None,
+        frozen_deadline_s: float = 10.0,
     ):
         self._base = base_url.rstrip("/")
         self._timeout_s = timeout_s
         self._retries = max(int(retries), 0)
+        #: how long one call may wait out a frozen namespace (shard
+        #: split window, DESIGN.md §31) before surfacing the typed
+        #: ShardFrozenTimeout.  Its OWN budget, jitter-backed, separate
+        #: from ``retries``: a healthy freeze is milliseconds, a dead
+        #: coordinator's freeze thaws at the lease TTL — so this bounds
+        #: the hammering without burning the transient-failure budget
+        self._frozen_deadline_s = max(float(frozen_deadline_s), 0.0)
         self._backoff_initial_s = backoff_initial_s
         self._backoff_factor = backoff_factor
         self._backoff_jitter = backoff_jitter
@@ -485,7 +494,14 @@ class RemoteStore:
         )
         last_err: Optional[BaseException] = None
         is_read = method == "GET"
-        for attempt in range(self._retries + 1):
+        # a frozen namespace (shard split window) gets its OWN
+        # jitter-backed deadline loop below instead of consuming the
+        # transient-failure attempt budget — hence the manual counter
+        attempt = 0
+        frozen_deadline: Optional[float] = None
+        frozen_delays: Any = None
+        while attempt < self._retries + 1:
+            frozen = False
             status = None
             base: Optional[str] = None
             try:
@@ -540,11 +556,14 @@ class RemoteStore:
                     raise WrongShard(body)
                 if status == 503 and "shard frozen" in body:
                     # bounded write-freeze window of a shard split:
-                    # transient by contract — the freeze is one
-                    # namespace-filtered checkpoint ship long, well
-                    # inside the backoff budget
+                    # transient by contract (a healthy freeze is one
+                    # namespace-filtered checkpoint ship long), but
+                    # waited out under the frozen DEADLINE below — a
+                    # dead coordinator's freeze only thaws at its lease
+                    # TTL, and hammering it must end in a typed timeout
                     counters.inc("remote.shard_frozen_retry")
                     last_err = ShardFrozen(body)
+                    frozen = True
                 elif status == 503 and "not leader" in body:
                     # fenced replica (DESIGN.md §27): retrying HERE can
                     # never succeed.  Single-endpoint callers get the
@@ -578,7 +597,37 @@ class RemoteStore:
                     raise RuntimeError(f"HTTP {status}: {body}")
                 else:
                     last_err = RuntimeError(f"HTTP {status}: {body}")
-            if attempt < self._retries:
+            if frozen:
+                # frozen-shard wait: its own deadline + jittered
+                # backoff, NOT the generic attempt budget — the freeze
+                # can outlast every transient-retry backoff combined
+                # (lease TTL bound) without being a dead server
+                now = time.monotonic()
+                if frozen_deadline is None:
+                    frozen_deadline = now + self._frozen_deadline_s
+                    frozen_delays = backoff_delays(
+                        self._backoff_initial_s,
+                        self._backoff_factor,
+                        1 << 20,
+                        self._backoff_jitter,
+                        self._rng,
+                    )
+                if now >= frozen_deadline:
+                    counters.inc("remote.shard_frozen_timeout")
+                    raise ShardFrozenTimeout(
+                        f"remote {method} {path} namespace still frozen "
+                        f"after its {self._frozen_deadline_s:.1f}s "
+                        f"deadline: {last_err}"
+                    )
+                time.sleep(
+                    min(
+                        next(frozen_delays),
+                        max(frozen_deadline - now, 0.0),
+                    )
+                )
+                continue
+            attempt += 1
+            if attempt <= self._retries:
                 counters.inc("remote.retry")
                 time.sleep(next(delays))
         if isinstance(last_err, StorageDegraded):

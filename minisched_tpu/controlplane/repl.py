@@ -1033,6 +1033,14 @@ class ReplRuntime:
                 if hub is not None
                 else int(getattr(self.store, "checkpoint_rv", 0) or 0)
             ),
+            # the whole replica set's data urls (self included) — what
+            # the sharded router's endpoint discovery unions into its
+            # per-group read fanout (DESIGN.md §31): one live answer
+            # describes the group
+            "peers": [
+                {"replica": p.replica_id, "url": p.data_url}
+                for p in self.peers
+            ],
         }
 
     # -- façade handlers (called from httpserver._Handler) -----------------

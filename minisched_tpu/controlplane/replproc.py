@@ -374,3 +374,144 @@ class ReplicatedPlane:
     def stop(self) -> None:
         for r in self.replicas:
             r.kill()
+
+
+# ---------------------------------------------------------------------------
+# killable split coordinator (DESIGN.md §31 chaos-split harness)
+# ---------------------------------------------------------------------------
+
+
+def _split_coordinator_child_main(
+    topology: dict,
+    namespace: str,
+    target_gid: str,
+    ttl_s: float,
+    hold_s: float = 0.0,
+) -> None:
+    """One split coordinator's whole life in a fresh interpreter: run
+    ``split_namespace`` against a live sharded plane, optionally PARKING
+    for ``hold_s`` inside the freeze window (right after the freeze
+    fanout, before the handoff) — the seam where the chaos-split soak
+    SIGKILLs this process to prove every replica's freeze lease
+    auto-thaws at its TTL with no coordinator left to unfreeze it.
+    Emits ``FROZEN <lease_id>`` the moment the namespace is frozen (the
+    parent's kill trigger) and ``DONE <result json>`` on completion."""
+    from minisched_tpu.controlplane.shards import (
+        ShardTopology,
+        split_namespace,
+    )
+
+    topo = ShardTopology.from_dict(topology)
+
+    def after_freeze(lease_id: str) -> None:
+        print(f"FROZEN {lease_id}", flush=True)
+        if hold_s > 0:
+            time.sleep(hold_s)
+
+    result = split_namespace(
+        topo, namespace, target_gid, ttl_s=ttl_s,
+        _after_freeze=after_freeze,
+    )
+    print("DONE " + json.dumps(result), flush=True)
+
+
+_COORD_CMD = (
+    "import json, sys; "
+    "from minisched_tpu.controlplane.replproc import "
+    "_split_coordinator_child_main; "
+    "_split_coordinator_child_main(**json.loads(sys.argv[1]))"
+)
+
+
+class SplitCoordinator:
+    """A killable split-coordinator child: drives one
+    ``split_namespace`` from its own interpreter so the chaos harness
+    can SIGKILL the COORDINATOR — not just a shard leader — anywhere in
+    the split and assert the plane self-heals (leases thaw at TTL,
+    ownership unchanged, no acked write lost)."""
+
+    def __init__(
+        self,
+        topology: dict,
+        namespace: str,
+        target_gid: str,
+        ttl_s: float,
+        hold_s: float = 0.0,
+    ):
+        self._cfg = {
+            "topology": topology,
+            "namespace": namespace,
+            "target_gid": target_gid,
+            "ttl_s": ttl_s,
+            "hold_s": hold_s,
+        }
+        self._proc: Any = None
+        self.lease_id = ""
+        self.result: Optional[dict] = None
+
+    def start(self) -> "SplitCoordinator":
+        env = dict(os.environ)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _COORD_CMD, json.dumps(self._cfg)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        return self
+
+    def wait_frozen(self, timeout_s: float = 30.0) -> str:
+        """Block until the child reports the freeze fanout landed;
+        returns the lease id (the SIGKILL trigger for the soak)."""
+        deadline = time.monotonic() + timeout_s
+        line = ""
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None and self._proc.stdout is None:
+                break
+            line = self._proc.stdout.readline()
+            if line.startswith("FROZEN "):
+                self.lease_id = line.split(None, 1)[1].strip()
+                return self.lease_id
+            if not line and self._proc.poll() is not None:
+                break
+        raise RuntimeError(
+            f"coordinator never froze (last line {line!r}, "
+            f"exit {self._proc.poll()})"
+        )
+
+    def wait_done(self, timeout_s: float = 60.0) -> dict:
+        """Block until the child's split completes; returns the split
+        result dict."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = self._proc.stdout.readline()
+            if line.startswith("DONE "):
+                self.result = json.loads(line[len("DONE "):])
+                self._proc.wait(timeout=10.0)
+                return self.result
+            if not line and self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator exited {self._proc.returncode} "
+                    "without completing the split"
+                )
+        raise RuntimeError(f"split not done within {timeout_s}s")
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL mid-split — the lease TTL is now the only thaw."""
+        if self._proc is None:
+            return
+        if self._proc.poll() is None:
+            self._proc.kill()
+        try:
+            self._proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass

@@ -59,11 +59,12 @@ the WAL.  The parity test pins WAL bytes.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from minisched_tpu.controlplane.checkpoint import KIND_TYPES, _decode, _encode
 from minisched_tpu.controlplane.store import (
@@ -85,6 +86,12 @@ __all__ = [
     "ShardedWatch",
     "ShardedClient",
     "ShardedPlane",
+    "ShardRuntime",
+    "AutoSplitWatcher",
+    "BudgetBoard",
+    "BudgetMirror",
+    "attach_shard_runtime",
+    "build_budget_doc",
     "split_namespace",
     "build_handoff",
     "apply_seed",
@@ -93,6 +100,11 @@ __all__ = [
 ]
 
 _CLUSTER_SCOPED = {"Node", "PersistentVolume"}
+
+#: default freeze-lease TTL (override per split / MINISCHED_FREEZE_TTL_S):
+#: generous against a healthy split's millisecond handoff, tight against
+#: an operator page — a dead coordinator's freeze thaws itself this fast
+DEFAULT_FREEZE_TTL_S = 30.0
 
 
 def shard_count(default: int = 1) -> int:
@@ -171,7 +183,22 @@ class ShardInfo:
     """One façade's view of its own shard membership: the group this
     replica belongs to plus the current topology.  The ownership guard
     every write verb consults lives here (httpserver._shard_guard); the
-    split driver mutates it through ``/shards/control``."""
+    split driver mutates it through ``/shards/control``.
+
+    Freeze state is held as per-namespace LEASES (DESIGN.md §31), never
+    as a bare flag: every freeze carries a coordinator-chosen lease id
+    and a TTL, and ``check_write`` reaps expired leases before judging —
+    a split coordinator that dies mid-freeze strands NOTHING, because
+    every replica auto-thaws independently at expiry.  Transitions are
+    journaled through ``self.journal`` (the durable store's
+    ``record_shard_lease`` when one is attached — see
+    ``attach_shard_runtime``) so a replica restarting inside a freeze
+    window keeps refusing until the TTL, not until someone notices.
+
+    ``budget_board`` / ``budget_mirror`` hang the capacity-mirror halves
+    here (home group: the board collecting remote usage reports; every
+    other group: the rv-stamped mirror of the home group's budget doc)
+    — one object per façade, wired by ``attach_shard_runtime``."""
 
     def __init__(self, group_id: str, topology: Any):
         self.group_id = str(group_id)
@@ -184,6 +211,74 @@ class ShardInfo:
                 f"group {self.group_id!r} not in topology "
                 f"{sorted(topology.groups)}"
             )
+        #: ns → {"ns", "lease_id", "ttl_s", "expires_at"} (wall clock);
+        #: invariant: set(self._leases) == self._topology.frozen after
+        #: every reap, so as_dict()/describe() stay truthful
+        self._leases: Dict[str, dict] = {
+            ns: self._new_lease(ns, "", None) for ns in topology.frozen
+        }
+        #: best-effort durable lease journal — callable(entry dict); set
+        #: by attach_shard_runtime when the store can persist (never a
+        #: ctor arg: in-process test stubs construct ShardInfo bare)
+        self.journal: Optional[Callable[[dict], None]] = None
+        #: per-namespace accepted-write tally since the last drain (the
+        #: autosplit watcher's "hottest namespace" signal)
+        self._write_counts: Dict[str, int] = {}
+        self.budget_board: Optional["BudgetBoard"] = None
+        self.budget_mirror: Optional["BudgetMirror"] = None
+
+    @staticmethod
+    def _new_lease(ns: str, lease_id: str, ttl_s: Any) -> dict:
+        ttl = float(ttl_s) if ttl_s else DEFAULT_FREEZE_TTL_S
+        return {
+            "ns": ns,
+            "lease_id": str(lease_id or ""),
+            "ttl_s": ttl,
+            "expires_at": time.time() + ttl,
+        }
+
+    def _journal_locked(self, entry: dict) -> None:
+        j = self.journal
+        if j is None:
+            return
+        try:
+            j(entry)
+        except Exception:  # noqa: BLE001 — best-effort: TTL bounds a
+            pass  # dropped record's damage
+
+    def _reap_locked(self, now: Optional[float] = None) -> None:
+        """Drop expired leases (caller holds ``_mu``): the auto-thaw —
+        coordinator death bounds the refusal window at the lease TTL
+        with no operator in the loop."""
+        now = time.time() if now is None else now
+        for ns in [
+            n for n, l in self._leases.items() if now >= l["expires_at"]
+        ]:
+            lease = self._leases.pop(ns)
+            self._topology.frozen.discard(ns)
+            counters.inc("storage.shard.freeze_expired")
+            self._journal_locked(
+                {"action": "thaw", "ns": ns, "lease_id": lease["lease_id"]}
+            )
+
+    def adopt_leases(self, recovered: Dict[str, dict]) -> None:
+        """Re-arm freeze leases recovered from the WAL/checkpoint at
+        boot (already journaled — adopting never re-journals); entries
+        whose TTL lapsed while the process was down are dropped."""
+        now = time.time()
+        with self._mu:
+            for ns, lease in recovered.items():
+                if float(lease.get("expires_at", 0)) <= now:
+                    continue
+                self._leases[str(ns)] = {
+                    "ns": str(ns),
+                    "lease_id": str(lease.get("lease_id") or ""),
+                    "ttl_s": float(
+                        lease.get("ttl_s") or DEFAULT_FREEZE_TTL_S
+                    ),
+                    "expires_at": float(lease["expires_at"]),
+                }
+                self._topology.frozen.add(str(ns))
 
     @property
     def topology(self) -> ShardTopology:
@@ -195,11 +290,16 @@ class ShardInfo:
         a write in ``namespace`` (the effective namespace: "" for
         cluster-scoped kinds).  Called BEFORE the store runs anything."""
         with self._mu:
+            self._reap_locked()
             topo = self._topology
-            if namespace in topo.frozen:
+            lease = self._leases.get(namespace)
+            if lease is not None:
+                remaining = max(lease["expires_at"] - time.time(), 0.0)
                 raise ShardFrozen(
                     f"shard frozen: namespace {namespace!r} is mid-split "
-                    f"(epoch {topo.epoch})"
+                    f"(epoch {topo.epoch}, lease "
+                    f"{lease['lease_id'] or '-'} thaws in "
+                    f"{remaining:.3f}s)"
                 )
             own = topo.owner(namespace)
             if own != self.group_id:
@@ -209,19 +309,57 @@ class ShardInfo:
                     f"{self.group_id!r} (epoch {topo.epoch})"
                 )
 
+    def note_writes(self, namespaces: Any) -> None:
+        """Tally accepted writes per effective namespace (one bump per
+        namespace per request) — drained by the autosplit watcher."""
+        with self._mu:
+            wc = self._write_counts
+            for ns in namespaces:
+                wc[ns] = wc.get(ns, 0) + 1
+
+    def drain_write_counts(self) -> Dict[str, int]:
+        with self._mu:
+            out, self._write_counts = self._write_counts, {}
+            return out
+
     def describe(self) -> dict:
         with self._mu:
+            self._reap_locked()
+            now = time.time()
             return {
                 "group": self.group_id,
                 "epoch": self._topology.epoch,
                 "topology": self._topology.as_dict(),
+                "leases": {
+                    ns: {
+                        "lease_id": l["lease_id"],
+                        "ttl_s": l["ttl_s"],
+                        "expires_in_s": round(
+                            max(l["expires_at"] - now, 0.0), 3
+                        ),
+                    }
+                    for ns, l in self._leases.items()
+                },
             }
 
     def apply_control(self, body: dict) -> None:
         """One ``/shards/control`` op: ``topology`` replaces the whole
         document (stale epochs refused — a racing older push must not
-        roll the map back), ``freeze``/``unfreeze`` toggle one
-        namespace's split window without an epoch bump."""
+        roll the map back), ``freeze``/``unfreeze`` manage one
+        namespace's split-window lease without an epoch bump, and
+        ``budget_report`` folds a non-home group's node-usage aggregate
+        into the home group's budget board.
+
+        Freeze semantics (DESIGN.md §31): a fresh freeze creates a
+        lease; re-freezing with the SAME lease id renews it (extends the
+        TTL); with ``renew: true`` a renewal is refused (ValueError →
+        HTTP 400 → the coordinator aborts the split) unless the very
+        lease is still live — the coordinator's proof that no replica
+        thawed and admitted writes mid-handoff.  Freezing over a LIVE
+        foreign lease is refused, so two coordinators can never split
+        the same namespace concurrently.  An unfreeze with a mismatched
+        lease id is a NO-OP: a stale coordinator must not thaw a newer
+        split's freeze."""
         op = body.get("op")
         if op == "topology":
             new = ShardTopology.from_dict(body["topology"])
@@ -231,22 +369,93 @@ class ShardInfo:
                         f"stale topology epoch {new.epoch} < "
                         f"{self._topology.epoch}"
                     )
+                self._reap_locked()
                 # a freeze applied through the freeze op survives a
-                # same-epoch re-push that does not mention it
-                new.frozen |= self._topology.frozen - set(
-                    body["topology"].get("unfrozen", [])
-                )
+                # re-push that does not mention it; ones the push names
+                # as unfrozen thaw here
+                unfrozen = set(body["topology"].get("unfrozen", []))
+                for ns in list(self._leases):
+                    if ns in unfrozen:
+                        lease = self._leases.pop(ns)
+                        self._journal_locked(
+                            {
+                                "action": "thaw",
+                                "ns": ns,
+                                "lease_id": lease["lease_id"],
+                            }
+                        )
+                # a pushed frozen list freezes WITH a default-TTL lease:
+                # nothing is ever frozen without an expiry
+                for ns in new.frozen:
+                    if ns not in unfrozen and ns not in self._leases:
+                        lease = self._new_lease(ns, "", None)
+                        self._leases[ns] = lease
+                        self._journal_locked(dict(lease, action="freeze"))
+                new.frozen = set(self._leases)
                 self._topology = new
             counters.inc("storage.shard.topology_updates")
         elif op == "freeze":
             ns = body["namespace"]
+            lid = str(body.get("lease_id") or "")
+            renew = bool(body.get("renew"))
             with self._mu:
+                self._reap_locked()
+                cur = self._leases.get(ns)
+                if (
+                    cur is not None
+                    and lid
+                    and cur["lease_id"]
+                    and cur["lease_id"] != lid
+                ):
+                    raise ValueError(
+                        f"namespace {ns!r} already frozen by lease "
+                        f"{cur['lease_id']!r}"
+                    )
+                if renew and cur is None:
+                    raise ValueError(
+                        f"freeze lease {lid!r} on {ns!r} was lost "
+                        f"(expired or thawed) — renewal refused"
+                    )
+                lease = self._new_lease(
+                    ns,
+                    lid or (cur or {}).get("lease_id", ""),
+                    body.get("ttl_s"),
+                )
+                self._leases[ns] = lease
                 self._topology.frozen.add(ns)
+                self._journal_locked(dict(lease, action="freeze"))
             counters.inc("storage.shard.freezes")
         elif op == "unfreeze":
             ns = body["namespace"]
+            lid = str(body.get("lease_id") or "")
             with self._mu:
-                self._topology.frozen.discard(ns)
+                cur = self._leases.get(ns)
+                if cur is None:
+                    self._topology.frozen.discard(ns)
+                elif not lid or not cur["lease_id"] \
+                        or cur["lease_id"] == lid:
+                    self._leases.pop(ns, None)
+                    self._topology.frozen.discard(ns)
+                    self._journal_locked(
+                        {
+                            "action": "thaw",
+                            "ns": ns,
+                            "lease_id": cur["lease_id"],
+                        }
+                    )
+                # else: stale coordinator's unfreeze against a newer
+                # lease — deliberately ignored
+        elif op == "budget_report":
+            gid = str(body.get("group") or "")
+            if not gid:
+                raise ValueError("budget_report requires group")
+            board = self.budget_board
+            if board is not None:
+                board.report(
+                    gid,
+                    body.get("nodes") or {},
+                    int(body.get("rv") or 0),
+                )
         else:
             raise ValueError(f"unknown shard control op {op!r}")
 
@@ -262,16 +471,19 @@ def build_handoff(store: Any, namespace: str) -> dict:
     SOURCE group's leader while the namespace is frozen, so the doc is a
     consistent cut: no write can land between the per-kind lists."""
     objects: Dict[str, list] = {}
+    names: Dict[str, list] = {}
     total = 0
     for kind in KIND_TYPES:
-        items = [
-            _encode(o)
-            for o in store.list(kind)
-            if o.metadata.namespace == namespace
+        shipped = [
+            o for o in store.list(kind) if o.metadata.namespace == namespace
         ]
-        if items:
-            objects[kind] = items
-            total += len(items)
+        if shipped:
+            objects[kind] = [_encode(o) for o in shipped]
+            # the keyed-purge manifest: the coordinator deletes exactly
+            # these names after the flip, so a write that slipped in
+            # post-thaw (lease expired mid-split) is never destroyed
+            names[kind] = sorted(o.metadata.name for o in shipped)
+            total += len(shipped)
     counters.inc("storage.shard.handoff_ships")
     counters.inc("storage.shard.handoff_objects", total)
     return {
@@ -279,6 +491,7 @@ def build_handoff(store: Any, namespace: str) -> dict:
         "namespace": namespace,
         "resource_version": store.applied_rv(),
         "objects": objects,
+        "names": names,
     }
 
 
@@ -309,16 +522,30 @@ def apply_seed(store: Any, doc: dict) -> dict:
     }
 
 
-def purge_namespace(store: Any, namespace: str) -> dict:
+def purge_namespace(
+    store: Any, namespace: str, names: Optional[Dict[str, list]] = None
+) -> dict:
     """Delete a moved namespace's objects from the SOURCE group after
     the topology flipped — the final step of a split.  The deletes fan
     out as DELETED watch events on this group; a vector-cursor watch
     suppresses them (the group no longer owns the namespace), so
-    consumers keep the target group's live copies."""
-    deleted = 0
+    consumers keep the target group's live copies.
+
+    When ``names`` (the handoff doc's per-kind manifest) is given the
+    purge is KEYED: exactly the shipped objects are deleted.  Anything
+    else in the namespace got there AFTER the handoff — a write admitted
+    when the freeze lease expired under a slow coordinator — and was
+    never copied to the target, so deleting it would be acked-write
+    loss.  Survivors are counted (``storage.shard.purge_skipped``) and
+    left for the 421 chase to surface."""
+    deleted = skipped = 0
     for kind in KIND_TYPES:
+        allow = None if names is None else set(names.get(kind, []))
         for o in store.list(kind):
             if o.metadata.namespace != namespace:
+                continue
+            if allow is not None and o.metadata.name not in allow:
+                skipped += 1
                 continue
             try:
                 store.delete(kind, namespace, o.metadata.name)
@@ -326,7 +553,9 @@ def purge_namespace(store: Any, namespace: str) -> dict:
             except KeyError:
                 pass  # raced its own retry
     counters.inc("storage.shard.purged_objects", deleted)
-    return {"namespace": namespace, "deleted": deleted}
+    if skipped:
+        counters.inc("storage.shard.purge_skipped", skipped)
+    return {"namespace": namespace, "deleted": deleted, "skipped": skipped}
 
 
 # ---------------------------------------------------------------------------
@@ -695,15 +924,38 @@ class ShardedStore:
         #: RemoteStore parity: informer jitter reads ``store.faults``
         self.faults = self._kw.get("faults")
 
+    @staticmethod
+    def _discover_endpoints(eps: List[str]) -> List[str]:
+        """Union a group's topology endpoints with the follower data
+        urls its ``/repl/status`` advertises (§29 multi-endpoint read
+        client folded into the router): reads/watches then fan across
+        that group's whole replica set even when the topology document
+        only names the leader.  A 404 means the group is unreplicated —
+        nothing to add; probe failures keep the topology list."""
+        out = [u.rstrip("/") for u in eps]
+        for url in out:
+            try:
+                status, doc = _raw_req(url, "GET", "/repl/status")
+            except Exception:  # noqa: BLE001 — dead endpoint, probe on
+                continue
+            if status != 200:
+                continue
+            for peer in doc.get("peers") or []:
+                pu = str(peer.get("url") or "").rstrip("/")
+                if pu and pu not in out:
+                    out.append(pu)
+                    counters.inc("shard.endpoint_discoveries")
+            break  # one live answer describes the whole group
+        return out
+
     def _build_stores(self, topology: ShardTopology) -> None:
         from minisched_tpu.controlplane.remote import RemoteStore
 
         fresh: Dict[str, Any] = {}
         for gid, eps in topology.groups.items():
+            eps = self._discover_endpoints(eps)
             old = self._stores.get(gid)
-            if old is not None and old._endpoints == [
-                u.rstrip("/") for u in eps
-            ]:
+            if old is not None and old._endpoints == eps:
                 fresh[gid] = old
                 continue
             fresh[gid] = RemoteStore(
@@ -1139,24 +1391,55 @@ def _control_all(topology: ShardTopology, body: dict) -> None:
         raise RuntimeError(f"shard control refused: {errors}")
 
 
+def freeze_ttl_s(default: Optional[float] = None) -> float:
+    """The freeze-lease TTL a split coordinator grants itself:
+    ``MINISCHED_FREEZE_TTL_S`` else the module default."""
+    if default is not None:
+        return float(default)
+    try:
+        return float(
+            os.environ.get(
+                "MINISCHED_FREEZE_TTL_S", str(DEFAULT_FREEZE_TTL_S)
+            )
+        )
+    except ValueError:
+        return DEFAULT_FREEZE_TTL_S
+
+
 def split_namespace(
     topology: ShardTopology,
     namespace: str,
     target_gid: str,
     timeout_s: float = 30.0,
+    ttl_s: Optional[float] = None,
+    _after_freeze: Optional[Callable[[str], None]] = None,
 ) -> dict:
     """Reassign ``namespace`` to ``target_gid`` via checkpoint-seed
-    handoff (DESIGN.md §30): freeze writes for ONLY this namespace on
-    every façade, ship its objects from the source leader as a §28-codec
-    doc, seed the target leader through the normal durable path, flip
-    the topology epoch everywhere, unfreeze, purge the source.  Returns
-    ``{namespace, from, to, epoch, objects, freeze_s}``; the freeze
-    window is the doc's round trip, not a function of shard size.
+    handoff (DESIGN.md §30/§31): freeze writes for ONLY this namespace
+    on every façade under a TTL'd lease, ship its objects from the
+    source leader as a §28-codec doc, seed the target leader through
+    the normal durable path, RENEW the lease (the proof no replica
+    thawed and admitted writes mid-handoff), flip the topology epoch
+    everywhere, unfreeze, purge the shipped objects from the source.
+    Returns ``{namespace, from, to, epoch, objects, freeze_s}``; the
+    freeze window is the doc's round trip, not a function of shard size.
 
-    On failure before the topology flip, the namespace is unfrozen and
-    ownership is UNCHANGED (a partially-seeded target holds orphaned
-    copies the next attempt's seed skips as conflicts — harmless, the
-    topology never pointed at them)."""
+    Crash safety (§31): every freeze carries ``lease_id`` +
+    ``ttl_s`` — a coordinator that dies anywhere in this function
+    strands NOTHING, because each replica auto-thaws its lease at
+    expiry independently.  If the lease expired under a slow
+    coordinator, the pre-flip renewal is refused (HTTP 400 →
+    RuntimeError here) and the split aborts with ownership unchanged;
+    the purge is keyed to the handoff manifest so a write admitted in
+    any thaw gap is never deleted.  On failure before the topology
+    flip, the namespace is unfrozen and ownership is UNCHANGED (a
+    partially-seeded target holds orphaned copies the next attempt's
+    seed skips as conflicts — harmless, the topology never pointed at
+    them).
+
+    ``_after_freeze`` is a test seam: called with the lease id right
+    after the freeze fanout (chaos harnesses SIGKILL leaders or the
+    coordinator itself inside this window)."""
     if target_gid not in topology.groups:
         raise ValueError(f"unknown target group {target_gid!r}")
     source_gid = topology.owner(namespace)
@@ -1165,10 +1448,22 @@ def split_namespace(
             "namespace": namespace, "from": source_gid, "to": target_gid,
             "epoch": topology.epoch, "objects": 0, "freeze_s": 0.0,
         }
+    lease_id = uuid.uuid4().hex
+    ttl = freeze_ttl_s(ttl_s)
     t0 = time.monotonic()
-    _control_all(topology, {"op": "freeze", "namespace": namespace})
+    _control_all(
+        topology,
+        {
+            "op": "freeze",
+            "namespace": namespace,
+            "lease_id": lease_id,
+            "ttl_s": ttl,
+        },
+    )
     flipped = False
     try:
+        if _after_freeze is not None:
+            _after_freeze(lease_id)
         src = _leader_of(topology.groups[source_gid], timeout_s)
         dst = _leader_of(topology.groups[target_gid], timeout_s)
         status, doc = _raw_req(
@@ -1182,6 +1477,20 @@ def split_namespace(
         )
         if status != 200:
             raise RuntimeError(f"seed: HTTP {status}: {seeded}")
+        # the liveness gate: renewing on EVERY replica proves no lease
+        # expired (and thus no writes were admitted on the source)
+        # between the freeze and this instant — a refusal (HTTP 400)
+        # raises out of _control_all and aborts the split pre-flip
+        _control_all(
+            topology,
+            {
+                "op": "freeze",
+                "namespace": namespace,
+                "lease_id": lease_id,
+                "ttl_s": ttl,
+                "renew": True,
+            },
+        )
         new_topo = topology.copy()
         new_topo.epoch += 1
         new_topo.overrides[namespace] = target_gid
@@ -1197,13 +1506,25 @@ def split_namespace(
         )
         flipped = True
     finally:
-        _control_all(topology, {"op": "unfreeze", "namespace": namespace})
+        _control_all(
+            topology,
+            {
+                "op": "unfreeze",
+                "namespace": namespace,
+                "lease_id": lease_id,
+            },
+        )
     freeze_s = time.monotonic() - t0
+    hist.observe("shard.freeze_s", freeze_s)
     # purge AFTER the unfreeze: ownership already flipped, so the source
-    # refuses new writes for the namespace regardless — the purge only
-    # clears the stale residents out of its snapshot
+    # refuses new writes for the namespace regardless — the purge is
+    # KEYED to the handoff manifest, clearing exactly the shipped
+    # objects out of the source's snapshot and nothing else
     status, purged = _raw_req(
-        src, "POST", "/shards/purge", {"namespace": namespace},
+        src,
+        "POST",
+        "/shards/purge",
+        {"namespace": namespace, "names": doc.get("names")},
         timeout_s=timeout_s,
     )
     if status != 200:
@@ -1223,6 +1544,482 @@ def split_namespace(
         ),
         "freeze_s": freeze_s,
     }
+
+
+# ---------------------------------------------------------------------------
+# capacity mirror (DESIGN.md §31): home budget board + remote mirrors
+# ---------------------------------------------------------------------------
+
+
+def build_budget_doc(store: Any, shard: ShardInfo) -> dict:
+    """The HOME group's per-Node budget document, served from
+    ``GET /shards/budget``: allocatable + home-side usage per Node
+    (straight off the store's incremental ``_pod_node_agg``), stamped
+    with the serving replica's applied rv, plus every non-home group's
+    last usage report (the board) so a mirror can reconstruct
+    used-elsewhere for ITS vantage by excluding its own report."""
+    nodes: Dict[str, dict] = {}
+    agg = getattr(store, "_pod_node_agg", None) or {}
+    lk = getattr(store, "locked", None)
+    ctx = lk() if callable(lk) else _null_lock()
+    with ctx:
+        agg_snap = {n: list(v) for n, v in agg.items()}
+        node_objs = list(store.list("Node"))
+        rv = store.applied_rv()
+    for node in node_objs:
+        alloc = node.status.allocatable
+        nodes[node.metadata.name] = {
+            "alloc": [alloc.milli_cpu, alloc.memory, alloc.pods],
+            "used": agg_snap.get(node.metadata.name, [0, 0, 0]),
+        }
+    board = shard.budget_board
+    return {
+        "group": shard.group_id,
+        "rv": rv,
+        "nodes": nodes,
+        "reported": board.snapshot() if board is not None else {},
+    }
+
+
+class _null_lock:
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+class BudgetBoard:
+    """HOME-group side of the capacity mirror: the last usage report
+    from every non-home group (``{gid: {"rv", "nodes": {name:
+    [cpu, mem, pods]}}}``), folded in via the ``budget_report`` control
+    op.  Reports are monotonic PER GROUP by the reporter's applied rv —
+    a delayed duplicate can never roll a newer aggregate back."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._reports: Dict[str, dict] = {}
+
+    def report(self, gid: str, nodes: Dict[str, Any], rv: int) -> None:
+        clean = {
+            str(n): [int(x) for x in (v or [0, 0, 0])[:3]]
+            for n, v in (nodes or {}).items()
+        }
+        with self._mu:
+            cur = self._reports.get(gid)
+            if cur is not None and rv < cur["rv"]:
+                return
+            self._reports[gid] = {"rv": int(rv), "nodes": clean}
+        counters.inc("shard.budget.reports")
+
+    def extra_used(self, name: str) -> Optional[List[int]]:
+        """Summed non-home usage of Node ``name`` across every group's
+        last report, or None when no group reported it — what the home
+        group's own bind path must debit ON TOP of its local agg."""
+        total = [0, 0, 0]
+        seen = False
+        with self._mu:
+            for rep in self._reports.values():
+                u = rep["nodes"].get(name)
+                if u is not None:
+                    seen = True
+                    for i in range(3):
+                        total[i] += u[i]
+        return total if seen else None
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._mu:
+            return {
+                gid: {"rv": r["rv"], "nodes": dict(r["nodes"])}
+                for gid, r in self._reports.items()
+            }
+
+
+class BudgetMirror:
+    """NON-home side of the capacity mirror: an rv-stamped read-only
+    view of the home group's budget doc.  ``update`` is monotonic on
+    the doc's rv (a stale fetch never rolls the view back); ``budget``
+    answers with (allocatable, used-elsewhere, rv) where used-elsewhere
+    excludes THIS group's own report — the local store's live
+    ``_pod_node_agg`` covers that share exactly, under the very lock
+    hold the bind commits under."""
+
+    def __init__(self, own_gid: str) -> None:
+        self._own = str(own_gid)
+        self._mu = threading.Lock()
+        self._rv = 0
+        #: name → (alloc [cpu, mem, pods], used-elsewhere [cpu, mem, pods])
+        self._budgets: Dict[str, Tuple[List[int], List[int]]] = {}
+
+    def update(self, doc: dict) -> bool:
+        rv = int(doc.get("rv") or 0)
+        reported = doc.get("reported") or {}
+        budgets: Dict[str, Tuple[List[int], List[int]]] = {}
+        for name, ent in (doc.get("nodes") or {}).items():
+            alloc = [int(x) for x in (ent.get("alloc") or [0, 0, 0])[:3]]
+            used = [int(x) for x in (ent.get("used") or [0, 0, 0])[:3]]
+            for gid, rep in reported.items():
+                if gid == self._own:
+                    continue
+                u = (rep.get("nodes") or {}).get(name)
+                if u is not None:
+                    for i in range(3):
+                        used[i] += int(u[i])
+            budgets[str(name)] = (alloc, used)
+        with self._mu:
+            if rv < self._rv:
+                return False
+            self._rv = rv
+            self._budgets = budgets
+        counters.inc("shard.budget.mirror_syncs")
+        return True
+
+    def budget(
+        self, name: str
+    ) -> Optional[Tuple[List[int], List[int], int]]:
+        with self._mu:
+            ent = self._budgets.get(name)
+            if ent is None:
+                return None
+            return list(ent[0]), list(ent[1]), self._rv
+
+    @property
+    def rv(self) -> int:
+        with self._mu:
+            return self._rv
+
+
+class _ShardBudgetView:
+    """The adapter the bind path's budget computation consults
+    (``store._shard_budget_view``, read inside ``_node_budgets`` under
+    the store lock): mirror budgets for Nodes this group's store does
+    not hold, board extra-usage for Nodes it does."""
+
+    def __init__(self, shard: ShardInfo) -> None:
+        self._shard = shard
+
+    def budget(
+        self, name: str
+    ) -> Optional[Tuple[List[int], List[int], int]]:
+        m = self._shard.budget_mirror
+        return None if m is None else m.budget(name)
+
+    def extra_used(self, name: str) -> Optional[List[int]]:
+        b = self._shard.budget_board
+        return None if b is None else b.extra_used(name)
+
+
+# ---------------------------------------------------------------------------
+# per-façade shard runtime: lease journal wiring, budget sync, autosplit
+# ---------------------------------------------------------------------------
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class AutoSplitWatcher:
+    """Per-group load watcher (DESIGN.md §31 leg 2): samples the
+    group-commit barrier's saturation — a WINDOWED p99 of
+    ``storage.group_wait_s`` (delta of the global histogram's bucket
+    counts between samples, nearest-rank over the shared ladder) plus
+    the live stage depth — and, after ``hot_samples`` consecutive hot
+    reads with a post-split cooldown, splits this group's hottest
+    namespace to the group the rendezvous hash picks among the OTHERS.
+    No operator in the loop; every decision is countered
+    (``shard.autosplit.*``) and the windowed p99 is observed as its own
+    histogram so "did the split help" is answerable off a scrape."""
+
+    def __init__(
+        self,
+        store: Any,
+        shard: ShardInfo,
+        p99_hot_s: Optional[float] = None,
+        depth_hot: Optional[int] = None,
+        hot_samples: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        split: Callable[..., dict] = None,  # type: ignore[assignment]
+    ) -> None:
+        self._store = store
+        self._shard = shard
+        self.p99_hot_s = (
+            _env_f("MINISCHED_AUTOSPLIT_P99_S", 0.05)
+            if p99_hot_s is None else float(p99_hot_s)
+        )
+        self.depth_hot = (
+            int(_env_f("MINISCHED_AUTOSPLIT_DEPTH", 64))
+            if depth_hot is None else int(depth_hot)
+        )
+        self.hot_samples = (
+            int(_env_f("MINISCHED_AUTOSPLIT_HOT", 3))
+            if hot_samples is None else int(hot_samples)
+        )
+        self.cooldown_s = (
+            _env_f("MINISCHED_AUTOSPLIT_COOLDOWN_S", 30.0)
+            if cooldown_s is None else float(cooldown_s)
+        )
+        self._split = split if split is not None else split_namespace
+        self._prev: Optional[Tuple[List[int], int]] = None
+        self._streak = 0
+        self._last_trigger: Optional[float] = None
+        self._tally: Dict[str, int] = {}
+
+    def _window_p99(self) -> Optional[float]:
+        """p99 over the observations that arrived SINCE the last sample:
+        delta of the merged bucket counts (the cumulative histogram can
+        never recover after a hot burst; the window can).  None when the
+        window is empty; +inf when the rank lands in overflow."""
+        counts, overflow, _s, _n = hist.GLOBAL.merged(
+            "storage.group_wait_s"
+        )
+        prev = self._prev
+        self._prev = (list(counts), overflow)
+        if prev is None:
+            return None
+        d = [c - p for c, p in zip(counts, prev[0])]
+        d_ovf = overflow - prev[1]
+        n = sum(d) + d_ovf
+        if n <= 0:
+            return None
+        rank = max(1, math.ceil(0.99 * n))
+        cum = 0
+        for i, c in enumerate(d):
+            cum += c
+            if cum >= rank:
+                return hist.BUCKET_BOUNDS[i]
+        return float("inf")
+
+    def _candidate(self) -> Optional[str]:
+        """The hottest namespace this group still OWNS (write tallies
+        drained from the guard), excluding "" (cluster-scoped objects
+        never move — the home group is the budget mirror's anchor) and
+        anything currently frozen."""
+        for ns, n in self._shard.drain_write_counts().items():
+            self._tally[ns] = self._tally.get(ns, 0) + n
+        topo = self._shard.topology
+        if len(topo.groups) < 2:
+            return None
+        for ns, _n in sorted(self._tally.items(), key=lambda kv: -kv[1]):
+            if not ns or ns in topo.frozen:
+                continue
+            if topo.owner(ns) != self._shard.group_id:
+                continue
+            return ns
+        return None
+
+    def sample(self) -> dict:
+        """One watcher tick; returns the decision record (tests drive
+        this synchronously, the runtime thread calls it on a timer)."""
+        counters.inc("shard.autosplit.samples")
+        p99 = self._window_p99()
+        depth = len(getattr(self._store, "_gc_stage", ()) or ())
+        if p99 is not None:
+            hist.observe(
+                "shard.autosplit.window_p99_s", min(p99, 3600.0)
+            )
+        hot = bool(
+            (p99 is not None and p99 >= self.p99_hot_s)
+            or depth >= self.depth_hot
+        )
+        out = {
+            "p99_s": p99, "depth": depth, "hot": hot,
+            "streak": self._streak, "split": None,
+        }
+        if not hot:
+            self._streak = 0
+            return out
+        counters.inc("shard.autosplit.hot")
+        self._streak += 1
+        out["streak"] = self._streak
+        if self._streak < self.hot_samples:
+            return out
+        now = time.monotonic()
+        if (
+            self._last_trigger is not None
+            and now - self._last_trigger < self.cooldown_s
+        ):
+            counters.inc("shard.autosplit.skipped")
+            return out
+        if getattr(self._store, "_fenced", False):
+            counters.inc("shard.autosplit.skipped")
+            return out
+        ns = self._candidate()
+        if ns is None:
+            counters.inc("shard.autosplit.skipped")
+            return out
+        topo = self._shard.topology.copy()
+        target = shard_owner(
+            ns, sorted(set(topo.groups) - {self._shard.group_id})
+        )
+        try:
+            result = self._split(topo, ns, target)
+        except Exception as e:  # noqa: BLE001 — next tick retries
+            counters.inc("shard.autosplit.errors")
+            out["split"] = {"namespace": ns, "error": str(e)}
+            return out
+        counters.inc("shard.autosplit.triggered")
+        self._last_trigger = now
+        self._streak = 0
+        self._tally.pop(ns, None)
+        out["split"] = result
+        return out
+
+
+def autosplit_enabled() -> bool:
+    return os.environ.get("MINISCHED_AUTOSPLIT", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+class ShardRuntime:
+    """Everything a sharded façade runs BESIDES serving requests
+    (DESIGN.md §31), owned per process and wired by
+    :func:`attach_shard_runtime`:
+
+    * freeze-lease durability — ``shard.journal`` points at the store's
+      ``record_shard_lease`` (leader-only inside) and leases recovered
+      from the WAL re-arm the guard at boot;
+    * the capacity mirror — home group grows a :class:`BudgetBoard`,
+      every other group a :class:`BudgetMirror` plus a sync loop that
+      fetches ``/shards/budget`` from the home group and reports its
+      own per-Node usage back (``budget_report`` control op); both
+      sides expose :class:`_ShardBudgetView` on the store for the bind
+      path;
+    * autosplit — an optional :class:`AutoSplitWatcher` ticking on its
+      own timer (``MINISCHED_AUTOSPLIT=1``)."""
+
+    def __init__(
+        self,
+        store: Any,
+        shard: ShardInfo,
+        autosplit: Optional[AutoSplitWatcher] = None,
+        sync_interval_s: Optional[float] = None,
+        autosplit_interval_s: Optional[float] = None,
+    ) -> None:
+        self.store = store
+        self.shard = shard
+        self.autosplit = autosplit
+        self.sync_interval_s = (
+            _env_f("MINISCHED_BUDGET_SYNC_S", 0.25)
+            if sync_interval_s is None else float(sync_interval_s)
+        )
+        self.autosplit_interval_s = (
+            _env_f("MINISCHED_AUTOSPLIT_INTERVAL_S", 1.0)
+            if autosplit_interval_s is None
+            else float(autosplit_interval_s)
+        )
+        self.is_home = shard.topology.owner("") == shard.group_id
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        journal = getattr(store, "record_shard_lease", None)
+        if callable(journal):
+            shard.journal = journal
+        recovered = getattr(store, "recovered_shard_leases", None)
+        if callable(recovered):
+            shard.adopt_leases(recovered())
+        if self.is_home:
+            shard.budget_board = BudgetBoard()
+        else:
+            shard.budget_mirror = BudgetMirror(shard.group_id)
+        store._shard_budget_view = _ShardBudgetView(shard)
+
+    def _home_urls(self) -> List[str]:
+        topo = self.shard.topology
+        return list(topo.groups.get(topo.owner(""), []))
+
+    def sync_once(self) -> bool:
+        """One budget round trip (non-home only): refresh the mirror
+        from any home replica that answers, then report this group's
+        own per-Node usage to EVERY home replica (each board copy folds
+        it — whichever serves the next budget doc has it).  Only a
+        non-fenced replica reports: a fenced store's agg is a stale
+        ghost of the partition it lost."""
+        if self.is_home:
+            return False
+        mirror = self.shard.budget_mirror
+        updated = False
+        for url in self._home_urls():
+            try:
+                status, doc = _raw_req(url, "GET", "/shards/budget")
+            except Exception:  # noqa: BLE001 — probe the next replica
+                continue
+            if status == 200 and isinstance(doc, dict) and doc.get("nodes") \
+                    is not None:
+                if mirror is not None:
+                    updated = mirror.update(doc)
+                break
+        if not getattr(self.store, "_fenced", False):
+            agg = getattr(self.store, "_pod_node_agg", None) or {}
+            lk = getattr(self.store, "locked", None)
+            ctx = lk() if callable(lk) else _null_lock()
+            with ctx:
+                nodes = {n: list(v) for n, v in agg.items()}
+                rv = self.store.applied_rv()
+            body = {
+                "op": "budget_report",
+                "group": self.shard.group_id,
+                "rv": rv,
+                "nodes": nodes,
+            }
+            for url in self._home_urls():
+                try:
+                    _raw_req(url, "POST", "/shards/control", body)
+                except Exception:  # noqa: BLE001 — next round resends
+                    pass
+        return updated
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_interval_s):
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 — loop must not die
+                pass
+
+    def _autosplit_loop(self) -> None:
+        while not self._stop.wait(self.autosplit_interval_s):
+            try:
+                self.autosplit.sample()
+            except Exception:  # noqa: BLE001 — loop must not die
+                pass
+
+    def start(self) -> "ShardRuntime":
+        if not self.is_home:
+            t = threading.Thread(
+                target=self._sync_loop,
+                name="shard-budget-sync",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        if self.autosplit is not None:
+            t = threading.Thread(
+                target=self._autosplit_loop,
+                name="shard-autosplit",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def attach_shard_runtime(
+    store: Any, shard: Optional[ShardInfo]
+) -> Optional[ShardRuntime]:
+    """Wire a façade's shard runtime onto its store (called from
+    ``start_api_server`` for sharded servers; None passthrough keeps
+    the unsharded plane byte-identical)."""
+    if shard is None:
+        return None
+    watcher = AutoSplitWatcher(store, shard) if autosplit_enabled() else None
+    return ShardRuntime(store, shard, autosplit=watcher).start()
 
 
 # ---------------------------------------------------------------------------

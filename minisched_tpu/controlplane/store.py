@@ -113,6 +113,17 @@ class ShardFrozen(Exception):
     never frozen."""
 
 
+class ShardFrozenTimeout(ShardFrozen):
+    """A frozen-namespace retry loop exhausted its DEADLINE
+    (``RemoteStore(frozen_deadline_s=)``) while the namespace stayed
+    frozen: either the split is pathologically slow or its coordinator
+    died and the freeze lease has not expired yet.  Subclasses
+    ShardFrozen on purpose — handlers that treat "frozen" as transient
+    keep working — but it is TERMINAL for this call: the client has
+    already waited longer than any healthy split's freeze window plus
+    the lease TTL bound, so surfacing beats hammering."""
+
+
 @dataclass
 class WatchEvent:
     type: EventType

@@ -772,6 +772,16 @@ class Scheduler:
                 self.on_decision(pod, node_name, Status.success())
         except Exception as err:
             self.run_unreserve_plugins(state, pod, node_name)
+            from minisched_tpu.controlplane.client import OutOfCapacity
+
+            if isinstance(err, OutOfCapacity) and "budget-mirror" in str(err):
+                # refused by a non-home shard's capacity MIRROR
+                # (DESIGN.md §31): the cross-shard budget view said no —
+                # counted apart from local OutOfCapacity races because a
+                # stale mirror rv is a sync-lag signal, not contention
+                from minisched_tpu.observability import counters
+
+                counters.inc("sched.bind_mirror_refusals")
             if self._is_bind_race(err) and self._bind_race_refresh(qpi):
                 # bound elsewhere or gone: no longer schedulable work —
                 # requeueing would retry (and re-conflict) forever.  A

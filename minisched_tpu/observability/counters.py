@@ -452,6 +452,56 @@ the router side under ``shard.`` and the façade/store side under
         — remote-client requests that absorbed a 503 "shard frozen"
           answer and retried with backoff (rides the split's bounded
           write-freeze instead of failing the caller)
+
+The self-defending shard plane (ISSUE 20, DESIGN.md §31: freeze
+leases, the cross-shard capacity mirror, autosplit) adds:
+
+    remote.shard_frozen_timeout
+        — frozen-shard waits that exhausted their OWN deadline
+          (``RemoteStore(frozen_deadline_s=)``) and surfaced the typed
+          ShardFrozenTimeout instead of hammering on: the freeze
+          outlived every healthy split's window plus the lease TTL
+    storage.shard.freeze_expired
+        — freeze leases a replica auto-thawed at TTL expiry (the
+          coordinator died or stalled mid-split; the namespace
+          un-strands itself with no operator in the loop)
+    storage.shard.purge_skipped
+        — source-side objects a keyed post-split purge left in place
+          because they were NOT in the handoff manifest: writes
+          admitted after a lease-expiry thaw — deleting them would be
+          acked-write loss
+    shard.endpoint_discoveries
+        — follower data urls the router's per-group endpoint discovery
+          learned from /repl/status beyond the topology document (the
+          §29 multi-endpoint read client folded into the shard router)
+    shard.budget.mirror_syncs / shard.budget.reports
+        — budget-doc refreshes a non-home group's mirror adopted
+          (rv-monotonic; stale fetches dropped) / per-group usage
+          reports the home group's board folded in (rv-monotonic per
+          reporting group)
+    shard.budget.mirror_checks / shard.budget.unknown_node /
+    shard.budget.refused
+        — bind budget lookups answered from the cross-shard mirror
+          (Node absent from the local store), lookups the mirror could
+          not answer (Node unknown — no check, matching the
+          reference's unvalidated bind), and binds REFUSED on the
+          mirror's verdict (the OutOfCapacity carries its
+          ``budget-mirror rv=`` watermark)
+    sched.bind_mirror_refusals
+        — engine bind failures whose OutOfCapacity carried the
+          budget-mirror watermark: cross-shard capacity said no —
+          sync-lag signal, counted apart from local capacity races
+    shard.autosplit.samples / shard.autosplit.hot
+        — load-watcher ticks, and ticks whose windowed
+          storage.group_wait_s p99 or live group-commit stage depth
+          crossed the hot thresholds (hysteresis: ``hot_samples``
+          consecutive hot ticks arm a split)
+    shard.autosplit.triggered / shard.autosplit.skipped /
+    shard.autosplit.errors
+        — autosplits fired (hottest owned namespace to the rendezvous
+          pick among the other groups), armed triggers skipped
+          (cooldown window, fenced store, or no eligible namespace),
+          and split attempts that raised (next tick retries)
 """
 
 from __future__ import annotations

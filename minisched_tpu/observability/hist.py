@@ -86,6 +86,17 @@ same contract as counters.py):
           each side's group-commit barrier + registry insert) — the
           cross-shard tax the bench ``shard`` role reports separately
           from single-group binds
+    shard.freeze_s
+        — a split's whole write-freeze window, coordinator-side: the
+          freeze fanout through handoff, seed, lease renewal, topology
+          flip and unfreeze (DESIGN.md §31) — what the lease TTL must
+          comfortably exceed for healthy splits
+    shard.autosplit.window_p99_s
+        — the autosplit watcher's WINDOWED storage.group_wait_s p99
+          (bucket-count delta between consecutive ticks, nearest-rank
+          over the shared ladder): the saturation signal the hot
+          threshold is judged against, recoverable after a split where
+          the cumulative histogram is not
 
 **Exemplars**: ``observe(..., exemplar="default/pod-1")`` stamps the
 bucket the sample lands in with that string (last writer wins, one per

@@ -511,3 +511,249 @@ def test_frozen_namespace_refuses_writes_transiently(two_groups):
         ss.create("Pod", make_pod("frozen-write", namespace=ns))
     finally:
         ss.close()
+
+
+# ---------------------------------------------------------------------------
+# freeze leases (DESIGN.md §31): TTL auto-thaw, journal recovery, keyed
+# purge, bounded frozen retry, follower endpoint discovery
+# ---------------------------------------------------------------------------
+
+
+def test_freeze_lease_auto_thaws_at_ttl():
+    """A freeze is a LEASE, never a bare flag: a coordinator that dies
+    holding one strands nothing — check_write reaps the expired lease
+    and the namespace accepts writes again, no unfreeze ever sent."""
+    from minisched_tpu.controlplane.store import ShardFrozen
+    from minisched_tpu.observability import counters
+
+    info = ShardInfo("g0", ShardTopology({"g0": ["http://x"]}))
+    info.apply_control({
+        "op": "freeze", "namespace": "default",
+        "lease_id": "L1", "ttl_s": 0.15,
+    })
+    with pytest.raises(ShardFrozen) as err:
+        info.check_write("default")
+    # the refusal names the lease and its remaining window
+    assert "L1" in str(err.value) and "thaws in" in str(err.value)
+    expired0 = counters.get("storage.shard.freeze_expired")
+    time.sleep(0.2)
+    info.check_write("default")  # auto-thawed: no raise
+    assert counters.get("storage.shard.freeze_expired") > expired0
+    assert info.describe()["leases"] == {}
+    assert not info.topology.frozen
+
+
+def test_freeze_lease_excludes_second_coordinator():
+    """A LIVE foreign lease refuses a second coordinator's freeze (two
+    coordinators must never split the same namespace concurrently), a
+    stale coordinator's unfreeze is a no-op against a newer lease, and
+    only the holder's unfreeze thaws."""
+    info = ShardInfo("g0", ShardTopology({"g0": ["http://x"]}))
+    info.apply_control({
+        "op": "freeze", "namespace": "default",
+        "lease_id": "A", "ttl_s": 30.0,
+    })
+    with pytest.raises(ValueError):
+        info.apply_control({
+            "op": "freeze", "namespace": "default",
+            "lease_id": "B", "ttl_s": 30.0,
+        })
+    # renewal by the holder extends; the stale coordinator's unfreeze
+    # must not thaw the newer lease
+    info.apply_control({
+        "op": "freeze", "namespace": "default",
+        "lease_id": "A", "ttl_s": 30.0, "renew": True,
+    })
+    info.apply_control({
+        "op": "unfreeze", "namespace": "default", "lease_id": "B",
+    })
+    assert "default" in info.topology.frozen
+    info.apply_control({
+        "op": "unfreeze", "namespace": "default", "lease_id": "A",
+    })
+    assert not info.topology.frozen
+
+
+def test_expired_lease_refuses_renewal_and_split_aborts(two_groups):
+    """A coordinator slower than its own lease: the TTL expires inside
+    the freeze window, every replica auto-thaws (and may admit writes),
+    so the pre-flip renewal is refused and the split ABORTS with
+    ownership unchanged — the write admitted in the thaw gap survives
+    because the flip never happened and the purge never ran."""
+    topo = two_groups.topology
+    ns = next(n or "default" for n in NAMESPACES
+              if topo.owner(n or "default") == "g0")
+    ss = ShardedStore(topology=topo.copy(), retries=2)
+    try:
+        ss.create("Pod", make_pod("pre-split", namespace=ns))
+
+        def slow_coordinator(lease_id: str) -> None:
+            time.sleep(0.7)  # outsleep the 0.3s lease
+            # the thaw gap: a write lands while the coordinator naps
+            ss.create("Pod", make_pod("gap-write", namespace=ns))
+
+        driver = topo.copy()
+        with pytest.raises(RuntimeError) as err:
+            split_namespace(
+                driver, ns, "g1", ttl_s=0.3,
+                _after_freeze=slow_coordinator,
+            )
+        assert "renewal refused" in str(err.value)
+        # ownership unchanged, nothing frozen, both writes alive on g0
+        assert driver.owner(ns) == "g0"
+        for info in two_groups.infos.values():
+            assert not info.topology.frozen
+            assert info.describe()["leases"] == {}
+        names = {p.metadata.name
+                 for p in two_groups.stores["g0"].list("Pod")}
+        assert {"pre-split", "gap-write"} <= names
+    finally:
+        ss.close()
+
+
+def test_freeze_lease_journal_recovers_across_restart(tmp_path):
+    """Lease transitions are WAL-journaled: a replica restarting inside
+    a freeze window re-arms the lease from recovery and keeps refusing
+    until the TTL — while thawed and already-expired leases stay gone."""
+    from minisched_tpu.controlplane.store import ShardFrozen
+
+    wal = str(tmp_path / "lease.wal")
+    store = DurableObjectStore(wal, fsync=False)
+    now = time.time()
+    store.record_shard_lease({
+        "action": "freeze", "ns": "held",
+        "lease_id": "L-live", "ttl_s": 60.0, "expires_at": now + 60.0,
+    })
+    store.record_shard_lease({
+        "action": "freeze", "ns": "thawed",
+        "lease_id": "L-gone", "ttl_s": 60.0, "expires_at": now + 60.0,
+    })
+    store.record_shard_lease({
+        "action": "thaw", "ns": "thawed", "lease_id": "L-gone",
+    })
+    store.record_shard_lease({
+        "action": "freeze", "ns": "stale",
+        "lease_id": "L-old", "ttl_s": 0.01, "expires_at": now - 5.0,
+    })
+    store.close()
+
+    reopened = DurableObjectStore(wal, fsync=False)
+    try:
+        recovered = reopened.recovered_shard_leases()
+        assert set(recovered) == {"held", "stale"}
+        info = ShardInfo("g0", ShardTopology({"g0": ["http://x"]}))
+        info.adopt_leases(recovered)
+        # live lease re-armed, expired one dropped at adoption
+        with pytest.raises(ShardFrozen):
+            info.check_write("held")
+        info.check_write("stale")
+        info.check_write("thawed")
+        assert info.topology.frozen == {"held"}
+    finally:
+        reopened.close()
+
+
+def test_purge_is_keyed_to_handoff_manifest():
+    """The purge deletes exactly the objects the handoff doc shipped:
+    a write admitted AFTER the manifest was cut (a thaw-gap write the
+    target never received) survives — deleting it would be acked-write
+    loss."""
+    from minisched_tpu.controlplane.shards import (
+        build_handoff,
+        purge_namespace,
+    )
+    from minisched_tpu.observability import counters
+
+    store = ObjectStore()
+    store.create("Pod", make_pod("shipped-a", namespace="mv"))
+    store.create("Pod", make_pod("shipped-b", namespace="mv"))
+    store.create("Pod", make_pod("bystander", namespace="other"))
+    doc = build_handoff(store, "mv")
+    assert doc["names"] == {"Pod": ["shipped-a", "shipped-b"]}
+    # the thaw-gap write: lands after the manifest, before the purge
+    store.create("Pod", make_pod("late-write", namespace="mv"))
+    skipped0 = counters.get("storage.shard.purge_skipped")
+    out = purge_namespace(store, "mv", names=doc["names"])
+    assert out == {"namespace": "mv", "deleted": 2, "skipped": 1}
+    assert counters.get("storage.shard.purge_skipped") == skipped0 + 1
+    names = {p.metadata.name for p in store.list("Pod")}
+    assert names == {"late-write", "bystander"}
+
+
+def test_frozen_retry_is_bounded_by_typed_deadline(two_groups):
+    """Satellite: the client's frozen-shard retry is BOUNDED — a freeze
+    that outlives ``frozen_deadline_s`` surfaces as ShardFrozenTimeout
+    (a typed ShardFrozen subclass) instead of spinning forever against
+    a dead coordinator's lease."""
+    from minisched_tpu.controlplane.store import (
+        ShardFrozen,
+        ShardFrozenTimeout,
+    )
+    from minisched_tpu.observability import counters
+
+    topo = two_groups.topology
+    ns = next(n or "default" for n in NAMESPACES
+              if topo.owner(n or "default") == "g0")
+    two_groups.infos["g0"].apply_control({
+        "op": "freeze", "namespace": ns,
+        "lease_id": "hung", "ttl_s": 60.0,
+    })
+    try:
+        rs = RemoteStore(
+            topo.groups["g0"][0], retries=4,
+            backoff_initial_s=0.05, frozen_deadline_s=0.5,
+        )
+        timeouts0 = counters.get("remote.shard_frozen_timeout")
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(ShardFrozenTimeout) as err:
+                rs.create("Pod", make_pod("stuck", namespace=ns))
+        finally:
+            rs.close()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"deadline did not bound the spin: {elapsed}"
+        assert "deadline" in str(err.value)
+        assert isinstance(err.value, ShardFrozen)  # old handlers still catch
+        assert counters.get("remote.shard_frozen_timeout") > timeouts0
+    finally:
+        two_groups.infos["g0"].apply_control({
+            "op": "unfreeze", "namespace": ns, "lease_id": "hung",
+        })
+
+
+def test_router_discovers_follower_endpoints(monkeypatch):
+    """Satellite: the router unions each group's topology endpoints with
+    the follower data urls ``/repl/status`` advertises — the §29
+    multi-endpoint read client folded into the shard router, so reads
+    and watches fan across the whole replica set even when the topology
+    doc only names the leader."""
+    from minisched_tpu.controlplane import shards as shards_mod
+
+    def fake_raw(base, method, path, payload=None, timeout_s=10.0):
+        assert path == "/repl/status"
+        if base == "http://lonely":
+            return 404, "unreplicated"
+        return 200, {
+            "role": "leader",
+            "peers": [
+                {"replica": "r0", "url": base},
+                {"replica": "r1", "url": "http://f1"},
+                {"replica": "r2", "url": "http://f2"},
+            ],
+        }
+
+    monkeypatch.setattr(shards_mod, "_raw_req", fake_raw)
+    eps = ShardedStore._discover_endpoints(["http://leader"])
+    assert eps == ["http://leader", "http://f1", "http://f2"]
+    # an unreplicated group (404) keeps exactly its topology list
+    assert ShardedStore._discover_endpoints(["http://lonely"]) == [
+        "http://lonely"
+    ]
+
+    def dead_raw(base, method, path, payload=None, timeout_s=10.0):
+        raise ConnectionError("down")
+
+    monkeypatch.setattr(shards_mod, "_raw_req", dead_raw)
+    assert ShardedStore._discover_endpoints(["http://dead"]) == [
+        "http://dead"
+    ]
